@@ -1,0 +1,45 @@
+"""auron-tpu: a TPU-native query-acceleration framework.
+
+A brand-new framework with the capabilities of Apache Auron (incubating):
+it accepts fully-optimized physical plans from host big-data engines
+(Spark / Flink) as a protobuf plan IR, and executes the convertible
+subtrees outside the JVM as vectorized *columnar programs on TPU* via
+JAX / XLA / Pallas — where Auron lowers onto a Rust/DataFusion/Arrow CPU
+engine (see /root/reference, e.g. native-engine/auron/src/rt.rs:76).
+
+Architecture (top to bottom):
+
+- ``proto/``    protobuf plan IR (PhysicalPlanNode / PhysicalExprNode /
+                TaskDefinition), the engine-neutral contract with host
+                front-ends (analog of native-engine/auron-planner/proto/auron.proto).
+- ``plan/``     planner: proto -> executable operator tree
+                (analog of auron-planner/src/planner.rs:122).
+- ``exec/``     operators: project/filter/agg/sort/joins/shuffle/window/
+                generate/scan/sink... (analog of datafusion-ext-plans).
+- ``exprs/``    expression evaluator with Spark-exact null semantics
+                (analog of datafusion-ext-exprs).
+- ``functions/``scalar function registry with Spark semantics
+                (analog of datafusion-ext-functions).
+- ``columnar/`` fixed-shape columnar device batches: padded value arrays +
+                validity masks + selection mask, dictionary-encoded strings;
+                Arrow <-> device interop (XLA demands static shapes, so
+                Arrow RecordBatch maps to capacity-bucketed dense buffers).
+- ``ops/``      device kernels: bit-exact spark hashes, sort-key packing,
+                segmented reductions, Pallas kernels for hot paths.
+- ``memory/``   HBM budget manager + device->host->disk spill tiers
+                (analog of native-engine/auron-memmgr).
+- ``parallel/`` device-mesh runtime: ICI AllToAll repartitioning,
+                broadcast replication, multi-host (DCN) design.
+- ``runtime/``  per-task execution runtime: batch pump, error relay,
+                resource map, conf bridge (analog of
+                native-engine/auron/src/{rt,exec}.rs and auron-jni-bridge).
+- ``bridge/``   host-engine integration protocol (JNI-analog C ABI).
+- ``models/``   canned query pipelines (TPC-DS-class) used as flagship
+                benchmarks and integration fixtures.
+"""
+
+from auron_tpu.jaxenv import setup_jax  # noqa: F401
+
+__version__ = "0.1.0"
+
+setup_jax()

@@ -1,0 +1,1 @@
+from auron_tpu.columnar.batch import Batch, DeviceBatch, bucket_capacity  # noqa: F401

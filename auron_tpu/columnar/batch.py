@@ -1,0 +1,306 @@
+"""Fixed-shape columnar device batches.
+
+The reference engine streams Arrow ``RecordBatch``es between operators
+(variable-length, pointer-rich — e.g. rt.rs:150-207 pumps them through an
+mpsc channel). XLA demands static shapes, so the TPU-native equivalent is a
+**capacity-bucketed dense batch**:
+
+- every column is a dense value array of length ``capacity`` (padded), plus
+  a boolean validity array (SQL NULLs);
+- the batch carries a boolean **selection mask** ``sel``: row *i* exists iff
+  ``sel[i]``. Filters do not compact — they refine ``sel`` (compaction is a
+  gather that only happens at blocking boundaries where it pays for itself);
+- ``capacity`` is drawn from power-of-two buckets so the number of distinct
+  compiled XLA programs stays bounded;
+- STRING/BINARY columns are dictionary-encoded: the device sees int32 codes,
+  the dictionary (a pyarrow array) rides on the host-side ``Batch`` wrapper
+  and never enters jitted code (keeps pytrees array-only, so jit caching
+  works on shapes alone).
+
+``DeviceBatch`` is the pytree that jitted kernels consume; ``Batch`` is the
+host-side handle (schema + dictionaries + the DeviceBatch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Iterable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from auron_tpu import types as T
+
+MIN_CAPACITY = 128
+
+
+def bucket_capacity(n: int) -> int:
+    """Static-shape bucket for a batch holding n rows: next power of two."""
+    c = MIN_CAPACITY
+    while c < n:
+        c <<= 1
+    return c
+
+
+class DeviceBatch(NamedTuple):
+    """The array-only pytree consumed by jitted kernels."""
+
+    sel: jnp.ndarray  # bool[capacity]; row exists iff sel[i]
+    values: tuple[jnp.ndarray, ...]  # one dense array per column
+    validity: tuple[jnp.ndarray, ...]  # bool[capacity] per column
+
+    @property
+    def capacity(self) -> int:
+        return int(self.sel.shape[0])
+
+    def num_rows(self) -> jnp.ndarray:
+        """Dynamic count of live rows (device scalar)."""
+        return jnp.sum(self.sel)
+
+
+@dataclass
+class Batch:
+    """Host-side handle: schema + dictionaries + device arrays."""
+
+    schema: T.Schema
+    device: DeviceBatch
+    dicts: tuple[pa.Array | None, ...]  # per column; non-None iff dict-encoded
+
+    # ---- construction ----
+
+    @staticmethod
+    def from_arrow(rb: pa.RecordBatch, capacity: int | None = None) -> "Batch":
+        schema = T.Schema.from_arrow(rb.schema)
+        n = rb.num_rows
+        cap = capacity or bucket_capacity(n)
+        assert cap >= n, (cap, n)
+        values, validity, dicts = [], [], []
+        for i, f in enumerate(schema):
+            arr = rb.column(i)
+            v, m, d = _arrow_to_device(arr, f.dtype, cap)
+            values.append(v)
+            validity.append(m)
+            dicts.append(d)
+        sel = np.zeros(cap, dtype=bool)
+        sel[:n] = True
+        dev = DeviceBatch(jnp.asarray(sel), tuple(values), tuple(validity))
+        return Batch(schema, dev, tuple(dicts))
+
+    @staticmethod
+    def from_pydict(data: dict, schema: T.Schema | None = None, capacity: int | None = None) -> "Batch":
+        if schema is not None:
+            rb = pa.record_batch(
+                [pa.array(data[f.name], type=f.dtype.to_arrow()) for f in schema],
+                names=[f.name for f in schema],
+            )
+        else:
+            rb = pa.RecordBatch.from_pydict(data)
+        return Batch.from_arrow(rb, capacity)
+
+    @staticmethod
+    def empty(schema: T.Schema, capacity: int = MIN_CAPACITY) -> "Batch":
+        values = tuple(
+            jnp.zeros(capacity, dtype=f.dtype.physical_dtype()) for f in schema
+        )
+        validity = tuple(jnp.zeros(capacity, dtype=bool) for _ in schema)
+        sel = jnp.zeros(capacity, dtype=bool)
+        dicts = tuple(
+            (_empty_dict(f.dtype) if f.dtype.is_dict_encoded else None)
+            for f in schema
+        )
+        return Batch(schema, DeviceBatch(sel, values, validity), dicts)
+
+    # ---- accessors ----
+
+    @property
+    def capacity(self) -> int:
+        return self.device.capacity
+
+    def num_rows(self) -> int:
+        """Live row count — host sync."""
+        return int(jax.device_get(self.device.num_rows()))
+
+    def col_values(self, i: int) -> jnp.ndarray:
+        return self.device.values[i]
+
+    def col_validity(self, i: int) -> jnp.ndarray:
+        return self.device.validity[i]
+
+    def with_device(self, dev: DeviceBatch, schema: T.Schema | None = None,
+                    dicts: tuple | None = None) -> "Batch":
+        return Batch(schema or self.schema, dev,
+                     dicts if dicts is not None else self.dicts)
+
+    # ---- materialization ----
+
+    def to_arrow(self, compact: bool = True) -> pa.RecordBatch:
+        """Pull to host as an Arrow RecordBatch (live rows only)."""
+        dev = jax.device_get(self.device)  # one transfer for the whole pytree
+        sel = np.asarray(dev.sel)
+        idx = np.nonzero(sel)[0] if compact else np.arange(self.capacity)
+        arrays = []
+        for i, f in enumerate(self.schema):
+            vals = np.asarray(dev.values[i])[idx]
+            mask = np.asarray(dev.validity[i])[idx]
+            arrays.append(_device_to_arrow(vals, mask, f.dtype, self.dicts[i]))
+        return pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
+
+    def to_pydict(self) -> dict:
+        return self.to_arrow().to_pydict()
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+
+# ---------------------------------------------------------------------------
+# Arrow <-> device conversion
+# ---------------------------------------------------------------------------
+
+
+def _empty_dict(dtype: T.DataType) -> pa.Array:
+    """One-entry sentinel dictionary (code 0 must always be decodable)."""
+    if dtype.kind == T.TypeKind.BINARY:
+        return pa.array([b""], type=pa.binary())
+    return pa.array([""], type=pa.string())
+
+
+def _arrow_to_device(arr: pa.Array, dtype: T.DataType, cap: int):
+    """Returns (values jnp[cap], validity jnp[cap] bool, dict or None)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    mask_np = np.zeros(cap, dtype=bool)
+    if n:
+        valid = pc.is_valid(arr).to_numpy(zero_copy_only=False)
+        mask_np[:n] = valid
+    phys = np.dtype(dtype.physical_dtype().name)
+    vals_np = np.zeros(cap, dtype=phys)
+    d: pa.Array | None = None
+
+    if dtype.is_dict_encoded:
+        if pa.types.is_dictionary(arr.type):
+            denc = arr
+        else:
+            denc = pc.dictionary_encode(arr.fill_null("" if dtype.kind == T.TypeKind.STRING else b""))
+        codes = denc.indices.fill_null(0).to_numpy(zero_copy_only=False).astype(np.int32)
+        vals_np[:n] = codes
+        d = denc.dictionary
+        if pa.types.is_large_string(d.type):
+            d = d.cast(pa.string())
+        elif pa.types.is_large_binary(d.type):
+            d = d.cast(pa.binary())
+        if len(d) == 0:
+            d = _empty_dict(dtype)
+    elif dtype.kind == T.TypeKind.DECIMAL:
+        # scaled int64 ("unscaled value"): decimal128 -> int64. Values whose
+        # unscaled magnitude exceeds int64 (possible for p>18) become NULL —
+        # matching Spark's non-ANSI overflow-to-null behavior rather than
+        # crashing ingestion (documented decimal64 limitation, types.py).
+        unscaled = arr.cast(pa.decimal128(38, dtype.scale))
+        if n:
+            ints = np.zeros(n, dtype=np.int64)
+            for j, x in enumerate(unscaled):
+                if not x.is_valid:
+                    continue
+                u = int(x.as_py().scaleb(dtype.scale))
+                if -(2**63) <= u < 2**63:
+                    ints[j] = u
+                else:
+                    mask_np[j] = False
+            vals_np[:n] = ints
+    elif dtype.kind == T.TypeKind.TIMESTAMP:
+        a = arr.cast(pa.timestamp("us")).fill_null(0)
+        vals_np[:n] = a.to_numpy(zero_copy_only=False).astype("datetime64[us]").astype(np.int64)
+    elif dtype.kind == T.TypeKind.DATE32:
+        a = arr.cast(pa.int32()).fill_null(0)
+        vals_np[:n] = a.to_numpy(zero_copy_only=False)
+    elif dtype.kind == T.TypeKind.NULL:
+        pass
+    else:
+        a = arr.cast(dtype.to_arrow()).fill_null(T.numpy_zero(dtype))
+        vals_np[:n] = a.to_numpy(zero_copy_only=False)
+    return jnp.asarray(vals_np), jnp.asarray(mask_np), d
+
+
+def _decimal_from_unscaled(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType) -> pa.Array:
+    pydecs = []
+    import decimal as pydec
+
+    q = pydec.Decimal(1).scaleb(-dtype.scale)
+    for v, m in zip(vals.tolist(), mask.tolist()):
+        pydecs.append(pydec.Decimal(v).scaleb(-dtype.scale).quantize(q) if m else None)
+    return pa.array(pydecs, type=pa.decimal128(dtype.precision, dtype.scale))
+
+
+def _device_to_arrow(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType,
+                     d: pa.Array | None) -> pa.Array:
+    k = dtype.kind
+    if dtype.is_dict_encoded:
+        assert d is not None
+        codes = np.where(mask, vals, 0).astype(np.int32)
+        taken = d.take(pa.array(codes, type=pa.int32()))
+        return pc.if_else(pa.array(mask), taken, pa.scalar(None, type=taken.type)).cast(
+            dtype.to_arrow()
+        )
+    if k == T.TypeKind.DECIMAL:
+        return _decimal_from_unscaled(vals, mask, dtype)
+    if k == T.TypeKind.TIMESTAMP:
+        return pa.array(vals.astype("datetime64[us]"), mask=~mask)
+    if k == T.TypeKind.DATE32:
+        return pa.array(vals.astype(np.int32), mask=~mask).cast(pa.date32())
+    if k == T.TypeKind.NULL:
+        return pa.nulls(len(vals))
+    if k == T.TypeKind.BOOL:
+        return pa.array(vals.astype(bool), mask=~mask)
+    return pa.array(vals, mask=~mask).cast(dtype.to_arrow())
+
+
+# ---------------------------------------------------------------------------
+# Batch-level utilities
+# ---------------------------------------------------------------------------
+
+
+def concat_batches(batches: Sequence[Batch]) -> Batch:
+    """Concatenate live rows of several batches into one (host-side gather).
+
+    Used at blocking boundaries (sort/agg/join build). Dictionary columns are
+    unified. Analog of the reference's coalesce/staging steps
+    (common/execution_context.rs:146).
+    """
+    assert batches
+    schema = batches[0].schema
+    tables = [b.to_arrow() for b in batches]
+    tbl = pa.Table.from_batches(tables, schema=schema.to_arrow())
+    combined = tbl.combine_chunks()
+    if combined.num_rows == 0:
+        return Batch.empty(schema)
+    rb = combined.to_batches()[0]
+    return Batch.from_arrow(rb)
+
+
+def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.ndarray]]:
+    """Build a unified dictionary for column `col` across batches.
+
+    Returns (unified_dict, per-batch code remap tables). The remap table
+    ``r`` satisfies: new_code = r[old_code]. Device-side remapping is then a
+    single gather.
+    """
+    dtype = batches[0].schema[col].dtype
+    vocab: dict = {}
+    remaps: list[np.ndarray] = []
+    for b in batches:
+        d = b.dicts[col]
+        assert d is not None
+        pylist = d.to_pylist()
+        r = np.empty(len(pylist), dtype=np.int32)
+        for i, s in enumerate(pylist):
+            code = vocab.setdefault(s, len(vocab))
+            r[i] = code
+        remaps.append(r)
+    keys = list(vocab.keys())
+    value_type = pa.binary() if dtype.kind == T.TypeKind.BINARY else pa.string()
+    unified = pa.array(keys, type=value_type) if keys else _empty_dict(dtype)
+    return unified, remaps

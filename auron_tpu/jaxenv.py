@@ -1,0 +1,60 @@
+"""Central JAX environment setup for auron-tpu.
+
+SQL engines need exact 64-bit integer semantics (BIGINT columns, 64-bit
+hashes, decimal-as-scaled-int64), so x64 mode is enabled globally. On TPU,
+s64 ops are lowered by XLA (emulated where needed); hot kernels use 32-bit
+lanes where possible.
+"""
+
+from __future__ import annotations
+
+import os
+
+_SETUP_DONE = False
+
+
+def setup_jax() -> None:
+    global _SETUP_DONE
+    if _SETUP_DONE:
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _SETUP_DONE = True
+
+
+def force_cpu_backend(num_devices: int = 8) -> None:
+    """Force the CPU backend with ``num_devices`` virtual devices.
+
+    Used by tests and the multi-chip dry-run: must be called before any
+    JAX backend is initialized. Also unhooks third-party PJRT platform
+    plugins that would otherwise be initialized eagerly.
+    """
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    want = f"--xla_force_host_platform_device_count={num_devices}"
+    os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as xb
+
+        for plat in list(xb._backend_factories):
+            if plat not in ("cpu",):
+                xb._backend_factories.pop(plat, None)
+    except Exception:
+        pass
+    setup_jax()
+
+
+def is_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False

@@ -1,0 +1,263 @@
+"""SQL type system and its TPU physical mapping.
+
+Logical types mirror the Spark/Arrow types the reference engine supports
+(reference: native-engine/auron-planner/proto/auron.proto ArrowType and
+datafusion-ext-commons/src/arrow/cast.rs), but the *physical* mapping is
+TPU-first — XLA requires static shapes and has no pointer-rich layouts:
+
+- fixed-width types map 1:1 onto dense jnp arrays + a validity mask;
+- DECIMAL(p<=18) is a scaled int64 ("decimal64"); precision 19..38 is
+  currently computed in the decimal64 domain too (documented limitation,
+  int128-limb emulation is planned);
+- DATE is int32 days since epoch, TIMESTAMP is int64 microseconds — same
+  physical encoding Arrow uses;
+- STRING/BINARY are dictionary-encoded: the device sees int32 codes, the
+  dictionary itself (a pyarrow array) stays on the host. Equality, group-by,
+  join and sort on strings are performed on codes after host-side dictionary
+  unification / ordering; string *functions* evaluate host-side round 1.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+
+class TypeKind(enum.Enum):
+    NULL = "null"
+    BOOL = "bool"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    DECIMAL = "decimal"
+    DATE32 = "date32"
+    TIMESTAMP = "timestamp"  # microseconds
+    STRING = "string"
+    BINARY = "binary"
+
+
+_INT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)
+_FLOAT_KINDS = (TypeKind.FLOAT32, TypeKind.FLOAT64)
+
+
+@dataclass(frozen=True)
+class DataType:
+    """A logical SQL data type. Hashable, usable as a jit static arg."""
+
+    kind: TypeKind
+    precision: int = 0  # DECIMAL only
+    scale: int = 0  # DECIMAL only
+
+    def __post_init__(self):
+        if self.kind == TypeKind.DECIMAL:
+            if not (1 <= self.precision <= 38):
+                raise ValueError(f"bad decimal precision {self.precision}")
+
+    # ---- classification ----
+    @property
+    def is_integer(self) -> bool:
+        return self.kind in _INT_KINDS
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in _FLOAT_KINDS
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_float or self.kind == TypeKind.DECIMAL
+
+    @property
+    def is_string_like(self) -> bool:
+        return self.kind in (TypeKind.STRING, TypeKind.BINARY)
+
+    @property
+    def is_dict_encoded(self) -> bool:
+        return self.is_string_like
+
+    # ---- physical mapping ----
+    def physical_dtype(self) -> jnp.dtype:
+        """jnp dtype of the device value array for this logical type."""
+        k = self.kind
+        if k == TypeKind.BOOL:
+            return jnp.dtype(jnp.bool_)
+        if k == TypeKind.INT8:
+            return jnp.dtype(jnp.int8)
+        if k == TypeKind.INT16:
+            return jnp.dtype(jnp.int16)
+        if k in (TypeKind.INT32, TypeKind.DATE32):
+            return jnp.dtype(jnp.int32)
+        if k in (TypeKind.INT64, TypeKind.TIMESTAMP):
+            return jnp.dtype(jnp.int64)
+        if k == TypeKind.FLOAT32:
+            return jnp.dtype(jnp.float32)
+        if k == TypeKind.FLOAT64:
+            return jnp.dtype(jnp.float64)
+        if k == TypeKind.DECIMAL:
+            return jnp.dtype(jnp.int64)  # scaled decimal64
+        if self.is_string_like:
+            return jnp.dtype(jnp.int32)  # dictionary codes
+        if k == TypeKind.NULL:
+            return jnp.dtype(jnp.int8)
+        raise TypeError(f"no physical dtype for {self}")
+
+    def to_arrow(self) -> pa.DataType:
+        k = self.kind
+        m = {
+            TypeKind.NULL: pa.null(),
+            TypeKind.BOOL: pa.bool_(),
+            TypeKind.INT8: pa.int8(),
+            TypeKind.INT16: pa.int16(),
+            TypeKind.INT32: pa.int32(),
+            TypeKind.INT64: pa.int64(),
+            TypeKind.FLOAT32: pa.float32(),
+            TypeKind.FLOAT64: pa.float64(),
+            TypeKind.DATE32: pa.date32(),
+            TypeKind.TIMESTAMP: pa.timestamp("us"),
+            TypeKind.STRING: pa.string(),
+            TypeKind.BINARY: pa.binary(),
+        }
+        if k == TypeKind.DECIMAL:
+            return pa.decimal128(self.precision, self.scale)
+        return m[k]
+
+    @staticmethod
+    def from_arrow(t: pa.DataType) -> "DataType":
+        if pa.types.is_null(t):
+            return NULL
+        if pa.types.is_boolean(t):
+            return BOOL
+        if pa.types.is_int8(t):
+            return INT8
+        if pa.types.is_int16(t):
+            return INT16
+        if pa.types.is_int32(t):
+            return INT32
+        if pa.types.is_int64(t):
+            return INT64
+        if pa.types.is_uint8(t):
+            return INT16
+        if pa.types.is_uint16(t):
+            return INT32
+        if pa.types.is_uint32(t) or pa.types.is_uint64(t):
+            return INT64
+        if pa.types.is_float32(t):
+            return FLOAT32
+        if pa.types.is_float64(t):
+            return FLOAT64
+        if pa.types.is_decimal(t):
+            return decimal(t.precision, t.scale)
+        if pa.types.is_date32(t):
+            return DATE32
+        if pa.types.is_date64(t):
+            return DATE32
+        if pa.types.is_timestamp(t):
+            return TIMESTAMP
+        if pa.types.is_string(t) or pa.types.is_large_string(t):
+            return STRING
+        if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+            return BINARY
+        if isinstance(t, pa.DictionaryType):
+            return DataType.from_arrow(t.value_type)
+        raise TypeError(f"unsupported arrow type {t}")
+
+    def __repr__(self) -> str:
+        if self.kind == TypeKind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        return self.kind.value
+
+
+# canonical singletons
+NULL = DataType(TypeKind.NULL)
+BOOL = DataType(TypeKind.BOOL)
+INT8 = DataType(TypeKind.INT8)
+INT16 = DataType(TypeKind.INT16)
+INT32 = DataType(TypeKind.INT32)
+INT64 = DataType(TypeKind.INT64)
+FLOAT32 = DataType(TypeKind.FLOAT32)
+FLOAT64 = DataType(TypeKind.FLOAT64)
+DATE32 = DataType(TypeKind.DATE32)
+TIMESTAMP = DataType(TypeKind.TIMESTAMP)
+STRING = DataType(TypeKind.STRING)
+BINARY = DataType(TypeKind.BINARY)
+
+
+def decimal(precision: int, scale: int) -> DataType:
+    return DataType(TypeKind.DECIMAL, precision, scale)
+
+
+#: Spark's default decimal for literals / sums
+DECIMAL_SYSTEM_DEFAULT = decimal(38, 18)
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+    def to_arrow(self) -> pa.Field:
+        return pa.field(self.name, self.dtype.to_arrow(), nullable=self.nullable)
+
+
+@dataclass(frozen=True)
+class Schema:
+    """A named, ordered list of fields. Hashable (jit-static)."""
+
+    fields: tuple[Field, ...] = field(default_factory=tuple)
+
+    @staticmethod
+    def of(*fields: Field) -> "Schema":
+        return Schema(tuple(fields))
+
+    @staticmethod
+    def from_arrow(s: pa.Schema) -> "Schema":
+        return Schema(
+            tuple(
+                Field(f.name, DataType.from_arrow(f.type), f.nullable) for f in s
+            )
+        )
+
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema([f.to_arrow() for f in self.fields])
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i: int) -> Field:
+        return self.fields[i]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def rename(self, names: list[str]) -> "Schema":
+        assert len(names) == len(self.fields)
+        return Schema(
+            tuple(
+                Field(n, f.dtype, f.nullable) for n, f in zip(names, self.fields)
+            )
+        )
+
+
+def numpy_zero(dtype: DataType):
+    """Padding value for the physical array of `dtype`."""
+    pd = dtype.physical_dtype()
+    if pd == jnp.bool_:
+        return False
+    return np.zeros((), dtype=np.dtype(pd.name))[()]

@@ -1,0 +1,199 @@
+"""Typed, self-documenting configuration system.
+
+Analog of the reference's three-tier config stack:
+- typed ``ConfigOption`` builder with categories / defaults / alt keys
+  (reference: auron-core/.../configuration/ConfigOption.java,
+  AuronConfiguration.java:26-65),
+- engine bindings such as SparkAuronConfiguration's 72 ``spark.auron.*``
+  keys (reference: spark-extension/.../SparkAuronConfiguration.java:42+),
+- engine-pulled native conf accessors (reference:
+  auron-jni-bridge/src/conf.rs:20-64).
+
+Here a single ``Configuration`` object backs all three roles: options are
+declared once with type+default, values are resolved from (1) an explicit
+session dict (set by the host-engine bridge when a task ships its
+TaskDefinition), (2) process environment ``AURON_TPU_<NAME>``, (3) the
+default. A doc table can be generated from the registry (analog of
+SparkAuronConfigurationDocGenerator.java).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: dict[str, "ConfigOption"] = {}
+
+
+@dataclass(frozen=True)
+class ConfigOption(Generic[T]):
+    key: str
+    default: T
+    parse: Callable[[str], T]
+    category: str = "general"
+    doc: str = ""
+
+    def __post_init__(self):
+        _REGISTRY[self.key] = self
+
+    def get(self, conf: "Configuration | None" = None) -> T:
+        c = conf if conf is not None else active_conf()
+        return c.get(self)
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def int_conf(key: str, default: int, category: str = "general", doc: str = "") -> ConfigOption[int]:
+    return ConfigOption(key, default, int, category, doc)
+
+
+def float_conf(key: str, default: float, category: str = "general", doc: str = "") -> ConfigOption[float]:
+    return ConfigOption(key, default, float, category, doc)
+
+
+def bool_conf(key: str, default: bool, category: str = "general", doc: str = "") -> ConfigOption[bool]:
+    return ConfigOption(key, default, _parse_bool, category, doc)
+
+
+def str_conf(key: str, default: str, category: str = "general", doc: str = "") -> ConfigOption[str]:
+    return ConfigOption(key, default, str, category, doc)
+
+
+class Configuration:
+    """Resolved key->value store with session overrides."""
+
+    def __init__(self, values: dict[str, Any] | None = None):
+        self._values: dict[str, Any] = dict(values or {})
+
+    def set(self, opt: ConfigOption[T] | str, value: Any) -> "Configuration":
+        key = opt if isinstance(opt, str) else opt.key
+        self._values[key] = value
+        return self
+
+    def get(self, opt: ConfigOption[T]) -> T:
+        if opt.key in self._values:
+            v = self._values[opt.key]
+            return opt.parse(v) if isinstance(v, str) else v
+        env_key = "AURON_TPU_" + opt.key.upper().replace(".", "_")
+        if env_key in os.environ:
+            return opt.parse(os.environ[env_key])
+        return opt.default
+
+    def copy(self) -> "Configuration":
+        return Configuration(self._values)
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+
+_local = threading.local()
+_GLOBAL = Configuration()
+
+
+def active_conf() -> Configuration:
+    return getattr(_local, "conf", None) or _GLOBAL
+
+
+class conf_scope:
+    """Context manager installing a Configuration for the current thread.
+
+    The task runtime wraps each task's execution in the configuration
+    shipped with its TaskDefinition (analog of the reference pulling conf
+    lazily over JNI per key, conf.rs:32-64).
+    """
+
+    def __init__(self, conf: Configuration):
+        self.conf = conf
+
+    def __enter__(self):
+        self._prev = getattr(_local, "conf", None)
+        _local.conf = self.conf
+        return self.conf
+
+    def __exit__(self, *exc):
+        _local.conf = self._prev
+        return False
+
+
+def generate_doc() -> str:
+    """Markdown doc table of all registered options (analog of
+    SparkAuronConfigurationDocGenerator.java)."""
+    rows = ["| key | default | category | doc |", "|---|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        o = _REGISTRY[key]
+        rows.append(f"| `{o.key}` | `{o.default!r}` | {o.category} | {o.doc} |")
+    return "\n".join(rows)
+
+
+# ---------------------------------------------------------------------------
+# Core engine options (subset mirroring auron-jni-bridge/src/conf.rs:20-64 and
+# SparkAuronConfiguration; grows as features land).
+# ---------------------------------------------------------------------------
+
+BATCH_SIZE = int_conf(
+    "batch.size", 8192, "exec", "target rows per columnar device batch"
+)
+MEMORY_FRACTION = float_conf(
+    "memory.fraction", 0.6, "memory", "fraction of HBM budget usable by consumers"
+)
+HBM_BUDGET_BYTES = int_conf(
+    "memory.hbm.budget.bytes", 8 << 30, "memory",
+    "total HBM bytes the memory manager may hand out (analog of native memory = overhead * fraction)",
+)
+SPILL_COMPRESSION_CODEC = str_conf(
+    "spill.compression.codec", "zstd", "memory", "codec for spill files and shuffle runs (zstd|lz4|none)"
+)
+BATCH_SIZE_BUCKETS = str_conf(
+    "batch.capacity.buckets", "auto", "exec",
+    "capacity bucketing policy for static shapes: auto = next_pow2",
+)
+SMJ_FALLBACK_ENABLE = bool_conf(
+    "smj.fallback.enable", True, "join",
+    "fall back from hash join to sort-merge when the build side exceeds budget (SMJ_FALLBACK_* in conf.rs:53-55)",
+)
+SMJ_FALLBACK_ROWS_THRESHOLD = int_conf(
+    "smj.fallback.rows.threshold", 10_000_000, "join", ""
+)
+SMJ_FALLBACK_MEM_SIZE_THRESHOLD = int_conf(
+    "smj.fallback.mem.threshold.bytes", 1 << 30, "join", ""
+)
+PARTIAL_AGG_SKIPPING_ENABLE = bool_conf(
+    "partial.agg.skipping.enable", True, "agg",
+    "skip partial aggregation when observed cardinality ratio is high (conf.rs:38-41)",
+)
+PARTIAL_AGG_SKIPPING_RATIO = float_conf(
+    "partial.agg.skipping.ratio", 0.8, "agg", ""
+)
+PARTIAL_AGG_SKIPPING_MIN_ROWS = int_conf(
+    "partial.agg.skipping.min.rows", 20480, "agg", ""
+)
+AGG_SPILL_BUCKETS = int_conf(
+    "agg.spill.buckets", 64, "agg",
+    "number of hash buckets for spilled aggregation merge (agg/agg_ctx.rs:611)",
+)
+SHUFFLE_COMPRESSION_TARGET_BUF_SIZE = int_conf(
+    "shuffle.compression.target.buf.size", 4 << 20, "shuffle", ""
+)
+IGNORE_CORRUPTED_FILES = bool_conf(
+    "files.ignore.corrupted", False, "scan", "tolerate unreadable input files (conf.rs:37)"
+)
+PARQUET_MAX_OVER_READ_SIZE = int_conf(
+    "parquet.max.over.read.size", 16 << 20, "scan",
+    "read coalescing window for remote-FS parquet reads (conf.rs:44)",
+)
+CASE_SENSITIVE = bool_conf("case.sensitive", False, "sql", "identifier resolution")
+UDF_FALLBACK_ENABLE = bool_conf(
+    "udf.fallback.enable", True, "expr",
+    "evaluate unconvertible expressions via host callback (SparkUDFWrapper analog)",
+)
+TOKIO_EQUIV_PREFETCH_DEPTH = int_conf(
+    "runtime.prefetch.depth", 2, "runtime",
+    "batches prefetched by the task pump (analog of the 1-slot sync_channel + tokio workers, rt.rs:108-140)",
+)
+NATIVE_LOG_LEVEL = str_conf("log.level", "info", "runtime", "engine log level (conf.rs:64)")

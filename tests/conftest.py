@@ -1,0 +1,19 @@
+"""Test harness: force the CPU backend with 8 virtual devices.
+
+Multi-chip behavior (shuffle exchange over a Mesh, sharded aggregation) is
+tested on a virtual 8-device CPU mesh — mirroring how the reference tests
+"multi-node" behavior on a single JVM with local task scheduling
+(reference: BaseAuronSQLSuite.scala:38-50). Real-TPU runs happen in
+bench.py / __graft_entry__.py, not in unit tests.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from auron_tpu.jaxenv import force_cpu_backend  # noqa: E402
+
+force_cpu_backend(8)
+
+import auron_tpu  # noqa: F401,E402  (enables x64)

@@ -1,0 +1,115 @@
+"""Columnar batch round-trip tests (Arrow <-> device)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch, bucket_capacity
+from auron_tpu.columnar.batch import concat_batches, unify_dict
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 128
+    assert bucket_capacity(128) == 128
+    assert bucket_capacity(129) == 256
+    assert bucket_capacity(8192) == 8192
+    assert bucket_capacity(8193) == 16384
+
+
+def test_roundtrip_numeric():
+    rb = pa.record_batch(
+        {
+            "i8": pa.array([1, None, -3], type=pa.int8()),
+            "i32": pa.array([100, 2, None], type=pa.int32()),
+            "i64": pa.array([2**40, None, -(2**40)], type=pa.int64()),
+            "f32": pa.array([1.5, None, float("nan")], type=pa.float32()),
+            "f64": pa.array([2.5, -0.0, None], type=pa.float64()),
+            "b": pa.array([True, None, False]),
+        }
+    )
+    b = Batch.from_arrow(rb)
+    assert b.capacity == 128
+    assert b.num_rows() == 3
+    out = b.to_arrow()
+    assert out.num_rows == 3
+    for name in rb.schema.names:
+        got, want = out.column(name), rb.column(name)
+        if name == "f32":
+            gl, wl = got.to_pylist(), want.to_pylist()
+            assert gl[0] == wl[0] and gl[1] is None and np.isnan(gl[2])
+        else:
+            assert got.equals(want), name
+
+
+def test_roundtrip_strings():
+    rb = pa.record_batch({"s": pa.array(["hello", None, "world", "hello"])})
+    b = Batch.from_arrow(rb)
+    assert b.dicts[0] is not None
+    assert b.to_arrow().column("s").to_pylist() == ["hello", None, "world", "hello"]
+
+
+def test_roundtrip_decimal_date_ts():
+    import decimal as d
+
+    rb = pa.record_batch(
+        {
+            "dec": pa.array(
+                [d.Decimal("123.45"), None, d.Decimal("-0.01")],
+                type=pa.decimal128(10, 2),
+            ),
+            "dt": pa.array([18000, None, 0], type=pa.int32()).cast(pa.date32()),
+            "ts": pa.array(
+                [np.datetime64("2024-01-01T12:34:56.789", "us"), None,
+                 np.datetime64("1970-01-01", "us")]
+            ),
+        }
+    )
+    b = Batch.from_arrow(rb)
+    out = b.to_arrow()
+    assert out.column("dec").to_pylist() == rb.column("dec").to_pylist()
+    assert out.column("dt").to_pylist() == rb.column("dt").to_pylist()
+    assert out.column("ts").to_pylist() == rb.column("ts").to_pylist()
+    # decimal physical repr is scaled int64
+    vals = np.asarray(b.col_values(0))
+    assert vals[0] == 12345 and vals[2] == -1
+
+
+def test_from_pydict_and_empty():
+    b = Batch.from_pydict({"x": [1, 2, 3], "y": ["a", "b", "a"]})
+    assert b.schema.names == ["x", "y"]
+    assert b.num_rows() == 3
+    e = Batch.empty(b.schema)
+    assert e.num_rows() == 0
+    assert e.to_arrow().num_rows == 0
+
+
+def test_concat_batches():
+    b1 = Batch.from_pydict({"x": [1, 2], "s": ["a", "b"]})
+    b2 = Batch.from_pydict({"x": [3], "s": ["c"]})
+    c = concat_batches([b1, b2])
+    assert c.to_pydict() == {"x": [1, 2, 3], "s": ["a", "b", "c"]}
+
+
+def test_unify_dict():
+    b1 = Batch.from_pydict({"s": ["a", "b", "a"]})
+    b2 = Batch.from_pydict({"s": ["b", "c"]})
+    unified, remaps = unify_dict([b1, b2], 0)
+    uni = unified.to_pylist()
+    # every (batch, code) remaps to the right string
+    for b, r in zip([b1, b2], remaps):
+        codes = np.asarray(b.col_values(0))
+        sel = np.asarray(b.device.sel)
+        strings = b.to_arrow().column("s").to_pylist()
+        live_codes = codes[sel]
+        for s, c in zip(strings, live_codes):
+            assert uni[r[c]] == s
+
+
+def test_large_batch_bucketing():
+    n = 10_000
+    rb = pa.record_batch({"x": pa.array(np.arange(n))})
+    b = Batch.from_arrow(rb)
+    assert b.capacity == 16384
+    assert b.num_rows() == n
+    assert b.to_arrow().column("x").to_pylist() == list(range(n))
