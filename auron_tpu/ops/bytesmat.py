@@ -1,0 +1,64 @@
+"""Device byte-matrix representation for variable-width values.
+
+XLA has no variable-length arrays, so strings/binary that must be processed
+*on device* (hashing, comparisons) are materialized as a fixed-shape byte
+matrix: ``bytes[u8, (n, max_len)]`` plus ``lengths[int32, (n,)]``, padded
+with zeros. Dictionary-encoded columns only materialize the *dictionary*
+(small) as a byte matrix; per-row access is a gather by code.
+
+The word view packs bytes little-endian into uint32 lanes so hash kernels
+can consume 4 bytes per step (see ops/hashing.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+
+class ByteMatrix:
+    """Host-built, device-resident padded byte matrix."""
+
+    def __init__(self, bytes_u8: jnp.ndarray, lengths: jnp.ndarray):
+        assert bytes_u8.ndim == 2 and bytes_u8.dtype == jnp.uint8
+        self.bytes = bytes_u8
+        self.lengths = lengths
+
+    @property
+    def max_len(self) -> int:
+        return int(self.bytes.shape[1])
+
+    @staticmethod
+    def from_arrow(arr: pa.Array, min_width: int = 4) -> "ByteMatrix":
+        """Build from a string/binary pyarrow array (typically a dictionary)."""
+        pylist = arr.to_pylist()
+        raw = [
+            (s.encode("utf-8") if isinstance(s, str) else (s or b""))
+            for s in pylist
+        ]
+        n = len(raw)
+        max_len = max([min_width] + [len(b) for b in raw])
+        # round up to a multiple of 4 so the word view needs no ragged tail
+        max_len = (max_len + 3) & ~3
+        mat = np.zeros((max(n, 1), max_len), dtype=np.uint8)
+        lens = np.zeros(max(n, 1), dtype=np.int32)
+        for i, b in enumerate(raw):
+            mat[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lens[i] = len(b)
+        return ByteMatrix(jnp.asarray(mat), jnp.asarray(lens))
+
+    def words_u32(self) -> jnp.ndarray:
+        """Little-endian uint32 word view, shape (n, max_len // 4)."""
+        n, m = self.bytes.shape
+        b = self.bytes.astype(jnp.uint32).reshape(n, m // 4, 4)
+        return (
+            b[:, :, 0]
+            | (b[:, :, 1] << 8)
+            | (b[:, :, 2] << 16)
+            | (b[:, :, 3] << 24)
+        )
+
+    def take(self, codes: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Per-row (bytes, length) via gather by dictionary code."""
+        return self.bytes[codes], self.lengths[codes]

@@ -1,0 +1,169 @@
+"""Bit-exactness tests for Spark hash kernels.
+
+Expected values are Spark-generated vectors (Murmur3Hash / XxHash64 with
+seed 42), the same spec vectors the reference engine tests against
+(datafusion-ext-commons/src/spark_hash.rs:416-520).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar import Batch
+from auron_tpu.ops.hash_dispatch import hash_batch
+
+
+def _m3(data_dict, cols=None, schema=None):
+    b = Batch.from_pydict(data_dict, schema=schema)
+    cols = cols if cols is not None else list(range(len(b.schema)))
+    return np.asarray(hash_batch(b, cols, "murmur3"))[: b.num_rows()].tolist()
+
+
+def _xx(data_dict, cols=None, schema=None):
+    b = Batch.from_pydict(data_dict, schema=schema)
+    cols = cols if cols is not None else list(range(len(b.schema)))
+    return np.asarray(hash_batch(b, cols, "xxhash64"))[: b.num_rows()].tolist()
+
+
+def _i32(vals):
+    return [v - (1 << 32) if v >= (1 << 31) else v for v in vals]
+
+
+def test_murmur3_i32():
+    got = _m3({"x": pa.array([1, 2, 3, 4], type=pa.int32())})
+    assert got == [-559580957, 1765031574, -1823081949, -397064898]
+
+
+def test_murmur3_i8():
+    got = _m3({"x": pa.array([1, 0, -1, 127, -128], type=pa.int8())})
+    assert got == _i32([0xDEA578E3, 0x379FAE8F, 0xA0590E3D, 0x43B4D8ED, 0x422A1365])
+
+
+def test_murmur3_i64():
+    got = _m3(
+        {"x": pa.array([1, 0, -1, 2**63 - 1, -(2**63)], type=pa.int64())}
+    )
+    assert got == _i32([0x99F0149D, 0x9C67B85D, 0xC8008529, 0xA05B5D7B, 0xCD1E64FB])
+
+
+def test_murmur3_str():
+    got = _m3({"s": pa.array(["hello", "bar", "", "😁", "天地"])})
+    assert got == _i32([3286402344, 2486176763, 142593372, 885025535, 2395000894])
+
+
+def test_xxhash64_i64():
+    got = _xx({"x": pa.array([1, 0, -1, 2**63 - 1, -(2**63)], type=pa.int64())})
+    assert got == [
+        -7001672635703045582,
+        -5252525462095825812,
+        3858142552250413010,
+        -3246596055638297850,
+        -8619748838626508300,
+    ]
+
+
+def test_xxhash64_str():
+    got = _xx({"s": pa.array(["hello", "bar", "", "😁", "天地"])})
+    assert got == [
+        -4367754540140381902,
+        -1798770879548125814,
+        -7444071767201028348,
+        -6337236088984028203,
+        -235771157374669727,
+    ]
+
+
+def test_null_skips_and_chaining():
+    # NULL leaves the running hash at its seed: hash(null) == seed 42 pattern
+    got = _m3({"x": pa.array([None, 1], type=pa.int32())})
+    # row0: no column contributes -> result is the initial seed 42
+    assert got[0] == 42
+    assert got[1] == -559580957
+    # chaining: hash((a,b)) must differ from hash(a) and use a's hash as seed
+    two = _m3(
+        {
+            "a": pa.array([1], type=pa.int32()),
+            "b": pa.array([2], type=pa.int32()),
+        }
+    )
+    one = _m3({"a": pa.array([1], type=pa.int32())})
+    assert two != one
+    # null in second column: result equals hash of first column alone
+    mixed = _m3(
+        {
+            "a": pa.array([1], type=pa.int32()),
+            "b": pa.array([None], type=pa.int32()),
+        }
+    )
+    assert mixed == one
+
+
+def test_murmur3_bool_float_decimal():
+    import decimal as d
+
+    got_b = _m3({"x": pa.array([True, False])})
+    # Spark hashes bool as int 1/0
+    assert got_b == _m3({"x": pa.array([1, 0], type=pa.int32())})
+    # float hashes its bit pattern as 4 bytes / 8 bytes
+    got_f = _m3({"x": pa.array([1.0, -0.0], type=pa.float32())})
+    assert len(set(got_f)) == 2
+    # decimal64 must hash like a 16-byte unscaled int128
+    got_d = _m3({"x": pa.array([d.Decimal("1.23"), d.Decimal("-1.23")], type=pa.decimal128(10, 2))})
+    assert len(set(got_d)) == 2
+
+
+def test_long_string_xxhash64():
+    # >= 32 bytes exercises the 4-accumulator streaming path; cross-check a
+    # couple of lengths against the pure-python reference implementation below
+    def xxh64_py(data: bytes, seed: int = 42) -> int:
+        M = (1 << 64) - 1
+        P1, P2, P3, P4, P5 = (
+            0x9E3779B185EBCA87,
+            0xC2B2AE3D27D4EB4F,
+            0x165667B19E3779F9,
+            0x85EBCA77C2B2AE63,
+            0x27D4EB2F165667C5,
+        )
+
+        def rotl(x, r):
+            return ((x << r) | (x >> (64 - r))) & M
+
+        def rnd(acc, lane):
+            return (rotl((acc + lane * P2) & M, 31) * P1) & M
+
+        i, n = 0, len(data)
+        if n >= 32:
+            v = [(seed + P1 + P2) & M, (seed + P2) & M, seed, (seed - P1) & M]
+            while i + 32 <= n:
+                for j in range(4):
+                    lane = int.from_bytes(data[i : i + 8], "little")
+                    v[j] = rnd(v[j], lane)
+                    i += 8
+            acc = (rotl(v[0], 1) + rotl(v[1], 7) + rotl(v[2], 12) + rotl(v[3], 18)) & M
+            for j in range(4):
+                acc = ((acc ^ rnd(0, v[j])) * P1 + P4) & M
+        else:
+            acc = (seed + P5) & M
+        acc = (acc + n) & M
+        while i + 8 <= n:
+            lane = int.from_bytes(data[i : i + 8], "little")
+            acc = ((rotl(acc ^ rnd(0, lane), 27) * P1) + P4) & M
+            i += 8
+        if i + 4 <= n:
+            word = int.from_bytes(data[i : i + 4], "little")
+            acc = ((rotl(acc ^ (word * P1) & M, 23) * P2) + P3) & M
+            i += 4
+        while i < n:
+            acc = (rotl(acc ^ (data[i] * P5) & M, 11) * P1) & M
+            i += 1
+        acc ^= acc >> 33
+        acc = (acc * P2) & M
+        acc ^= acc >> 29
+        acc = (acc * P3) & M
+        acc ^= acc >> 32
+        return acc - (1 << 64) if acc >= (1 << 63) else acc
+
+    strings = ["a" * 31, "b" * 32, "c" * 33, "d" * 64, "e" * 100, "xyz" * 17]
+    got = _xx({"s": pa.array(strings)})
+    want = [xxh64_py(s.encode()) for s in strings]
+    assert got == want
