@@ -1,0 +1,16 @@
+from auron_tpu.exprs.ir import (  # noqa: F401
+    BinaryOp,
+    Case,
+    Cast,
+    Coalesce,
+    Column,
+    If,
+    In,
+    IsNotNull,
+    IsNull,
+    Like,
+    Literal,
+    Not,
+    ScalarFunc,
+)
+from auron_tpu.exprs.eval import ColumnVal, Evaluator, eval_exprs  # noqa: F401

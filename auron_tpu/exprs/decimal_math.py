@@ -1,0 +1,130 @@
+"""Checked decimal64 arithmetic on device.
+
+Decimals are scaled int64 (types.py). Spark's non-ANSI overflow contract is
+overflow -> NULL (CheckOverflow wraps every decimal arithmetic result —
+the reference implements the same via its check_overflow/make_decimal
+function family, datafusion-ext-functions/src/lib.rs). All helpers return
+``(values, ok_mask)`` so the evaluator can fold failures into validity.
+
+Rounding follows java.math.RoundingMode.HALF_UP (Spark's decimal division
+and rescale-down), implemented with truncating lax.div/lax.rem plus a
+half-adjust — no floats in the value path; float64 magnitude estimates are
+only used to *detect* would-be int64 overflow, which is sound here because
+any value that close to 2^63 already exceeds decimal64's 18-digit domain
+and must become NULL anyway.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_POW10 = [10**i for i in range(19)]
+_I64_MAX = (1 << 63) - 1
+
+
+def pow10(k: int) -> int:
+    assert 0 <= k <= 18, k
+    return _POW10[k]
+
+
+def checked_mul_pow10(v: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """v * 10^k with overflow detection."""
+    if k == 0:
+        return v, jnp.ones_like(v, dtype=bool)
+    if k > 18:
+        return jnp.zeros_like(v), jnp.zeros_like(v, dtype=bool)
+    p = jnp.int64(pow10(k))
+    limit = jnp.int64(_I64_MAX // pow10(k))
+    ok = jnp.abs(v) <= limit
+    return v * p, ok
+
+
+def rescale(
+    v: jnp.ndarray, from_scale: int, to_scale: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Change scale with HALF_UP rounding on scale-down."""
+    if to_scale == from_scale:
+        return v, jnp.ones_like(v, dtype=bool)
+    if to_scale > from_scale:
+        return checked_mul_pow10(v, to_scale - from_scale)
+    k = from_scale - to_scale
+    if k > 18:
+        return jnp.zeros_like(v), jnp.ones_like(v, dtype=bool)
+    p = jnp.int64(pow10(k))
+    q = lax.div(v, p)  # truncates toward zero
+    r = lax.rem(v, p)
+    half = p // 2
+    adj = jnp.where(r >= half, 1, 0) - jnp.where(r <= -half, 1, 0)
+    # HALF_UP: |r| >= ceil(p/2) rounds away from zero; p is even except 10^0
+    return q + adj, jnp.ones_like(v, dtype=bool)
+
+
+def precision_ok(v: jnp.ndarray, precision: int) -> jnp.ndarray:
+    """Spark CheckOverflow: |v| must fit in `precision` digits."""
+    if precision >= 19:
+        return jnp.ones_like(v, dtype=bool)  # int64 range is the only bound
+    bound = jnp.int64(pow10(precision))
+    return jnp.abs(v) < bound
+
+
+def add(
+    a: jnp.ndarray, sa: int, b: jnp.ndarray, sb: int, out_prec: int, out_scale: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    av, aok = rescale(a, sa, out_scale)
+    bv, bok = rescale(b, sb, out_scale)
+    s = av + bv
+    # detect int64 wraparound of the sum
+    wrap_ok = ~(((av > 0) & (bv > 0) & (s < 0)) | ((av < 0) & (bv < 0) & (s > 0)))
+    return s, aok & bok & wrap_ok & precision_ok(s, out_prec)
+
+
+def sub(a, sa, b, sb, out_prec, out_scale):
+    return add(a, sa, -b, sb, out_prec, out_scale)
+
+
+def mul(
+    a: jnp.ndarray, sa: int, b: jnp.ndarray, sb: int, out_prec: int, out_scale: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    prod = a * b  # scale sa+sb
+    est = jnp.abs(a.astype(jnp.float64) * b.astype(jnp.float64))
+    no_wrap = est < 9.0e18
+    v, rok = rescale(prod, sa + sb, out_scale)
+    return v, no_wrap & rok & precision_ok(v, out_prec)
+
+
+def div(
+    a: jnp.ndarray, sa: int, b: jnp.ndarray, sb: int, out_prec: int, out_scale: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """HALF_UP division; divisor 0 -> not-ok (Spark returns NULL)."""
+    # result = a / b scaled so that: a/10^sa / (b/10^sb) * 10^s
+    # = a * 10^(s - sa + sb) / b
+    k = out_scale - sa + sb
+    bz = b == 0
+    bsafe = jnp.where(bz, 1, b)
+    if k >= 0:
+        num, nok = checked_mul_pow10(a, k)
+        q = lax.div(num, bsafe)
+        r = lax.rem(num, bsafe)
+        adj = jnp.where(2 * jnp.abs(r) >= jnp.abs(bsafe), jnp.sign(num) * jnp.sign(bsafe), 0)
+        v = q + adj
+    else:
+        # negative k: divide then rescale down
+        q = lax.div(a, bsafe)
+        r = lax.rem(a, bsafe)
+        adj = jnp.where(2 * jnp.abs(r) >= jnp.abs(bsafe), jnp.sign(a) * jnp.sign(bsafe), 0)
+        v, nok = rescale(q + adj, -k, 0)
+    return v, nok & ~bz & precision_ok(v, out_prec)
+
+
+def mod(
+    a: jnp.ndarray, sa: int, b: jnp.ndarray, sb: int, out_prec: int, out_scale: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    s = max(sa, sb)
+    av, aok = rescale(a, sa, s)
+    bv, bok = rescale(b, sb, s)
+    bz = bv == 0
+    bsafe = jnp.where(bz, 1, bv)
+    r = lax.rem(av, bsafe)
+    v, rok = rescale(r, s, out_scale)
+    return v, aok & bok & rok & ~bz & precision_ok(v, out_prec)

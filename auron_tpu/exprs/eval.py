@@ -1,0 +1,438 @@
+"""Expression evaluator: IR trees -> jnp columnar programs.
+
+Evaluates ``exprs.ir`` trees over a ``Batch``, producing per-expression
+``ColumnVal`` (values + validity + dtype + optional dictionary). Device math
+is pure jnp; dictionary-encoded strings are handled by transforming the
+*dictionary* host-side (small) and gathering by code on device — so string
+equality/ordering/LIKE/casts stay on the TPU data path with only O(|dict|)
+host work (analog of how the reference hashes/compares dictionary arrays,
+spark_hash.rs:228-249).
+
+Common subexpressions are evaluated once per batch via a structural memo —
+the analog of the reference's CachedExprsEvaluator
+(datafusion-ext-plans/src/common/cached_exprs_evaluator.rs). SQL
+three-valued logic: AND/OR use Kleene semantics, arithmetic propagates
+NULLs, division/modulo by zero produce NULL (Spark non-ANSI), decimal
+overflow produces NULL via the checked kernels in decimal_math.py.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exprs import cast as C
+from auron_tpu.exprs import decimal_math as D
+from auron_tpu.exprs import ir
+
+
+@dataclass
+class ColumnVal:
+    values: jnp.ndarray
+    validity: jnp.ndarray
+    dtype: T.DataType
+    dict: pa.Array | None = None  # set iff dtype.is_dict_encoded
+
+
+class Evaluator:
+    def __init__(self, schema: T.Schema):
+        self.schema = schema
+
+    # ---- public ----
+
+    def evaluate(self, batch: Batch, exprs: list[ir.Expr]) -> list[ColumnVal]:
+        memo: dict = {}
+        return [self._eval(e, batch, memo) for e in exprs]
+
+    # ---- core dispatch ----
+
+    def _eval(self, e: ir.Expr, b: Batch, memo: dict) -> ColumnVal:
+        key = e
+        try:
+            if key in memo:
+                return memo[key]
+        except TypeError:  # unhashable (shouldn't happen, all nodes frozen)
+            key = None
+        out = self._eval_uncached(e, b, memo)
+        if key is not None:
+            memo[key] = out
+        return out
+
+    def _eval_uncached(self, e: ir.Expr, b: Batch, memo: dict) -> ColumnVal:
+        if isinstance(e, ir.Column):
+            f = self.schema[e.index]
+            return ColumnVal(
+                b.col_values(e.index), b.col_validity(e.index), f.dtype, b.dicts[e.index]
+            )
+        if isinstance(e, ir.Literal):
+            return self._literal(e, b.capacity)
+        if isinstance(e, ir.Cast):
+            return self._cast(self._eval(e.child, b, memo), e.to)
+        if isinstance(e, ir.BinaryOp):
+            return self._binary(e, b, memo)
+        if isinstance(e, ir.Not):
+            c = self._eval(e.child, b, memo)
+            return ColumnVal(~c.values.astype(bool), c.validity, T.BOOL)
+        if isinstance(e, ir.IsNull):
+            c = self._eval(e.child, b, memo)
+            return ColumnVal(~c.validity, jnp.ones_like(c.validity), T.BOOL)
+        if isinstance(e, ir.IsNotNull):
+            c = self._eval(e.child, b, memo)
+            return ColumnVal(c.validity, jnp.ones_like(c.validity), T.BOOL)
+        if isinstance(e, ir.If):
+            return self._case([(e.cond, e.then)], e.orelse, b, memo)
+        if isinstance(e, ir.Case):
+            return self._case(list(e.branches), e.orelse, b, memo)
+        if isinstance(e, ir.Coalesce):
+            return self._coalesce([self._eval(a, b, memo) for a in e.args])
+        if isinstance(e, ir.In):
+            return self._in(e, b, memo)
+        if isinstance(e, ir.Like):
+            return self._like(e, b, memo)
+        if isinstance(e, ir.ScalarFunc):
+            from auron_tpu.functions import registry
+
+            args = [self._eval(a, b, memo) for a in e.args]
+            return registry.dispatch(e.name, args, b.capacity)
+        raise TypeError(f"unsupported expression {type(e).__name__}")
+
+    # ---- literals ----
+
+    def _literal(self, e: ir.Literal, cap: int) -> ColumnVal:
+        dt = e.dtype
+        if e.value is None or dt.kind == T.TypeKind.NULL:
+            phys = dt.physical_dtype() if dt.kind != T.TypeKind.NULL else jnp.int8
+            return ColumnVal(
+                jnp.zeros(cap, phys), jnp.zeros(cap, bool), dt,
+                _single_dict(dt, "") if dt.is_dict_encoded else None,
+            )
+        if dt.is_dict_encoded:
+            return ColumnVal(
+                jnp.zeros(cap, jnp.int32), jnp.ones(cap, bool), dt,
+                _single_dict(dt, e.value),
+            )
+        if dt.kind == T.TypeKind.DECIMAL:
+            import decimal as pd
+
+            u = int(pd.Decimal(str(e.value)).scaleb(dt.scale).quantize(pd.Decimal(1)))
+            v = jnp.full(cap, jnp.int64(u))
+        elif dt.kind == T.TypeKind.BOOL:
+            v = jnp.full(cap, bool(e.value))
+        else:
+            v = jnp.full(cap, e.value, dtype=dt.physical_dtype())
+        return ColumnVal(v, jnp.ones(cap, bool), dt)
+
+    # ---- casts ----
+
+    def _cast(self, c: ColumnVal, to: T.DataType) -> ColumnVal:
+        if c.dtype == to:
+            return c
+        if c.dtype.is_dict_encoded and not to.is_dict_encoded:
+            if to.is_string_like:
+                return ColumnVal(c.values, c.validity, to, c.dict)
+            dvals, dok = C.cast_string_dict(c.dict, to)
+            codes = jnp.clip(c.values, 0, len(dvals) - 1)
+            vals = jnp.asarray(dvals)[codes]
+            ok = jnp.asarray(dok)[codes]
+            return ColumnVal(vals, c.validity & ok, to)
+        if to.is_dict_encoded:
+            if c.dtype.is_dict_encoded:
+                return ColumnVal(c.values, c.validity, to, c.dict)
+            raise NotImplementedError(
+                "numeric -> string cast requires the host-fallback projection "
+                "(dictionary construction from data); planner wraps it"
+            )
+        v, m = C.cast_values(c.values, c.validity, c.dtype, to)
+        return ColumnVal(v, m, to)
+
+    # ---- binary ops ----
+
+    def _binary(self, e: ir.BinaryOp, b: Batch, memo: dict) -> ColumnVal:
+        l = self._eval(e.left, b, memo)
+        r = self._eval(e.right, b, memo)
+        op = e.op
+        if op in ("and", "or"):
+            return self._logic(op, l, r)
+        if op in ir._CMP_OPS:
+            return self._compare(op, l, r)
+        return self._arith(op, l, r)
+
+    def _logic(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        lv = l.values.astype(bool)
+        rv = r.values.astype(bool)
+        if op == "and":
+            known = (l.validity & ~lv) | (r.validity & ~rv)  # a known False
+            value = jnp.where(known, False, lv & rv)
+            valid = (l.validity & r.validity) | known
+        else:
+            known = (l.validity & lv) | (r.validity & rv)  # a known True
+            value = jnp.where(known, True, lv | rv)
+            valid = (l.validity & r.validity) | known
+        return ColumnVal(value, valid, T.BOOL)
+
+    def _compare(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        if l.dtype.is_string_like or r.dtype.is_string_like:
+            return self._compare_strings(op, l, r)
+        valid = l.validity & r.validity
+        if l.dtype.kind == T.TypeKind.DECIMAL or r.dtype.kind == T.TypeKind.DECIMAL:
+            lv, rv, fallback = self._align_decimals(l, r)
+            res = _cmp_apply(op, lv, rv)
+            if fallback is not None:
+                res = jnp.where(fallback[0], _cmp_apply(op, fallback[1], fallback[2]), res)
+            return ColumnVal(res, valid, T.BOOL)
+        common = ir.numeric_common_type(l.dtype, r.dtype) if l.dtype != r.dtype else l.dtype
+        lc = self._cast(l, common)
+        rc = self._cast(r, common)
+        return ColumnVal(_cmp_apply(op, lc.values, rc.values), valid, T.BOOL)
+
+    def _align_decimals(self, l: ColumnVal, r: ColumnVal):
+        ld = l if l.dtype.kind == T.TypeKind.DECIMAL else self._cast(l, ir._as_decimal(l.dtype))
+        rd = r if r.dtype.kind == T.TypeKind.DECIMAL else self._cast(r, ir._as_decimal(r.dtype))
+        s = max(ld.dtype.scale, rd.dtype.scale)
+        lv, lok = D.rescale(ld.values, ld.dtype.scale, s)
+        rv, rok = D.rescale(rd.values, rd.dtype.scale, s)
+        bad = ~(lok & rok)
+        # if aligning overflowed int64 (enormous values), compare as float64
+        lf = ld.values.astype(jnp.float64) * (10.0 ** (-ld.dtype.scale))
+        rf = rd.values.astype(jnp.float64) * (10.0 ** (-rd.dtype.scale))
+        return lv, rv, (bad, lf, rf)
+
+    def _compare_strings(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        assert l.dtype.is_string_like and r.dtype.is_string_like, (l.dtype, r.dtype)
+        lmap, rmap, rank = _unify_two_dicts(l.dict, r.dict)
+        lu = jnp.asarray(lmap)[jnp.clip(l.values, 0, len(lmap) - 1)]
+        ru = jnp.asarray(rmap)[jnp.clip(r.values, 0, len(rmap) - 1)]
+        valid = l.validity & r.validity
+        if op in ("eq", "neq"):
+            res = lu == ru if op == "eq" else lu != ru
+            return ColumnVal(res, valid, T.BOOL)
+        rk = jnp.asarray(rank)
+        return ColumnVal(_cmp_apply(op, rk[lu], rk[ru]), valid, T.BOOL)
+
+    def _arith(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        out = ir.arith_result_type(op, l.dtype, r.dtype)
+        valid = l.validity & r.validity
+        if out.kind == T.TypeKind.DECIMAL:
+            ld = l if l.dtype.kind == T.TypeKind.DECIMAL else self._cast(l, ir._as_decimal(l.dtype))
+            rd = r if r.dtype.kind == T.TypeKind.DECIMAL else self._cast(r, ir._as_decimal(r.dtype))
+            fn = {"add": D.add, "sub": D.sub, "mul": D.mul, "div": D.div, "mod": D.mod}[op]
+            v, ok = fn(
+                ld.values, ld.dtype.scale, rd.values, rd.dtype.scale,
+                out.precision, out.scale,
+            )
+            return ColumnVal(v, valid & ld.validity & rd.validity & ok, out)
+        lc = self._cast(l, out)
+        rc = self._cast(r, out)
+        lv, rv = lc.values, rc.values
+        if op == "add":
+            v = lv + rv
+        elif op == "sub":
+            v = lv - rv
+        elif op == "mul":
+            v = lv * rv
+        elif op == "div":
+            zero = rv == 0
+            if out.is_float:
+                v = lv / jnp.where(zero, 1, rv)
+            else:
+                from jax import lax
+
+                v = lax.div(lv, jnp.where(zero, 1, rv))
+            valid = valid & ~zero
+        elif op == "mod":
+            from jax import lax
+
+            zero = rv == 0
+            safe = jnp.where(zero, 1, rv)
+            if out.is_float:
+                # Java % keeps the dividend's sign
+                v = lv - jnp.trunc(lv / safe) * safe
+            else:
+                v = lax.rem(lv, safe)
+            valid = valid & ~zero
+        else:
+            raise ValueError(op)
+        return ColumnVal(v, valid, out)
+
+    # ---- conditionals ----
+
+    def _case(
+        self, branches: list[tuple[ir.Expr, ir.Expr]], orelse: ir.Expr | None,
+        b: Batch, memo: dict,
+    ) -> ColumnVal:
+        conds = [self._eval(c, b, memo) for c, _ in branches]
+        vals = [self._eval(v, b, memo) for _, v in branches]
+        if orelse is not None:
+            els = self._eval(orelse, b, memo)
+        else:
+            els = _null_like(vals[0], b.capacity)
+        vals = _unify_vals(vals + [els])
+        els = vals[-1]
+        vals = vals[:-1]
+        # NULL condition counts as false; first true branch wins
+        taken = jnp.zeros(b.capacity, bool)
+        out_v = els.values
+        out_m = els.validity
+        for c, v in zip(conds, vals):
+            fire = c.validity & c.values.astype(bool) & ~taken
+            out_v = jnp.where(fire, v.values, out_v)
+            out_m = jnp.where(fire, v.validity, out_m)
+            taken = taken | fire
+        return ColumnVal(out_v, out_m, vals[0].dtype, vals[0].dict)
+
+    def _coalesce(self, args: list[ColumnVal]) -> ColumnVal:
+        args = _unify_vals(args)
+        out_v = args[0].values
+        out_m = args[0].validity
+        for a in args[1:]:
+            take = ~out_m & a.validity
+            out_v = jnp.where(take, a.values, out_v)
+            out_m = out_m | a.validity
+        return ColumnVal(out_v, out_m, args[0].dtype, args[0].dict)
+
+    # ---- membership / pattern ----
+
+    def _in(self, e: ir.In, b: Batch, memo: dict) -> ColumnVal:
+        c = self._eval(e.child, b, memo)
+        has_null_item = any(i is None for i in e.items)
+        if c.dtype.is_string_like:
+            entries = c.dict.to_pylist()
+            member = np.array(
+                [s in set(i for i in e.items if i is not None) for s in entries],
+                dtype=bool,
+            )
+            hit = jnp.asarray(member)[jnp.clip(c.values, 0, len(member) - 1)]
+        else:
+            hit = jnp.zeros(b.capacity, bool)
+            for item in e.items:
+                if item is None:
+                    continue
+                lv = self._literal(ir.lit(item) if not isinstance(item, ir.Literal) else item, b.capacity)
+                hit = hit | jnp.asarray(
+                    self._compare("eq", c, lv).values
+                )
+        if e.negated:
+            value = ~hit
+        else:
+            value = hit
+        # Spark: x IN (...) is NULL if x is NULL, or no match and list has NULL
+        valid = c.validity & ~(jnp.asarray(~hit) & has_null_item)
+        return ColumnVal(value, valid, T.BOOL)
+
+    def _like(self, e: ir.Like, b: Batch, memo: dict) -> ColumnVal:
+        c = self._eval(e.child, b, memo)
+        assert c.dtype.is_string_like, "LIKE requires a string input"
+        rx = _like_to_regex(e.pattern, e.escape)
+        entries = c.dict.to_pylist()
+        match = np.array(
+            [bool(rx.fullmatch(s)) if s is not None else False for s in entries],
+            dtype=bool,
+        )
+        hit = jnp.asarray(match)[jnp.clip(c.values, 0, len(match) - 1)]
+        return ColumnVal(~hit if e.negated else hit, c.validity, T.BOOL)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def eval_exprs(batch: Batch, exprs: list[ir.Expr]) -> list[ColumnVal]:
+    return Evaluator(batch.schema).evaluate(batch, exprs)
+
+
+def _cmp_apply(op: str, l: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
+    if op == "eq":
+        return l == r
+    if op == "neq":
+        return l != r
+    if op == "lt":
+        return l < r
+    if op == "lteq":
+        return l <= r
+    if op == "gt":
+        return l > r
+    if op == "gteq":
+        return l >= r
+    raise ValueError(op)
+
+
+def _single_dict(dtype: T.DataType, value) -> pa.Array:
+    if dtype.kind == T.TypeKind.BINARY:
+        return pa.array([value if value is not None else b""], type=pa.binary())
+    return pa.array([value if value is not None else ""], type=pa.string())
+
+
+def _null_like(proto: ColumnVal, cap: int) -> ColumnVal:
+    return ColumnVal(
+        jnp.zeros(cap, proto.values.dtype), jnp.zeros(cap, bool), proto.dtype, proto.dict
+    )
+
+
+def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
+    """Make CASE/COALESCE branch values physically mergeable (same dtype, and
+    for strings, the same dictionary)."""
+    if any(v.dtype.is_dict_encoded for v in vals):
+        assert all(v.dtype.is_dict_encoded for v in vals), "mixed string/non-string branches"
+        vocab: dict = {}
+        remaps = []
+        for v in vals:
+            pl = v.dict.to_pylist()
+            r = np.empty(len(pl), dtype=np.int32)
+            for i, s in enumerate(pl):
+                r[i] = vocab.setdefault(s, len(vocab))
+            remaps.append(r)
+        unified = pa.array(list(vocab.keys()) or [""], type=pa.string())
+        out = []
+        for v, r in zip(vals, remaps):
+            codes = jnp.asarray(r)[jnp.clip(v.values, 0, len(r) - 1)]
+            out.append(ColumnVal(codes, v.validity, vals[0].dtype, unified))
+        return out
+    target = vals[0].dtype
+    for v in vals[1:]:
+        if v.dtype != target:
+            target = ir.numeric_common_type(target, v.dtype)
+    ev = Evaluator(T.Schema())  # casts don't need the schema
+    return [ev._cast(v, target) for v in vals]
+
+
+def _unify_two_dicts(ld: pa.Array, rd: pa.Array):
+    """Returns (lmap, rmap, rank): per-code unified ids and ordering ranks."""
+    vocab: dict = {}
+    maps = []
+    for d in (ld, rd):
+        pl = d.to_pylist()
+        m = np.empty(len(pl), dtype=np.int32)
+        for i, s in enumerate(pl):
+            m[i] = vocab.setdefault(s, len(vocab))
+        maps.append(m)
+    keys = list(vocab.keys())
+    order = np.argsort(np.array(keys, dtype=object), kind="stable")
+    rank = np.empty(len(keys), dtype=np.int32)
+    rank[order] = np.arange(len(keys), dtype=np.int32)
+    return maps[0], maps[1], rank
+
+
+def _like_to_regex(pattern: str, escape: str) -> "re.Pattern":
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)

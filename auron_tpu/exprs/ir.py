@@ -1,0 +1,331 @@
+"""Physical expression IR.
+
+The in-memory form of ``PhysicalExprNode`` (see proto/plan.proto): a small
+tree of frozen dataclasses the planner builds from the protobuf plan and the
+evaluator lowers onto jnp ops. Mirrors the expression surface of the
+reference planner (auron-planner/src/planner.rs expression match +
+datafusion-ext-exprs), redesigned so every node is structurally hashable —
+node identity drives common-subexpression caching in the evaluator (analog
+of the reference's CachedExprsEvaluator,
+datafusion-ext-plans/src/common/cached_exprs_evaluator.rs).
+
+Type inference lives here (``dtype_of``): Spark result-type rules for
+arithmetic (incl. decimal precision/scale propagation capped at 38),
+comparisons, and conditionals.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from auron_tpu import types as T
+
+# ---------------------------------------------------------------------------
+# nodes
+# ---------------------------------------------------------------------------
+
+
+class Expr:
+    """Base class; subclasses are frozen dataclasses."""
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        raise NotImplementedError
+
+    def children(self) -> tuple["Expr", ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class Column(Expr):
+    index: int
+    name: str = ""
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return schema[self.index].dtype
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any  # python scalar; str for STRING, int unscaled for DECIMAL
+    dtype: T.DataType
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.dtype
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    child: Expr
+    to: T.DataType
+    try_: bool = False  # TryCast: error -> null even in ANSI mode
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.to
+
+    def children(self):
+        return (self.child,)
+
+
+_CMP_OPS = ("eq", "neq", "lt", "lteq", "gt", "gteq")
+_LOGIC_OPS = ("and", "or")
+_ARITH_OPS = ("add", "sub", "mul", "div", "mod")
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # one of _CMP_OPS, _LOGIC_OPS, _ARITH_OPS
+    left: Expr
+    right: Expr
+
+    def children(self):
+        return (self.left, self.right)
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        if self.op in _CMP_OPS or self.op in _LOGIC_OPS:
+            return T.BOOL
+        lt = self.left.dtype_of(schema)
+        rt = self.right.dtype_of(schema)
+        return arith_result_type(self.op, lt, rt)
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    child: Expr
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    child: Expr
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class IsNotNull(Expr):
+    child: Expr
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class If(Expr):
+    cond: Expr
+    then: Expr
+    orelse: Expr
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.then.dtype_of(schema)
+
+    def children(self):
+        return (self.cond, self.then, self.orelse)
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    """CASE WHEN c1 THEN v1 WHEN c2 THEN v2 ... ELSE e END."""
+
+    branches: tuple[tuple[Expr, Expr], ...]
+    orelse: Expr | None = None
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.branches[0][1].dtype_of(schema)
+
+    def children(self):
+        cs: list[Expr] = []
+        for c, v in self.branches:
+            cs += [c, v]
+        if self.orelse is not None:
+            cs.append(self.orelse)
+        return tuple(cs)
+
+
+@dataclass(frozen=True)
+class In(Expr):
+    child: Expr
+    items: tuple[Any, ...]  # literal values
+    negated: bool = False
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class Coalesce(Expr):
+    args: tuple[Expr, ...]
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.args[0].dtype_of(schema)
+
+    def children(self):
+        return self.args
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    """SQL LIKE with % and _ wildcards; evaluated over the dictionary."""
+
+    child: Expr
+    pattern: str
+    negated: bool = False
+    escape: str = "\\"
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
+class ScalarFunc(Expr):
+    """Named scalar function dispatched through the function registry
+    (analog of datafusion-ext-functions/src/lib.rs:28-100)."""
+
+    name: str
+    args: tuple[Expr, ...]
+    out_dtype: T.DataType | None = None  # override; else registry infers
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        if self.out_dtype is not None:
+            return self.out_dtype
+        from auron_tpu.functions import registry
+
+        return registry.infer_dtype(self.name, [a.dtype_of(schema) for a in self.args])
+
+    def children(self):
+        return self.args
+
+
+# ---------------------------------------------------------------------------
+# Spark arithmetic result-type rules
+# ---------------------------------------------------------------------------
+
+_INT_RANK = {
+    T.TypeKind.INT8: 1,
+    T.TypeKind.INT16: 2,
+    T.TypeKind.INT32: 3,
+    T.TypeKind.INT64: 4,
+}
+
+
+def numeric_common_type(lt: T.DataType, rt: T.DataType) -> T.DataType:
+    """Widest common type for comparisons / non-decimal arithmetic."""
+    if lt == rt:
+        return lt
+    if lt.kind == T.TypeKind.FLOAT64 or rt.kind == T.TypeKind.FLOAT64:
+        return T.FLOAT64
+    if lt.kind == T.TypeKind.FLOAT32 or rt.kind == T.TypeKind.FLOAT32:
+        # int64/decimal with float32 promotes to float64 in Spark
+        other = rt if lt.kind == T.TypeKind.FLOAT32 else lt
+        if other.kind in (T.TypeKind.INT64, T.TypeKind.DECIMAL):
+            return T.FLOAT64
+        return T.FLOAT32
+    if lt.kind == T.TypeKind.DECIMAL or rt.kind == T.TypeKind.DECIMAL:
+        ld = _as_decimal(lt)
+        rd = _as_decimal(rt)
+        scale = max(ld.scale, rd.scale)
+        prec = max(ld.precision - ld.scale, rd.precision - rd.scale) + scale
+        return T.decimal(min(prec, 38), scale)
+    if lt.is_integer and rt.is_integer:
+        return lt if _INT_RANK[lt.kind] >= _INT_RANK[rt.kind] else rt
+    if lt.kind == T.TypeKind.NULL:
+        return rt
+    if rt.kind == T.TypeKind.NULL:
+        return lt
+    if lt.is_string_like or rt.is_string_like:
+        return T.STRING
+    raise TypeError(f"no common type for {lt} and {rt}")
+
+
+def _as_decimal(t: T.DataType) -> T.DataType:
+    if t.kind == T.TypeKind.DECIMAL:
+        return t
+    m = {
+        T.TypeKind.INT8: (3, 0),
+        T.TypeKind.INT16: (5, 0),
+        T.TypeKind.INT32: (10, 0),
+        T.TypeKind.INT64: (20, 0),
+    }
+    p, s = m[t.kind]
+    return T.decimal(p, s)
+
+
+def _bounded(p: int, s: int) -> T.DataType:
+    """Spark DecimalType.bounded + adjustPrecisionScale (non-allowPrecisionLoss
+    simplified): cap precision at 38, reducing scale but keeping >= 6 digits
+    of scale when truncating."""
+    if p <= 38:
+        return T.decimal(p, s)
+    digits = p - s  # integral digits
+    min_scale = min(s, 6)
+    adj_scale = max(38 - digits, min_scale)
+    return T.decimal(38, adj_scale)
+
+
+def arith_result_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
+    if lt.kind == T.TypeKind.DECIMAL or rt.kind == T.TypeKind.DECIMAL:
+        if lt.is_float or rt.is_float:
+            return T.FLOAT64
+        ld, rd = _as_decimal(lt), _as_decimal(rt)
+        p1, s1, p2, s2 = ld.precision, ld.scale, rd.precision, rd.scale
+        if op in ("add", "sub"):
+            s = max(s1, s2)
+            p = max(p1 - s1, p2 - s2) + s + 1
+            return _bounded(p, s)
+        if op == "mul":
+            return _bounded(p1 + p2 + 1, s1 + s2)
+        if op == "div":
+            s = max(6, s1 + p2 + 1)
+            p = p1 - s1 + s2 + s
+            return _bounded(p, s)
+        if op == "mod":
+            return _bounded(min(p1 - s1, p2 - s2) + max(s1, s2), max(s1, s2))
+        raise ValueError(op)
+    if op == "div":
+        # Spark's `/` on integers yields double
+        return T.FLOAT64 if (lt.is_integer and rt.is_integer) else numeric_common_type(lt, rt)
+    return numeric_common_type(lt, rt)
+
+
+# convenience constructors ---------------------------------------------------
+
+
+def col(index: int, name: str = "") -> Column:
+    return Column(index, name)
+
+
+def lit(value: Any, dtype: T.DataType | None = None) -> Literal:
+    if dtype is None:
+        if isinstance(value, bool):
+            dtype = T.BOOL
+        elif isinstance(value, int):
+            dtype = T.INT64 if not (-(2**31) <= value < 2**31) else T.INT32
+        elif isinstance(value, float):
+            dtype = T.FLOAT64
+        elif isinstance(value, str):
+            dtype = T.STRING
+        elif isinstance(value, bytes):
+            dtype = T.BINARY
+        elif value is None:
+            dtype = T.NULL
+        else:
+            raise TypeError(f"cannot infer literal type of {value!r}")
+    return Literal(value, dtype)

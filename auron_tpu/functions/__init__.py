@@ -1,0 +1,1 @@
+from auron_tpu.functions.registry import registry  # noqa: F401

@@ -1,0 +1,248 @@
+"""Expression engine tests: null semantics, decimal math, casts, functions.
+
+Differential where possible (python/pandas oracle), plus Spark-semantics
+edge cases (division by zero -> NULL, Java float->int narrowing, HALF_UP
+decimal rounding, Kleene logic).
+"""
+
+import datetime as dt
+import decimal as pydec
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exprs import eval_exprs
+from auron_tpu.exprs.ir import (
+    BinaryOp, Case, Cast, Coalesce, Column, If, In, IsNull, Like, Literal,
+    Not, ScalarFunc, col, lit,
+)
+
+
+def _run(data, exprs, schema=None):
+    b = Batch.from_pydict(data, schema=schema)
+    outs = eval_exprs(b, exprs)
+    n = b.num_rows()
+    res = []
+    for o in outs:
+        vals = np.asarray(o.values)[:n]
+        mask = np.asarray(o.validity)[:n]
+        if o.dtype.is_dict_encoded:
+            d = o.dict.to_pylist()
+            res.append([d[v] if m else None for v, m in zip(vals, mask)])
+        elif o.dtype.kind == T.TypeKind.DECIMAL:
+            res.append(
+                [
+                    pydec.Decimal(int(v)).scaleb(-o.dtype.scale) if m else None
+                    for v, m in zip(vals.tolist(), mask)
+                ]
+            )
+        else:
+            res.append([v if m else None for v, m in zip(vals.tolist(), mask)])
+    return res
+
+
+def test_arithmetic_nulls():
+    data = {"a": pa.array([1, None, 3], type=pa.int64()),
+            "b": pa.array([10, 20, None], type=pa.int64())}
+    (add,), (mul,) = (
+        _run(data, [BinaryOp("add", col(0), col(1))]),
+        _run(data, [BinaryOp("mul", col(0), col(1))]),
+    )
+    assert add == [11, None, None]
+    assert mul == [10, None, None]
+
+
+def test_int_div_is_double_and_div_by_zero_null():
+    data = {"a": pa.array([7, 1], type=pa.int32()),
+            "b": pa.array([2, 0], type=pa.int32())}
+    (r,) = _run(data, [BinaryOp("div", col(0), col(1))])
+    assert r[0] == pytest.approx(3.5)
+    assert r[1] is None  # Spark: x / 0 -> NULL
+    (m,) = _run(data, [BinaryOp("mod", col(0), col(1))])
+    assert m == [1, None]
+
+
+def test_mod_sign_follows_dividend():
+    data = {"a": pa.array([-7, 7], type=pa.int64()),
+            "b": pa.array([3, -3], type=pa.int64())}
+    (m,) = _run(data, [BinaryOp("mod", col(0), col(1))])
+    assert m == [-1, 1]  # Java % semantics
+
+
+def test_decimal_arith():
+    data = {
+        "a": pa.array([pydec.Decimal("12.34"), pydec.Decimal("-0.05"), None],
+                      type=pa.decimal128(10, 2)),
+        "b": pa.array([pydec.Decimal("1.5"), pydec.Decimal("2.5"), pydec.Decimal("1")],
+                      type=pa.decimal128(10, 1)),
+    }
+    (add,), (mul,), (div,) = (
+        _run(data, [BinaryOp("add", col(0), col(1))]),
+        _run(data, [BinaryOp("mul", col(0), col(1))]),
+        _run(data, [BinaryOp("div", col(0), col(1))]),
+    )
+    assert add == [pydec.Decimal("13.84"), pydec.Decimal("2.45"), None]
+    assert mul == [pydec.Decimal("18.510"), pydec.Decimal("-0.125"), None]
+    # div scale: max(6, s1+p2+1) = max(6, 2+10+1) = 13, HALF_UP
+    assert div[0] == pydec.Decimal("8.2266666666667")
+    assert div[1] == pydec.Decimal("-0.02")
+
+
+def test_decimal_overflow_null():
+    t = T.Schema.of(T.Field("a", T.decimal(18, 0)), T.Field("b", T.decimal(18, 0)))
+    data = {"a": [pydec.Decimal(10**17)], "b": [pydec.Decimal(10**17)]}
+    (m,) = _run(data, [BinaryOp("mul", col(0), col(1))], schema=t)
+    assert m == [None]  # 10^34 exceeds decimal64 domain -> NULL
+
+
+def test_three_valued_logic():
+    data = {"a": pa.array([True, True, True, False, False, None, None]),
+            "b": pa.array([True, False, None, False, None, None, True])}
+    (a,), (o,) = (
+        _run(data, [BinaryOp("and", col(0), col(1))]),
+        _run(data, [BinaryOp("or", col(0), col(1))]),
+    )
+    assert a == [True, False, None, False, False, None, None]
+    assert o == [True, True, True, False, None, None, True]
+
+
+def test_comparisons_and_strings():
+    data = {"s": pa.array(["apple", "banana", None, "apple"]),
+            "t": pa.array(["apricot", "banana", "x", None])}
+    (eq,), (lt,) = (
+        _run(data, [BinaryOp("eq", col(0), col(1))]),
+        _run(data, [BinaryOp("lt", col(0), col(1))]),
+    )
+    assert eq == [False, True, None, None]
+    assert lt == [True, False, None, None]
+    (lit_cmp,) = _run(data, [BinaryOp("gteq", col(0), lit("b"))])
+    assert lit_cmp == [False, True, None, False]
+
+
+def test_case_if_coalesce():
+    data = {"x": pa.array([1, 5, None, 10], type=pa.int64())}
+    expr = Case(
+        branches=(
+            (BinaryOp("lt", col(0), lit(3)), lit("small")),
+            (BinaryOp("lt", col(0), lit(7)), lit("mid")),
+        ),
+        orelse=lit("big"),
+    )
+    (r,) = _run(data, [expr])
+    assert r == ["small", "mid", "big", "big"]  # NULL cond -> falls to else
+    (c,) = _run(data, [Coalesce((col(0), lit(-1)))])
+    assert c == [1, 5, -1, 10]
+    (i,) = _run(data, [If(IsNull(col(0)), lit(0), col(0))])
+    assert i == [1, 5, 0, 10]
+
+
+def test_in_and_like():
+    data = {"s": pa.array(["foo", "bar", "baz", None])}
+    (r,) = _run(data, [In(col(0), ("foo", "baz"))])
+    assert r == [True, False, True, None]
+    (l,) = _run(data, [Like(col(0), "ba%")])
+    assert l == [False, True, True, None]
+    (l2,) = _run(data, [Like(col(0), "_a_")])
+    assert l2 == [False, True, True, None]
+
+
+def test_cast_int_wrap_and_float_saturate():
+    data = {"x": pa.array([300, -300], type=pa.int64()),
+            "f": pa.array([1e20, float("nan")], type=pa.float64())}
+    (w,) = _run(data, [Cast(col(0), T.INT8)])
+    assert w == [44, -44]  # two's complement wrap like Java
+    (s,) = _run(data, [Cast(col(1), T.INT32)])
+    assert s == [2**31 - 1, 0]  # saturate; NaN -> 0
+    (s64,) = _run(data, [Cast(col(1), T.INT64)])
+    assert s64 == [2**63 - 1, 0]
+
+
+def test_cast_string_to_numeric():
+    data = {"s": pa.array(["123", " 45 ", "1.9", "abc", None])}
+    (i,) = _run(data, [Cast(col(0), T.INT32)])
+    assert i == [123, 45, 1, None, None]
+    (f,) = _run(data, [Cast(col(0), T.FLOAT64)])
+    assert f == [123.0, 45.0, 1.9, None, None]
+    (d,) = _run(data, [Cast(col(0), T.decimal(10, 2))])
+    assert d == [pydec.Decimal("123.00"), pydec.Decimal("45.00"),
+                 pydec.Decimal("1.90"), None, None]
+
+
+def test_cast_date_timestamp():
+    data = {"d": pa.array([18000, 0], type=pa.int32()).cast(pa.date32())}
+    (ts,) = _run(data, [Cast(col(0), T.TIMESTAMP)])
+    assert ts == [18000 * 86_400_000_000, 0]
+    data2 = {"t": pa.array([np.datetime64("2024-03-05T17:30:00", "us")])}
+    (back,) = _run(data2, [Cast(col(0), T.DATE32)])
+    want = (dt.date(2024, 3, 5) - dt.date(1970, 1, 1)).days
+    assert back == [want]
+
+
+def test_date_functions_vs_python():
+    dates = [dt.date(1969, 12, 31), dt.date(1970, 1, 1), dt.date(2000, 2, 29),
+             dt.date(2024, 12, 31), dt.date(1900, 3, 1)]
+    days = [(d - dt.date(1970, 1, 1)).days for d in dates]
+    data = {"d": pa.array(days, type=pa.int32()).cast(pa.date32())}
+    (y,), (m,), (dd,), (doy,), (dow,) = (
+        _run(data, [ScalarFunc("year", (col(0),))]),
+        _run(data, [ScalarFunc("month", (col(0),))]),
+        _run(data, [ScalarFunc("day", (col(0),))]),
+        _run(data, [ScalarFunc("dayofyear", (col(0),))]),
+        _run(data, [ScalarFunc("dayofweek", (col(0),))]),
+    )
+    assert y == [d.year for d in dates]
+    assert m == [d.month for d in dates]
+    assert dd == [d.day for d in dates]
+    assert doy == [d.timetuple().tm_yday for d in dates]
+    assert dow == [(d.isoweekday() % 7) + 1 for d in dates]
+
+
+def test_round_half_up():
+    data = {"f": pa.array([2.5, -2.5, 1.4], type=pa.float64()),
+            "d": pa.array([pydec.Decimal("2.345"), pydec.Decimal("-2.345"),
+                           pydec.Decimal("1.004")], type=pa.decimal128(10, 3))}
+    (rf,) = _run(data, [ScalarFunc("round", (col(0),))])
+    assert rf == [3.0, -3.0, 1.0]  # away from zero, unlike banker's
+    (rd,) = _run(data, [ScalarFunc("round", (col(1), lit(2)))])
+    assert rd == [pydec.Decimal("2.35"), pydec.Decimal("-2.35"), pydec.Decimal("1.00")]
+
+
+def test_string_functions():
+    data = {"s": pa.array(["Hello", "wORLD", None, ""])}
+    (u,), (low,), (ln,), (sub,) = (
+        _run(data, [ScalarFunc("upper", (col(0),))]),
+        _run(data, [ScalarFunc("lower", (col(0),))]),
+        _run(data, [ScalarFunc("length", (col(0),))]),
+        _run(data, [ScalarFunc("substring", (col(0), lit(2), lit(3)))]),
+    )
+    assert u == ["HELLO", "WORLD", None, ""]
+    assert low == ["hello", "world", None, ""]
+    assert ln == [5, 5, None, 0]
+    assert sub == ["ell", "ORL", None, ""]
+    (sw,) = _run(data, [ScalarFunc("starts_with", (col(0), lit("He")))])
+    assert sw == [True, False, None, False]
+
+
+def test_common_subexpression_memo():
+    # same structural subtree evaluated once: verify via evaluation count
+    from auron_tpu.exprs.eval import Evaluator
+
+    data = {"x": pa.array([1.0, 2.0], type=pa.float64())}
+    b = Batch.from_pydict(data)
+    ev = Evaluator(b.schema)
+    sub = BinaryOp("mul", col(0), col(0))
+    e1 = BinaryOp("add", sub, sub)
+    calls = {"n": 0}
+    orig = ev._eval_uncached
+
+    def counting(e, bb, memo):
+        calls["n"] += 1
+        return orig(e, bb, memo)
+
+    ev._eval_uncached = counting
+    ev.evaluate(b, [e1])
+    # nodes: e1, sub (once), col (once) => 3, not 5
+    assert calls["n"] == 3
