@@ -1,0 +1,2 @@
+from auron_tpu.exec.base import ExecOperator, ExecutionContext  # noqa: F401
+from auron_tpu.exec.metrics import MetricNode  # noqa: F401

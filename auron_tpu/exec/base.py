@@ -1,0 +1,135 @@
+"""Operator base classes and per-task execution context.
+
+The reference's operators are DataFusion ExecutionPlans streaming Arrow
+batches through tokio tasks (common/execution_context.rs wraps TaskContext,
+metrics, coalescing, cancellation). The TPU-native analog: operators are
+host-side generators of ``Batch``es — Python orchestrates batch flow while
+all per-row compute happens in jnp/XLA programs on device. Pipelines of
+stateless operators therefore cost one device program per batch, and
+blocking operators (sort/agg/join/shuffle) delimit pipelines exactly where
+the reference inserts coalesce/spill boundaries (SURVEY.md §7).
+
+``ExecutionContext`` carries the task identity (stage/partition), the
+resolved configuration, the metric tree node for the operator, cancellation,
+and the task-scoped resource map (the bridge hands scan providers / shuffle
+readers to operators through it, analog of JniBridge.putResource/
+getResource, JniBridge.java:65-70).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import Batch, bucket_capacity, concat_batches
+from auron_tpu.exec.metrics import MetricNode
+from auron_tpu.utils.config import BATCH_SIZE, Configuration, active_conf
+
+
+class TaskCancelled(Exception):
+    pass
+
+
+@dataclass
+class ExecutionContext:
+    stage_id: int = 0
+    partition_id: int = 0
+    conf: Configuration = field(default_factory=lambda: active_conf().copy())
+    metrics: MetricNode = field(default_factory=lambda: MetricNode("root"))
+    resources: dict = field(default_factory=dict)
+    _cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def check_cancelled(self) -> None:
+        if self._cancelled.is_set():
+            raise TaskCancelled(
+                f"task stage={self.stage_id} partition={self.partition_id} cancelled"
+            )
+
+    def batch_size(self) -> int:
+        return self.conf.get(BATCH_SIZE)
+
+
+class ExecOperator:
+    """Base class. Subclasses set ``schema`` and implement ``_execute``."""
+
+    schema: T.Schema
+    children: list["ExecOperator"]
+
+    def __init__(self, children: list["ExecOperator"], schema: T.Schema):
+        self.children = children
+        self.schema = schema
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        """Stream output batches, maintaining per-operator metrics."""
+        node = ctx.metrics
+        rows = 0
+        for batch in self._execute(partition, ctx):
+            ctx.check_cancelled()
+            n = batch.num_rows()
+            rows += n
+            node.add("output_rows", n)
+            node.add("output_batches", 1)
+            yield batch
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        raise NotImplementedError
+
+    def child_stream(
+        self, i: int, partition: int, ctx: ExecutionContext
+    ) -> Iterator[Batch]:
+        """Execute child i with its own metric child node."""
+        child_ctx = ExecutionContext(
+            stage_id=ctx.stage_id,
+            partition_id=ctx.partition_id,
+            conf=ctx.conf,
+            metrics=ctx.metrics.child(i),
+            resources=ctx.resources,
+            _cancelled=ctx._cancelled,
+        )
+        child_ctx.metrics.name = self.children[i].name
+        return self.children[i].execute(partition, child_ctx)
+
+    # -- conveniences for tests / host consumers --
+
+    def collect(self, partition: int = 0, ctx: ExecutionContext | None = None) -> Batch:
+        ctx = ctx or ExecutionContext()
+        ctx.metrics.name = self.name
+        batches = list(self.execute(partition, ctx))
+        if not batches:
+            return Batch.empty(self.schema)
+        return concat_batches(batches)
+
+    def collect_pydict(self, partition: int = 0) -> dict:
+        return self.collect(partition).to_pydict()
+
+
+def coalesce_stream(
+    stream: Iterable[Batch], target_rows: int, schema: T.Schema
+) -> Iterator[Batch]:
+    """Merge small batches toward target_rows (analog of the reference's
+    output batch coalescing, common/execution_context.rs:146)."""
+    pending: list[Batch] = []
+    pending_rows = 0
+    for b in stream:
+        n = b.num_rows()
+        if n == 0:
+            continue
+        if n >= target_rows and not pending:
+            yield b
+            continue
+        pending.append(b)
+        pending_rows += n
+        if pending_rows >= target_rows:
+            yield concat_batches(pending)
+            pending, pending_rows = [], 0
+    if pending:
+        yield concat_batches(pending)

@@ -1,0 +1,210 @@
+"""Stateless streaming operators.
+
+Analogs of the reference's project/filter/limit/union/expand/rename/empty/
+coalesce/debug execs (datafusion-ext-plans/src/{project_exec,filter_exec,
+limit_exec,union_exec,expand_exec,rename_columns_exec,empty_partitions_exec,
+debug_exec}.rs), redesigned for fixed-shape device batches:
+
+- FilterExec refines the selection mask instead of compacting — a filter is
+  one fused elementwise device program, no gather, no dynamic shapes;
+- ProjectExec evaluates the expression DAG (with common-subexpression
+  caching) into a new batch sharing the input's selection mask;
+- ExpandExec emits one projected batch per projection per input batch
+  (used by ROLLUP/CUBE); LimitExec counts live rows host-side and trims the
+  final batch with a prefix mask.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import jax.numpy as jnp
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import Batch, DeviceBatch
+from auron_tpu.exec.base import ExecOperator, ExecutionContext
+from auron_tpu.exprs import Evaluator, ir
+from auron_tpu.exprs.eval import ColumnVal
+
+
+def batch_from_columns(
+    vals: Sequence[ColumnVal], names: Sequence[str], sel: jnp.ndarray
+) -> Batch:
+    fields = tuple(
+        T.Field(n, v.dtype if v.dtype.kind != T.TypeKind.NULL else T.INT32, True)
+        for n, v in zip(names, vals)
+    )
+    schema = T.Schema(fields)
+    dev = DeviceBatch(
+        sel=sel,
+        values=tuple(v.values for v in vals),
+        validity=tuple(v.validity for v in vals),
+    )
+    return Batch(schema, dev, tuple(v.dict for v in vals))
+
+
+class MemoryScanExec(ExecOperator):
+    """In-memory batch source (the reference tests against TestMemoryExec;
+    also the substrate for FFI readers handing pre-imported batches)."""
+
+    def __init__(self, partitions: list[list[Batch]], schema: T.Schema):
+        super().__init__([], schema)
+        self.partitions = partitions
+
+    @staticmethod
+    def single(batches: list[Batch]) -> "MemoryScanExec":
+        assert batches
+        return MemoryScanExec([batches], batches[0].schema)
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        yield from self.partitions[partition]
+
+
+class ProjectExec(ExecOperator):
+    def __init__(self, child: ExecOperator, exprs: list[ir.Expr], names: list[str]):
+        self.exprs = exprs
+        self.names = names
+        out = []
+        for e, n in zip(exprs, names):
+            dt = e.dtype_of(child.schema)
+            out.append(T.Field(n, dt, True))
+        super().__init__([child], T.Schema(tuple(out)))
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        ev = Evaluator(self.children[0].schema)
+        for b in self.child_stream(0, partition, ctx):
+            with ctx.metrics.timer("elapsed_compute"):
+                vals = ev.evaluate(b, self.exprs)
+                yield batch_from_columns(vals, self.names, b.device.sel)
+
+
+class FilterExec(ExecOperator):
+    def __init__(self, child: ExecOperator, predicates: list[ir.Expr]):
+        super().__init__([child], child.schema)
+        self.predicates = predicates
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        ev = Evaluator(self.children[0].schema)
+        for b in self.child_stream(0, partition, ctx):
+            with ctx.metrics.timer("elapsed_compute"):
+                sel = b.device.sel
+                for p in self.predicates:
+                    cv = ev.evaluate(b, [p])[0]
+                    sel = sel & cv.validity & cv.values.astype(bool)
+                yield b.with_device(
+                    DeviceBatch(sel, b.device.values, b.device.validity)
+                )
+
+
+class LimitExec(ExecOperator):
+    """First `limit` live rows of the partition stream."""
+
+    def __init__(self, child: ExecOperator, limit: int):
+        super().__init__([child], child.schema)
+        self.limit = limit
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        remaining = self.limit
+        for b in self.child_stream(0, partition, ctx):
+            if remaining <= 0:
+                break
+            n = b.num_rows()
+            if n <= remaining:
+                remaining -= n
+                yield b
+            else:
+                sel = b.device.sel
+                # keep only the first `remaining` live rows
+                live_rank = jnp.cumsum(sel.astype(jnp.int32))
+                keep = sel & (live_rank <= remaining)
+                remaining = 0
+                yield b.with_device(
+                    DeviceBatch(keep, b.device.values, b.device.validity)
+                )
+
+
+class UnionExec(ExecOperator):
+    """Concatenates children partition-wise. The planner maps (child, child
+    partition) pairs onto output partitions; in-partition semantics here is
+    stream concatenation (union ALL)."""
+
+    def __init__(self, children: list[ExecOperator]):
+        assert children
+        super().__init__(children, children[0].schema)
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        for i in range(len(self.children)):
+            yield from self.child_stream(i, partition, ctx)
+
+
+class ExpandExec(ExecOperator):
+    """Emit one batch per projection per input batch (ROLLUP/CUBE)."""
+
+    def __init__(
+        self, child: ExecOperator, projections: list[list[ir.Expr]], names: list[str]
+    ):
+        self.projections = projections
+        self.names = names
+        out = tuple(
+            T.Field(n, e.dtype_of(child.schema), True)
+            for n, e in zip(names, projections[0])
+        )
+        super().__init__([child], T.Schema(out))
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        ev = Evaluator(self.children[0].schema)
+        for b in self.child_stream(0, partition, ctx):
+            for proj in self.projections:
+                vals = ev.evaluate(b, proj)
+                yield batch_from_columns(vals, self.names, b.device.sel)
+
+
+class RenameColumnsExec(ExecOperator):
+    def __init__(self, child: ExecOperator, names: list[str]):
+        super().__init__([child], child.schema.rename(names))
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        for b in self.child_stream(0, partition, ctx):
+            yield Batch(self.schema, b.device, b.dicts)
+
+
+class EmptyPartitionsExec(ExecOperator):
+    def __init__(self, schema: T.Schema, num_partitions: int):
+        super().__init__([], schema)
+        self.num_partitions = num_partitions
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        return iter(())
+
+
+class CoalesceBatchesExec(ExecOperator):
+    def __init__(self, child: ExecOperator, target_rows: int | None = None):
+        super().__init__([child], child.schema)
+        self.target_rows = target_rows
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        from auron_tpu.exec.base import coalesce_stream
+
+        target = self.target_rows or ctx.batch_size()
+        yield from coalesce_stream(
+            self.child_stream(0, partition, ctx), target, self.schema
+        )
+
+
+class DebugExec(ExecOperator):
+    """Logs batches flowing through (reference: debug_exec.rs)."""
+
+    def __init__(self, child: ExecOperator, tag: str = "debug"):
+        super().__init__([child], child.schema)
+        self.tag = tag
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        import logging
+
+        log = logging.getLogger("auron_tpu")
+        for i, b in enumerate(self.child_stream(0, partition, ctx)):
+            log.info(
+                "[%s] partition=%d batch=%d rows=%d cap=%d",
+                self.tag, partition, i, b.num_rows(), b.capacity,
+            )
+            yield b

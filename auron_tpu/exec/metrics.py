@@ -1,0 +1,50 @@
+"""Per-operator metric tree.
+
+Analog of the reference's MetricNode mirror between native and JVM
+(native-engine/auron/src/metrics.rs:7-35 pushing into the engine's
+SQLMetric registry, NativeHelper.scala:168-213): every operator owns a node
+with named counters/nanos-timers; the tree mirrors the plan and is harvested
+by the task runtime at finalize and handed to the host-engine bridge.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class MetricNode:
+    def __init__(self, name: str = "", children: list["MetricNode"] | None = None):
+        self.name = name
+        self.values: dict[str, int] = {}
+        self.children: list[MetricNode] = children or []
+
+    def child(self, i: int) -> "MetricNode":
+        while len(self.children) <= i:
+            self.children.append(MetricNode(f"{self.name}.{len(self.children)}"))
+        return self.children[i]
+
+    def add(self, metric: str, value: int) -> None:
+        self.values[metric] = self.values.get(metric, 0) + int(value)
+
+    def set(self, metric: str, value: int) -> None:
+        self.values[metric] = int(value)
+
+    @contextmanager
+    def timer(self, metric: str):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.add(metric, time.perf_counter_ns() - t0)
+
+    def snapshot(self) -> dict:
+        """Flatten to {name: {metric: value}, children: [...]} for the bridge."""
+        return {
+            "name": self.name,
+            "values": dict(self.values),
+            "children": [c.snapshot() for c in self.children],
+        }
+
+    def total(self, metric: str) -> int:
+        return self.values.get(metric, 0) + sum(c.total(metric) for c in self.children)

@@ -1,0 +1,106 @@
+"""Stateless operator tests (project/filter/limit/union/expand/...)."""
+
+import pyarrow as pa
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exec.basic import (
+    CoalesceBatchesExec,
+    EmptyPartitionsExec,
+    ExpandExec,
+    FilterExec,
+    LimitExec,
+    MemoryScanExec,
+    ProjectExec,
+    RenameColumnsExec,
+    UnionExec,
+)
+from auron_tpu.exprs.ir import BinaryOp, Column, ScalarFunc, col, lit
+
+
+def _scan(data: dict, nbatches: int = 1):
+    b = Batch.from_pydict(data)
+    return MemoryScanExec.single([b] * nbatches)
+
+
+def test_project_filter_pipeline():
+    scan = _scan({"x": [1, 2, 3, 4, 5], "y": [10.0, 20.0, 30.0, 40.0, 50.0]})
+    filt = FilterExec(scan, [BinaryOp("gt", col(0), lit(2))])
+    proj = ProjectExec(
+        filt,
+        [BinaryOp("mul", col(0), lit(2)), col(1, "y")],
+        ["x2", "y"],
+    )
+    out = proj.collect_pydict()
+    assert out == {"x2": [6, 8, 10], "y": [30.0, 40.0, 50.0]}
+
+
+def test_filter_keeps_capacity_no_compaction():
+    scan = _scan({"x": list(range(100))})
+    filt = FilterExec(scan, [BinaryOp("lt", col(0), lit(10))])
+    ctx = ExecutionContext()
+    batches = list(filt.execute(0, ctx))
+    assert len(batches) == 1
+    assert batches[0].capacity == 128  # same bucket, mask refined
+    assert batches[0].num_rows() == 10
+
+
+def test_limit_across_batches():
+    scan = _scan({"x": list(range(10))}, nbatches=3)
+    lim = LimitExec(scan, 25)
+    out = lim.collect_pydict()
+    assert len(out["x"]) == 25
+    assert out["x"][:10] == list(range(10))
+
+
+def test_limit_mid_batch():
+    scan = _scan({"x": list(range(10))})
+    lim = LimitExec(scan, 4)
+    assert lim.collect_pydict() == {"x": [0, 1, 2, 3]}
+
+
+def test_union():
+    u = UnionExec([_scan({"x": [1, 2]}), _scan({"x": [3]})])
+    assert u.collect_pydict() == {"x": [1, 2, 3]}
+
+
+def test_expand():
+    scan = _scan({"x": [1, 2]})
+    ex = ExpandExec(
+        scan,
+        [[col(0), lit(0)], [col(0), lit(1)]],
+        ["x", "tag"],
+    )
+    out = ex.collect_pydict()
+    assert out == {"x": [1, 2, 1, 2], "tag": [0, 0, 1, 1]}
+
+
+def test_rename_empty_coalesce():
+    scan = _scan({"x": [1, 2]}, nbatches=4)
+    ren = RenameColumnsExec(scan, ["renamed"])
+    assert list(ren.collect_pydict().keys()) == ["renamed"]
+    e = EmptyPartitionsExec(scan.schema, 3)
+    assert e.collect_pydict() == {"x": []}
+    co = CoalesceBatchesExec(scan, target_rows=8)
+    ctx = ExecutionContext()
+    bs = list(co.execute(0, ctx))
+    assert len(bs) == 1 and bs[0].num_rows() == 8
+
+
+def test_metrics_tree():
+    scan = _scan({"x": [1, 2, 3]})
+    filt = FilterExec(scan, [BinaryOp("gteq", col(0), lit(2))])
+    ctx = ExecutionContext()
+    ctx.metrics.name = filt.name
+    list(filt.execute(0, ctx))
+    snap = ctx.metrics.snapshot()
+    assert snap["values"]["output_rows"] == 2
+    assert snap["children"][0]["values"]["output_rows"] == 3
+    assert snap["children"][0]["name"] == "MemoryScanExec"
+
+
+def test_project_string_function():
+    scan = _scan({"s": ["a", "bb", None]})
+    proj = ProjectExec(scan, [ScalarFunc("upper", (col(0),))], ["u"])
+    assert proj.collect_pydict() == {"u": ["A", "BB", None]}
