@@ -281,6 +281,58 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
     return Batch.from_arrow(rb)
 
 
+def device_concat(batches: Sequence[Batch]) -> Batch:
+    """Concatenate batches on device without an Arrow round-trip.
+
+    Output capacity is the sum of input capacities (dead rows keep sel=0).
+    Dictionary columns are unified host-side (O(total dict size)) and codes
+    remapped with one device gather per batch. This is the blocking-boundary
+    concat used by aggregation/sort/join accumulation.
+    """
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    ncols = len(schema)
+    new_dicts: list[pa.Array | None] = [None] * ncols
+    remapped: dict[int, list[jnp.ndarray]] = {}
+    for ci, f in enumerate(schema):
+        if f.dtype.is_dict_encoded:
+            unified, remaps = unify_dict(batches, ci)
+            new_dicts[ci] = unified
+            remapped[ci] = [
+                jnp.asarray(r)[jnp.clip(b.col_values(ci), 0, len(r) - 1)]
+                for b, r in zip(batches, remaps)
+            ]
+    sel = jnp.concatenate([b.device.sel for b in batches])
+    values = []
+    validity = []
+    for ci in range(ncols):
+        if ci in remapped:
+            values.append(jnp.concatenate(remapped[ci]))
+        else:
+            values.append(jnp.concatenate([b.col_values(ci) for b in batches]))
+        validity.append(jnp.concatenate([b.col_validity(ci) for b in batches]))
+    return Batch(schema, DeviceBatch(sel, tuple(values), tuple(validity)), tuple(new_dicts))
+
+
+def prefix_slice(batch: Batch, new_capacity: int) -> Batch:
+    """Keep only the first new_capacity slots (used to shrink prefix-packed
+    group states back to a small capacity bucket)."""
+    if new_capacity >= batch.capacity:
+        return batch
+    dev = batch.device
+    return Batch(
+        batch.schema,
+        DeviceBatch(
+            dev.sel[:new_capacity],
+            tuple(v[:new_capacity] for v in dev.values),
+            tuple(m[:new_capacity] for m in dev.validity),
+        ),
+        batch.dicts,
+    )
+
+
 def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.ndarray]]:
     """Build a unified dictionary for column `col` across batches.
 

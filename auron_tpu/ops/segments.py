@@ -1,0 +1,172 @@
+"""Device group-by primitives: key normalization, sort-segmentation, reducers.
+
+The reference aggregates through an in-memory hash table with
+cardinality-adaptive switching to sorted merge
+(datafusion-ext-plans/src/agg/agg_table.rs:474-520). Pointer-chasing hash
+tables don't map to the TPU's vector units, so the TPU-native design is
+**sort-segmented grouping**, which is also exact (no hash collisions):
+
+1. each group-key column is normalized to a canonical uint64 word
+   (0 for NULL; a packed null-bits word distinguishes NULL from 0 and makes
+   SQL GROUP BY treat NULLs as equal);
+2. one multi-operand ``lax.sort`` clusters equal keys (dead rows — sel=0 —
+   sort to the end via a leading liveness key);
+3. segment boundaries are adjacent-difference compares; segment ids are a
+   cumsum; every aggregate becomes a ``jax.ops.segment_*`` reduction with a
+   **static** segment count equal to the batch capacity.
+
+Output groups land in a padded batch (one slot per potential group) with a
+validity prefix — shapes stay static for XLA, the dynamic group count only
+matters host-side when slicing results.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from auron_tpu import types as T
+from auron_tpu.exprs.eval import ColumnVal
+
+
+def key_words(vals: list[ColumnVal]) -> list[jnp.ndarray]:
+    """Canonical uint64 equality words for group keys: one word per column
+    plus one packed null-bits word per 64 columns."""
+    words: list[jnp.ndarray] = []
+    null_bits = None
+    for i, cv in enumerate(vals):
+        w = _canonical_word(cv)
+        words.append(jnp.where(cv.validity, w, jnp.uint64(0)))
+        bit = jnp.where(cv.validity, jnp.uint64(0), jnp.uint64(1) << jnp.uint64(i % 64))
+        null_bits = bit if null_bits is None else (null_bits | bit)
+    if null_bits is not None:
+        words.append(null_bits)
+    return words
+
+
+def _canonical_word(cv: ColumnVal) -> jnp.ndarray:
+    dt = cv.dtype
+    v = cv.values
+    if dt.kind == T.TypeKind.BOOL:
+        return v.astype(jnp.uint64)
+    if dt.is_integer or dt.kind in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP, T.TypeKind.DECIMAL):
+        return v.astype(jnp.int64).view(jnp.uint64)
+    if dt.kind == T.TypeKind.FLOAT32:
+        # normalize -0.0 == 0.0 and NaNs equal (Spark group-by semantics)
+        f = v.astype(jnp.float32)
+        f = jnp.where(f == 0, jnp.float32(0), f)
+        f = jnp.where(jnp.isnan(f), jnp.float32(jnp.nan), f)
+        return f.view(jnp.uint32).astype(jnp.uint64)
+    if dt.kind == T.TypeKind.FLOAT64:
+        f = v.astype(jnp.float64)
+        f = jnp.where(f == 0, jnp.float64(0), f)
+        f = jnp.where(jnp.isnan(f), jnp.float64(jnp.nan), f)
+        return f.view(jnp.uint64)
+    if dt.is_dict_encoded:
+        # codes are equality keys within a unified-dictionary context
+        return v.astype(jnp.int64).view(jnp.uint64)
+    raise TypeError(f"ungroupable type {dt}")
+
+
+class Segmentation(NamedTuple):
+    order: jnp.ndarray  # permutation clustering equal keys, dead rows last
+    seg_ids: jnp.ndarray  # per sorted position; dead rows -> cap (overflow bucket)
+    boundary: jnp.ndarray  # bool per sorted position: first of its segment
+    group_of_slot: jnp.ndarray  # sorted position of each group's first row
+    num_groups: jnp.ndarray  # dynamic scalar
+    sel_sorted: jnp.ndarray  # liveness in sorted order
+
+
+def segment_by_keys(words: list[jnp.ndarray], sel: jnp.ndarray) -> Segmentation:
+    cap = sel.shape[0]
+    dead_first_key = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    operands = [dead_first_key, *words, iota]
+    sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1)
+    sel_sorted = sorted_ops[0] == 0
+    sorted_words = sorted_ops[1:-1]
+    order = sorted_ops[-1]
+
+    diff = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for w in sorted_words:
+        diff = diff | jnp.concatenate([jnp.ones(1, bool), w[1:] != w[:-1]])
+    boundary = diff & sel_sorted
+    seg_ids_live = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_ids = jnp.where(sel_sorted, seg_ids_live, cap)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    group_of_slot = jax.ops.segment_min(
+        jnp.arange(cap, dtype=jnp.int32), seg_ids, num_segments=cap + 1
+    )[:cap]
+    return Segmentation(order, seg_ids, boundary, group_of_slot, num_groups, sel_sorted)
+
+
+# ---------------------------------------------------------------------------
+# segment reducers (operate on *sorted* value arrays)
+# ---------------------------------------------------------------------------
+
+
+def _masked(vals: jnp.ndarray, mask: jnp.ndarray, identity) -> jnp.ndarray:
+    return jnp.where(mask, vals, jnp.asarray(identity, dtype=vals.dtype))
+
+
+def seg_sum(vals, valid, seg_ids, cap):
+    s = jax.ops.segment_sum(_masked(vals, valid, 0), seg_ids, num_segments=cap + 1)[:cap]
+    any_valid = jax.ops.segment_max(
+        valid.astype(jnp.int32), seg_ids, num_segments=cap + 1
+    )[:cap].astype(bool)
+    return s, any_valid
+
+
+def seg_count(valid, seg_ids, cap):
+    return jax.ops.segment_sum(
+        valid.astype(jnp.int64), seg_ids, num_segments=cap + 1
+    )[:cap]
+
+
+def seg_min(vals, valid, seg_ids, cap):
+    ident = _max_identity(vals.dtype)
+    m = jax.ops.segment_min(_masked(vals, valid, ident), seg_ids, num_segments=cap + 1)[:cap]
+    any_valid = jax.ops.segment_max(valid.astype(jnp.int32), seg_ids, num_segments=cap + 1)[
+        :cap
+    ].astype(bool)
+    return m, any_valid
+
+
+def seg_max(vals, valid, seg_ids, cap):
+    ident = _min_identity(vals.dtype)
+    m = jax.ops.segment_max(_masked(vals, valid, ident), seg_ids, num_segments=cap + 1)[:cap]
+    any_valid = jax.ops.segment_max(valid.astype(jnp.int32), seg_ids, num_segments=cap + 1)[
+        :cap
+    ].astype(bool)
+    return m, any_valid
+
+
+def seg_first(vals, valid, seg_ids, cap, ignores_null: bool):
+    n = vals.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    eligible = valid if ignores_null else jnp.ones_like(valid)
+    pos_or_inf = jnp.where(eligible, pos, n)
+    first_pos = jax.ops.segment_min(pos_or_inf, seg_ids, num_segments=cap + 1)[:cap]
+    safe = jnp.clip(first_pos, 0, n - 1)
+    fv = vals[safe]
+    fm = valid[safe] & (first_pos < n)
+    return fv, fm
+
+
+def _max_identity(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf
+    if dtype == jnp.bool_:
+        return True
+    return jnp.iinfo(dtype).max
+
+
+def _min_identity(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf
+    if dtype == jnp.bool_:
+        return False
+    return jnp.iinfo(dtype).min

@@ -1,0 +1,217 @@
+"""Hash-aggregate tests, differential against pandas groupby."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.agg_exec import FINAL, PARTIAL, PARTIAL_MERGE, AggExpr, HashAggExec
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exec.basic import MemoryScanExec
+from auron_tpu.exprs.ir import col
+from auron_tpu.utils.config import (
+    PARTIAL_AGG_SKIPPING_MIN_ROWS,
+    PARTIAL_AGG_SKIPPING_RATIO,
+)
+
+
+def _agg_pipeline(batches, groupings, aggs):
+    """partial -> (simulated exchange) -> final, like Spark plans it."""
+    scan = MemoryScanExec.single(batches)
+    partial = HashAggExec(scan, groupings, aggs, PARTIAL)
+    shuffled = MemoryScanExec.single(list(partial.execute(0, ExecutionContext())) or
+                                     [Batch.empty(partial.inter_schema)])
+    final = HashAggExec(shuffled, groupings, aggs, FINAL)
+    return final.collect().to_pandas()
+
+
+def _sorted(df, by):
+    return df.sort_values(by).reset_index(drop=True)
+
+
+def test_sum_count_avg_min_max_basic():
+    data = {
+        "k": ["a", "b", "a", "c", "b", "a"],
+        "v": [1, 2, 3, None, 5, 6],
+    }
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.STRING), T.Field("v", T.INT64))
+    )
+    got = _agg_pipeline(
+        [b],
+        [(col(0), "k")],
+        [
+            (AggExpr("sum", col(1)), "s"),
+            (AggExpr("count", col(1)), "c"),
+            (AggExpr("count_star", None), "cs"),
+            (AggExpr("avg", col(1)), "a"),
+            (AggExpr("min", col(1)), "mn"),
+            (AggExpr("max", col(1)), "mx"),
+        ],
+    )
+    df = pd.DataFrame(data)
+    want = df.groupby("k", dropna=False).agg(
+        s=("v", "sum"), c=("v", "count"), cs=("v", "size"),
+        a=("v", "mean"), mn=("v", "min"), mx=("v", "max"),
+    ).reset_index()
+    got = _sorted(got, "k")
+    want = _sorted(want, "k")
+    assert got["k"].tolist() == want["k"].tolist()
+    # c group has sum NULL (all inputs null), count 0
+    assert got["s"].tolist()[:2] == [10, 7] and pd.isna(got["s"][2])
+    assert got["c"].tolist() == [3, 2, 0]
+    assert got["cs"].tolist() == [3, 2, 1]
+    assert got["a"].tolist()[:2] == [pytest.approx(10 / 3), pytest.approx(3.5)]
+    assert pd.isna(got["a"][2])
+    assert got["mn"].tolist()[:2] == [1, 2]
+    assert got["mx"].tolist()[:2] == [6, 5]
+
+
+def test_multi_batch_multi_key_random_vs_pandas():
+    rng = np.random.default_rng(0)
+    n = 5000
+    k1 = rng.integers(0, 50, n)
+    k2 = rng.choice(["x", "y", "z", "w"], n)
+    v = rng.normal(size=n)
+    vmask = rng.random(n) < 0.1
+    vs = pd.array(v, dtype="Float64")
+    vs[vmask] = pd.NA
+    df = pd.DataFrame({"k1": k1, "k2": k2, "v": vs})
+    batches = []
+    for i in range(0, n, 1000):
+        chunk = df.iloc[i : i + 1000]
+        batches.append(
+            Batch.from_arrow(pa.RecordBatch.from_pandas(chunk, preserve_index=False))
+        )
+    got = _agg_pipeline(
+        batches,
+        [(col(0), "k1"), (col(1), "k2")],
+        [
+            (AggExpr("sum", col(2)), "s"),
+            (AggExpr("count", col(2)), "c"),
+            (AggExpr("min", col(2)), "mn"),
+            (AggExpr("max", col(2)), "mx"),
+        ],
+    )
+    want = (
+        df.groupby(["k1", "k2"], dropna=False)
+        .agg(s=("v", "sum"), c=("v", "count"), mn=("v", "min"), mx=("v", "max"))
+        .reset_index()
+    )
+    got = _sorted(got, ["k1", "k2"])
+    want = _sorted(want, ["k1", "k2"])
+    assert len(got) == len(want)
+    assert got["k1"].tolist() == want["k1"].tolist()
+    assert got["k2"].tolist() == want["k2"].tolist()
+    assert got["c"].tolist() == want["c"].tolist()
+    # pandas sum over all-NA group gives 0.0 with count 0; ours gives NULL
+    for g, w, c in zip(got["s"], want["s"], want["c"]):
+        if c == 0:
+            assert pd.isna(g)
+        else:
+            assert g == pytest.approx(w, rel=1e-9)
+    for colname in ("mn", "mx"):
+        for g, w in zip(got[colname], want[colname]):
+            assert (pd.isna(g) and pd.isna(w)) or g == pytest.approx(w)
+
+
+def test_null_group_key():
+    data = {"k": [1, None, 1, None], "v": [1.0, 2.0, 3.0, 4.0]}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.FLOAT64))
+    )
+    got = _agg_pipeline([b], [(col(0), "k")], [(AggExpr("sum", col(1)), "s")])
+    got = got.sort_values("k", na_position="last").reset_index(drop=True)
+    assert got["s"].tolist() == [4.0, 6.0]
+    assert got["k"][0] == 1 and pd.isna(got["k"][1])
+
+
+def test_global_agg_and_empty_input():
+    b = Batch.from_pydict({"v": [1, 2, 3]},
+                          schema=T.Schema.of(T.Field("v", T.INT64)))
+    got = _agg_pipeline([b], [], [(AggExpr("sum", col(0)), "s"),
+                                  (AggExpr("count", col(0)), "c")])
+    assert got["s"].tolist() == [6] and got["c"].tolist() == [3]
+    # empty input: global agg still yields one row: sum NULL, count 0
+    e = Batch.empty(b.schema)
+    got2 = _agg_pipeline([e], [], [(AggExpr("sum", col(0)), "s"),
+                                   (AggExpr("count", col(0)), "c")])
+    assert len(got2) == 1
+    assert pd.isna(got2["s"][0]) and got2["c"].tolist() == [0]
+
+
+def test_decimal_sum_avg():
+    import decimal as d
+
+    data = {"k": [1, 1, 2], "v": [d.Decimal("1.10"), d.Decimal("2.05"), d.Decimal("-0.50")]}
+    b = Batch.from_pydict(
+        data,
+        schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.decimal(7, 2))),
+    )
+    got = _agg_pipeline([b], [(col(0), "k")],
+                        [(AggExpr("sum", col(1)), "s"), (AggExpr("avg", col(1)), "a")])
+    got = _sorted(got, "k")
+    assert got["s"].tolist() == [d.Decimal("3.15"), d.Decimal("-0.50")]
+    # avg type decimal(11,6)
+    assert got["a"].tolist() == [d.Decimal("1.575000"), d.Decimal("-0.500000")]
+
+
+def test_first_and_first_ignores_null():
+    data = {"k": [1, 1, 2], "v": [None, 5, None]}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.INT64))
+    )
+    got = _agg_pipeline(
+        [b], [(col(0), "k")], [(AggExpr("first_ignores_null", col(1)), "f")]
+    )
+    got = _sorted(got, "k")
+    assert got["f"].tolist()[0] == 5
+    assert pd.isna(got["f"][1])
+
+
+def test_partial_merge_mode():
+    """partial -> partial_merge -> final three-stage plan."""
+    data = {"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.FLOAT64))
+    )
+    scan = MemoryScanExec.single([b])
+    p = HashAggExec(scan, [(col(0), "k")], [(AggExpr("avg", col(1)), "a")], PARTIAL)
+    mid = MemoryScanExec.single(list(p.execute(0, ExecutionContext())))
+    pm = HashAggExec(mid, [(col(0), "k")], [(AggExpr("avg", col(1)), "a")], PARTIAL_MERGE)
+    fin_in = MemoryScanExec.single(list(pm.execute(0, ExecutionContext())))
+    fin = HashAggExec(fin_in, [(col(0), "k")], [(AggExpr("avg", col(1)), "a")], FINAL)
+    got = _sorted(fin.collect().to_pandas(), "k")
+    assert got["a"].tolist() == [pytest.approx(2.0), pytest.approx(2.0)]
+
+
+def test_partial_skipping_still_correct():
+    """High-cardinality keys trigger pass-through partials; final agg must
+    still produce exact results."""
+    from auron_tpu.utils.config import Configuration, conf_scope
+
+    n = 4000
+    rng = np.random.default_rng(1)
+    k = rng.permutation(n)  # all distinct -> ratio 1.0
+    v = rng.integers(0, 100, n)
+    df = pd.DataFrame({"k": k, "v": v})
+    batches = [
+        Batch.from_arrow(
+            pa.RecordBatch.from_pandas(df.iloc[i : i + 500], preserve_index=False)
+        )
+        for i in range(0, n, 500)
+    ]
+    conf = Configuration().set(PARTIAL_AGG_SKIPPING_MIN_ROWS, 1000)
+    scan = MemoryScanExec.single(batches)
+    partial = HashAggExec(scan, [(col(0), "k")], [(AggExpr("sum", col(1)), "s")], PARTIAL)
+    ctx = ExecutionContext(conf=conf)
+    partial_out = list(partial.execute(0, ctx))
+    assert ctx.metrics.values.get("partial_agg_skipped", 0) == 1
+    shuffled = MemoryScanExec.single(partial_out)
+    final = HashAggExec(shuffled, [(col(0), "k")], [(AggExpr("sum", col(1)), "s")], FINAL)
+    got = _sorted(final.collect().to_pandas(), "k")
+    want = _sorted(df.groupby("k").agg(s=("v", "sum")).reset_index(), "k")
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["s"].tolist() == want["s"].tolist()
