@@ -1,0 +1,89 @@
+"""Order-preserving sort-key encoding.
+
+The reference sorts with a key-prefix row format + comparators
+(sort_exec.rs key-prefix compare, ext-commons eq_comparator). The TPU-native
+equivalent encodes every sort key into uint64 words whose *unsigned* order
+equals the SQL order, so a single multi-operand ``lax.sort`` implements any
+(asc/desc, nulls first/last) lexicographic sort:
+
+- signed ints/date/timestamp/decimal: XOR the sign bit;
+- floats: IEEE total-order trick (negative -> ~bits, positive -> bits|sign),
+  which also places NaN above +inf — Spark's NaN-greatest semantics;
+- strings: rank through the (host-)sorted unified dictionary — UTF-8 byte
+  order, matching Spark's unicode-code-point comparisons;
+- descending inverts the word; null placement is a leading 0/1 word per key.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu import types as T
+from auron_tpu.exprs.eval import ColumnVal
+
+
+@dataclass(frozen=True)
+class SortSpec:
+    asc: bool = True
+    nulls_first: bool = True  # Spark default: nulls first for asc, last for desc
+
+
+def orderable_word(cv: ColumnVal) -> jnp.ndarray:
+    """uint64 whose unsigned order == SQL ascending order (nulls excluded)."""
+    dt = cv.dtype
+    v = cv.values
+    sign = jnp.uint64(1) << jnp.uint64(63)
+    if dt.kind == T.TypeKind.BOOL:
+        return v.astype(jnp.uint64)
+    if dt.is_integer or dt.kind in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP, T.TypeKind.DECIMAL):
+        return v.astype(jnp.int64).view(jnp.uint64) ^ sign
+    if dt.kind == T.TypeKind.FLOAT32:
+        b = v.astype(jnp.float32).view(jnp.uint32).astype(jnp.uint64) << jnp.uint64(32)
+        neg = (b & sign) != 0
+        return jnp.where(neg, ~b, b | sign)
+    if dt.kind == T.TypeKind.FLOAT64:
+        b = v.astype(jnp.float64).view(jnp.uint64)
+        neg = (b & sign) != 0
+        return jnp.where(neg, ~b, b | sign)
+    if dt.is_dict_encoded:
+        rank = _dict_rank(cv.dict)
+        return jnp.asarray(rank)[jnp.clip(v, 0, len(rank) - 1)].astype(jnp.uint64)
+    raise TypeError(f"unsortable type {dt}")
+
+
+def _dict_rank(d) -> np.ndarray:
+    entries = d.to_pylist()
+    keyed = [
+        (e.encode("utf-8") if isinstance(e, str) else (e if e is not None else b""))
+        for e in entries
+    ]
+    order = sorted(range(len(keyed)), key=lambda i: keyed[i])
+    rank = np.empty(len(keyed), dtype=np.uint64)
+    for r, i in enumerate(order):
+        rank[i] = r
+    return rank
+
+
+def sort_operands(
+    keys: list[ColumnVal], specs: list[SortSpec]
+) -> list[jnp.ndarray]:
+    """Build the lax.sort key operands: per key a null-placement word then the
+    (direction-adjusted) value word."""
+    ops: list[jnp.ndarray] = []
+    for cv, spec in zip(keys, specs):
+        nf = spec.nulls_first
+        null_word = jnp.where(
+            cv.validity,
+            jnp.uint64(1) if nf else jnp.uint64(0),
+            jnp.uint64(0) if nf else jnp.uint64(1),
+        )
+        w = orderable_word(cv)
+        if not spec.asc:
+            w = ~w
+        w = jnp.where(cv.validity, w, jnp.uint64(0))
+        ops.append(null_word)
+        ops.append(w)
+    return ops
