@@ -1,0 +1,9 @@
+from auron_tpu.exec.shuffle.partitioning import (  # noqa: F401
+    HashPartitioning,
+    Partitioning,
+    RangePartitioning,
+    RoundRobinPartitioning,
+    SinglePartitioning,
+)
+from auron_tpu.exec.shuffle.writer import ShuffleWriterExec  # noqa: F401
+from auron_tpu.exec.shuffle.reader import IpcReaderExec  # noqa: F401

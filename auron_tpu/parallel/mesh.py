@@ -1,0 +1,46 @@
+"""Device-mesh context for distributed execution.
+
+The reference's "distributed communication backend" is Spark's netty
+shuffle + RSS push shuffle (SURVEY.md §2.3). The TPU-native equivalent
+scales inside a pod slice via XLA collectives over ICI — repartitioning is
+an ``all_to_all``, broadcast is replication — and across slices/hosts via
+DCN with the same collective API (jax.distributed multi-process: each host
+drives its local devices, the Mesh spans all of them).
+
+Axis convention: one mesh axis ``"p"`` enumerates *partition executors* —
+the unit that corresponds to a Spark task slot. Data parallelism over
+partitions IS the engine's parallelism model (NativeRDD one-runtime-per-
+partition, SURVEY §2.3), so a 1-D mesh is the faithful layout; the design
+leaves room for a second ``"intra"`` axis to split a single partition's
+batch across chips (the analog of intra-task tokio threads).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PARTITION_AXIS = "p"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    assert len(devs) >= n, f"need {n} devices, have {len(devs)}"
+    return Mesh(np.array(devs[:n]), (PARTITION_AXIS,))
+
+
+def shard_spec() -> P:
+    return P(PARTITION_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_rows(mesh: Mesh, arr):
+    """Place a [P, ...] stacked array with leading axis sharded over p."""
+    return jax.device_put(arr, NamedSharding(mesh, P(PARTITION_AXIS)))

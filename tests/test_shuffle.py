@@ -1,0 +1,136 @@
+"""Shuffle write/read round-trip tests."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exec.basic import MemoryScanExec
+from auron_tpu.exec.shuffle import (
+    HashPartitioning,
+    IpcReaderExec,
+    RangePartitioning,
+    RoundRobinPartitioning,
+    ShuffleWriterExec,
+    SinglePartitioning,
+)
+from auron_tpu.exec.shuffle.partitioning import make_range_bounds
+from auron_tpu.exec.shuffle.reader import LocalFileBlockProvider, MultiMapBlockProvider
+from auron_tpu.exprs.ir import col
+from auron_tpu.ops.sortkeys import SortSpec
+
+
+def _write(tmp_path, batches, partitioning, map_id=0):
+    scan = MemoryScanExec.single(batches)
+    data = str(tmp_path / f"map{map_id}.data")
+    index = str(tmp_path / f"map{map_id}.index")
+    w = ShuffleWriterExec(scan, partitioning, data, index)
+    ctx = ExecutionContext(partition_id=map_id)
+    assert list(w.execute(0, ctx)) == []
+    return data, index
+
+
+def _read_all(schema, provider, n_partitions):
+    out = {}
+    for p in range(n_partitions):
+        r = IpcReaderExec(schema, "blocks")
+        ctx = ExecutionContext()
+        ctx.resources["blocks"] = provider
+        parts = [b.to_pandas() for b in r.execute(p, ctx)]
+        out[p] = pd.concat(parts).reset_index(drop=True) if parts else pd.DataFrame()
+    return out
+
+
+def test_hash_partitioning_roundtrip(tmp_path):
+    df = pd.DataFrame({"k": np.arange(1000) % 37, "v": np.arange(1000.0)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    part = HashPartitioning([col(0)], 4)
+    data, index = _write(tmp_path, [b], part)
+    out = _read_all(b.schema, LocalFileBlockProvider(data, index), 4)
+    # all rows preserved
+    total = pd.concat(out.values())
+    assert len(total) == 1000
+    assert sorted(total["v"].tolist()) == sorted(df["v"].tolist())
+    # co-location: every key appears in exactly one partition
+    seen = {}
+    for p, d in out.items():
+        for k in set(d["k"].tolist()):
+            assert k not in seen, f"key {k} in partitions {seen[k]} and {p}"
+            seen[k] = p
+    # bit-exactness: partition of k must equal pmod(murmur3(k))
+    from auron_tpu.ops.hash_dispatch import hash_batch
+    from auron_tpu.ops.hashing import pmod
+
+    kb = Batch.from_pydict({"k": list(seen.keys())},
+                           schema=T.Schema.of(T.Field("k", T.INT64)))
+    expected_pids = np.asarray(pmod(hash_batch(kb, [0], "murmur3"), 4))[: len(seen)]
+    for (k, p), ep in zip(seen.items(), expected_pids):
+        assert p == ep
+
+
+def test_round_robin_and_single(tmp_path):
+    df = pd.DataFrame({"x": np.arange(10)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    data, index = _write(tmp_path, [b], RoundRobinPartitioning(3))
+    out = _read_all(b.schema, LocalFileBlockProvider(data, index), 3)
+    sizes = sorted(len(d) for d in out.values())
+    assert sum(sizes) == 10 and max(sizes) - min(sizes) <= 1
+    data2, index2 = _write(tmp_path, [b], SinglePartitioning(), map_id=1)
+    out2 = _read_all(b.schema, LocalFileBlockProvider(data2, index2), 1)
+    assert len(out2[0]) == 10
+
+
+def test_range_partitioning(tmp_path):
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({"x": rng.integers(0, 1000, 500)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    specs = [SortSpec()]
+    bounds = make_range_bounds(b, [col(0)], specs, 4)
+    part = RangePartitioning([col(0)], specs, 4, bounds)
+    data, index = _write(tmp_path, [b], part)
+    out = _read_all(b.schema, LocalFileBlockProvider(data, index), 4)
+    total = pd.concat(out.values())
+    assert len(total) == 500
+    # ranges are disjoint and ordered
+    for p in range(3):
+        if len(out[p]) and len(out[p + 1]):
+            assert out[p]["x"].max() <= out[p + 1]["x"].min()
+
+
+def test_multi_map_exchange_with_strings(tmp_path):
+    dfs = [
+        pd.DataFrame({"k": ["a", "b", "c", "a"], "v": [1, 2, 3, 4]}),
+        pd.DataFrame({"k": ["b", "c", "d"], "v": [5, 6, 7]}),
+    ]
+    pairs = []
+    part = HashPartitioning([col(0)], 3)
+    schema = None
+    for mid, df in enumerate(dfs):
+        b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+        schema = b.schema
+        pairs.append(_write(tmp_path, [b], part, map_id=mid))
+    out = _read_all(schema, MultiMapBlockProvider(pairs), 3)
+    total = pd.concat(out.values())
+    assert len(total) == 7
+    assert sorted(total["v"].tolist()) == [1, 2, 3, 4, 5, 6, 7]
+    # same key from different maps lands in the same partition
+    where = {}
+    for p, d in out.items():
+        if len(d) == 0:
+            continue
+        for k in set(d["k"]):
+            where.setdefault(k, set()).add(p)
+    assert all(len(v) == 1 for v in where.values())
+
+
+def test_empty_partition_regions(tmp_path):
+    df = pd.DataFrame({"k": [5, 5, 5], "v": [1.0, 2.0, 3.0]})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    data, index = _write(tmp_path, [b], HashPartitioning([col(0)], 8))
+    out = _read_all(b.schema, LocalFileBlockProvider(data, index), 8)
+    nonempty = [p for p, d in out.items() if len(d)]
+    assert len(nonempty) == 1
+    assert len(out[nonempty[0]]) == 3
