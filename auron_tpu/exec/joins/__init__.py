@@ -1,0 +1,2 @@
+from auron_tpu.exec.joins.smj import SortMergeJoinExec  # noqa: F401
+from auron_tpu.exec.joins.bhj import BroadcastHashJoinExec, ShuffledHashJoinExec  # noqa: F401

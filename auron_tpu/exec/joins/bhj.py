@@ -1,0 +1,82 @@
+"""Broadcast / shuffled hash join execs.
+
+Analogs of the reference's broadcast_join_exec.rs +
+broadcast_join_build_hash_map_exec.rs: the build side (broadcast data or the
+shuffled small side) becomes a sorted-array key map, optionally **cached per
+executor through the task resource map** so many tasks probing the same
+broadcast reuse one build (the reference caches its built hash map the same
+way). PartitionMode BuildLeft/BuildRight decides which child builds.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.base import ExecOperator, ExecutionContext
+from auron_tpu.exec.joins.core import PreparedBuild
+from auron_tpu.exec.joins.driver import EquiJoinDriver
+from auron_tpu.exprs import ir
+
+
+class BroadcastHashJoinExec(ExecOperator):
+    def __init__(
+        self,
+        left: ExecOperator,
+        right: ExecOperator,
+        left_keys: list[ir.Expr],
+        right_keys: list[ir.Expr],
+        join_type: str,
+        build_side: str = "right",
+        condition: ir.Expr | None = None,
+        cached_build_id: str | None = None,
+        exists_col: str = "exists",
+    ):
+        self.driver = EquiJoinDriver(
+            left.schema, right.schema, left_keys, right_keys,
+            join_type, build_side=build_side, condition=condition,
+            exists_col=exists_col,
+        )
+        self.build_side = build_side
+        self.cached_build_id = cached_build_id
+        super().__init__([left, right], self.driver.out_schema)
+
+    def _build(self, partition: int, ctx: ExecutionContext) -> PreparedBuild:
+        build_child = 0 if self.build_side == "left" else 1
+        key = self.cached_build_id
+        if key is not None and key in ctx.resources:
+            cached: PreparedBuild = ctx.resources[key]
+            # fresh matched-flags per task; the map itself is shared
+            import jax.numpy as jnp
+
+            return PreparedBuild(
+                cached.batch, cached.words, cached.n_live,
+                jnp.zeros(cached.batch.capacity, bool),
+            )
+        with ctx.metrics.timer("build_hash_map_time"):
+            batches = list(self.child_stream(build_child, partition, ctx))
+            built = self.driver.prepare(batches)
+        if key is not None:
+            ctx.resources[key] = built
+        return built
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        build = self._build(partition, ctx)
+        probe_child = 1 if self.build_side == "left" else 0
+        for pb in self.child_stream(probe_child, partition, ctx):
+            ctx.check_cancelled()
+            if pb.num_rows() == 0:
+                continue
+            with ctx.metrics.timer("probe_time"):
+                yield from self.driver.probe_batch(build, pb)
+        yield from self.driver.finish(build)
+
+
+class ShuffledHashJoinExec(BroadcastHashJoinExec):
+    """Same machinery, build side fed by a shuffle instead of a broadcast
+    (the reference routes both through the same join core; SMJ fallback for
+    oversized build sides is a planner decision via SMJ_FALLBACK_* confs)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("cached_build_id", None)
+        super().__init__(*args, cached_build_id=None, **kwargs)

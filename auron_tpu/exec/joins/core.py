@@ -1,0 +1,234 @@
+"""Equi-join core shared by sort-merge and hash joins.
+
+Join-type semantics mirror the reference's matrix (Inner/Left/Right/Full/
+LeftSemi/LeftAnti/Existence — auron.proto:508-517, tested by
+datafusion-ext-plans/src/joins/test.rs). The execution strategy is
+TPU-first: the build side becomes a **sorted-array map** (canonical key
+words + one device sort; analog of joins/join_hash_map.rs but
+vector-friendly), probes are batched branchless binary searches
+(ops/binsearch.py), and pair output is a capacity-bucketed *ragged
+expansion*: per-probe match counts -> cumsum offsets -> searchsorted slot
+decoding, emitted in fixed-shape chunks. The only host syncs are one per
+probe batch (total match count) — everything else stays on device.
+
+SQL null semantics: a NULL in any join key never matches (probe rows with
+null keys get count 0); join conditions (non-equi residual predicates)
+filter candidate pairs *before* outer/semi/anti matching is decided, as in
+Spark.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+from jax import lax
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import (
+    Batch,
+    DeviceBatch,
+    bucket_capacity,
+    device_concat,
+)
+from auron_tpu.exec.basic import batch_from_columns
+from auron_tpu.exprs import Evaluator, ir
+from auron_tpu.exprs.eval import ColumnVal
+from auron_tpu.ops import binsearch
+from auron_tpu.ops import segments as S
+
+INNER = "inner"
+LEFT = "left"
+RIGHT = "right"
+FULL = "full"
+LEFT_SEMI = "left_semi"
+LEFT_ANTI = "left_anti"
+EXISTENCE = "existence"
+
+JOIN_TYPES = (INNER, LEFT, RIGHT, FULL, LEFT_SEMI, LEFT_ANTI, EXISTENCE)
+
+_EXPAND_CHUNK = 1 << 16  # pair slots per emitted chunk
+
+
+def join_output_schema(
+    left: T.Schema, right: T.Schema, join_type: str, exists_col: str = "exists"
+) -> T.Schema:
+    if join_type in (LEFT_SEMI, LEFT_ANTI):
+        return left
+    if join_type == EXISTENCE:
+        return T.Schema(tuple(left.fields) + (T.Field(exists_col, T.BOOL, False),))
+    lf = [T.Field(f.name, f.dtype, True) for f in left.fields]
+    rf = [T.Field(f.name, f.dtype, True) for f in right.fields]
+    return T.Schema(tuple(lf + rf))
+
+
+@dataclass
+class PreparedBuild:
+    batch: Batch  # build rows, clustered by key (sorted), dead rows last
+    words: list[jnp.ndarray]  # canonical key words, sorted order
+    n_live: int  # live row count (host)
+    matched: jnp.ndarray  # bool per build row, updated across probe batches
+
+
+def _key_columns(batch: Batch, key_exprs: list[ir.Expr]) -> list[ColumnVal]:
+    return Evaluator(batch.schema).evaluate(batch, key_exprs)
+
+
+def _canon_words(vals: list[ColumnVal]) -> tuple[list[jnp.ndarray], jnp.ndarray]:
+    """Equality words per key + all-keys-valid mask (null keys never join)."""
+    words = []
+    valid = None
+    for cv in vals:
+        w = S._canonical_word(cv)
+        words.append(jnp.where(cv.validity, w, jnp.uint64(0)))
+        valid = cv.validity if valid is None else (valid & cv.validity)
+    return words, valid
+
+
+def unify_key_dicts(
+    build_vals: list[ColumnVal], probe_vals: list[ColumnVal]
+) -> tuple[list[ColumnVal], list[ColumnVal]]:
+    """Remap dict-encoded key pairs onto a joint vocabulary so codes are
+    directly comparable equality words."""
+    out_b, out_p = [], []
+    for bv, pv in zip(build_vals, probe_vals):
+        if not bv.dtype.is_dict_encoded:
+            out_b.append(bv)
+            out_p.append(pv)
+            continue
+        vocab: dict = {}
+        remaps = []
+        for d in (bv.dict, pv.dict):
+            pl = d.to_pylist()
+            m = np.empty(len(pl), dtype=np.int64)
+            for i, s in enumerate(pl):
+                m[i] = vocab.setdefault(s, len(vocab))
+            remaps.append(m)
+        nb = jnp.asarray(remaps[0])[jnp.clip(bv.values, 0, len(remaps[0]) - 1)]
+        np_ = jnp.asarray(remaps[1])[jnp.clip(pv.values, 0, len(remaps[1]) - 1)]
+        joint = pa.array(list(vocab.keys()) or [""], type=pa.string())
+        out_b.append(ColumnVal(nb.astype(jnp.int32), bv.validity, bv.dtype, joint))
+        out_p.append(ColumnVal(np_.astype(jnp.int32), pv.validity, pv.dtype, joint))
+    return out_b, out_p
+
+
+def prepare_build(batches: list[Batch], key_exprs: list[ir.Expr], schema: T.Schema) -> PreparedBuild:
+    if batches:
+        big = device_concat(batches)
+    else:
+        big = Batch.empty(schema)
+    vals = _key_columns(big, key_exprs)
+    words, valid = _canon_words(vals)
+    sel = big.device.sel & (valid if valid is not None else True)
+    cap = big.capacity
+    live_first = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    sorted_ops = lax.sort(tuple([live_first, *words, iota]), num_keys=len(words) + 1)
+    order = sorted_ops[-1]
+    dev = big.device
+    clustered = Batch(
+        big.schema,
+        DeviceBatch(
+            sel=big.device.sel[order],  # keep null-keyed rows live (outer emits them)
+            values=tuple(v[order] for v in dev.values),
+            validity=tuple(m[order] for m in dev.validity),
+        ),
+        big.dicts,
+    )
+    sorted_words = [w for w in sorted_ops[1:-1]]
+    n_live = int(jax.device_get(jnp.sum(sel)))
+    return PreparedBuild(
+        batch=clustered,
+        words=sorted_words,
+        n_live=n_live,
+        matched=jnp.zeros(cap, bool),
+    )
+
+
+def probe_ranges(build: PreparedBuild, probe_words, probe_valid, probe_sel):
+    lo = binsearch.lower_bound(build.words, probe_words, build.n_live)
+    hi = binsearch.upper_bound(build.words, probe_words, build.n_live)
+    ok = probe_sel & (probe_valid if probe_valid is not None else True)
+    counts = jnp.where(ok, hi - lo, 0).astype(jnp.int32)
+    return lo, counts
+
+
+def expand_pairs(
+    probe_batch: Batch,
+    build: PreparedBuild,
+    lo: jnp.ndarray,
+    counts: jnp.ndarray,
+    condition,  # None | (combined_schema, expr, swapped)
+    track_probe_matched: bool,
+) -> tuple[list[tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]], jnp.ndarray, jnp.ndarray]:
+    """Produce per-chunk (probe_idx, build_idx, pair_ok) index triples.
+
+    Returns (chunks, probe_matched, build_matched_delta). Gathering into
+    output batches is the caller's job (it knows the column order).
+    """
+    offsets = jnp.cumsum(counts)
+    total = int(jax.device_get(offsets[-1])) if counts.shape[0] else 0
+    pcap = probe_batch.capacity
+    bcap = build.batch.capacity
+    probe_matched = counts > 0
+    build_matched_delta = jnp.zeros(bcap, bool)
+    chunks = []
+    if total == 0:
+        return chunks, probe_matched & probe_batch.device.sel, build_matched_delta
+
+    starts = offsets - counts
+    for cstart in range(0, total, _EXPAND_CHUNK):
+        ccap = bucket_capacity(min(_EXPAND_CHUNK, total - cstart))
+        t = jnp.arange(ccap, dtype=jnp.int32) + cstart
+        pair_live = t < total
+        li = jnp.searchsorted(offsets, t, side="right").astype(jnp.int32)
+        li = jnp.clip(li, 0, pcap - 1)
+        within = t - starts[li]
+        ri = jnp.clip(lo[li] + within, 0, bcap - 1)
+        ok = pair_live
+        chunks.append((li, ri, ok))
+
+    if condition is not None:
+        comb_schema, expr, assemble = condition
+        new_chunks = []
+        probe_matched = jnp.zeros(pcap, bool)
+        for li, ri, ok in chunks:
+            pair_batch = assemble(probe_batch, build.batch, li, ri, ok)
+            cv = Evaluator(comb_schema).evaluate(pair_batch, [expr])[0]
+            ok2 = ok & cv.validity & cv.values.astype(bool)
+            new_chunks.append((li, ri, ok2))
+            probe_matched = probe_matched.at[li].max(ok2, mode="drop")
+        chunks = new_chunks
+        probe_matched = probe_matched & probe_batch.device.sel
+
+    for li, ri, ok in chunks:
+        build_matched_delta = build_matched_delta.at[ri].max(ok, mode="drop")
+
+    return chunks, probe_matched, build_matched_delta
+
+
+def gather_columns(batch: Batch, idx: jnp.ndarray, row_ok: jnp.ndarray) -> list[ColumnVal]:
+    out = []
+    for i, f in enumerate(batch.schema):
+        v = batch.col_values(i)[idx]
+        m = batch.col_validity(i)[idx] & row_ok
+        out.append(ColumnVal(v, m, f.dtype, batch.dicts[i]))
+    return out
+
+
+def null_columns(schema: T.Schema, cap: int, dicts) -> list[ColumnVal]:
+    out = []
+    for i, f in enumerate(schema):
+        out.append(
+            ColumnVal(
+                jnp.zeros(cap, f.dtype.physical_dtype()),
+                jnp.zeros(cap, bool),
+                f.dtype,
+                dicts[i],
+            )
+        )
+    return out

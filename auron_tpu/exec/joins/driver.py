@@ -1,0 +1,228 @@
+"""Join-type driver shared by SortMergeJoinExec and the hash joins.
+
+Runs one prepared build side against a stream of probe batches, emitting
+pair chunks and the outer/semi/anti/existence completions. The build side
+may be the plan's left or right child (PartitionMode BuildLeft/BuildRight,
+auron.proto:457-461 analog); output columns are always (left ++ right).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import jax.numpy as jnp
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.basic import batch_from_columns
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import ColumnVal
+from auron_tpu.exec.joins import core
+from auron_tpu.exec.joins.core import (
+    EXISTENCE, FULL, INNER, LEFT, LEFT_ANTI, LEFT_SEMI, RIGHT,
+    PreparedBuild, expand_pairs, gather_columns, null_columns, probe_ranges,
+    unify_key_dicts, _canon_words, _key_columns,
+)
+
+
+class EquiJoinDriver:
+    def __init__(
+        self,
+        left_schema: T.Schema,
+        right_schema: T.Schema,
+        left_keys: list[ir.Expr],
+        right_keys: list[ir.Expr],
+        join_type: str,
+        build_side: str,  # "left" | "right"
+        condition: ir.Expr | None = None,
+        exists_col: str = "exists",
+    ):
+        assert join_type in core.JOIN_TYPES
+        assert build_side in ("left", "right")
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.join_type = join_type
+        self.build_side = build_side
+        self.condition = condition
+        self.exists_col = exists_col
+        self.out_schema = core.join_output_schema(
+            left_schema, right_schema, join_type, exists_col
+        )
+        self.probe_is_left = build_side == "right"
+        jt = join_type
+        self.wants_pairs = jt in (INNER, LEFT, RIGHT, FULL)
+        self.probe_outer = (
+            jt == FULL
+            or (jt == LEFT and self.probe_is_left)
+            or (jt == RIGHT and not self.probe_is_left)
+        )
+        self.build_outer = (
+            jt == FULL
+            or (jt == LEFT and not self.probe_is_left)
+            or (jt == RIGHT and self.probe_is_left)
+        )
+        # semi/anti/existence are defined on the LEFT input
+        self.probe_mark = jt in (LEFT_SEMI, LEFT_ANTI, EXISTENCE) and self.probe_is_left
+        self.build_mark = jt in (LEFT_SEMI, LEFT_ANTI, EXISTENCE) and not self.probe_is_left
+
+    # ------------------------------------------------------------------
+
+    def prepare(self, build_batches: list[Batch]) -> PreparedBuild:
+        schema = self.left_schema if self.build_side == "left" else self.right_schema
+        keys = self.left_keys if self.build_side == "left" else self.right_keys
+        return core.prepare_build(build_batches, keys, schema)
+
+    def probe_batch(self, build: PreparedBuild, pb: Batch) -> Iterator[Batch]:
+        """Probe one batch; updates build.matched in place."""
+        probe_keys = self.left_keys if self.probe_is_left else self.right_keys
+        pvals = _key_columns(pb, probe_keys)
+        build_keys = self.left_keys if self.build_side == "left" else self.right_keys
+        bvals = _key_columns(build.batch, build_keys)
+        has_dict_keys = any(v.dtype.is_dict_encoded for v in pvals)
+        if has_dict_keys:
+            bvals, pvals = unify_key_dicts(bvals, pvals)
+            bwords, _ = _canon_words(bvals)
+            build = PreparedBuild(build.batch, bwords, build.n_live, build.matched)
+            # note: build rows are already clustered by their own codes; a
+            # joint vocabulary preserves equality but NOT order, so remap
+            # must keep the original sort order valid -> it does, because
+            # unify_key_dicts maps build codes first (identity order).
+        pwords, pvalid = _canon_words(pvals)
+
+        lo, counts = probe_ranges(build, pwords, pvalid, pb.device.sel)
+
+        condition = None
+        if self.condition is not None:
+            comb = core.join_output_schema(self.left_schema, self.right_schema, INNER)
+            condition = (comb, self.condition, self._assemble_pairs_batch)
+
+        need_pairs = self.wants_pairs or condition is not None
+        if need_pairs:
+            chunks, probe_matched, build_delta = expand_pairs(
+                pb, build, lo, counts, condition, True
+            )
+        else:
+            chunks = []
+            probe_matched = (counts > 0) & pb.device.sel
+            build_delta = self._mark_build_matched(build, lo, counts)
+        build.matched = build.matched | build_delta
+
+        if self.wants_pairs:
+            for li, ri, ok in chunks:
+                yield self._emit_pairs(pb, build.batch, li, ri, ok)
+            if self.probe_outer:
+                unmatched = pb.device.sel & ~probe_matched
+                yield self._emit_probe_extended(pb, unmatched)
+        elif self.probe_mark:
+            if self.join_type == LEFT_SEMI:
+                yield self._emit_probe_only(pb, pb.device.sel & probe_matched)
+            elif self.join_type == LEFT_ANTI:
+                yield self._emit_probe_only(pb, pb.device.sel & ~probe_matched)
+            else:  # existence
+                yield self._emit_probe_exists(pb, probe_matched)
+
+    def finish(self, build: PreparedBuild) -> Iterator[Batch]:
+        bb = build.batch
+        if self.build_outer:
+            unmatched = bb.device.sel & ~build.matched
+            yield self._emit_build_extended(bb, unmatched)
+        elif self.build_mark:
+            if self.join_type == LEFT_SEMI:
+                yield self._emit_build_only(bb, bb.device.sel & build.matched)
+            elif self.join_type == LEFT_ANTI:
+                yield self._emit_build_only(bb, bb.device.sel & ~build.matched)
+            else:  # existence: all build rows + flag
+                cols = [
+                    ColumnVal(bb.col_values(i), bb.col_validity(i), f.dtype, bb.dicts[i])
+                    for i, f in enumerate(bb.schema)
+                ]
+                cols.append(
+                    ColumnVal(build.matched, jnp.ones_like(build.matched), T.BOOL)
+                )
+                yield self._finish_batch(cols, bb.device.sel)
+
+    # ------------------------------------------------------------------
+
+    def _mark_build_matched(self, build: PreparedBuild, lo, counts) -> jnp.ndarray:
+        """Without pair expansion, mark build rows in [lo, lo+count) ranges
+        as matched via a difference array (for build-side semi/anti)."""
+        cap = build.batch.capacity
+        hit = counts > 0
+        starts = jnp.where(hit, lo, cap)
+        stops = jnp.where(hit, lo + counts, cap)
+        diff = jnp.zeros(cap + 1, jnp.int32)
+        diff = diff.at[starts].add(1, mode="drop")
+        diff = diff.at[stops].add(-1, mode="drop")
+        covered = jnp.cumsum(diff[:cap]) > 0
+        return covered
+
+    def _assemble_pairs_batch(self, probe_b, build_b, li, ri, ok) -> Batch:
+        if self.probe_is_left:
+            lcols = gather_columns(probe_b, li, ok)
+            rcols = gather_columns(build_b, ri, ok)
+        else:
+            lcols = gather_columns(build_b, ri, ok)
+            rcols = gather_columns(probe_b, li, ok)
+        comb = core.join_output_schema(self.left_schema, self.right_schema, INNER)
+        out = batch_from_columns(lcols + rcols, comb.names, ok)
+        return Batch(comb, out.device, out.dicts)
+
+    def _emit_pairs(self, probe_b, build_b, li, ri, ok) -> Batch:
+        b = self._assemble_pairs_batch(probe_b, build_b, li, ri, ok)
+        return Batch(self.out_schema, b.device, b.dicts)
+
+    def _emit_probe_extended(self, pb: Batch, sel) -> Batch:
+        probe_cols = [
+            ColumnVal(pb.col_values(i), pb.col_validity(i) & sel, f.dtype, pb.dicts[i])
+            for i, f in enumerate(pb.schema)
+        ]
+        other_schema = self.right_schema if self.probe_is_left else self.left_schema
+        other_dicts = tuple(
+            (core.pa.array([""], type=core.pa.string()) if f.dtype.is_dict_encoded else None)
+            for f in other_schema
+        )
+        nulls = null_columns(other_schema, pb.capacity, other_dicts)
+        cols = probe_cols + nulls if self.probe_is_left else nulls + probe_cols
+        return self._finish_batch(cols, sel)
+
+    def _emit_build_extended(self, bb: Batch, sel) -> Batch:
+        build_cols = [
+            ColumnVal(bb.col_values(i), bb.col_validity(i) & sel, f.dtype, bb.dicts[i])
+            for i, f in enumerate(bb.schema)
+        ]
+        other_schema = self.right_schema if self.build_side == "left" else self.left_schema
+        other_dicts = tuple(
+            (core.pa.array([""], type=core.pa.string()) if f.dtype.is_dict_encoded else None)
+            for f in other_schema
+        )
+        nulls = null_columns(other_schema, bb.capacity, other_dicts)
+        cols = build_cols + nulls if self.build_side == "left" else nulls + build_cols
+        return self._finish_batch(cols, sel)
+
+    def _emit_probe_only(self, pb: Batch, sel) -> Batch:
+        cols = [
+            ColumnVal(pb.col_values(i), pb.col_validity(i), f.dtype, pb.dicts[i])
+            for i, f in enumerate(pb.schema)
+        ]
+        return self._finish_batch(cols, sel)
+
+    def _emit_build_only(self, bb: Batch, sel) -> Batch:
+        cols = [
+            ColumnVal(bb.col_values(i), bb.col_validity(i), f.dtype, bb.dicts[i])
+            for i, f in enumerate(bb.schema)
+        ]
+        return self._finish_batch(cols, sel)
+
+    def _emit_probe_exists(self, pb: Batch, matched) -> Batch:
+        cols = [
+            ColumnVal(pb.col_values(i), pb.col_validity(i), f.dtype, pb.dicts[i])
+            for i, f in enumerate(pb.schema)
+        ]
+        cols.append(ColumnVal(matched, jnp.ones_like(matched), T.BOOL))
+        return self._finish_batch(cols, pb.device.sel)
+
+    def _finish_batch(self, cols: list[ColumnVal], sel) -> Batch:
+        out = batch_from_columns(cols, self.out_schema.names, sel)
+        return Batch(self.out_schema, out.device, out.dicts)

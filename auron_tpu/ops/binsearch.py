@@ -1,0 +1,79 @@
+"""Vectorized lexicographic binary search over multi-word sorted keys.
+
+The join core (exec/joins/) represents equi-join keys as tuples of uint64
+words (same canonical encoding as group-by, ops/segments.py). The build/right
+side is sorted by those words; probing is a branchless fixed-trip binary
+search (ceil(log2(n)) steps) done for every query row in parallel — the
+TPU-native replacement for the reference's row hash map probes
+(datafusion-ext-plans/src/joins/join_hash_map.rs).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _lex_less(a_words: list, a_idx: jnp.ndarray, b_words: list) -> jnp.ndarray:
+    """sorted[a_idx] < query, lexicographically. a_idx: per-query candidate."""
+    lt = jnp.zeros(a_idx.shape, bool)
+    eq = jnp.ones(a_idx.shape, bool)
+    for sw, qw in zip(a_words, b_words):
+        s = sw[a_idx]
+        lt = lt | (eq & (s < qw))
+        eq = eq & (s == qw)
+    return lt
+
+
+def _lex_less_eq(a_words: list, a_idx: jnp.ndarray, b_words: list) -> jnp.ndarray:
+    lt = jnp.zeros(a_idx.shape, bool)
+    eq = jnp.ones(a_idx.shape, bool)
+    for sw, qw in zip(a_words, b_words):
+        s = sw[a_idx]
+        lt = lt | (eq & (s < qw))
+        eq = eq & (s == qw)
+    return lt | eq
+
+
+def lower_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
+    """First index i in [0, n] with sorted[i] >= query (per query row)."""
+    m = query_words[0].shape[0]
+    lo = jnp.zeros(m, jnp.int32)
+    steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
+    hi = jnp.full(m, n, jnp.int32)
+
+    def body(_, state):
+        lo, hi = state
+        active = lo < hi  # fixed-trip loop: freeze once converged
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, max(n - 1, 0))
+        less = _lex_less(sorted_words, midc, query_words)
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def upper_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
+    """First index i in [0, n] with sorted[i] > query (per query row)."""
+    m = query_words[0].shape[0]
+    lo = jnp.zeros(m, jnp.int32)
+    steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
+    hi = jnp.full(m, n, jnp.int32)
+
+    def body(_, state):
+        lo, hi = state
+        active = lo < hi  # fixed-trip loop: freeze once converged
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, max(n - 1, 0))
+        le = _lex_less_eq(sorted_words, midc, query_words)
+        lo = jnp.where(active & le, mid + 1, lo)
+        hi = jnp.where(active & ~le, mid, hi)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, steps, body, (lo, hi))
+    return lo

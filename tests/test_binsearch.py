@@ -1,0 +1,38 @@
+"""Property tests for the multiword branchless binary search."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from auron_tpu.ops.binsearch import lower_bound, upper_bound
+
+
+def test_single_word_vs_numpy():
+    rng = np.random.default_rng(13)
+    for n in (0, 1, 2, 5, 40, 1000):
+        arr = np.sort(rng.integers(0, 50, n).astype(np.uint64))
+        q = rng.integers(-1, 52, 300).astype(np.uint64)
+        lo = np.asarray(lower_bound([jnp.asarray(arr)], [jnp.asarray(q)], n))
+        hi = np.asarray(upper_bound([jnp.asarray(arr)], [jnp.asarray(q)], n))
+        want_lo = np.searchsorted(arr, q, side="left")
+        want_hi = np.searchsorted(arr, q, side="right")
+        assert (lo == want_lo).all(), n
+        assert (hi == want_hi).all(), n
+
+
+def test_multi_word_lexicographic():
+    rng = np.random.default_rng(14)
+    n = 500
+    w1 = rng.integers(0, 8, n).astype(np.uint64)
+    w2 = rng.integers(0, 8, n).astype(np.uint64)
+    order = np.lexsort((w2, w1))
+    w1, w2 = w1[order], w2[order]
+    packed = w1 * 8 + w2
+    q1 = rng.integers(0, 8, 200).astype(np.uint64)
+    q2 = rng.integers(0, 8, 200).astype(np.uint64)
+    qp = q1 * 8 + q2
+    lo = np.asarray(lower_bound([jnp.asarray(w1), jnp.asarray(w2)],
+                                [jnp.asarray(q1), jnp.asarray(q2)], n))
+    hi = np.asarray(upper_bound([jnp.asarray(w1), jnp.asarray(w2)],
+                                [jnp.asarray(q1), jnp.asarray(q2)], n))
+    assert (lo == np.searchsorted(packed, qp, side="left")).all()
+    assert (hi == np.searchsorted(packed, qp, side="right")).all()

@@ -1,0 +1,218 @@
+"""Join matrix tests: {SMJ, BHJ-build-left, BHJ-build-right} x 7 join types,
+differential against pandas merge (the reference tests the same matrix in
+datafusion-ext-plans/src/joins/test.rs)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.basic import MemoryScanExec
+from auron_tpu.exec.joins import BroadcastHashJoinExec, SortMergeJoinExec
+from auron_tpu.exec.joins.core import (
+    EXISTENCE, FULL, INNER, LEFT, LEFT_ANTI, LEFT_SEMI, RIGHT,
+)
+from auron_tpu.exprs.ir import BinaryOp, col, lit
+
+
+def _mk(df, chunk=None):
+    if chunk is None:
+        return MemoryScanExec.single(
+            [Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))]
+        )
+    bs = [
+        Batch.from_arrow(
+            pa.RecordBatch.from_pandas(df.iloc[i : i + chunk], preserve_index=False)
+        )
+        for i in range(0, len(df), chunk)
+    ]
+    return MemoryScanExec.single(bs or [Batch.from_arrow(
+        pa.RecordBatch.from_pandas(df, preserve_index=False))])
+
+
+def _join(kind, ldf, rdf, jt, lkeys, rkeys, condition=None, chunk=None):
+    left = _mk(ldf, chunk)
+    right = _mk(rdf, chunk)
+    lk = [col(i) for i in lkeys]
+    rk = [col(i) for i in rkeys]
+    if kind == "smj":
+        op = SortMergeJoinExec(left, right, lk, rk, jt, condition=condition)
+    elif kind == "bhj_right":
+        op = BroadcastHashJoinExec(left, right, lk, rk, jt, build_side="right",
+                                   condition=condition)
+    else:
+        op = BroadcastHashJoinExec(left, right, lk, rk, jt, build_side="left",
+                                   condition=condition)
+    return op.collect().to_pandas()
+
+
+LDF = pd.DataFrame(
+    {
+        "k": pd.array([1, 2, 2, 3, None, 5], dtype="Int64"),
+        "lv": ["a", "b", "c", "d", "e", "f"],
+    }
+)
+RDF = pd.DataFrame(
+    {
+        "k2": pd.array([2, 2, 3, 4, None], dtype="Int64"),
+        "rv": [20.0, 21.0, 30.0, 40.0, 50.0],
+    }
+)
+
+KINDS = ["smj", "bhj_right", "bhj_left"]
+
+
+def sql_merge(ldf, rdf, how, lk="k", rk="k2"):
+    """pandas merge with SQL NULL semantics (NULL keys never match)."""
+    lnn = ldf[ldf[lk].notna()]
+    rnn = rdf[rdf[rk].notna()]
+    if how == "inner":
+        return lnn.merge(rnn, left_on=lk, right_on=rk, how="inner")
+    if how == "left":
+        return ldf.merge(rnn, left_on=lk, right_on=rk, how="left")
+    if how == "right":
+        return lnn.merge(rdf, left_on=lk, right_on=rk, how="right")
+    if how == "outer":
+        left_part = ldf.merge(rnn, left_on=lk, right_on=rk, how="left", indicator=False)
+        matched_rkeys = set(lnn[lk].dropna()) & set(rnn[rk].dropna())
+        right_unmatched = rdf[~rdf[rk].isin(matched_rkeys) | rdf[rk].isna()]
+        pad = pd.DataFrame({c: [None] * len(right_unmatched) for c in ldf.columns})
+        pad.index = right_unmatched.index
+        right_part = pd.concat([pad, right_unmatched], axis=1)
+        return pd.concat([left_part, right_part], ignore_index=True)
+    raise ValueError(how)
+
+
+def _norm(df, cols):
+    return (
+        df.sort_values(cols, na_position="last")
+        .reset_index(drop=True)
+        .where(lambda d: d.notna(), None)
+    )
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_inner(kind):
+    got = _join(kind, LDF, RDF, INNER, [0], [0])
+    want = sql_merge(LDF, RDF, "inner")
+    got = _norm(got, ["k", "lv", "rv"])
+    want = _norm(want, ["k", "lv", "rv"])
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_left(kind):
+    got = _join(kind, LDF, RDF, LEFT, [0], [0])
+    want = sql_merge(LDF, RDF, "left")
+    got = _norm(got, ["k", "lv", "rv"])
+    want = _norm(want, ["k", "lv", "rv"])
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_right(kind):
+    got = _join(kind, LDF, RDF, RIGHT, [0], [0])
+    want = sql_merge(LDF, RDF, "right")
+    got = _norm(got, ["k2", "rv", "lv"])
+    want = _norm(want, ["k2", "rv", "lv"])
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def _row_multiset(df, cols):
+    from collections import Counter
+
+    rows = []
+    for _, r in df[cols].iterrows():
+        rows.append(
+            tuple(
+                None if pd.isna(v) else (float(v) if isinstance(v, (int, float, np.number)) else v)
+                for v in r
+            )
+        )
+    return Counter(rows)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_full(kind):
+    got = _join(kind, LDF, RDF, FULL, [0], [0])
+    want = sql_merge(LDF, RDF, "outer")
+    cols = ["k", "lv", "k2", "rv"]
+    assert _row_multiset(got, cols) == _row_multiset(want, cols)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_semi_anti_existence(kind):
+    got_semi = _join(kind, LDF, RDF, LEFT_SEMI, [0], [0])
+    # keys present in right: 2, 3 (null never matches)
+    assert sorted(got_semi["lv"].tolist()) == ["b", "c", "d"]
+    got_anti = _join(kind, LDF, RDF, LEFT_ANTI, [0], [0])
+    assert sorted(got_anti["lv"].tolist()) == ["a", "e", "f"]
+    got_ex = _join(kind, LDF, RDF, EXISTENCE, [0], [0])
+    ex = dict(zip(got_ex["lv"], got_ex["exists"]))
+    assert ex == {"a": False, "b": True, "c": True, "d": True, "e": False, "f": False}
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_condition_join(kind):
+    # residual predicate: rv > 20 — pairs failing it do not count as matches
+    cond = BinaryOp("gt", col(3), lit(20.0))
+    got = _join(kind, LDF, RDF, LEFT, [0], [0], condition=cond)
+    want_pairs = LDF.merge(RDF, left_on="k", right_on="k2")
+    want_pairs = want_pairs[want_pairs.rv > 20]
+    matched = set(want_pairs["lv"])
+    n_expected = len(want_pairs) + (len(LDF) - len(set(LDF.lv) & matched))
+    assert len(got) == n_expected
+    # row 'b' (k=2) keeps only the rv=21 pair
+    b_rows = got[got.lv == "b"]
+    assert b_rows["rv"].dropna().tolist() == [21.0]
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_string_keys_multibatch(kind):
+    rng = np.random.default_rng(11)
+    n, m = 500, 300
+    ldf = pd.DataFrame(
+        {
+            "k": rng.choice(["aa", "bb", "cc", "dd", "ee", "zz"], n),
+            "lv": rng.integers(0, 1000, n),
+        }
+    )
+    rdf = pd.DataFrame(
+        {
+            "k2": rng.choice(["bb", "cc", "dd", "qq"], m),
+            "rv": rng.normal(size=m),
+        }
+    )
+    got = _join(kind, ldf, rdf, INNER, [0], [0], chunk=128)
+    want = ldf.merge(rdf, left_on="k", right_on="k2", how="inner")
+    assert len(got) == len(want)
+    gs = got.groupby("k").size().to_dict()
+    ws = want.groupby("k").size().to_dict()
+    assert gs == ws
+    assert got["lv"].sum() == want["lv"].sum()
+    assert got["rv"].sum() == pytest.approx(want["rv"].sum())
+
+
+@pytest.mark.parametrize("kind", ["smj", "bhj_right"])
+def test_multi_key_join(kind):
+    ldf = pd.DataFrame({"a": [1, 1, 2, 2], "b": ["x", "y", "x", "y"], "lv": [1, 2, 3, 4]})
+    rdf = pd.DataFrame({"a2": [1, 2, 2], "b2": ["y", "x", "q"], "rv": [10, 20, 30]})
+    got = _join(kind, ldf, rdf, INNER, [0, 1], [0, 1])
+    want = ldf.merge(rdf, left_on=["a", "b"], right_on=["a2", "b2"])
+    got = _norm(got, ["a", "b"])
+    want = _norm(want, ["a", "b"])
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_empty_sides(kind):
+    empty = LDF.iloc[0:0]
+    got = _join(kind, empty, RDF, LEFT, [0], [0])
+    assert len(got) == 0
+    got2 = _join(kind, LDF, RDF.iloc[0:0], LEFT, [0], [0])
+    assert len(got2) == len(LDF)
+    assert got2["rv"].isna().all()
+    got3 = _join(kind, LDF, RDF.iloc[0:0], INNER, [0], [0])
+    assert len(got3) == 0
