@@ -41,6 +41,8 @@ def lower_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
     """First index i in [0, n] with sorted[i] >= query (per query row)."""
     m = query_words[0].shape[0]
     lo = jnp.zeros(m, jnp.int32)
+    if n == 0:
+        return lo
     steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
     hi = jnp.full(m, n, jnp.int32)
 
@@ -62,6 +64,8 @@ def upper_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
     """First index i in [0, n] with sorted[i] > query (per query row)."""
     m = query_words[0].shape[0]
     lo = jnp.zeros(m, jnp.int32)
+    if n == 0:
+        return lo
     steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
     hi = jnp.full(m, n, jnp.int32)
 
