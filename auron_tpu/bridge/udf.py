@@ -1,0 +1,35 @@
+"""Host-callback (UDF) registry.
+
+The engine-integration analog of the reference's JVM UDF/UDAF/UDTF wrapper
+contexts (auron-core AuronUDFWrapperContext, spark-extension
+SparkUDAFWrapperContext.scala / SparkUDTFWrapperContext.scala): the host
+engine serializes the function, the native side calls back with Arrow
+arrays. Here the callback is a python callable registered per name; the
+Spark bridge would register a py4j/JNI trampoline under the same interface.
+
+Callback contract: fn(args: list[pa.Array], n: int) -> pa.Array of length n.
+Positions correspond 1:1 to batch slots (including dead rows — callbacks
+must tolerate padding values; the engine keeps the selection mask).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import pyarrow as pa
+
+_UDFS: dict[str, Callable] = {}
+
+
+def register_udf(name: str, fn: Callable) -> None:
+    _UDFS[name] = fn
+
+
+def lookup_udf(name: str) -> Callable:
+    if name not in _UDFS:
+        raise KeyError(f"host UDF '{name}' is not registered with the bridge")
+    return _UDFS[name]
+
+
+def udf_names() -> list[str]:
+    return sorted(_UDFS)

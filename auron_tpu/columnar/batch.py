@@ -164,7 +164,18 @@ def _empty_dict(dtype: T.DataType) -> pa.Array:
     """One-entry sentinel dictionary (code 0 must always be decodable)."""
     if dtype.kind == T.TypeKind.BINARY:
         return pa.array([b""], type=pa.binary())
+    if dtype.kind == T.TypeKind.LIST:
+        return pa.array([[]], type=dtype.to_arrow())
     return pa.array([""], type=pa.string())
+
+
+def _vocab_key(v):
+    """Hashable key for arbitrary dictionary values (lists -> tuples)."""
+    if isinstance(v, list):
+        return tuple(_vocab_key(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _vocab_key(x)) for k, x in v.items()))
+    return v
 
 
 def _arrow_to_device(arr: pa.Array, dtype: T.DataType, cap: int):
@@ -180,6 +191,13 @@ def _arrow_to_device(arr: pa.Array, dtype: T.DataType, cap: int):
     vals_np = np.zeros(cap, dtype=phys)
     d: pa.Array | None = None
 
+    if dtype.kind == T.TypeKind.LIST:
+        # nested values ride as identity codes into a per-batch dictionary
+        vals_np[:n] = np.arange(n, dtype=np.int32)
+        d = arr
+        if len(d) == 0:
+            d = _empty_dict(dtype)
+        return jnp.asarray(vals_np), jnp.asarray(mask_np), d
     if dtype.is_dict_encoded:
         if pa.types.is_dictionary(arr.type):
             denc = arr
@@ -242,6 +260,11 @@ def _device_to_arrow(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType,
         assert d is not None
         codes = np.where(mask, vals, 0).astype(np.int32)
         taken = d.take(pa.array(codes, type=pa.int32()))
+        if k == T.TypeKind.LIST:
+            pl = taken.to_pylist()
+            return pa.array(
+                [v if m else None for v, m in zip(pl, mask)], type=dtype.to_arrow()
+            )
         return pc.if_else(pa.array(mask), taken, pa.scalar(None, type=taken.type)).cast(
             dtype.to_arrow()
         )
@@ -342,6 +365,7 @@ def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.nd
     """
     dtype = batches[0].schema[col].dtype
     vocab: dict = {}
+    values: list = []
     remaps: list[np.ndarray] = []
     for b in batches:
         d = b.dicts[col]
@@ -349,10 +373,18 @@ def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.nd
         pylist = d.to_pylist()
         r = np.empty(len(pylist), dtype=np.int32)
         for i, s in enumerate(pylist):
-            code = vocab.setdefault(s, len(vocab))
-            r[i] = code
+            k = _vocab_key(s)
+            if k in vocab:
+                r[i] = vocab[k]
+            else:
+                r[i] = vocab[k] = len(values)
+                values.append(s)
         remaps.append(r)
-    keys = list(vocab.keys())
-    value_type = pa.binary() if dtype.kind == T.TypeKind.BINARY else pa.string()
-    unified = pa.array(keys, type=value_type) if keys else _empty_dict(dtype)
+    if dtype.kind == T.TypeKind.LIST:
+        value_type = dtype.to_arrow()
+    elif dtype.kind == T.TypeKind.BINARY:
+        value_type = pa.binary()
+    else:
+        value_type = pa.string()
+    unified = pa.array(values, type=value_type) if values else _empty_dict(dtype)
     return unified, remaps

@@ -1,0 +1,318 @@
+"""Window exec.
+
+Analog of the reference's window operator (window_exec.rs +
+window/processors/*: RowNumber/Rank/DenseRank/PercentRank/CumeDist/Lead/
+Lag/NthValue + aggregates-over-window, auron.proto:570-595). TPU-native
+strategy: one global (partition-keys, order-keys) device sort, then every
+processor is O(n) vectorized segment arithmetic:
+
+- partition/peer boundaries are adjacent-compare bitmaps;
+- row_number/rank/dense_rank/percent_rank/cume_dist come from global
+  cumsums re-based at segment starts;
+- lead/lag/nth_value are shifted/based gathers guarded by partition bounds;
+- running aggregates (default RANGE UNBOUNDED PRECEDING..CURRENT ROW frame,
+  ties share values) are segment-rebased prefix scans evaluated at peer-group
+  ends; whole-partition aggregates are segment reduces gathered back.
+
+Output preserves the sorted row order (Spark's window also emits
+sorted-by-window order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import (
+    Batch,
+    DeviceBatch,
+    bucket_capacity,
+    device_concat,
+)
+from auron_tpu.exec.base import ExecOperator, ExecutionContext
+from auron_tpu.exec.basic import batch_from_columns
+from auron_tpu.exprs import Evaluator, ir
+from auron_tpu.exprs.eval import ColumnVal
+from auron_tpu.ops import segments as S
+from auron_tpu.ops.sortkeys import SortSpec, sort_operands
+
+RANK_FUNCS = ("row_number", "rank", "dense_rank", "percent_rank", "cume_dist")
+SHIFT_FUNCS = ("lead", "lag", "nth_value")
+AGG_FUNCS = ("sum", "count", "min", "max", "avg")
+
+
+@dataclass(frozen=True)
+class WindowFunc:
+    kind: str  # one of RANK_FUNCS | SHIFT_FUNCS | "agg"
+    agg: str | None = None  # for kind == "agg"
+    expr: ir.Expr | None = None
+    offset: int = 1  # lead/lag distance, nth_value n
+    frame_whole: bool = False  # agg over the whole partition vs running
+
+    def out_dtype(self, in_dtype: T.DataType | None) -> T.DataType:
+        if self.kind in ("row_number", "rank", "dense_rank"):
+            return T.INT32
+        if self.kind in ("percent_rank", "cume_dist"):
+            return T.FLOAT64
+        if self.kind in SHIFT_FUNCS:
+            return in_dtype
+        if self.kind == "agg":
+            from auron_tpu.exec.agg_exec import avg_type, sum_type
+
+            if self.agg == "count":
+                return T.INT64
+            if self.agg == "sum":
+                return sum_type(in_dtype)
+            if self.agg == "avg":
+                return avg_type(in_dtype)
+            return in_dtype
+        raise ValueError(self.kind)
+
+
+class WindowExec(ExecOperator):
+    def __init__(
+        self,
+        child: ExecOperator,
+        partition_by: list[ir.Expr],
+        order_by: list[tuple[ir.Expr, SortSpec]],
+        funcs: list[tuple[WindowFunc, str]],
+    ):
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.funcs = funcs
+        fields = list(child.schema.fields)
+        for wf, name in funcs:
+            in_t = wf.expr.dtype_of(child.schema) if wf.expr is not None else None
+            fields.append(T.Field(name, wf.out_dtype(in_t), True))
+        super().__init__([child], T.Schema(tuple(fields)))
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        batches = list(self.child_stream(0, partition, ctx))
+        if not batches:
+            return
+        big = device_concat(batches)
+        if big.num_rows() == 0:
+            return
+        ev = Evaluator(self.children[0].schema)
+
+        # ---- global sort: (liveness, partition words, order words, iota) ----
+        pvals = ev.evaluate(big, self.partition_by) if self.partition_by else []
+        pwords = S.key_words(pvals) if pvals else []
+        ovals = [ev.evaluate(big, [e])[0] for e, _ in self.order_by]
+        owords = sort_operands(ovals, [s for _, s in self.order_by]) if ovals else []
+        cap = big.capacity
+        live = jnp.where(big.device.sel, jnp.uint64(0), jnp.uint64(1))
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        ops = [live, *pwords, *owords, iota]
+        sorted_ops = lax.sort(tuple(ops), num_keys=len(ops) - 1)
+        order = sorted_ops[-1]
+        sel_sorted = sorted_ops[0] == 0
+        n_pw = len(pwords)
+        pw_sorted = list(sorted_ops[1 : 1 + n_pw])
+        ow_sorted = list(sorted_ops[1 + n_pw : -1])
+
+        # ---- partition & peer boundaries ----
+        first = jnp.zeros(cap, bool).at[0].set(True)
+        part_diff = first
+        for w in pw_sorted:
+            part_diff = part_diff | jnp.concatenate([jnp.ones(1, bool), w[1:] != w[:-1]])
+        part_b = part_diff & sel_sorted
+        peer_diff = part_diff
+        for w in ow_sorted:
+            peer_diff = peer_diff | jnp.concatenate([jnp.ones(1, bool), w[1:] != w[:-1]])
+        peer_b = peer_diff & sel_sorted
+
+        seg_ids = jnp.where(sel_sorted, jnp.cumsum(part_b.astype(jnp.int32)) - 1, cap)
+        seg_start = jax.ops.segment_min(iota, seg_ids, num_segments=cap + 1)[:cap]
+        seg_len = jax.ops.segment_sum(
+            sel_sorted.astype(jnp.int32), seg_ids, num_segments=cap + 1
+        )[:cap]
+        pos = iota - seg_start[jnp.clip(seg_ids, 0, cap - 1)]  # 0-based in partition
+        n_part = seg_len[jnp.clip(seg_ids, 0, cap - 1)]
+
+        peer_ids = jnp.where(sel_sorted, jnp.cumsum(peer_b.astype(jnp.int32)) - 1, cap)
+        peer_start = jax.ops.segment_min(iota, peer_ids, num_segments=cap + 1)[:cap]
+        peer_len = jax.ops.segment_sum(
+            sel_sorted.astype(jnp.int32), peer_ids, num_segments=cap + 1
+        )[:cap]
+        my_peer_start = peer_start[jnp.clip(peer_ids, 0, cap - 1)]
+        my_peer_end = my_peer_start + peer_len[jnp.clip(peer_ids, 0, cap - 1)]  # exclusive
+
+        # ---- assemble output ----
+        dev = big.device
+        cols: list[ColumnVal] = []
+        names: list[str] = []
+        for i, f in enumerate(big.schema):
+            cols.append(
+                ColumnVal(dev.values[i][order], dev.validity[i][order], f.dtype, big.dicts[i])
+            )
+            names.append(f.name)
+
+        for wf, name in self.funcs:
+            cv_in = None
+            if wf.expr is not None:
+                cv0 = ev.evaluate(big, [wf.expr])[0]
+                cv_in = ColumnVal(cv0.values[order], cv0.validity[order] & sel_sorted, cv0.dtype, cv0.dict)
+            cols.append(
+                self._compute(
+                    wf, cv_in, sel_sorted, iota, pos, n_part, seg_ids, seg_start,
+                    my_peer_start, my_peer_end, cap,
+                )
+            )
+            names.append(name)
+
+        out = batch_from_columns(cols, names, sel_sorted)
+        whole = Batch(self.schema, out.device, out.dicts)
+        # chunked emission like sort
+        n = int(jax.device_get(jnp.sum(sel_sorted)))
+        chunk = bucket_capacity(ctx.batch_size())
+        if n <= chunk:
+            yield whole
+            return
+        for start in range(0, n, chunk):
+            stop = min(start + chunk, cap)
+            sl = slice(start, stop)
+            pad = chunk - (stop - start)
+            sel_c = whole.device.sel[sl]
+            vals_c = tuple(v[sl] for v in whole.device.values)
+            mask_c = tuple(m[sl] for m in whole.device.validity)
+            if pad:
+                sel_c = jnp.pad(sel_c, (0, pad))
+                vals_c = tuple(jnp.pad(v, (0, pad)) for v in vals_c)
+                mask_c = tuple(jnp.pad(m, (0, pad)) for m in mask_c)
+            yield Batch(self.schema, DeviceBatch(sel_c, vals_c, mask_c), whole.dicts)
+
+    # ------------------------------------------------------------------
+
+    def _compute(
+        self, wf, cv, sel, iota, pos, n_part, seg_ids, seg_start,
+        peer_start, peer_end, cap,
+    ) -> ColumnVal:
+        ones = jnp.ones(cap, bool)
+        if wf.kind == "row_number":
+            return ColumnVal((pos + 1).astype(jnp.int32), sel, T.INT32)
+        if wf.kind == "rank":
+            my_seg_start = seg_start[jnp.clip(seg_ids, 0, cap - 1)]
+            rank = peer_start - my_seg_start + 1
+            return ColumnVal(rank.astype(jnp.int32), sel, T.INT32)
+        if wf.kind == "dense_rank":
+            # number of peer groups at or before mine, within my partition:
+            # cumsum(peer boundaries) rebased at segment start
+            peer_cum = jnp.cumsum((peer_start == iota).astype(jnp.int32))
+            base = peer_cum[jnp.clip(seg_start[jnp.clip(seg_ids, 0, cap - 1)], 0, cap - 1)]
+            dense = peer_cum - base + 1
+            return ColumnVal(dense.astype(jnp.int32), sel, T.INT32)
+        if wf.kind == "percent_rank":
+            my_seg_start = seg_start[jnp.clip(seg_ids, 0, cap - 1)]
+            rank = (peer_start - my_seg_start).astype(jnp.float64)
+            denom = jnp.maximum(n_part - 1, 1).astype(jnp.float64)
+            v = jnp.where(n_part > 1, rank / denom, 0.0)
+            return ColumnVal(v, sel, T.FLOAT64)
+        if wf.kind == "cume_dist":
+            my_seg_start = seg_start[jnp.clip(seg_ids, 0, cap - 1)]
+            covered = (peer_end - my_seg_start).astype(jnp.float64)
+            return ColumnVal(covered / jnp.maximum(n_part, 1), sel, T.FLOAT64)
+        if wf.kind in ("lead", "lag"):
+            k = wf.offset if wf.kind == "lead" else -wf.offset
+            src = iota + k
+            in_bounds = (pos + k >= 0) & (pos + k < n_part)
+            srcc = jnp.clip(src, 0, cap - 1)
+            v = cv.values[srcc]
+            m = cv.validity[srcc] & in_bounds & sel
+            return ColumnVal(v, m, cv.dtype, cv.dict)
+        if wf.kind == "nth_value":
+            my_seg_start = seg_start[jnp.clip(seg_ids, 0, cap - 1)]
+            src = my_seg_start + (wf.offset - 1)
+            in_bounds = (wf.offset - 1) < n_part
+            # default frame is running: nth value visible only from row n on
+            visible = pos >= (wf.offset - 1)
+            srcc = jnp.clip(src, 0, cap - 1)
+            return ColumnVal(
+                cv.values[srcc], cv.validity[srcc] & in_bounds & visible & sel,
+                cv.dtype, cv.dict,
+            )
+        assert wf.kind == "agg"
+        return self._agg(wf, cv, sel, iota, seg_ids, seg_start, peer_end, cap)
+
+    def _agg(self, wf, cv, sel, iota, seg_ids, seg_start, peer_end, cap) -> ColumnVal:
+        from auron_tpu.exec.agg_exec import avg_type, sum_type
+
+        valid = cv.validity & sel
+        if wf.agg in ("sum", "avg", "count"):
+            in_sum_t = sum_type(cv.dtype) if wf.agg != "count" else None
+            if wf.agg != "count":
+                ev = Evaluator(T.Schema())
+                cvs = ev._cast(cv, in_sum_t)
+                vals = jnp.where(valid, cvs.values, jnp.zeros_like(cvs.values))
+            cnts = valid.astype(jnp.int64)
+            if wf.frame_whole:
+                if wf.agg != "count":
+                    tot = jax.ops.segment_sum(vals, seg_ids, num_segments=cap + 1)[:cap]
+                    svals = tot[jnp.clip(seg_ids, 0, cap - 1)]
+                tot_c = jax.ops.segment_sum(cnts, seg_ids, num_segments=cap + 1)[:cap]
+                scnt = tot_c[jnp.clip(seg_ids, 0, cap - 1)]
+            else:
+                # running prefix to peer-group end, rebased at segment start
+                if wf.agg != "count":
+                    cum = jnp.cumsum(vals)
+                    base = jnp.where(
+                        seg_start[jnp.clip(seg_ids, 0, cap - 1)] > 0,
+                        cum[jnp.clip(seg_start[jnp.clip(seg_ids, 0, cap - 1)] - 1, 0, cap - 1)],
+                        jnp.zeros_like(cum[:1])[0],
+                    )
+                    svals = cum[jnp.clip(peer_end - 1, 0, cap - 1)] - base
+                cumc = jnp.cumsum(cnts)
+                base_c = jnp.where(
+                    seg_start[jnp.clip(seg_ids, 0, cap - 1)] > 0,
+                    cumc[jnp.clip(seg_start[jnp.clip(seg_ids, 0, cap - 1)] - 1, 0, cap - 1)],
+                    jnp.int64(0),
+                )
+                scnt = cumc[jnp.clip(peer_end - 1, 0, cap - 1)] - base_c
+            if wf.agg == "count":
+                return ColumnVal(scnt, sel, T.INT64)
+            any_valid = scnt > 0
+            if wf.agg == "sum":
+                return ColumnVal(svals, any_valid & sel, in_sum_t)
+            at = avg_type(cv.dtype)
+            if at.kind == T.TypeKind.DECIMAL:
+                from auron_tpu.exprs import decimal_math as D
+
+                v, ok = D.div(svals, in_sum_t.scale, scnt, 0, at.precision, at.scale)
+                return ColumnVal(v, any_valid & ok & sel, at)
+            v = svals.astype(jnp.float64) / jnp.where(any_valid, scnt, 1)
+            return ColumnVal(v, any_valid & sel, at)
+
+        # min/max: segmented scan (running) or segment reduce (whole)
+        assert wf.agg in ("min", "max")
+        ident = S._max_identity(cv.values.dtype) if wf.agg == "min" else S._min_identity(cv.values.dtype)
+        masked = jnp.where(valid, cv.values, jnp.asarray(ident, cv.values.dtype))
+        if wf.frame_whole:
+            fn = jax.ops.segment_min if wf.agg == "min" else jax.ops.segment_max
+            red = fn(masked, seg_ids, num_segments=cap + 1)[:cap]
+            v = red[jnp.clip(seg_ids, 0, cap - 1)]
+            anyv = jax.ops.segment_max(valid.astype(jnp.int32), seg_ids, num_segments=cap + 1)[
+                :cap
+            ][jnp.clip(seg_ids, 0, cap - 1)].astype(bool)
+            return ColumnVal(v, anyv & sel, cv.dtype, cv.dict)
+        # segmented running scan with boundary resets
+        boundary = seg_start[jnp.clip(seg_ids, 0, cap - 1)] == iota
+
+        def combine(a, b):
+            ab, av = a
+            bb, bv = b
+            op = jnp.minimum if wf.agg == "min" else jnp.maximum
+            return ab | bb, jnp.where(bb, bv, op(av, bv))
+
+        _, scanned = lax.associative_scan(combine, (boundary, masked))
+        anyv_run = lax.associative_scan(
+            combine, (boundary, valid.astype(jnp.int32) if wf.agg == "max" else -valid.astype(jnp.int32))
+        )[1]
+        anyv = (anyv_run > 0) if wf.agg == "max" else (anyv_run < 0)
+        # ties (peers) must share the frame end value: take value at peer end
+        pe = jnp.clip(peer_end - 1, 0, cap - 1)
+        return ColumnVal(scanned[pe], anyv[pe] & sel, cv.dtype, cv.dict)

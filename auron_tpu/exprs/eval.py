@@ -100,7 +100,28 @@ class Evaluator:
 
             args = [self._eval(a, b, memo) for a in e.args]
             return registry.dispatch(e.name, args, b.capacity)
+        if isinstance(e, ir.HostUDF):
+            return self._host_udf(e, b, memo)
         raise TypeError(f"unsupported expression {type(e).__name__}")
+
+    def _host_udf(self, e: ir.HostUDF, b: Batch, memo: dict) -> ColumnVal:
+        """Materialize args to Arrow, call the bridge callback, re-ingest."""
+        import jax
+
+        from auron_tpu.bridge.udf import lookup_udf
+        from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+
+        args = [self._eval(a, b, memo) for a in e.args]
+        cap = b.capacity
+        host_args = []
+        for cv in args:
+            vals = np.asarray(jax.device_get(cv.values))
+            mask = np.asarray(jax.device_get(cv.validity))
+            host_args.append(_device_to_arrow(vals, mask, cv.dtype, cv.dict))
+        result = lookup_udf(e.name)(host_args, cap)
+        assert len(result) == cap, "host UDF must return one value per slot"
+        v, m, d = _arrow_to_device(result, e.out_dtype, cap)
+        return ColumnVal(v, m, e.out_dtype, d)
 
     # ---- literals ----
 

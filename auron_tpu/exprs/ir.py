@@ -195,6 +195,26 @@ class Like(Expr):
 
 
 @dataclass(frozen=True)
+class HostUDF(Expr):
+    """Host-callback expression: the fallback for functions the device engine
+    cannot evaluate (analog of the reference's JVM-callback UDF wrapper,
+    datafusion-ext-exprs/src/spark_udf_wrapper.rs + SparkUDFWrapperContext).
+    Arguments are materialized to Arrow host-side, the registered callback
+    (bridge/udf.py) returns an Arrow array, and the result re-enters the
+    device pipeline."""
+
+    name: str
+    args: tuple[Expr, ...]
+    out_dtype: T.DataType
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.out_dtype
+
+    def children(self):
+        return self.args
+
+
+@dataclass(frozen=True)
 class ScalarFunc(Expr):
     """Named scalar function dispatched through the function registry
     (analog of datafusion-ext-functions/src/lib.rs:28-100)."""

@@ -41,6 +41,7 @@ class TypeKind(enum.Enum):
     TIMESTAMP = "timestamp"  # microseconds
     STRING = "string"
     BINARY = "binary"
+    LIST = "list"  # dict-encoded on device (codes); dictionary holds lists
 
 
 _INT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)
@@ -54,6 +55,7 @@ class DataType:
     kind: TypeKind
     precision: int = 0  # DECIMAL only
     scale: int = 0  # DECIMAL only
+    inner: tuple = ()  # LIST: (element DataType,)
 
     def __post_init__(self):
         if self.kind == TypeKind.DECIMAL:
@@ -79,7 +81,7 @@ class DataType:
 
     @property
     def is_dict_encoded(self) -> bool:
-        return self.is_string_like
+        return self.is_string_like or self.kind == TypeKind.LIST
 
     # ---- physical mapping ----
     def physical_dtype(self) -> jnp.dtype:
@@ -101,7 +103,7 @@ class DataType:
             return jnp.dtype(jnp.float64)
         if k == TypeKind.DECIMAL:
             return jnp.dtype(jnp.int64)  # scaled decimal64
-        if self.is_string_like:
+        if self.is_dict_encoded:
             return jnp.dtype(jnp.int32)  # dictionary codes
         if k == TypeKind.NULL:
             return jnp.dtype(jnp.int8)
@@ -125,6 +127,8 @@ class DataType:
         }
         if k == TypeKind.DECIMAL:
             return pa.decimal128(self.precision, self.scale)
+        if k == TypeKind.LIST:
+            return pa.list_(self.inner[0].to_arrow())
         return m[k]
 
     @staticmethod
@@ -165,6 +169,8 @@ class DataType:
             return BINARY
         if isinstance(t, pa.DictionaryType):
             return DataType.from_arrow(t.value_type)
+        if pa.types.is_list(t) or pa.types.is_large_list(t):
+            return DataType(TypeKind.LIST, inner=(DataType.from_arrow(t.value_type),))
         raise TypeError(f"unsupported arrow type {t}")
 
     def __repr__(self) -> str:

@@ -1,0 +1,94 @@
+"""Generate (explode/pos_explode/json_tuple) and host-UDF fallback tests."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.basic import MemoryScanExec, ProjectExec
+from auron_tpu.exec.generate_exec import GenerateExec
+from auron_tpu.exprs.ir import HostUDF, col
+from auron_tpu.bridge.udf import register_udf
+
+
+def _scan(rb):
+    return MemoryScanExec.single([Batch.from_arrow(rb)])
+
+
+def test_list_column_roundtrip():
+    rb = pa.record_batch({"l": pa.array([[1, 2], None, [], [3]], type=pa.list_(pa.int64()))})
+    b = Batch.from_arrow(rb)
+    assert b.schema[0].dtype.kind == T.TypeKind.LIST
+    assert b.to_arrow().column("l").to_pylist() == [[1, 2], None, [], [3]]
+
+
+def test_explode():
+    rb = pa.record_batch(
+        {
+            "id": pa.array([1, 2, 3, 4]),
+            "l": pa.array([[10, 20], None, [], [30]], type=pa.list_(pa.int64())),
+        }
+    )
+    g = GenerateExec(_scan(rb), "explode", col(1), required_cols=[0])
+    out = g.collect_pydict()
+    assert out == {"id": [1, 1, 4], "col": [10, 20, 30]}
+
+
+def test_explode_outer_and_pos():
+    rb = pa.record_batch(
+        {
+            "id": pa.array([1, 2, 3]),
+            "l": pa.array([["a", "b"], None, []], type=pa.list_(pa.string())),
+        }
+    )
+    g = GenerateExec(_scan(rb), "pos_explode", col(1), required_cols=[0], outer=True)
+    out = g.collect_pydict()
+    assert out["id"] == [1, 1, 2, 3]
+    assert out["col"] == ["a", "b", None, None]
+    assert out["pos"][:2] == [0, 1]
+
+
+def test_json_tuple():
+    rb = pa.record_batch(
+        {
+            "id": pa.array([1, 2, 3]),
+            "j": pa.array(
+                ['{"a": "x", "b": 2}', '{"a": null}', "not json"]
+            ),
+        }
+    )
+    g = GenerateExec(
+        _scan(rb), "json_tuple", col(1), required_cols=[0], json_fields=["a", "b"]
+    )
+    out = g.collect_pydict()
+    assert out == {"id": [1, 2, 3], "a": ["x", None, None], "b": ["2", None, None]}
+
+
+def test_host_udf_fallback():
+    def my_udf(args, n):
+        a = args[0].to_pylist()
+        return pa.array(
+            [(s.upper() + "!" if s is not None else None) for s in a],
+            type=pa.string(),
+        )
+
+    register_udf("exclaim", my_udf)
+    rb = pa.record_batch({"s": pa.array(["hi", None, "yo"])})
+    p = ProjectExec(
+        _scan(rb), [HostUDF("exclaim", (col(0),), T.STRING)], ["e"]
+    )
+    assert p.collect_pydict() == {"e": ["HI!", None, "YO!"]}
+
+
+def test_host_udf_numeric():
+    def add_mod(args, n):
+        import pyarrow.compute as pc
+
+        return pc.add(args[0], args[1])
+
+    register_udf("add2", add_mod)
+    rb = pa.record_batch({"x": pa.array([1, 2]), "y": pa.array([10, None])})
+    p = ProjectExec(_scan(rb), [HostUDF("add2", (col(0), col(1)), T.INT64)], ["z"])
+    assert p.collect_pydict() == {"z": [11, None]}

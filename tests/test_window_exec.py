@@ -1,0 +1,142 @@
+"""Window exec tests vs pandas oracles."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.basic import MemoryScanExec
+from auron_tpu.exec.window_exec import WindowExec, WindowFunc
+from auron_tpu.exprs.ir import col
+from auron_tpu.ops.sortkeys import SortSpec
+
+
+def _win(df, funcs, chunk=None, part_cols=(0,), order_cols=(1,)):
+    if chunk:
+        bs = [
+            Batch.from_arrow(
+                pa.RecordBatch.from_pandas(df.iloc[i : i + chunk], preserve_index=False)
+            )
+            for i in range(0, len(df), chunk)
+        ]
+    else:
+        bs = [Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))]
+    scan = MemoryScanExec.single(bs)
+    w = WindowExec(
+        scan,
+        [col(i) for i in part_cols],
+        [(col(i), SortSpec()) for i in order_cols],
+        funcs,
+    )
+    return w.collect().to_pandas()
+
+
+def _df(n=200, seed=21):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "g": rng.integers(0, 8, n),
+            "o": rng.permutation(n),
+            "v": rng.normal(size=n).round(3),
+        }
+    )
+
+
+def test_row_number_rank_dense():
+    df = _df()
+    got = _win(
+        df,
+        [
+            (WindowFunc("row_number"), "rn"),
+            (WindowFunc("rank"), "rk"),
+            (WindowFunc("dense_rank"), "dr"),
+        ],
+        chunk=64,
+    )
+    got = got.sort_values(["g", "o"]).reset_index(drop=True)
+    want = df.sort_values(["g", "o"]).reset_index(drop=True)
+    grp = want.groupby("g")["o"]
+    assert got["rn"].tolist() == grp.cumcount().add(1).tolist()
+    assert got["rk"].tolist() == grp.rank(method="min").astype(int).tolist()
+    assert got["dr"].tolist() == grp.rank(method="dense").astype(int).tolist()
+
+
+def test_rank_with_ties():
+    df = pd.DataFrame({"g": [1] * 6, "o": [10, 10, 20, 20, 20, 30], "v": range(6)})
+    got = _win(df, [(WindowFunc("rank"), "rk"), (WindowFunc("dense_rank"), "dr"),
+                    (WindowFunc("percent_rank"), "pr"), (WindowFunc("cume_dist"), "cd")])
+    assert got["rk"].tolist() == [1, 1, 3, 3, 3, 6]
+    assert got["dr"].tolist() == [1, 1, 2, 2, 2, 3]
+    assert got["pr"].tolist() == pytest.approx([0, 0, 0.4, 0.4, 0.4, 1.0])
+    assert got["cd"].tolist() == pytest.approx([2 / 6, 2 / 6, 5 / 6, 5 / 6, 5 / 6, 1.0])
+
+
+def test_lead_lag():
+    df = _df(100)
+    got = _win(
+        df,
+        [
+            (WindowFunc("lead", expr=col(2), offset=1), "ld"),
+            (WindowFunc("lag", expr=col(2), offset=2), "lg"),
+        ],
+    )
+    got = got.sort_values(["g", "o"]).reset_index(drop=True)
+    want = df.sort_values(["g", "o"]).reset_index(drop=True)
+    wld = want.groupby("g")["v"].shift(-1)
+    wlg = want.groupby("g")["v"].shift(2)
+    assert [None if pd.isna(x) else x for x in got["ld"]] == [
+        None if pd.isna(x) else x for x in wld
+    ]
+    assert [None if pd.isna(x) else x for x in got["lg"]] == [
+        None if pd.isna(x) else x for x in wlg
+    ]
+
+
+def test_running_and_whole_aggs():
+    df = _df(150, seed=22)
+    got = _win(
+        df,
+        [
+            (WindowFunc("agg", agg="sum", expr=col(2)), "rsum"),
+            (WindowFunc("agg", agg="count", expr=col(2)), "rcnt"),
+            (WindowFunc("agg", agg="min", expr=col(2)), "rmin"),
+            (WindowFunc("agg", agg="max", expr=col(2)), "rmax"),
+            (WindowFunc("agg", agg="sum", expr=col(2), frame_whole=True), "tsum"),
+            (WindowFunc("agg", agg="avg", expr=col(2), frame_whole=True), "tavg"),
+        ],
+        chunk=50,
+    )
+    got = got.sort_values(["g", "o"]).reset_index(drop=True)
+    want = df.sort_values(["g", "o"]).reset_index(drop=True)
+    g = want.groupby("g")["v"]
+    assert got["rsum"].tolist() == pytest.approx(g.cumsum().tolist())
+    assert got["rcnt"].tolist() == g.cumcount().add(1).tolist()
+    assert got["rmin"].tolist() == pytest.approx(g.cummin().tolist())
+    assert got["rmax"].tolist() == pytest.approx(g.cummax().tolist())
+    assert got["tsum"].tolist() == pytest.approx(g.transform("sum").tolist())
+    assert got["tavg"].tolist() == pytest.approx(g.transform("mean").tolist())
+
+
+def test_running_sum_ties_share_value():
+    # RANGE frame: peer rows (same order key) share the running value
+    df = pd.DataFrame({"g": [1] * 4, "o": [1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0]})
+    got = _win(df, [(WindowFunc("agg", agg="sum", expr=col(2)), "rs")])
+    assert got["rs"].tolist() == pytest.approx([1.0, 6.0, 6.0, 10.0])
+
+
+def test_nulls_in_agg_input():
+    df = pd.DataFrame(
+        {"g": [1, 1, 1], "o": [1, 2, 3], "v": pd.array([1.0, None, 3.0], dtype="Float64")}
+    )
+    got = _win(df, [(WindowFunc("agg", agg="sum", expr=col(2)), "rs"),
+                    (WindowFunc("agg", agg="count", expr=col(2)), "rc")])
+    assert got["rs"].tolist() == pytest.approx([1.0, 1.0, 4.0])
+    assert got["rc"].tolist() == [1, 1, 2]
+
+
+def test_no_partition_by():
+    df = pd.DataFrame({"g": [0, 0], "o": [2, 1], "v": [5.0, 7.0]})
+    got = _win(df, [(WindowFunc("row_number"), "rn")], part_cols=(), order_cols=(1,))
+    assert got.sort_values("o")["rn"].tolist() == [1, 2]
