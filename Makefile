@@ -1,0 +1,10 @@
+.PHONY: proto test native
+
+proto:
+	protoc --python_out=. auron_tpu/proto/plan.proto
+
+native:
+	$(MAKE) -C native
+
+test:
+	python -m pytest tests/ -q

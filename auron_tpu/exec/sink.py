@@ -1,0 +1,82 @@
+"""Sinks: Parquet writer and IPC writer (collect/broadcast path).
+
+Analogs of the reference's parquet_sink_exec.rs (native Hive-style output
+through the host FS) and ipc_writer_exec.rs (length-prefixed IPC to a host
+channel for collect-to-driver / broadcast).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.base import ExecOperator, ExecutionContext
+from auron_tpu.exec.shuffle.format import encode_block
+
+
+class ParquetSinkExec(ExecOperator):
+    """Writes the partition stream as part-<partition>.parquet under
+    output_path; yields nothing (the host engine commits the files)."""
+
+    def __init__(self, child: ExecOperator, output_path: str, props: dict | None = None):
+        super().__init__([child], child.schema)
+        self.output_path = output_path
+        self.props = props or {}
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        import os
+
+        os.makedirs(self.output_path, exist_ok=True)
+        path = os.path.join(self.output_path, f"part-{partition:05d}.parquet")
+        compression = self.props.get("compression", "zstd")
+        writer = None
+        rows = 0
+        try:
+            for b in self.child_stream(0, partition, ctx):
+                ctx.check_cancelled()
+                rb = b.to_arrow()
+                if rb.num_rows == 0:
+                    continue
+                if writer is None:
+                    with ctx.metrics.timer("io_time"):
+                        writer = pq.ParquetWriter(path, rb.schema, compression=compression)
+                with ctx.metrics.timer("io_time"):
+                    writer.write_batch(rb)
+                rows += rb.num_rows
+        finally:
+            if writer is not None:
+                writer.close()
+        if writer is None:  # write an empty file with the right schema
+            pq.write_table(
+                pa.Table.from_batches([], schema=self.schema.to_arrow()),
+                path, compression=compression,
+            )
+        ctx.metrics.add("rows_written", rows)
+        return
+        yield  # pragma: no cover
+
+
+class IpcWriterExec(ExecOperator):
+    """Streams the partition's batches as length-prefixed compressed IPC
+    blocks into a host channel registered in the resource map (list-like
+    with .append or callable)."""
+
+    def __init__(self, child: ExecOperator, resource_id: str):
+        super().__init__([child], child.schema)
+        self.resource_id = resource_id
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        channel = ctx.resources[self.resource_id]
+        push = channel if callable(channel) else channel.append
+        for b in self.child_stream(0, partition, ctx):
+            ctx.check_cancelled()
+            rb = b.to_arrow()
+            if rb.num_rows == 0:
+                continue
+            with ctx.metrics.timer("encode_time"):
+                push(encode_block(rb))
+        return
+        yield  # pragma: no cover

@@ -1,0 +1,1 @@
+from auron_tpu.plan.planner import expr_from_proto, plan_from_proto, task_from_proto  # noqa: F401

@@ -1,0 +1,307 @@
+"""Host-side plan builders: python expression IR / operator specs -> protobuf.
+
+This is the in-process stand-in for the host engine's plan conversion layer
+(the role the reference's Spark extension plays: AuronConverters building
+PhysicalPlanNode protos per operator, AuronConverters.scala:212-305 and
+NativeConverters.convertExpr, NativeConverters.scala:329). The TPC-DS
+harness and tests build plans through these, ship serialized
+TaskDefinitions, and the planner (plan/planner.py) reconstructs exec trees —
+exercising the same wire contract a real engine front-end would.
+"""
+
+from __future__ import annotations
+
+import decimal as pydec
+from typing import Any
+
+from auron_tpu import types as T
+from auron_tpu.exprs import ir
+from auron_tpu.ops.sortkeys import SortSpec
+from auron_tpu.plan.planner import dtype_to_proto, schema_to_proto
+from auron_tpu.proto import plan_pb2 as pb
+
+# ---------------------------------------------------------------------------
+# expressions: ir -> proto
+# ---------------------------------------------------------------------------
+
+
+def literal_to_proto(value: Any, dtype: T.DataType) -> pb.LiteralExpr:
+    p = pb.LiteralExpr(dtype=dtype_to_proto(dtype))
+    if value is None:
+        p.is_null = True
+        return p
+    k = dtype.kind
+    if k == T.TypeKind.BOOL:
+        p.bool_value = bool(value)
+    elif dtype.is_integer or k in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP):
+        p.int_value = int(value)
+    elif dtype.is_float:
+        p.float_value = float(value)
+    elif k == T.TypeKind.STRING:
+        p.string_value = str(value)
+    elif k == T.TypeKind.BINARY:
+        p.bytes_value = bytes(value)
+    elif k == T.TypeKind.DECIMAL:
+        u = int(pydec.Decimal(str(value)).scaleb(dtype.scale).quantize(pydec.Decimal(1)))
+        p.decimal_unscaled = u
+    else:
+        raise TypeError(f"literal of type {dtype}")
+    return p
+
+
+def expr_to_proto(e: ir.Expr) -> pb.PhysicalExprNode:
+    n = pb.PhysicalExprNode()
+    if isinstance(e, ir.Column):
+        n.column.index = e.index
+        n.column.name = e.name
+    elif isinstance(e, ir.Literal):
+        n.literal.CopyFrom(literal_to_proto(e.value, e.dtype))
+    elif isinstance(e, ir.Cast):
+        n.cast.child.CopyFrom(expr_to_proto(e.child))
+        n.cast.to.CopyFrom(dtype_to_proto(e.to))
+        n.cast.try_cast = e.try_
+    elif isinstance(e, ir.BinaryOp):
+        n.binary.op = e.op
+        n.binary.left.CopyFrom(expr_to_proto(e.left))
+        n.binary.right.CopyFrom(expr_to_proto(e.right))
+    elif isinstance(e, ir.IsNull):
+        n.is_null.child.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, ir.IsNotNull):
+        n.is_not_null.child.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, ir.Not):
+        getattr(n, "not").child.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, ir.If):
+        n.if_expr.cond.CopyFrom(expr_to_proto(e.cond))
+        n.if_expr.then.CopyFrom(expr_to_proto(e.then))
+        n.if_expr.orelse.CopyFrom(expr_to_proto(e.orelse))
+    elif isinstance(e, ir.Case):
+        for c, v in e.branches:
+            b = n.case_expr.branches.add()
+            b.when.CopyFrom(expr_to_proto(c))
+            b.then.CopyFrom(expr_to_proto(v))
+        if e.orelse is not None:
+            n.case_expr.orelse.CopyFrom(expr_to_proto(e.orelse))
+    elif isinstance(e, ir.In):
+        n.in_list.child.CopyFrom(expr_to_proto(e.child))
+        n.in_list.negated = e.negated
+        for item in e.items:
+            lit = ir.lit(item) if not isinstance(item, ir.Literal) else item
+            n.in_list.items.add().CopyFrom(literal_to_proto(lit.value, lit.dtype))
+    elif isinstance(e, ir.Coalesce):
+        for a in e.args:
+            n.coalesce.args.add().CopyFrom(expr_to_proto(a))
+    elif isinstance(e, ir.Like):
+        n.like.child.CopyFrom(expr_to_proto(e.child))
+        n.like.pattern = e.pattern
+        n.like.negated = e.negated
+        n.like.escape = e.escape
+    elif isinstance(e, ir.ScalarFunc):
+        n.scalar_func.name = e.name
+        for a in e.args:
+            n.scalar_func.args.add().CopyFrom(expr_to_proto(a))
+        if e.out_dtype is not None:
+            n.scalar_func.out_dtype.CopyFrom(dtype_to_proto(e.out_dtype))
+            n.scalar_func.has_out_dtype = True
+    elif isinstance(e, ir.HostUDF):
+        n.host_udf.name = e.name
+        for a in e.args:
+            n.host_udf.args.add().CopyFrom(expr_to_proto(a))
+        n.host_udf.out_dtype.CopyFrom(dtype_to_proto(e.out_dtype))
+    else:
+        raise TypeError(f"cannot serialize {type(e).__name__}")
+    return n
+
+
+def sort_field(e: ir.Expr, spec: SortSpec) -> pb.SortField:
+    f = pb.SortField(asc=spec.asc, nulls_first=spec.nulls_first)
+    f.expr.CopyFrom(expr_to_proto(e))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# plan nodes
+# ---------------------------------------------------------------------------
+
+
+def _wrap(**kwargs) -> pb.PhysicalPlanNode:
+    return pb.PhysicalPlanNode(**kwargs)
+
+
+def memory_scan(schema: T.Schema, resource_id: str) -> pb.PhysicalPlanNode:
+    return _wrap(memory_scan=pb.MemoryScanNode(
+        schema=schema_to_proto(schema), resource_id=resource_id))
+
+
+def ffi_reader(schema: T.Schema, resource_id: str) -> pb.PhysicalPlanNode:
+    return _wrap(ffi_reader=pb.FfiReaderNode(
+        schema=schema_to_proto(schema), resource_id=resource_id))
+
+
+def parquet_scan(schema: T.Schema, files: list[str],
+                 pruning: list[ir.Expr] = (), fs_resource_id: str = "") -> pb.PhysicalPlanNode:
+    n = pb.ParquetScanNode(schema=schema_to_proto(schema), file_paths=list(files),
+                           fs_resource_id=fs_resource_id)
+    for p in pruning:
+        n.pruning_predicates.add().CopyFrom(expr_to_proto(p))
+    return _wrap(parquet_scan=n)
+
+
+def project(child: pb.PhysicalPlanNode, exprs: list[tuple[ir.Expr, str]]) -> pb.PhysicalPlanNode:
+    n = pb.ProjectNode(child=child)
+    for e, name in exprs:
+        ne = n.exprs.add()
+        ne.expr.CopyFrom(expr_to_proto(e))
+        ne.name = name
+    return _wrap(project=n)
+
+
+def filter_(child: pb.PhysicalPlanNode, predicates: list[ir.Expr]) -> pb.PhysicalPlanNode:
+    n = pb.FilterNode(child=child)
+    for p in predicates:
+        n.predicates.add().CopyFrom(expr_to_proto(p))
+    return _wrap(filter=n)
+
+
+def limit(child: pb.PhysicalPlanNode, k: int) -> pb.PhysicalPlanNode:
+    return _wrap(limit=pb.LimitNode(child=child, limit=k))
+
+
+def union(children: list[pb.PhysicalPlanNode]) -> pb.PhysicalPlanNode:
+    return _wrap(union=pb.UnionNode(children=children))
+
+
+def hash_agg(child: pb.PhysicalPlanNode, groupings: list[tuple[ir.Expr, str]],
+             aggs: list[tuple[str, ir.Expr | None, str]], mode: str) -> pb.PhysicalPlanNode:
+    m = {"partial": pb.AGG_PARTIAL, "partial_merge": pb.AGG_PARTIAL_MERGE,
+         "final": pb.AGG_FINAL}[mode]
+    fmap = {"sum": pb.AGG_SUM, "count": pb.AGG_COUNT, "count_star": pb.AGG_COUNT_STAR,
+            "avg": pb.AGG_AVG, "min": pb.AGG_MIN, "max": pb.AGG_MAX,
+            "first": pb.AGG_FIRST, "first_ignores_null": pb.AGG_FIRST_IGNORES_NULL}
+    n = pb.HashAggNode(child=child, mode=m)
+    for e, name in groupings:
+        g = n.groupings.add()
+        g.expr.CopyFrom(expr_to_proto(e))
+        g.name = name
+    for func, e, name in aggs:
+        a = n.aggs.add()
+        a.func = fmap[func]
+        a.name = name
+        if e is not None:
+            a.expr.CopyFrom(expr_to_proto(e))
+            a.has_expr = True
+    return _wrap(hash_agg=n)
+
+
+def sort(child: pb.PhysicalPlanNode, fields: list[tuple[ir.Expr, SortSpec]],
+         fetch: int | None = None) -> pb.PhysicalPlanNode:
+    n = pb.SortNode(child=child)
+    for e, s in fields:
+        n.fields.add().CopyFrom(sort_field(e, s))
+    if fetch is not None:
+        n.fetch = fetch
+        n.has_fetch = True
+    return _wrap(sort=n)
+
+
+_JT = {"inner": pb.JOIN_INNER, "left": pb.JOIN_LEFT, "right": pb.JOIN_RIGHT,
+       "full": pb.JOIN_FULL, "left_semi": pb.JOIN_LEFT_SEMI,
+       "left_anti": pb.JOIN_LEFT_ANTI, "existence": pb.JOIN_EXISTENCE}
+
+
+def sort_merge_join(left, right, left_keys, right_keys, join_type,
+                    condition=None) -> pb.PhysicalPlanNode:
+    n = pb.SortMergeJoinNode(left=left, right=right, join_type=_JT[join_type])
+    for e in left_keys:
+        n.left_keys.add().CopyFrom(expr_to_proto(e))
+    for e in right_keys:
+        n.right_keys.add().CopyFrom(expr_to_proto(e))
+    if condition is not None:
+        n.condition.CopyFrom(expr_to_proto(condition))
+        n.has_condition = True
+    return _wrap(sort_merge_join=n)
+
+
+def hash_join(left, right, left_keys, right_keys, join_type,
+              build_side="right", condition=None,
+              cached_build_id: str = "") -> pb.PhysicalPlanNode:
+    n = pb.HashJoinNode(
+        left=left, right=right, join_type=_JT[join_type],
+        build_side=pb.BUILD_LEFT if build_side == "left" else pb.BUILD_RIGHT,
+        cached_build_id=cached_build_id,
+    )
+    for e in left_keys:
+        n.left_keys.add().CopyFrom(expr_to_proto(e))
+    for e in right_keys:
+        n.right_keys.add().CopyFrom(expr_to_proto(e))
+    if condition is not None:
+        n.condition.CopyFrom(expr_to_proto(condition))
+        n.has_condition = True
+    return _wrap(hash_join=n)
+
+
+def hash_partitioning(exprs: list[ir.Expr], n: int) -> pb.Partitioning:
+    p = pb.Partitioning(kind=pb.Partitioning.HASH, num_partitions=n)
+    for e in exprs:
+        p.hash_exprs.add().CopyFrom(expr_to_proto(e))
+    return p
+
+
+def shuffle_writer(child, partitioning: pb.Partitioning,
+                   data_file: str, index_file: str) -> pb.PhysicalPlanNode:
+    return _wrap(shuffle_writer=pb.ShuffleWriterNode(
+        child=child, partitioning=partitioning,
+        output_data_file=data_file, output_index_file=index_file))
+
+
+def ipc_reader(schema: T.Schema, resource_id: str) -> pb.PhysicalPlanNode:
+    return _wrap(ipc_reader=pb.IpcReaderNode(
+        schema=schema_to_proto(schema), resource_id=resource_id))
+
+
+def window(child, partition_by: list[ir.Expr],
+           order_by: list[tuple[ir.Expr, SortSpec]],
+           funcs: list[tuple]) -> pb.PhysicalPlanNode:
+    """funcs: (kind, agg, expr, offset, frame_whole, name) tuples."""
+    n = pb.WindowNode(child=child)
+    for e in partition_by:
+        n.partition_by.add().CopyFrom(expr_to_proto(e))
+    for e, s in order_by:
+        n.order_by.add().CopyFrom(sort_field(e, s))
+    for kind, agg, e, offset, whole, name in funcs:
+        f = n.funcs.add()
+        f.kind = kind
+        f.agg = agg or ""
+        if e is not None:
+            f.expr.CopyFrom(expr_to_proto(e))
+            f.has_expr = True
+        f.offset = offset
+        f.frame_whole = whole
+        f.name = name
+    return _wrap(window=n)
+
+
+def generate(child, generator: str, gen_expr: ir.Expr, required_cols: list[int],
+             outer=False, json_fields=(), elem_name="col", pos_name="pos") -> pb.PhysicalPlanNode:
+    n = pb.GenerateNode(child=child, generator=generator,
+                        required_cols=list(required_cols), outer=outer,
+                        json_fields=list(json_fields),
+                        elem_name=elem_name, pos_name=pos_name)
+    n.gen_expr.CopyFrom(expr_to_proto(gen_expr))
+    return _wrap(generate=n)
+
+
+def parquet_sink(child, output_path: str, props: dict | None = None) -> pb.PhysicalPlanNode:
+    return _wrap(parquet_sink=pb.ParquetSinkNode(
+        child=child, output_path=output_path, props=props or {}))
+
+
+def ipc_writer(child, resource_id: str) -> pb.PhysicalPlanNode:
+    return _wrap(ipc_writer=pb.IpcWriterNode(child=child, resource_id=resource_id))
+
+
+def task(plan: pb.PhysicalPlanNode, stage_id=0, partition_id=0,
+         conf: dict | None = None) -> pb.TaskDefinition:
+    t = pb.TaskDefinition(plan=plan, stage_id=stage_id, partition_id=partition_id)
+    for k, v in (conf or {}).items():
+        t.conf[k] = str(v)
+    return t

@@ -1,0 +1,7 @@
+"""Plan IR protobuf bindings.
+
+``plan_pb2.py`` is generated from ``plan.proto`` (see Makefile:
+``make proto``) and checked in so the engine runs without protoc.
+"""
+
+from auron_tpu.proto import plan_pb2  # noqa: F401
