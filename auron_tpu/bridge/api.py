@@ -1,0 +1,93 @@
+"""Host-engine bridge: the 4-entry-point task ABI + resource map.
+
+Analog of the reference's JNI surface (auron-core JniBridge.java:49-80):
+``callNative / nextBatch / finalizeNative / onExit`` plus the resource map
+(putResource/getResource) that hands scan providers, shuffle-block readers,
+UDF contexts and FS openers to tasks. A JVM front-end binds these through
+the C ABI exported by native/bridge (see native/), a python front-end calls
+them directly. Batches cross the boundary as Arrow (in-process objects or
+IPC bytes — the C-data-interface analog).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any
+
+import pyarrow as pa
+
+from auron_tpu.runtime.task import TaskRuntime
+
+_lock = threading.Lock()
+_resources: dict[str, Any] = {}
+_runtimes: dict[int, TaskRuntime] = {}
+_next_handle = itertools.count(1)
+
+
+# ---- resource map (JniBridge.putResource/getResource analog) ----
+
+
+def put_resource(key: str, value: Any) -> None:
+    with _lock:
+        _resources[key] = value
+
+
+def get_resource(key: str) -> Any:
+    with _lock:
+        return _resources.get(key)
+
+
+def remove_resource(key: str) -> None:
+    with _lock:
+        _resources.pop(key, None)
+
+
+# ---- task entry points ----
+
+
+def call_native(task_bytes: bytes) -> int:
+    """Start a task from a serialized TaskDefinition; returns a handle."""
+    with _lock:
+        resources = dict(_resources)
+    rt = TaskRuntime(task_bytes, resources=resources)
+    h = next(_next_handle)
+    with _lock:
+        _runtimes[h] = rt
+    return h
+
+
+def next_batch(handle: int) -> pa.RecordBatch | None:
+    rt = _runtimes[handle]
+    return rt.next_arrow()
+
+
+def next_batch_ipc(handle: int) -> bytes | None:
+    """IPC-serialized variant for out-of-process hosts."""
+    rb = next_batch(handle)
+    if rb is None:
+        return None
+    import io
+
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def finalize_native(handle: int) -> dict:
+    with _lock:
+        rt = _runtimes.pop(handle, None)
+    if rt is None:
+        return {}
+    return rt.finalize()
+
+
+def on_exit() -> None:
+    with _lock:
+        handles = list(_runtimes)
+    for h in handles:
+        try:
+            finalize_native(h)
+        except Exception:
+            pass

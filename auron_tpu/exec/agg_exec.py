@@ -165,54 +165,56 @@ class HashAggExec(ExecOperator):
         skip_ratio = conf.get(PARTIAL_AGG_SKIPPING_RATIO)
         skip_min_rows = conf.get(PARTIAL_AGG_SKIPPING_MIN_ROWS)
 
-        state: Batch | None = None
-        staged: list[Batch] = []
-        staged_rows = 0
+        from auron_tpu.exec.sort_exec import batch_nbytes
+        from auron_tpu.memory.memmgr import MemManager
+
+        mm = MemManager.get()
+        table = _AggTableConsumer(self, ctx)
+        mm.register(table)
         seen_rows = 0
         seen_groups = 0
         skipping = False
         merge_threshold = max(ctx.batch_size() * 4, 1 << 15)
 
-        for b in self.child_stream(0, partition, ctx):
-            ctx.check_cancelled()
-            n = b.num_rows()
-            if n == 0:
-                continue
-            with ctx.metrics.timer("elapsed_compute"):
-                inter = self._to_intermediate(b, ctx)
-            g = inter.num_rows()
-            seen_rows += n
-            seen_groups += g
-            if skipping:
-                yield inter
-                continue
-            if (
-                skipping_enabled
-                and seen_rows >= skip_min_rows
-                and seen_groups >= skip_ratio * seen_rows
-            ):
-                # high cardinality: stop accumulating, stream through
-                ctx.metrics.add("partial_agg_skipped", 1)
-                skipping = True
-                for s in staged:
-                    yield s
-                if state is not None:
-                    yield state
-                staged, state = [], None
-                yield inter
-                continue
-            staged.append(inter)
-            staged_rows += g
-            if staged_rows >= merge_threshold:
-                with ctx.metrics.timer("merge_time"):
-                    state = self._merge([state] if state is not None else [], staged)
-                staged, staged_rows = [], 0
-                ctx.metrics.add("num_merges", 1)
+        try:
+            for b in self.child_stream(0, partition, ctx):
+                ctx.check_cancelled()
+                n = b.num_rows()
+                if n == 0:
+                    continue
+                with ctx.metrics.timer("elapsed_compute"):
+                    inter = self._to_intermediate(b, ctx)
+                g = inter.num_rows()
+                seen_rows += n
+                seen_groups += g
+                if skipping:
+                    yield inter
+                    continue
+                if (
+                    skipping_enabled
+                    and seen_rows >= skip_min_rows
+                    and seen_groups >= skip_ratio * seen_rows
+                    and not table.parked
+                ):
+                    # high cardinality: stop accumulating, stream through
+                    ctx.metrics.add("partial_agg_skipped", 1)
+                    skipping = True
+                    yield from table.drain()
+                    yield inter
+                    continue
+                mm.acquire(table, batch_nbytes(inter))
+                table.add(inter, g)
+                if table.staged_rows >= merge_threshold:
+                    with ctx.metrics.timer("merge_time"):
+                        table.compact()
+                    ctx.metrics.add("num_merges", 1)
+        finally:
+            mm.unregister(table)
 
         if skipping:
             return
         with ctx.metrics.timer("merge_time"):
-            state = self._merge([state] if state is not None else [], staged)
+            state = table.collect_state()
         if state is None:
             if self.n_keys == 0:
                 yield self._empty_global_agg(ctx)
@@ -494,6 +496,79 @@ class HashAggExec(ExecOperator):
         sel = jnp.zeros(cap, bool).at[0].set(True)
         out = batch_from_columns(vals, names, sel)
         return Batch(schema, out.device, out.dicts)
+
+
+class _AggTableConsumer:
+    """Spillable aggregation state (reference: agg/agg_table.rs —
+    in-memory table + spill with bucketed merge; here: device state batches
+    + disk-parked intermediate runs merged back at output)."""
+
+    def __init__(self, exec_: "HashAggExec", ctx: ExecutionContext):
+        self.name = f"agg-{id(exec_):x}"
+        self.exec = exec_
+        self.ctx = ctx
+        self.state: Batch | None = None
+        self.staged: list[Batch] = []
+        self.staged_rows = 0
+        self.parked: list = []  # DiskSpill objects
+
+    def add(self, inter: Batch, groups: int) -> None:
+        self.staged.append(inter)
+        self.staged_rows += groups
+
+    def compact(self) -> None:
+        self.state = self.exec._merge(
+            [self.state] if self.state is not None else [], self.staged
+        )
+        self.staged, self.staged_rows = [], 0
+
+    def mem_used(self) -> int:
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
+        total = sum(batch_nbytes(b) for b in self.staged)
+        if self.state is not None:
+            total += batch_nbytes(self.state)
+        return total
+
+    def spill(self) -> int:
+        """Park the merged state as a compressed disk run."""
+        from auron_tpu.memory.memmgr import DiskSpill
+
+        freed = self.mem_used()
+        if freed == 0:
+            return 0
+        with self.ctx.metrics.timer("spill_time"):
+            self.compact()
+            if self.state is not None:
+                ds = DiskSpill()
+                ds.write_table(self.state.to_arrow())
+                self.parked.append(ds)
+        self.ctx.metrics.add("spilled_aggs", 1)
+        self.state = None
+        return freed
+
+    def drain(self):
+        """Yield current contents without merging (partial-skip path)."""
+        for s in self.staged:
+            yield s
+        if self.state is not None:
+            yield self.state
+        self.staged, self.staged_rows, self.state = [], 0, None
+
+    def collect_state(self) -> Batch | None:
+        """Merge staged + state + parked disk runs into the final state."""
+        parts: list[Batch] = list(self.staged)
+        if self.state is not None:
+            parts.append(self.state)
+        for ds in self.parked:
+            for rb in ds.read_tables():
+                parts.append(Batch.from_arrow(rb))
+            ds.release()
+        self.parked = []
+        self.staged, self.staged_rows, self.state = [], 0, None
+        if not parts:
+            return None
+        return self.exec._merge([], parts)
 
 
 def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataType | None:

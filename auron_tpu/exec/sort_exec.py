@@ -56,22 +56,24 @@ class SortExec(ExecOperator):
         self.spill_threshold_rows = spill_threshold_rows
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
-        pending: list[Batch] = []
-        pending_rows = 0
-        runs: list[_HostRun] = []  # spilled sorted runs
+        from auron_tpu.memory.memmgr import MemManager
 
-        for b in self.child_stream(0, partition, ctx):
-            ctx.check_cancelled()
-            n = b.num_rows()
-            if n == 0:
-                continue
-            pending.append(b)
-            pending_rows += n
-            if pending_rows >= self.spill_threshold_rows:
-                with ctx.metrics.timer("spill_time"):
-                    runs.append(self._sort_run(pending, ctx).to_host())
-                ctx.metrics.add("spilled_runs", 1)
-                pending, pending_rows = [], 0
+        mm = MemManager.get()
+        sorter = _SorterConsumer(self, ctx)
+        mm.register(sorter)
+        try:
+            for b in self.child_stream(0, partition, ctx):
+                ctx.check_cancelled()
+                n = b.num_rows()
+                if n == 0:
+                    continue
+                mm.acquire(sorter, batch_nbytes(b))
+                sorter.add(b, n)
+                if sorter.pending_rows >= self.spill_threshold_rows:
+                    sorter.spill()
+        finally:
+            mm.unregister(sorter)
+        pending, runs = sorter.pending, sorter.runs
 
         if not runs:
             if not pending:
@@ -142,6 +144,50 @@ class SortExec(ExecOperator):
                 vals = tuple(jnp.pad(v, (0, pad)) for v in vals)
                 mask = tuple(jnp.pad(m, (0, pad)) for m in mask)
             yield Batch(self.schema, DeviceBatch(sel, vals, mask), sorted_batch.dicts)
+
+
+def batch_nbytes(b: Batch) -> int:
+    """Device-memory estimate of a batch (values + validity + sel)."""
+    total = b.capacity  # sel bool
+    for v in b.device.values:
+        total += v.size * v.dtype.itemsize
+    for m in b.device.validity:
+        total += m.size
+    return total
+
+
+class _SorterConsumer:
+    """MemConsumer facade over the sorter's in-device pending batches
+    (reference: ExternalSorter: MemConsumer, sort_exec.rs:375-390)."""
+
+    def __init__(self, exec_: "SortExec", ctx: ExecutionContext):
+        self.name = f"sort-{id(exec_):x}"
+        self.exec = exec_
+        self.ctx = ctx
+        self.pending: list[Batch] = []
+        self.runs: list["_HostRun"] = []
+        self.pending_rows = 0
+        self._bytes = 0
+
+    def add(self, b: Batch, n: int) -> None:
+        self.pending.append(b)
+        self.pending_rows += n
+        self._bytes += batch_nbytes(b)
+
+    def mem_used(self) -> int:
+        return self._bytes
+
+    def spill(self) -> int:
+        if not self.pending:
+            return 0
+        freed = self._bytes
+        with self.ctx.metrics.timer("spill_time"):
+            self.runs.append(self.exec._sort_run(self.pending, self.ctx).to_host())
+        self.ctx.metrics.add("spilled_runs", 1)
+        self.pending = []
+        self.pending_rows = 0
+        self._bytes = 0
+        return freed
 
 
 class _SortedRun:

@@ -1,0 +1,1 @@
+from auron_tpu.memory.memmgr import MemConsumer, MemManager  # noqa: F401

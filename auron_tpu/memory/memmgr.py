@@ -1,0 +1,143 @@
+"""HBM budget manager with spillable consumers.
+
+Analog of the reference's memory manager (native-engine/auron-memmgr/src/
+lib.rs): a global budget (total = overhead * memory_fraction, set at session
+init — exec.rs:80-88), consumers register and report usage
+(MemConsumer trait, lib.rs:46,202), per-consumer fair share drives who
+spills (mem_used_percent, lib.rs:213-225), and spills cascade until the
+budget is met (lib.rs:393-410). The reference spills to JVM-heap blocks or
+local files (spill.rs:90-101); the TPU-native tiers are:
+
+    HBM (device arrays) -> host RAM (numpy, this module's HostSpill)
+                        -> local disk (zstd-compressed Arrow IPC files)
+
+Stateful operators (sort runs, agg states, shuffle staging, join builds)
+register as consumers; when an ``acquire`` would exceed the budget the
+manager asks the largest-usage consumers to spill first (the requester
+last), exactly the ordering policy the reference uses.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Protocol
+
+from auron_tpu.utils.config import HBM_BUDGET_BYTES, MEMORY_FRACTION, active_conf
+
+
+class MemConsumer(Protocol):
+    name: str
+
+    def mem_used(self) -> int: ...
+
+    def spill(self) -> int:
+        """Release memory; returns bytes freed."""
+        ...
+
+
+class MemManager:
+    _instance: "MemManager | None" = None
+
+    def __init__(self, budget_bytes: int | None = None):
+        conf = active_conf()
+        total = budget_bytes if budget_bytes is not None else conf.get(HBM_BUDGET_BYTES)
+        self.budget = int(total * conf.get(MEMORY_FRACTION))
+        self._lock = threading.RLock()
+        self._consumers: list[MemConsumer] = []
+        self.num_spills = 0
+
+    # ---- lifecycle ----
+
+    @classmethod
+    def init(cls, budget_bytes: int | None = None) -> "MemManager":
+        cls._instance = MemManager(budget_bytes)
+        return cls._instance
+
+    @classmethod
+    def get(cls) -> "MemManager":
+        if cls._instance is None:
+            cls._instance = MemManager()
+        return cls._instance
+
+    # ---- consumer API ----
+
+    def register(self, consumer: MemConsumer) -> None:
+        with self._lock:
+            self._consumers.append(consumer)
+
+    def unregister(self, consumer: MemConsumer) -> None:
+        with self._lock:
+            if consumer in self._consumers:
+                self._consumers.remove(consumer)
+
+    def total_used(self) -> int:
+        with self._lock:
+            return sum(c.mem_used() for c in self._consumers)
+
+    def mem_used_percent(self, consumer: MemConsumer) -> float:
+        """Consumer's share of the budget (fair-share signal)."""
+        return consumer.mem_used() / max(self.budget, 1)
+
+    def acquire(self, consumer: MemConsumer, additional: int) -> None:
+        """Declare intent to grow; triggers spills if over budget.
+
+        Spill order: largest other consumers first, the requester last —
+        so small consumers can grow at the expense of dominant ones.
+        """
+        with self._lock:
+            needed = self.total_used() + additional - self.budget
+            if needed <= 0:
+                return
+            others = sorted(
+                (c for c in self._consumers if c is not consumer),
+                key=lambda c: c.mem_used(),
+                reverse=True,
+            )
+            for c in others + [consumer]:
+                if needed <= 0:
+                    break
+                if c.mem_used() == 0:
+                    continue
+                freed = c.spill()
+                self.num_spills += 1
+                needed -= freed
+
+
+# ---------------------------------------------------------------------------
+# spill containers (host-RAM and disk tiers)
+# ---------------------------------------------------------------------------
+
+
+class DiskSpill:
+    """Disk tier: zstd-compressed Arrow IPC blocks in a temp file (analog of
+    the reference's compressed file spills, spill.rs:40-56)."""
+
+    def __init__(self, spill_dir: str | None = None):
+        fd, self.path = tempfile.mkstemp(
+            suffix=".spill", dir=spill_dir or tempfile.gettempdir()
+        )
+        os.close(fd)
+        self._offsets: list[int] = [0]
+
+    def write_table(self, tbl) -> None:
+        from auron_tpu.exec.shuffle.format import encode_block
+
+        blk = encode_block(tbl)
+        with open(self.path, "ab") as f:
+            f.write(blk)
+        self._offsets.append(self._offsets[-1] + len(blk))
+
+    def read_tables(self):
+        from auron_tpu.exec.shuffle.format import decode_blocks
+
+        with open(self.path, "rb") as f:
+            data = f.read()
+        yield from decode_blocks(data)
+
+    def release(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

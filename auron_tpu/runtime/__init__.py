@@ -1,0 +1,1 @@
+from auron_tpu.runtime.task import TaskRuntime  # noqa: F401

@@ -1,0 +1,116 @@
+"""Per-task execution runtime: the batch pump.
+
+Analog of the reference's NativeExecutionRuntime (native-engine/auron/src/
+rt.rs:76-303): a task ships a TaskDefinition, the runtime builds the exec
+tree, drives it on a background thread into a bounded queue (the reference
+uses a 1-slot sync_channel inside a per-task tokio runtime, rt.rs:175-195),
+and the host pulls batches one at a time (``next_batch`` — the analog of the
+JNI nextBatch entry, exec.rs:122). Errors anywhere in the operator stream
+are captured and re-raised on the consumer side (panic -> host-exception
+relay, lib.rs:30-73); ``finalize`` cancels the stream, joins the thread and
+hands back the harvested metric tree (metrics.rs:7-35).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import pyarrow as pa
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.base import ExecOperator, ExecutionContext, TaskCancelled
+from auron_tpu.exec.metrics import MetricNode
+from auron_tpu.proto import plan_pb2 as pb
+from auron_tpu.utils.config import TOKIO_EQUIV_PREFETCH_DEPTH, Configuration, conf_scope
+
+_END = object()
+
+
+class TaskRuntime:
+    def __init__(
+        self,
+        task: pb.TaskDefinition | bytes,
+        resources: dict | None = None,
+    ):
+        if isinstance(task, (bytes, bytearray)):
+            t = pb.TaskDefinition()
+            t.ParseFromString(bytes(task))
+            task = t
+        from auron_tpu.plan.planner import task_from_proto
+
+        self.plan, stage_id, partition_id, conf = task_from_proto(task)
+        self.ctx = ExecutionContext(
+            stage_id=stage_id,
+            partition_id=partition_id,
+            conf=conf,
+            metrics=MetricNode(self.plan.name),
+            resources=resources or {},
+        )
+        depth = conf.get(TOKIO_EQUIV_PREFETCH_DEPTH)
+        self._queue: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._error: BaseException | None = None
+        self._finalized = False
+        self._thread = threading.Thread(target=self._pump, daemon=True, name="auron-task-pump")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+
+    def _pump(self) -> None:
+        try:
+            with conf_scope(self.ctx.conf):
+                for batch in self.plan.execute(self.ctx.partition_id, self.ctx):
+                    self._queue.put(batch)
+        except TaskCancelled:
+            pass
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            self._error = e
+        finally:
+            self._queue.put(_END)
+
+    def _check_error(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                f"task stage={self.ctx.stage_id} partition={self.ctx.partition_id} failed"
+            ) from err
+
+    # ------------------------------------------------------------------
+
+    def next_batch(self) -> Batch | None:
+        """Next device batch, or None at end of stream."""
+        if self._finalized:
+            return None
+        item = self._queue.get()
+        if item is _END:
+            self._check_error()
+            return None
+        return item
+
+    def next_arrow(self) -> pa.RecordBatch | None:
+        """Next batch materialized to Arrow — the host FFI boundary."""
+        b = self.next_batch()
+        return None if b is None else b.to_arrow()
+
+    def __iter__(self) -> Iterator[Batch]:
+        while (b := self.next_batch()) is not None:
+            yield b
+
+    def finalize(self) -> dict:
+        """Cancel, drain, join; returns the metric-tree snapshot."""
+        self._finalized = True
+        self.ctx.cancel()
+        # keep draining so the pump can observe cancellation instead of
+        # blocking on a full queue
+        deadline = 30.0
+        while self._thread.is_alive() and deadline > 0:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            deadline -= 0.05
+        self._check_error()
+        return self.ctx.metrics.snapshot()

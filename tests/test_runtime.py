@@ -1,0 +1,184 @@
+"""Task runtime + bridge ABI + memory manager tests."""
+
+import time
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.bridge import api
+from auron_tpu.columnar import Batch
+from auron_tpu.exprs.ir import BinaryOp, col, lit
+from auron_tpu.memory.memmgr import DiskSpill, MemManager
+from auron_tpu.plan import builders as B
+from auron_tpu.runtime.task import TaskRuntime
+
+
+def _task_bytes(plan, **kw):
+    return B.task(plan, **kw).SerializeToString()
+
+
+def test_runtime_pump_and_metrics():
+    b = Batch.from_pydict({"x": list(range(100))})
+    plan = B.filter_(B.memory_scan(b.schema, "src"), [BinaryOp("lt", col(0), lit(10))])
+    rt = TaskRuntime(_task_bytes(plan), resources={"src": [[b]]})
+    out = [rb for rb in iter(rt.next_arrow, None)]
+    assert sum(r.num_rows for r in out) == 10
+    snap = rt.finalize()
+    assert snap["values"]["output_rows"] == 10
+    assert snap["children"][0]["values"]["output_rows"] == 100
+
+
+def test_runtime_error_relay():
+    b = Batch.from_pydict({"x": [1, 0]})
+    # division by a string function that doesn't exist -> error in pump
+    from auron_tpu.exprs.ir import ScalarFunc
+
+    plan = B.project(B.memory_scan(b.schema, "src"), [(ScalarFunc("nope", (col(0),)), "y")])
+    rt = TaskRuntime(_task_bytes(plan), resources={"src": [[b]]})
+    with pytest.raises(RuntimeError, match="failed"):
+        while rt.next_batch() is not None:
+            pass
+
+
+def test_runtime_cancellation():
+    b = Batch.from_pydict({"x": list(range(10))})
+    plan = B.memory_scan(b.schema, "src")
+    rt = TaskRuntime(
+        _task_bytes(plan), resources={"src": [[b] * 200]}
+    )
+    assert rt.next_batch() is not None
+    rt.finalize()  # cancels mid-stream without hanging
+
+
+def test_bridge_abi_roundtrip():
+    b = Batch.from_pydict({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+    api.put_resource("bridge_src", [[b]])
+    partial = B.hash_agg(B.memory_scan(b.schema, "bridge_src"),
+                         [(col(0), "k")], [("sum", col(1), "s")], "partial")
+    final = B.hash_agg(partial, [(col(0), "k")], [("sum", col(1), "s")], "final")
+    h = api.call_native(_task_bytes(final))
+    rows = []
+    while (ipc := api.next_batch_ipc(h)) is not None:
+        with pa.ipc.open_stream(ipc) as r:
+            for rb in r:
+                rows += rb.to_pylist()
+    metrics = api.finalize_native(h)
+    api.remove_resource("bridge_src")
+    got = sorted((r["k"], r["s"]) for r in rows)
+    assert got == [(1, 4.0), (2, 2.0)]
+    assert metrics["values"]["output_rows"] == 2
+
+
+class _FakeConsumer:
+    def __init__(self, name, used):
+        self.name = name
+        self._used = used
+        self.spilled = 0
+
+    def mem_used(self):
+        return self._used
+
+    def spill(self):
+        freed = self._used
+        self._used = 0
+        self.spilled += 1
+        return freed
+
+
+def test_memmgr_spill_ordering():
+    mm = MemManager.init(budget_bytes=1000)
+    assert mm.budget == 600  # x fraction 0.6
+    big = _FakeConsumer("big", 400)
+    small = _FakeConsumer("small", 150)
+    mm.register(big)
+    mm.register(small)
+    # small asks for more -> big (largest other) spills first
+    mm.acquire(small, 200)
+    assert big.spilled == 1 and small.spilled == 0
+    assert mm.total_used() == 150
+    # requester spills only if others can't cover
+    big2 = _FakeConsumer("big2", 550)
+    mm.register(big2)
+    mm.acquire(big2, 500)
+    assert small.spilled == 1 and big2.spilled == 1
+
+
+def test_disk_spill_roundtrip(tmp_path):
+    ds = DiskSpill(str(tmp_path))
+    t1 = pa.table({"x": [1, 2]})
+    t2 = pa.table({"x": [3]})
+    ds.write_table(t1)
+    ds.write_table(t2)
+    got = [rb.to_pydict() for rb in ds.read_tables()]
+    assert got == [{"x": [1, 2]}, {"x": [3]}]
+    ds.release()
+
+
+def test_agg_spill_under_pressure():
+    import numpy as np
+    import pandas as pd
+
+    from auron_tpu.exec.agg_exec import FINAL, PARTIAL, AggExpr, HashAggExec
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.basic import MemoryScanExec
+
+    MemManager.init(budget_bytes=200_000)  # tiny budget forces agg spills
+    try:
+        rng = np.random.default_rng(31)
+        n = 20_000
+        df = pd.DataFrame({"k": rng.integers(0, 3000, n), "v": rng.normal(size=n)})
+        batches = [
+            Batch.from_arrow(
+                pa.RecordBatch.from_pandas(df.iloc[i : i + 2000], preserve_index=False)
+            )
+            for i in range(0, n, 2000)
+        ]
+        scan = MemoryScanExec.single(batches)
+        partial = HashAggExec(scan, [(col(0), "k")], [(AggExpr("sum", col(1)), "s")], PARTIAL)
+        ctx = ExecutionContext()
+        partial_out = list(partial.execute(0, ctx))
+        spilled = ctx.metrics.total("spilled_aggs")
+        final = HashAggExec(
+            MemoryScanExec.single(partial_out), [(col(0), "k")],
+            [(AggExpr("sum", col(1)), "s")], FINAL,
+        )
+        got = final.collect().to_pandas().sort_values("k").reset_index(drop=True)
+        want = df.groupby("k").agg(s=("v", "sum")).reset_index()
+        assert got["k"].tolist() == want["k"].tolist()
+        for g, w in zip(got["s"], want["s"]):
+            assert g == pytest.approx(w, rel=1e-9)
+        assert spilled > 0
+    finally:
+        MemManager.init()  # restore default budget
+
+
+def test_sort_spill_under_pressure():
+    import numpy as np
+    import pandas as pd
+
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.exec.sort_exec import SortExec
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    MemManager.init(budget_bytes=150_000)
+    try:
+        rng = np.random.default_rng(32)
+        n = 30_000
+        df = pd.DataFrame({"x": rng.permutation(n)})
+        batches = [
+            Batch.from_arrow(
+                pa.RecordBatch.from_pandas(df.iloc[i : i + 3000], preserve_index=False)
+            )
+            for i in range(0, n, 3000)
+        ]
+        s = SortExec(MemoryScanExec.single(batches), [col(0)], [SortSpec()])
+        ctx = ExecutionContext()
+        out = []
+        for b in s.execute(0, ctx):
+            out += b.to_pydict()["x"]
+        assert out == list(range(n))
+        assert ctx.metrics.total("spilled_runs") > 0
+    finally:
+        MemManager.init()
