@@ -1,0 +1,303 @@
+"""TPC-DS-class data generator and canned query pipelines.
+
+The reference's flagship gate is TPC-DS differential testing through its
+engine integration (dev/auron-it, SURVEY.md §4). This module provides the
+equivalent in-process: a seeded synthetic star-schema (store_sales fact +
+date_dim/item dimensions with TPC-DS-like columns), query pipelines built
+**through the protobuf plan IR** (plan/builders.py — exercising the same
+wire contract a Spark front-end would), a single-process multi-partition
+scheduler with real file shuffles between stages, and pandas oracles for
+result checking (QueryResultComparator analog).
+
+Queries follow BASELINE.md's benchmark shapes:
+- q1-class: scan + filter + global aggregation;
+- q3-class: fact scan -> broadcast joins with two filtered dimensions ->
+  partial agg -> hash shuffle -> final agg -> sort + limit (the flagship).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from auron_tpu import types as T
+from auron_tpu.bridge import api
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.shuffle.reader import MultiMapBlockProvider
+from auron_tpu.exprs.ir import BinaryOp, col, lit
+from auron_tpu.ops.sortkeys import SortSpec
+from auron_tpu.plan import builders as B
+
+# ---------------------------------------------------------------------------
+# data generation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TpcdsData:
+    store_sales: pd.DataFrame
+    date_dim: pd.DataFrame
+    item: pd.DataFrame
+
+    def fact_rows(self) -> int:
+        return len(self.store_sales)
+
+
+def generate(sf: float = 0.01, seed: int = 42) -> TpcdsData:
+    """Synthetic star schema; sf=1 ~ 2.88M fact rows (TPC-DS sf=1 scale)."""
+    rng = np.random.default_rng(seed)
+    n_fact = int(2_880_000 * sf)
+    n_dates = 365 * 5
+    n_items = max(int(18_000 * min(sf * 10, 1.0)), 100)
+
+    date_sk = 2_450_815 + np.arange(n_dates)
+    years = 1998 + (np.arange(n_dates) // 365)
+    moy = (np.arange(n_dates) % 365) // 31 + 1
+    date_dim = pd.DataFrame(
+        {
+            "d_date_sk": date_sk.astype(np.int64),
+            "d_year": years.astype(np.int32),
+            "d_moy": np.minimum(moy, 12).astype(np.int32),
+        }
+    )
+
+    item = pd.DataFrame(
+        {
+            "i_item_sk": np.arange(1, n_items + 1, dtype=np.int64),
+            "i_brand_id": rng.integers(1_000_000, 1_010_000, n_items).astype(np.int32),
+            "i_category_id": rng.integers(1, 11, n_items).astype(np.int32),
+            "i_category": rng.choice(
+                ["Books", "Home", "Electronics", "Music", "Sports"], n_items
+            ),
+        }
+    )
+
+    prices = np.round(rng.gamma(2.0, 25.0, n_fact), 2)
+    store_sales = pd.DataFrame(
+        {
+            "ss_sold_date_sk": rng.choice(date_sk, n_fact).astype(np.int64),
+            "ss_item_sk": rng.integers(1, n_items + 1, n_fact).astype(np.int64),
+            "ss_customer_sk": np.where(
+                rng.random(n_fact) < 0.04, -1, rng.integers(1, 100_000, n_fact)
+            ).astype(np.int64),
+            "ss_quantity": rng.integers(1, 100, n_fact).astype(np.int32),
+            "ss_ext_sales_price": prices,
+        }
+    )
+    store_sales.loc[store_sales.ss_customer_sk == -1, "ss_customer_sk"] = pd.NA
+    store_sales["ss_customer_sk"] = store_sales["ss_customer_sk"].astype("Int64")
+    return TpcdsData(store_sales, date_dim, item)
+
+
+def _schema_of(df: pd.DataFrame) -> T.Schema:
+    rb = pa.RecordBatch.from_pandas(df.iloc[:1], preserve_index=False)
+    return T.Schema.from_arrow(rb.schema)
+
+
+def to_batches(df: pd.DataFrame, n_partitions: int, batch_rows: int = 65536) -> list[list[Batch]]:
+    """Split a table into per-partition batch lists."""
+    parts: list[list[Batch]] = []
+    n = len(df)
+    per = (n + n_partitions - 1) // n_partitions
+    for p in range(n_partitions):
+        chunk = df.iloc[p * per : (p + 1) * per]
+        bs = [
+            Batch.from_arrow(
+                pa.RecordBatch.from_pandas(chunk.iloc[i : i + batch_rows], preserve_index=False)
+            )
+            for i in range(0, len(chunk), batch_rows)
+        ] or [Batch.from_arrow(pa.RecordBatch.from_pandas(chunk, preserve_index=False))]
+        parts.append(bs)
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# q1-class: scan + filter + global agg
+# ---------------------------------------------------------------------------
+
+
+def run_q1_class(data: TpcdsData, n_partitions: int = 4, year: int = 2000) -> pd.DataFrame:
+    """SELECT count(*), sum(price), avg(price) FROM store_sales, date_dim
+    WHERE ss_sold_date_sk = d_date_sk AND d_year = <year>."""
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    fact_parts = to_batches(data.store_sales, n_partitions)
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+
+    api.put_resource("q1_fact", fact_parts)
+    api.put_resource("q1_dd", [dd] * n_partitions)
+    try:
+        scan = B.memory_scan(fact_schema, "q1_fact")
+        dscan = B.filter_(
+            B.memory_scan(dd_schema, "q1_dd"),
+            [BinaryOp("eq", col(1), lit(year))],
+        )
+        joined = B.hash_join(
+            scan, dscan, [col(0)], [col(0)], "inner",
+            build_side="right", cached_build_id="q1_dd_build",
+        )
+        proj = B.project(joined, [(col(4), "price")])
+        partial = B.hash_agg(
+            proj, [],
+            [("count_star", None, "cnt"), ("sum", col(0), "total"), ("avg", col(0), "mean")],
+            "partial",
+        )
+        outs = []
+        for p in range(n_partitions):
+            h = api.call_native(B.task(partial, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                outs.append(Batch.from_arrow(rb))
+            api.finalize_native(h)
+        inter_schema = _agg_inter_schema(partial)
+        api.put_resource("q1_inter", [outs])
+        final = B.hash_agg(
+            B.memory_scan(inter_schema, "q1_inter"), [],
+            [("count_star", None, "cnt"), ("sum", col(0), "total"), ("avg", col(0), "mean")],
+            "final",
+        )
+        h = api.call_native(B.task(final, partition_id=0).SerializeToString())
+        frames = []
+        while (rb := api.next_batch(h)) is not None:
+            frames.append(rb.to_pandas())
+        api.finalize_native(h)
+        return pd.concat(frames).reset_index(drop=True)
+    finally:
+        for k in ("q1_fact", "q1_dd", "q1_dd_build", "q1_inter"):
+            api.remove_resource(k)
+
+
+def q1_class_oracle(data: TpcdsData, year: int = 2000) -> pd.DataFrame:
+    m = data.store_sales.merge(
+        data.date_dim[data.date_dim.d_year == year], left_on="ss_sold_date_sk",
+        right_on="d_date_sk",
+    )
+    return pd.DataFrame(
+        {
+            "cnt": [len(m)],
+            "total": [m.ss_ext_sales_price.sum()],
+            "mean": [m.ss_ext_sales_price.mean()],
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# q3-class: the flagship join + shuffle + agg + topk pipeline
+# ---------------------------------------------------------------------------
+
+
+def run_q3_class(
+    data: TpcdsData,
+    n_map: int = 4,
+    n_reduce: int = 4,
+    moy: int = 11,
+    category_id: int = 1,
+    limit: int = 100,
+    work_dir: str | None = None,
+) -> pd.DataFrame:
+    """SELECT d_year, i_brand_id, sum(ss_ext_sales_price) s
+    FROM store_sales JOIN date_dim ON ss_sold_date_sk = d_date_sk
+                     JOIN item     ON ss_item_sk = i_item_sk
+    WHERE d_moy = <moy> AND i_category_id = <cat>
+    GROUP BY d_year, i_brand_id ORDER BY d_year, s DESC LIMIT <k>."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q3_")
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    it_schema = _schema_of(data.item)
+
+    fact_parts = to_batches(data.store_sales, n_map)
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+
+    api.put_resource("q3_fact", fact_parts)
+    api.put_resource("q3_dd", [dd] * n_map)
+    api.put_resource("q3_item", [it] * n_map)
+    try:
+        # ---- map stage: scan -> bhj(date) -> bhj(item) -> partial agg -> shuffle
+        scan = B.memory_scan(fact_schema, "q3_fact")
+        dscan = B.filter_(B.memory_scan(dd_schema, "q3_dd"),
+                          [BinaryOp("eq", col(2), lit(moy))])
+        iscan = B.filter_(B.memory_scan(it_schema, "q3_item"),
+                          [BinaryOp("eq", col(2), lit(category_id))])
+        j1 = B.hash_join(scan, dscan, [col(0)], [col(0)], "inner",
+                         build_side="right", cached_build_id="q3_dd_build")
+        # fact(5 cols) + date_dim(3) -> ss_item_sk at 1, price 4, d_year 6
+        j2 = B.hash_join(j1, iscan, [col(1)], [col(0)], "inner",
+                         build_side="right", cached_build_id="q3_it_build")
+        # + item(4) -> i_brand_id at 9
+        proj = B.project(j2, [(col(6), "d_year"), (col(9), "i_brand_id"),
+                              (col(4), "price")])
+        partial = B.hash_agg(
+            proj, [(col(0), "d_year"), (col(1), "i_brand_id")],
+            [("sum", col(2), "s")], "partial",
+        )
+        part = B.hash_partitioning([col(0), col(1)], n_reduce)
+        pairs = []
+        for p in range(n_map):
+            data_f = os.path.join(work, f"map{p}.data")
+            index_f = os.path.join(work, f"map{p}.index")
+            w = B.shuffle_writer(partial, part, data_f, index_f)
+            h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+            while api.next_batch(h) is not None:
+                pass
+            api.finalize_native(h)
+            pairs.append((data_f, index_f))
+
+        # ---- reduce stage: ipc read -> final agg -> sort desc -> limit
+        inter_schema = _agg_inter_schema(partial)
+        api.put_resource("q3_blocks", MultiMapBlockProvider(pairs))
+        reader = B.ipc_reader(inter_schema, "q3_blocks")
+        final = B.hash_agg(
+            reader, [(col(0), "d_year"), (col(1), "i_brand_id")],
+            [("sum", col(2), "s")], "final",
+        )
+        frames = []
+        for p in range(n_reduce):
+            h = api.call_native(B.task(final, stage_id=2, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
+            api.finalize_native(h)
+        merged = pd.concat(frames).reset_index(drop=True) if frames else pd.DataFrame()
+        # global top-k (driver-side, like Spark's takeOrdered on collect)
+        merged = merged.sort_values(
+            ["d_year", "s"], ascending=[True, False], kind="stable"
+        ).head(limit).reset_index(drop=True)
+        return merged
+    finally:
+        for k in ("q3_fact", "q3_dd", "q3_item", "q3_dd_build", "q3_it_build", "q3_blocks"):
+            api.remove_resource(k)
+
+
+def q3_class_oracle(data: TpcdsData, moy=11, category_id=1, limit=100) -> pd.DataFrame:
+    m = data.store_sales.merge(
+        data.date_dim[data.date_dim.d_moy == moy], left_on="ss_sold_date_sk",
+        right_on="d_date_sk",
+    ).merge(
+        data.item[data.item.i_category_id == category_id], left_on="ss_item_sk",
+        right_on="i_item_sk",
+    )
+    g = (
+        m.groupby(["d_year", "i_brand_id"])
+        .agg(s=("ss_ext_sales_price", "sum"))
+        .reset_index()
+    )
+    return (
+        g.sort_values(["d_year", "s"], ascending=[True, False], kind="stable")
+        .head(limit)
+        .reset_index(drop=True)
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+def _agg_inter_schema(agg_plan) -> T.Schema:
+    """Intermediate schema of a partial agg plan node (host-side mirror)."""
+    from auron_tpu.plan.planner import plan_from_proto
+
+    op = plan_from_proto(agg_plan)
+    return op.inter_schema
