@@ -1,0 +1,55 @@
+"""Flagship fused device kernels for the compile-check / bench entry points.
+
+``fused_filter_agg_step`` is the single-chip jittable heart of a q1-class
+pipeline — filter + project + sort-segmented group aggregation as ONE XLA
+program (the fused per-pipeline computation of SURVEY.md §7): no host sync,
+static shapes, pure jnp/lax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fused_filter_agg_step(
+    keys: jnp.ndarray,  # int64[cap] group keys
+    filter_col: jnp.ndarray,  # int64[cap] filter input
+    vals: jnp.ndarray,  # float64[cap] aggregation input
+    sel: jnp.ndarray,  # bool[cap] row liveness
+    lo: jnp.ndarray,  # scalar filter bound (lo <= filter_col < hi)
+    hi: jnp.ndarray,
+):
+    """SELECT k, sum(v), count(v) WHERE lo <= f < hi GROUP BY k — fused.
+
+    Returns (group_keys, sums, counts, group_valid) prefix-packed to cap.
+    """
+    cap = keys.shape[0]
+    live = sel & (filter_col >= lo) & (filter_col < hi)
+    lw = jnp.where(live, jnp.uint64(0), jnp.uint64(1))
+    kw = keys.view(jnp.uint64)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    s_lw, s_kw, order = lax.sort((lw, kw, iota), num_keys=2)
+    s_live = s_lw == 0
+    s_keys = keys[order]
+    s_vals = jnp.where(s_live, vals[order], 0.0)
+    boundary = jnp.concatenate([jnp.ones(1, bool), s_kw[1:] != s_kw[:-1]]) & s_live
+    seg = jnp.where(s_live, jnp.cumsum(boundary.astype(jnp.int32)) - 1, cap)
+    sums = jax.ops.segment_sum(s_vals, seg, num_segments=cap + 1)[:cap]
+    counts = jax.ops.segment_sum(s_live.astype(jnp.int64), seg, num_segments=cap + 1)[:cap]
+    first_pos = jax.ops.segment_min(iota, seg, num_segments=cap + 1)[:cap]
+    gkeys = s_keys[jnp.clip(first_pos, 0, cap - 1)]
+    gvalid = iota < jnp.sum(boundary.astype(jnp.int32))
+    return gkeys, sums, counts, gvalid
+
+
+def example_args(cap: int = 8192, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    keys = jnp.asarray(rng.integers(0, 1000, cap).astype(np.int64))
+    filt = jnp.asarray(rng.integers(0, 100, cap).astype(np.int64))
+    vals = jnp.asarray(rng.normal(size=cap))
+    sel = jnp.asarray(rng.random(cap) < 0.95)
+    return (keys, filt, vals, sel, jnp.int64(10), jnp.int64(60))

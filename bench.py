@@ -1,0 +1,66 @@
+"""Benchmark: flagship q3-class TPC-DS pipeline throughput.
+
+Runs the full engine path (protobuf plans -> planner -> runtime -> device
+compute -> file shuffle -> final agg -> top-k) on the available accelerator
+and compares against a pandas single-thread baseline of the same query.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Env knobs: BENCH_SF (scale factor, default 0.05 ~ 144k fact rows),
+BENCH_PARTS (map partitions, default 4).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import auron_tpu  # noqa: F401
+    from auron_tpu.models import tpcds
+
+    sf = float(os.environ.get("BENCH_SF", "0.05"))
+    n_parts = int(os.environ.get("BENCH_PARTS", "4"))
+    data = tpcds.generate(sf=sf, seed=42)
+    n_rows = data.fact_rows()
+
+    # --- pandas baseline (single-thread CPU) ---
+    t0 = time.perf_counter()
+    want = tpcds.q3_class_oracle(data)
+    baseline_s = time.perf_counter() - t0
+
+    # --- engine: warm-up (compile) then timed run ---
+    with tempfile.TemporaryDirectory(prefix="auron_bench_") as wd0:
+        tpcds.run_q3_class(data, n_map=n_parts, n_reduce=n_parts, work_dir=wd0)
+    with tempfile.TemporaryDirectory(prefix="auron_bench_") as wd:
+        t0 = time.perf_counter()
+        got = tpcds.run_q3_class(data, n_map=n_parts, n_reduce=n_parts, work_dir=wd)
+        engine_s = time.perf_counter() - t0
+
+    # result check (differential gate, tolerance like the reference's
+    # QueryResultComparator double tolerance)
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got["s"], want["s"]):
+        assert abs(float(g) - float(w)) <= 1e-6 * max(1.0, abs(float(w))), (g, w)
+
+    rows_per_s = n_rows / engine_s
+    baseline_rows_per_s = n_rows / baseline_s
+    print(
+        json.dumps(
+            {
+                "metric": "tpcds_q3_class_throughput",
+                "value": round(rows_per_s, 1),
+                "unit": "fact_rows/s",
+                "vs_baseline": round(rows_per_s / baseline_rows_per_s, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
