@@ -222,16 +222,30 @@ class _HostRun:
 
 
 def _merge_runs(runs: list[_HostRun], schema: T.Schema) -> Batch:
-    """K-way merge of sorted host runs via numpy lexsort over concatenated
-    key words (runs are individually sorted; a stable global lexsort is the
-    vectorized equivalent of the reference's loser tree —
-    ext-commons/src/algorithm/loser_tree.rs)."""
+    """K-way merge of sorted host runs by their uint64 key words.
+
+    Uses the native loser-tree (native/auron_native.cpp loser_tree_merge —
+    the C++ analog of ext-commons/src/algorithm/loser_tree.rs) when built,
+    falling back to a stable numpy lexsort."""
+    from auron_tpu import native
+
     live_idx = [np.nonzero(r.sel)[0] for r in runs]
-    words = [
-        np.concatenate([r.key_words[k][i] for r, i in zip(runs, live_idx)])
-        for k in range(len(runs[0].key_words))
-    ]
-    order = np.lexsort(list(reversed(words)))  # last key primary for lexsort
+    n_words = len(runs[0].key_words)
+    if native.available():
+        run_words = [
+            [r.key_words[w][i] for w in range(n_words)]
+            for r, i in zip(runs, live_idx)
+        ]
+        out_run, out_idx = native.loser_tree_merge_host(run_words)
+        run_base = np.zeros(len(runs) + 1, dtype=np.int64)
+        np.cumsum([len(i) for i in live_idx], out=run_base[1:])
+        order = run_base[out_run] + out_idx
+    else:
+        words = [
+            np.concatenate([r.key_words[k][i] for r, i in zip(runs, live_idx)])
+            for k in range(n_words)
+        ]
+        order = np.lexsort(list(reversed(words)))  # last key primary
     import pyarrow as pa
 
     from auron_tpu.columnar.batch import unify_dict

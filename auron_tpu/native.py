@@ -1,0 +1,166 @@
+"""ctypes bindings for the native runtime helpers (native/auron_native.cpp).
+
+Loads ``native/libauron_native.so`` (built by ``make native``); every entry
+has a numpy fallback so the engine runs without the library (mirrors the
+reference's is_jni_bridge_inited() branching that lets kernels run without
+a JVM, spill.rs:90-101).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(here, "native", "libauron_native.so")
+    if not os.path.exists(so):
+        src = os.path.join(here, "native", "auron_native.cpp")
+        if os.path.exists(src):
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.join(here, "native")],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception:
+                return None
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.murmur3_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.murmur3_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.murmur3_bytes.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.radix_partition.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.loser_tree_merge.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def murmur3_i64_host(v: np.ndarray, seed: int = 42) -> np.ndarray:
+    v = np.ascontiguousarray(v, dtype=np.int64)
+    out = np.empty(len(v), dtype=np.int32)
+    lib = _lib()
+    if lib is None:  # numpy fallback via the device kernel on host arrays
+        import jax.numpy as jnp
+
+        from auron_tpu.ops.hashing import murmur3_i64
+
+        return np.asarray(murmur3_i64(jnp.asarray(v), jnp.uint32(seed)).view(jnp.int32))
+    lib.murmur3_i64(_ptr(v, ctypes.c_int64), len(v), seed, _ptr(out, ctypes.c_int32))
+    return out
+
+
+def murmur3_bytes_host(data: bytes | np.ndarray, offsets: np.ndarray,
+                       seed: int = 42) -> np.ndarray:
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.ascontiguousarray(data, np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    out = np.empty(n, dtype=np.int32)
+    lib = _lib()
+    if lib is None:
+        from auron_tpu.ops.hashing import murmur3_bytes as dev_m3
+        import jax.numpy as jnp
+
+        lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+        max_len = int(((lens.max() if n else 0) + 3) & ~3) or 4
+        mat = np.zeros((n, max_len), np.uint8)
+        for i in range(n):
+            mat[i, : lens[i]] = buf[offsets[i] : offsets[i + 1]]
+        return np.asarray(
+            dev_m3(jnp.asarray(mat), jnp.asarray(lens), jnp.uint32(seed)).view(jnp.int32)
+        )
+    lib.murmur3_bytes(_ptr(buf, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+                      n, seed, _ptr(out, ctypes.c_int32))
+    return out
+
+
+def radix_partition_host(pids: np.ndarray, n_parts: int) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (counts[n_parts], order[n]) clustering rows by partition."""
+    pids = np.ascontiguousarray(pids, dtype=np.int32)
+    n = len(pids)
+    counts = np.empty(n_parts, dtype=np.int64)
+    order = np.empty(n, dtype=np.int64)
+    lib = _lib()
+    if lib is None:
+        counts[:] = np.bincount(pids, minlength=n_parts)
+        order[:] = np.argsort(pids, kind="stable")
+        return counts, order
+    lib.radix_partition(_ptr(pids, ctypes.c_int32), n, n_parts,
+                        _ptr(counts, ctypes.c_int64), _ptr(order, ctypes.c_int64))
+    return counts, order
+
+
+def loser_tree_merge_host(
+    run_words: list[list[np.ndarray]],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Merge sorted runs keyed by uint64 word lists.
+
+    run_words[r][w]: w-th key array of run r (all runs same n_words).
+    Returns (out_run, out_idx) in globally sorted order.
+    """
+    n_runs = len(run_words)
+    n_words = len(run_words[0])
+    lens = np.array([len(r[0]) for r in run_words], dtype=np.int64)
+    total = int(lens.sum())
+    out_run = np.empty(total, dtype=np.int32)
+    out_idx = np.empty(total, dtype=np.int64)
+    lib = _lib()
+    if lib is None:
+        words = [
+            np.concatenate([np.ascontiguousarray(r[w], np.uint64) for r in run_words])
+            for w in range(n_words)
+        ]
+        runs = np.concatenate(
+            [np.full(len(r[0]), i, np.int32) for i, r in enumerate(run_words)]
+        )
+        idxs = np.concatenate([np.arange(len(r[0]), dtype=np.int64) for r in run_words])
+        order = np.lexsort(list(reversed(words)) + [idxs * 0])  # keys only; stable
+        return runs[order], idxs[order]
+    arrs = []  # keep references alive
+    ptrs = (ctypes.c_void_p * (n_runs * n_words))()
+    for r in range(n_runs):
+        for w in range(n_words):
+            a = np.ascontiguousarray(run_words[r][w], dtype=np.uint64)
+            arrs.append(a)
+            ptrs[r * n_words + w] = a.ctypes.data
+    lib.loser_tree_merge(ptrs, _ptr(lens, ctypes.c_int64), n_runs, n_words,
+                         _ptr(out_run, ctypes.c_int32), _ptr(out_idx, ctypes.c_int64))
+    return out_run, out_idx

@@ -1,0 +1,196 @@
+// auron-tpu native runtime helpers.
+//
+// The reference implements its host-side runtime machinery natively
+// (loser-tree k-way merge ext-commons/src/algorithm/loser_tree.rs, radix
+// sort rdx_sort.rs, spark hashes spark_hash.rs — all Rust). These are the
+// C++ equivalents for this engine's *host* hot paths: merging spilled
+// sorted runs, clustering host rows by partition id, and hashing host-side
+// dictionary/sample data. Device-side compute stays in XLA; this library
+// covers the paths that run on the host CPU around it.
+//
+// Exposed as a plain C ABI consumed through ctypes (auron_tpu/native.py),
+// with pure-numpy fallbacks when the shared library is absent.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// spark murmur3_x86_32 (bit-exact; see ops/hashing.py for the contract)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xcc9e2d51u;
+  k1 = rotl32(k1, 15);
+  return k1 * 0x1b873593u;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + 0xe6546b64u;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t len) {
+  h1 ^= len;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+static inline uint32_t murmur3_bytes_one(const uint8_t* data, int32_t len,
+                                         uint32_t seed) {
+  uint32_t h1 = seed;
+  const int32_t aligned = len - (len % 4);
+  for (int32_t i = 0; i < aligned; i += 4) {
+    uint32_t word;
+    std::memcpy(&word, data + i, 4);
+    h1 = mix_h1(h1, mix_k1(word));
+  }
+  // spark quirk: each trailing byte is a full round, sign-extended
+  for (int32_t i = aligned; i < len; i++) {
+    const uint32_t b = (uint32_t)(int32_t)(int8_t)data[i];
+    h1 = mix_h1(h1, mix_k1(b));
+  }
+  return fmix(h1, (uint32_t)len);
+}
+
+void murmur3_i32(const int32_t* v, int64_t n, int32_t seed, int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t h = mix_h1((uint32_t)seed, mix_k1((uint32_t)v[i]));
+    out[i] = (int32_t)fmix(h, 4);
+  }
+}
+
+void murmur3_i64(const int64_t* v, int64_t n, int32_t seed, int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint64_t u = (uint64_t)v[i];
+    uint32_t h = mix_h1((uint32_t)seed, mix_k1((uint32_t)(u & 0xffffffffu)));
+    h = mix_h1(h, mix_k1((uint32_t)(u >> 32)));
+    out[i] = (int32_t)fmix(h, 8);
+  }
+}
+
+// offsets: n+1 entries into data
+void murmur3_bytes(const uint8_t* data, const int64_t* offsets, int64_t n,
+                   int32_t seed, int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = (int32_t)murmur3_bytes_one(data + offsets[i],
+                                        (int32_t)(offsets[i + 1] - offsets[i]),
+                                        (uint32_t)seed);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// radix (counting) partition: cluster row indices by partition id
+// ---------------------------------------------------------------------------
+
+// pids[n] in [0, n_parts); writes counts[n_parts] and order[n] such that
+// order lists row indices partition-by-partition, stable within partitions.
+void radix_partition(const int32_t* pids, int64_t n, int32_t n_parts,
+                     int64_t* counts, int64_t* order) {
+  std::vector<int64_t> pos((size_t)n_parts + 1, 0);
+  for (int64_t i = 0; i < n; i++) pos[(size_t)pids[i] + 1]++;
+  for (int32_t p = 0; p < n_parts; p++) counts[p] = pos[(size_t)p + 1];
+  for (int32_t p = 0; p < n_parts; p++) pos[(size_t)p + 1] += pos[(size_t)p];
+  for (int64_t i = 0; i < n; i++) order[pos[(size_t)pids[i]]++] = i;
+}
+
+// ---------------------------------------------------------------------------
+// loser-tree k-way merge of sorted runs keyed by multiword uint64 keys
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct MergeSource {
+  // words[w] points at run's w-th key array (uint64, ascending lex order)
+  const uint64_t* const* words;
+  int n_words;
+  int64_t len;
+  int64_t pos;
+};
+
+// lexicographic: is source a's current key < source b's current key?
+// ties break by run index for stability.
+static inline bool src_less(const MergeSource& a, int ia, const MergeSource& b,
+                            int ib) {
+  for (int w = 0; w < a.n_words; w++) {
+    const uint64_t aw = a.words[w][a.pos];
+    const uint64_t bw = b.words[w][b.pos];
+    if (aw != bw) return aw < bw;
+  }
+  return ia < ib;
+}
+
+}  // namespace
+
+// run_words: flattened pointers, run r's word w at run_words[r * n_words + w].
+// Writes (out_run[i], out_idx[i]) for i in [0, total) in merged order.
+void loser_tree_merge(const uint64_t* const* run_words, const int64_t* run_lens,
+                      int32_t n_runs, int32_t n_words, int32_t* out_run,
+                      int64_t* out_idx) {
+  std::vector<MergeSource> src((size_t)n_runs);
+  for (int32_t r = 0; r < n_runs; r++) {
+    src[(size_t)r] = {run_words + (size_t)r * n_words, n_words, run_lens[r], 0};
+  }
+  // tournament tree of "losers"; tree[0] holds the winner
+  const int32_t k = n_runs;
+  std::vector<int32_t> tree((size_t)k, -1);
+
+  auto exhausted = [&](int32_t r) { return src[(size_t)r].pos >= src[(size_t)r].len; };
+  // a beats b if b is exhausted or a's key is smaller
+  auto beats = [&](int32_t a, int32_t b) {
+    if (a < 0) return false;
+    if (b < 0) return true;
+    if (exhausted(a)) return false;
+    if (exhausted(b)) return true;
+    return src_less(src[(size_t)a], a, src[(size_t)b], b);
+  };
+
+  // initialize by playing everyone up the tree
+  std::vector<int32_t> winner_of((size_t)(2 * k), -1);
+  for (int32_t i = 0; i < k; i++) winner_of[(size_t)(k + i)] = i;
+  for (int32_t node = k - 1; node >= 1; node--) {
+    int32_t a = winner_of[(size_t)(2 * node)];
+    int32_t b = winner_of[(size_t)(2 * node + 1)];
+    if (beats(a, b)) {
+      winner_of[(size_t)node] = a;
+      tree[(size_t)node] = b;
+    } else {
+      winner_of[(size_t)node] = b;
+      tree[(size_t)node] = a;
+    }
+  }
+  int32_t winner = winner_of[1];
+
+  int64_t out = 0;
+  while (winner >= 0 && !exhausted(winner)) {
+    out_run[out] = winner;
+    out_idx[out] = src[(size_t)winner].pos;
+    out++;
+    src[(size_t)winner].pos++;
+    // replay from the winner's leaf up
+    int32_t node = (k + winner) / 2;
+    int32_t cur = winner;
+    while (node >= 1) {
+      if (beats(tree[(size_t)node], cur)) {
+        const int32_t tmp = cur;
+        cur = tree[(size_t)node];
+        tree[(size_t)node] = tmp;
+      }
+      node /= 2;
+    }
+    winner = cur;
+  }
+}
+
+}  // extern "C"
