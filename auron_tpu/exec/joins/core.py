@@ -211,6 +211,16 @@ def expand_pairs(
     return chunks, probe_matched, build_matched_delta
 
 
+@jax.jit
+def gather_pair_arrays(probe_vals, probe_masks, build_vals, build_masks, li, ri, ok):
+    """One fused program gathering all pair columns (both sides)."""
+    pv = tuple(v[li] for v in probe_vals)
+    pm = tuple(m[li] & ok for m in probe_masks)
+    bv = tuple(v[ri] for v in build_vals)
+    bm = tuple(m[ri] & ok for m in build_masks)
+    return pv, pm, bv, bm
+
+
 def gather_columns(batch: Batch, idx: jnp.ndarray, row_ok: jnp.ndarray) -> list[ColumnVal]:
     out = []
     for i, f in enumerate(batch.schema):

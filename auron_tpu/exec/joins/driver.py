@@ -159,12 +159,19 @@ class EquiJoinDriver:
         return covered
 
     def _assemble_pairs_batch(self, probe_b, build_b, li, ri, ok) -> Batch:
-        if self.probe_is_left:
-            lcols = gather_columns(probe_b, li, ok)
-            rcols = gather_columns(build_b, ri, ok)
-        else:
-            lcols = gather_columns(build_b, ri, ok)
-            rcols = gather_columns(probe_b, li, ok)
+        pv, pm, bv, bm = core.gather_pair_arrays(
+            probe_b.device.values, probe_b.device.validity,
+            build_b.device.values, build_b.device.validity, li, ri, ok,
+        )
+        pcols = [
+            ColumnVal(v, m, f.dtype, probe_b.dicts[i])
+            for i, (v, m, f) in enumerate(zip(pv, pm, probe_b.schema))
+        ]
+        bcols = [
+            ColumnVal(v, m, f.dtype, build_b.dicts[i])
+            for i, (v, m, f) in enumerate(zip(bv, bm, build_b.schema))
+        ]
+        lcols, rcols = (pcols, bcols) if self.probe_is_left else (bcols, pcols)
         comb = core.join_output_schema(self.left_schema, self.right_schema, INNER)
         out = batch_from_columns(lcols + rcols, comb.names, ok)
         return Batch(comb, out.device, out.dicts)

@@ -85,30 +85,35 @@ class ShuffleWriterExec(ExecOperator):
         yield  # pragma: no cover — generator with no items
 
 
-def partition_batch(
-    b: Batch, partitioning: Partitioning, ctx: ExecutionContext
-) -> list[tuple[int, pa.RecordBatch]]:
-    """Cluster a batch by partition id on device; return per-partition arrow
-    slices (host). Dead rows are excluded."""
-    pids = partitioning.partition_ids(b, ctx)
-    n_out = partitioning.num_partitions
-    sel = b.device.sel
-    cap = b.capacity
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("n_out",))
+def _cluster_by_pid(dev: DeviceBatch, pids: jnp.ndarray, n_out: int):
+    sel = dev.sel
+    cap = sel.shape[0]
     sort_pid = jnp.where(sel, pids, n_out).astype(jnp.int32)
     iota = jnp.arange(cap, dtype=jnp.int32)
     s_pid, order = lax.sort((sort_pid, iota), num_keys=1)
     counts = jnp.bincount(s_pid, length=n_out + 1)
-
-    dev = b.device
-    clustered = Batch(
-        b.schema,
-        DeviceBatch(
-            sel=dev.sel[order],
-            values=tuple(v[order] for v in dev.values),
-            validity=tuple(m[order] for m in dev.validity),
-        ),
-        b.dicts,
+    out = DeviceBatch(
+        sel=dev.sel[order],
+        values=tuple(v[order] for v in dev.values),
+        validity=tuple(m[order] for m in dev.validity),
     )
+    return out, counts
+
+
+def partition_batch(
+    b: Batch, partitioning: Partitioning, ctx: ExecutionContext
+) -> list[tuple[int, pa.RecordBatch]]:
+    """Cluster a batch by partition id on device; return per-partition arrow
+    slices (host). Dead rows are excluded. The device portion (pid sort +
+    counts + gather) is one jitted program per batch shape."""
+    pids = partitioning.partition_ids(b, ctx)
+    n_out = partitioning.num_partitions
+    clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
+    clustered = Batch(b.schema, clustered_dev, b.dicts)
     counts_np = np.asarray(jax.device_get(counts))[:n_out]
     rb = clustered.to_arrow(compact=False)  # one transfer; rows already clustered
     out = []

@@ -3,15 +3,20 @@
 The join core (exec/joins/) represents equi-join keys as tuples of uint64
 words (same canonical encoding as group-by, ops/segments.py). The build/right
 side is sorted by those words; probing is a branchless fixed-trip binary
-search (ceil(log2(n)) steps) done for every query row in parallel — the
-TPU-native replacement for the reference's row hash map probes
+search (ceil(log2(capacity)) steps) done for every query row in parallel —
+the TPU-native replacement for the reference's row hash map probes
 (datafusion-ext-plans/src/joins/join_hash_map.rs).
+
+Both entry points are jitted with the live count ``n`` as a *dynamic*
+scalar: the trip count comes from the static array capacity, so compilation
+caches purely on shapes (capacity buckets), not on data-dependent sizes.
 """
 
 from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -37,21 +42,21 @@ def _lex_less_eq(a_words: list, a_idx: jnp.ndarray, b_words: list) -> jnp.ndarra
     return lt | eq
 
 
-def lower_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
-    """First index i in [0, n] with sorted[i] >= query (per query row)."""
+def _search(sorted_words: list, query_words: list, n, less_fn) -> jnp.ndarray:
+    cap = sorted_words[0].shape[0]
     m = query_words[0].shape[0]
     lo = jnp.zeros(m, jnp.int32)
-    if n == 0:
+    if cap == 0:
         return lo
-    steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
-    hi = jnp.full(m, n, jnp.int32)
+    hi = jnp.full(m, jnp.int32(n))
+    steps = max(1, math.ceil(math.log2(max(cap, 2))) + 1)
 
     def body(_, state):
         lo, hi = state
         active = lo < hi  # fixed-trip loop: freeze once converged
         mid = (lo + hi) // 2
-        midc = jnp.clip(mid, 0, max(n - 1, 0))
-        less = _lex_less(sorted_words, midc, query_words)
+        midc = jnp.clip(mid, 0, cap - 1)
+        less = less_fn(sorted_words, midc, query_words)
         lo = jnp.where(active & less, mid + 1, lo)
         hi = jnp.where(active & ~less, mid, hi)
         return lo, hi
@@ -60,24 +65,25 @@ def lower_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
     return lo
 
 
+@jax.jit
+def lower_bound_dyn(sorted_words: list, query_words: list, n) -> jnp.ndarray:
+    return _search(sorted_words, query_words, n, _lex_less)
+
+
+@jax.jit
+def upper_bound_dyn(sorted_words: list, query_words: list, n) -> jnp.ndarray:
+    return _search(sorted_words, query_words, n, _lex_less_eq)
+
+
+def lower_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
+    """First index i in [0, n] with sorted[i] >= query (per query row)."""
+    if sorted_words[0].shape[0] == 0:
+        return jnp.zeros(query_words[0].shape[0], jnp.int32)
+    return lower_bound_dyn(sorted_words, query_words, jnp.int32(n))
+
+
 def upper_bound(sorted_words: list, query_words: list, n: int) -> jnp.ndarray:
     """First index i in [0, n] with sorted[i] > query (per query row)."""
-    m = query_words[0].shape[0]
-    lo = jnp.zeros(m, jnp.int32)
-    if n == 0:
-        return lo
-    steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
-    hi = jnp.full(m, n, jnp.int32)
-
-    def body(_, state):
-        lo, hi = state
-        active = lo < hi  # fixed-trip loop: freeze once converged
-        mid = (lo + hi) // 2
-        midc = jnp.clip(mid, 0, max(n - 1, 0))
-        le = _lex_less_eq(sorted_words, midc, query_words)
-        lo = jnp.where(active & le, mid + 1, lo)
-        hi = jnp.where(active & ~le, mid, hi)
-        return lo, hi
-
-    lo, hi = lax.fori_loop(0, steps, body, (lo, hi))
-    return lo
+    if sorted_words[0].shape[0] == 0:
+        return jnp.zeros(query_words[0].shape[0], jnp.int32)
+    return upper_bound_dyn(sorted_words, query_words, jnp.int32(n))

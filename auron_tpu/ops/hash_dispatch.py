@@ -57,6 +57,43 @@ def _column_hash_fn(dtype: T.DataType, algo: str) -> Callable:
         raise TypeError(f"xxhash64: unhashable fixed type {dtype}")
 
 
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("dtypes", "algo", "seed"))
+def _hash_columns_jit(values, validity, dict_mats, dtypes, algo, seed):
+    """Jitted chained hash over prepared column arrays.
+
+    dict_mats: per-column (bytes_mat, lens) or None for fixed types.
+    """
+    n = values[0].shape[0]
+    if algo == "murmur3":
+        h = jnp.full((n,), jnp.uint32(seed))
+    else:
+        h = jnp.full((n,), jnp.int64(seed).view(jnp.uint64))
+    for v, valid, dm, dtype in zip(values, validity, dict_mats, dtypes):
+        if dtype.kind == T.TypeKind.NULL:
+            continue
+        if dm is not None:
+            bytes_mat, lens = dm
+            codes = jnp.clip(v, 0, bytes_mat.shape[0] - 1)
+            row_bytes = bytes_mat[codes]
+            row_lens = lens[codes]
+            if algo == "murmur3":
+                hashed = H.murmur3_bytes(row_bytes, row_lens, h)
+            else:
+                hashed = H.xxhash64_bytes(row_bytes, row_lens, h)
+        else:
+            fn = _column_hash_fn(dtype, algo)
+            hashed = fn(v, h)
+        h = jnp.where(valid, hashed, h)
+    if algo == "murmur3":
+        return h.view(jnp.int32)
+    return h.view(jnp.int64)
+
+
 def hash_batch(
     batch: Batch,
     cols: list[int],
@@ -66,33 +103,23 @@ def hash_batch(
     """Per-row chained Spark hash of the given columns of a batch.
 
     Returns int32 (murmur3) or int64 (xxhash64) per row. Rows with sel=False
-    still get a value (of the padding), callers mask as needed.
+    still get a value (of the padding), callers mask as needed. One jitted
+    program per (shapes, dtypes) signature; dictionary byte matrices are
+    prepared host-side per dictionary.
     """
     assert algo in ("murmur3", "xxhash64")
     dev = batch.device
-    n = batch.capacity
-    if algo == "murmur3":
-        h = jnp.full((n,), jnp.uint32(seed))
-    else:
-        h = jnp.full((n,), jnp.int64(seed).view(jnp.uint64))
-
+    values, validity, dict_mats, dtypes = [], [], [], []
     for ci in cols:
         dtype = batch.schema[ci].dtype
-        valid = dev.validity[ci]
-        if dtype.kind == T.TypeKind.NULL:
-            continue
+        values.append(dev.values[ci])
+        validity.append(dev.validity[ci])
+        dtypes.append(dtype)
         if dtype.is_string_like:
             bm = ByteMatrix.from_arrow(batch.dicts[ci])
-            row_bytes, row_lens = bm.take(jnp.clip(dev.values[ci], 0, None))
-            if algo == "murmur3":
-                hashed = H.murmur3_bytes(row_bytes, row_lens, h)
-            else:
-                hashed = H.xxhash64_bytes(row_bytes, row_lens, h)
+            dict_mats.append((bm.bytes, bm.lengths))
         else:
-            fn = _column_hash_fn(dtype, algo)
-            hashed = fn(dev.values[ci], h)
-        h = jnp.where(valid, hashed, h)
-
-    if algo == "murmur3":
-        return h.view(jnp.int32)
-    return h.view(jnp.int64)
+            dict_mats.append(None)
+    return _hash_columns_jit(
+        tuple(values), tuple(validity), tuple(dict_mats), tuple(dtypes), algo, seed
+    )

@@ -80,6 +80,7 @@ class Segmentation(NamedTuple):
     sel_sorted: jnp.ndarray  # liveness in sorted order
 
 
+@jax.jit
 def segment_by_keys(words: list[jnp.ndarray], sel: jnp.ndarray) -> Segmentation:
     cap = sel.shape[0]
     dead_first_key = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))

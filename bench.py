@@ -24,8 +24,8 @@ def main() -> None:
     import auron_tpu  # noqa: F401
     from auron_tpu.models import tpcds
 
-    sf = float(os.environ.get("BENCH_SF", "0.05"))
-    n_parts = int(os.environ.get("BENCH_PARTS", "4"))
+    sf = float(os.environ.get("BENCH_SF", "0.5"))
+    n_parts = int(os.environ.get("BENCH_PARTS", "2"))
     data = tpcds.generate(sf=sf, seed=42)
     n_rows = data.fact_rows()
 
