@@ -413,3 +413,23 @@ _dict_transform(
     "rpad", lambda s, n, p=" ": (s + p * n)[:n] if n > len(s) else s[:n]
 )
 _dict_transform("instr", lambda s, sub: s.find(sub) + 1, T.INT32)
+
+
+# ---------------------------------------------------------------------------
+# runtime filters
+# ---------------------------------------------------------------------------
+
+
+@registry.register("bloom_filter_might_contain", T.BOOL)
+def _bloom_might_contain(args, cap):
+    """args: (serialized bloom filter as BINARY literal, long column).
+    Analog of datafusion-ext-exprs bloom_filter_might_contain — the filter
+    is built by the bloom-filter aggregate on the other side of a join and
+    shipped through the plan."""
+    from auron_tpu.ops.bloom import SparkBloomFilter
+
+    filt_cv, col_cv = args
+    payload = _scalar_arg(filt_cv)
+    bf = SparkBloomFilter.deserialize(payload)
+    hit = bf.might_contain_long(col_cv.values.astype(jnp.int64))
+    return _cv(hit, col_cv.validity, T.BOOL)

@@ -1,0 +1,104 @@
+"""Spark-compatible bloom filter (runtime filter pushdown).
+
+Analog of the reference's spark bloom filter + bit array
+(datafusion-ext-commons/src/spark_bloom_filter.rs, spark_bit_array.rs) used
+by the bloom-filter aggregate and the ``bloom_filter_might_contain``
+expression (datafusion-ext-exprs). Algorithm follows Spark's
+BloomFilterImpl: k probes derived from the 32-bit murmur3 double-hash
+(h1 = hash(item, 0), h2 = hash(item, h1), probe_i = h1 + i*h2 with
+negative-flip, mod numBits).
+
+The bit array lives on device as uint32 words, so ``might_contain`` over a
+column is a fused gather + bit-test program — the runtime-filter probe runs
+at full batch width on the TPU.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.ops import hashing as H
+
+
+def optimal_num_bits(n_items: int, fpp: float) -> int:
+    return max(64, int(-n_items * math.log(fpp) / (math.log(2) ** 2)))
+
+
+def optimal_num_hashes(n_items: int, n_bits: int) -> int:
+    return max(1, round(n_bits / max(n_items, 1) * math.log(2)))
+
+
+class SparkBloomFilter:
+    def __init__(self, num_bits: int, num_hashes: int, words: jnp.ndarray | None = None):
+        self.num_bits = (num_bits + 31) & ~31
+        self.num_hashes = num_hashes
+        n_words = self.num_bits // 32
+        self.words = (
+            words if words is not None else jnp.zeros(n_words, dtype=jnp.uint32)
+        )
+
+    @staticmethod
+    def create(expected_items: int, fpp: float = 0.03) -> "SparkBloomFilter":
+        bits = optimal_num_bits(expected_items, fpp)
+        return SparkBloomFilter(bits, optimal_num_hashes(expected_items, bits))
+
+    # ---- probes (device) ----
+
+    def _probe_bits(self, values_i64: jnp.ndarray) -> jnp.ndarray:
+        """[n, k] bit positions per value (Spark double-hash scheme)."""
+        h1 = H.murmur3_i64(values_i64, jnp.uint32(0)).view(jnp.int32)
+        h2 = H.murmur3_i64(values_i64, h1.view(jnp.uint32)).view(jnp.int32)
+        probes = []
+        for i in range(1, self.num_hashes + 1):
+            combined = (h1.astype(jnp.int64) + i * h2.astype(jnp.int64)).astype(jnp.int32)
+            combined = jnp.where(combined < 0, ~combined, combined)
+            probes.append(combined.astype(jnp.int64) % self.num_bits)
+        return jnp.stack(probes, axis=1)
+
+    def put_long(self, values_i64: jnp.ndarray, valid: jnp.ndarray | None = None) -> None:
+        bits = self._probe_bits(values_i64)  # [n, k]
+        if valid is not None:
+            # out-of-range (>= num_bits) is dropped by the scatter; negative
+            # indices would wrap in JAX, so use the past-the-end sentinel
+            bits = jnp.where(valid[:, None], bits, self.num_bits)
+        # OR-scatter: set a bool bit array, then pack 32 bits/word. The sum
+        # is exact because each bit position contributes one distinct power
+        # of two at most once.
+        hits = jnp.zeros(self.num_bits, bool).at[bits.reshape(-1)].set(True, mode="drop")
+        packed = jnp.sum(
+            hits.reshape(-1, 32).astype(jnp.uint32)
+            << jnp.arange(32, dtype=jnp.uint32)[None, :],
+            axis=1,
+            dtype=jnp.uint32,
+        )
+        self.words = self.words | packed
+
+    def might_contain_long(self, values_i64: jnp.ndarray) -> jnp.ndarray:
+        bits = self._probe_bits(values_i64)
+        words = self.words[(bits // 32)]
+        hit = (words >> (bits % 32).astype(jnp.uint32)) & jnp.uint32(1)
+        return jnp.all(hit == 1, axis=1)
+
+    def merge(self, other: "SparkBloomFilter") -> "SparkBloomFilter":
+        assert self.num_bits == other.num_bits and self.num_hashes == other.num_hashes
+        return SparkBloomFilter(self.num_bits, self.num_hashes, self.words | other.words)
+
+    # ---- serde (binary payload shipped through plans/literals) ----
+
+    def serialize(self) -> bytes:
+        w = np.asarray(jax.device_get(self.words)).astype("<u4").tobytes()
+        return struct.pack("<III", 1, self.num_hashes, self.num_bits) + w
+
+    @staticmethod
+    def deserialize(data: bytes) -> "SparkBloomFilter":
+        version, k, num_bits = struct.unpack_from("<III", data, 0)
+        assert version == 1
+        words = jnp.asarray(np.frombuffer(data[12:], dtype="<u4").copy())
+        return SparkBloomFilter(num_bits, k, words)
+
+

@@ -1,0 +1,90 @@
+"""Broadcast exchange + AQE partition statistics.
+
+Analogs of:
+- NativeBroadcastExchangeBase (spark-extension .../NativeBroadcastExchangeBase.scala:117-190):
+  the driver runs the build-side plan, collects compressed IPC bytes, and
+  the engine replicates them to every executor. ``collect_ipc`` /
+  ``batches_from_ipc`` implement the native halves of that protocol; on a
+  device mesh, replication is a ``jax.device_put`` with a replicated
+  sharding (an all-gather in SPMD terms).
+- AQE stage statistics: the shuffle writer's .index files ARE the map
+  output sizes (MapStatus analog); ``map_output_stats`` aggregates them and
+  ``plan_coalesced_partitions`` computes AQE-style post-shuffle partition
+  coalescing (merge small reduce partitions up to a target size).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.base import ExecOperator, ExecutionContext
+from auron_tpu.exec.shuffle.format import decode_blocks, encode_block, read_index
+
+
+def collect_ipc(op: ExecOperator, partitions: list[int] | None = None) -> list[bytes]:
+    """Run the plan (driver-side) and collect its output as IPC blocks."""
+    parts = partitions if partitions is not None else [0]
+    blocks: list[bytes] = []
+    for p in parts:
+        ctx = ExecutionContext(partition_id=p)
+        for b in op.execute(p, ctx):
+            rb = b.to_arrow()
+            if rb.num_rows:
+                blocks.append(encode_block(rb))
+    return blocks
+
+
+def batches_from_ipc(blocks: list[bytes]) -> list[Batch]:
+    out = []
+    for blk in blocks:
+        for rb in decode_blocks(blk):
+            if rb.num_rows:
+                out.append(Batch.from_arrow(rb))
+    return out
+
+
+def replicate_to_mesh(batch: Batch, mesh):
+    """Replicate a batch's device arrays across a mesh (broadcast join build
+    side living on every chip)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    dev = jax.device_put(batch.device, sharding)
+    return batch.with_device(dev)
+
+
+# ---------------------------------------------------------------------------
+# AQE statistics
+# ---------------------------------------------------------------------------
+
+
+def map_output_stats(index_files: list[str]) -> np.ndarray:
+    """Per-reduce-partition output bytes summed over all map tasks."""
+    totals: np.ndarray | None = None
+    for f in index_files:
+        offsets = np.asarray(read_index(f), dtype=np.int64)
+        sizes = offsets[1:] - offsets[:-1]
+        totals = sizes if totals is None else totals + sizes
+    return totals if totals is not None else np.zeros(0, np.int64)
+
+
+def plan_coalesced_partitions(
+    partition_bytes: np.ndarray, target_bytes: int
+) -> list[list[int]]:
+    """AQE post-shuffle coalescing: group adjacent small reduce partitions
+    until each group reaches ~target_bytes (Spark's
+    CoalesceShufflePartitions behavior)."""
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for p, sz in enumerate(partition_bytes.tolist()):
+        cur.append(p)
+        cur_bytes += sz
+        if cur_bytes >= target_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        groups.append(cur)
+    return groups

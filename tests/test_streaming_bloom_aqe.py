@@ -1,0 +1,130 @@
+"""Streaming Calc, bloom filter, broadcast, AQE statistics tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.exec.streaming import (
+    EARLIEST, LATEST, JsonRowDeserializer, MockKafkaSource, StreamingCalcExec,
+)
+from auron_tpu.exprs.ir import BinaryOp, ScalarFunc, col, lit
+
+
+def _records(n, start=0):
+    return [json.dumps({"id": i, "v": i * 1.5, "s": f"u{i % 3}"}).encode()
+            for i in range(start, start + n)]
+
+
+def _calc(source, schema):
+    return StreamingCalcExec(
+        source=source,
+        deserializer=JsonRowDeserializer(schema),
+        in_schema=schema,
+        predicates=[BinaryOp("gteq", col(0), lit(3))],
+        projections=[(col(0), "id"), (BinaryOp("mul", col(1), lit(2.0)), "v2")],
+        max_batch_records=4,
+    )
+
+
+def test_streaming_calc_earliest():
+    schema = T.Schema.of(T.Field("id", T.INT64), T.Field("v", T.FLOAT64),
+                         T.Field("s", T.STRING))
+    src = MockKafkaSource([_records(5), _records(5, start=5)])
+    out = []
+    for b in _calc(src, schema).run():
+        out += b.to_pydict()["id"]
+    assert sorted(out) == list(range(3, 10))
+    assert src.offsets() == {0: 5, 1: 5}
+
+
+def test_streaming_startup_modes():
+    schema = T.Schema.of(T.Field("id", T.INT64), T.Field("v", T.FLOAT64),
+                         T.Field("s", T.STRING))
+    src = MockKafkaSource([_records(5)], startup_mode=LATEST)
+    assert list(_calc(src, schema).run()) == []
+    src2 = MockKafkaSource([_records(5)], startup_mode="offsets", start_offsets={0: 4})
+    out = []
+    for b in _calc(src2, schema).run():
+        out += b.to_pydict()["id"]
+    assert out == [4]
+
+
+def test_streaming_bad_records_become_nulls():
+    schema = T.Schema.of(T.Field("id", T.INT64), T.Field("v", T.FLOAT64),
+                         T.Field("s", T.STRING))
+    src = MockKafkaSource([[b"not json", json.dumps({"id": 7, "v": 1.0, "s": "x"}).encode()]])
+    out = []
+    for b in _calc(src, schema).run():
+        out += b.to_pydict()["id"]
+    assert out == [7]  # bad record -> null id -> filtered by predicate
+
+
+def test_bloom_filter_no_false_negatives():
+    import jax.numpy as jnp
+
+    from auron_tpu.ops.bloom import SparkBloomFilter
+
+    rng = np.random.default_rng(23)
+    items = jnp.asarray(rng.integers(-(2**62), 2**62, 5000))
+    bf = SparkBloomFilter.create(5000, fpp=0.03)
+    bf.put_long(items)
+    assert bool(bf.might_contain_long(items).all())
+    others = jnp.asarray(rng.integers(-(2**62), 2**62, 5000))
+    fp = float(bf.might_contain_long(others).mean())
+    assert fp < 0.1
+    # serde roundtrip
+    bf2 = SparkBloomFilter.deserialize(bf.serialize())
+    assert bool(bf2.might_contain_long(items).all())
+
+
+def test_bloom_might_contain_expr():
+    import jax.numpy as jnp
+
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exec.basic import MemoryScanExec, ProjectExec
+    from auron_tpu.ops.bloom import SparkBloomFilter
+
+    bf = SparkBloomFilter.create(10)
+    bf.put_long(jnp.asarray([5, 7, 9], dtype=jnp.int64))
+    payload = bf.serialize()
+    b = Batch.from_pydict({"x": [5, 6, 7, 8]},
+                          schema=T.Schema.of(T.Field("x", T.INT64)))
+    p = ProjectExec(
+        MemoryScanExec.single([b]),
+        [ScalarFunc("bloom_filter_might_contain", (lit(payload, T.BINARY), col(0)))],
+        ["hit"],
+    )
+    out = p.collect_pydict()["hit"]
+    assert out[0] is True and out[2] is True  # no false negatives
+
+
+def test_broadcast_and_aqe(tmp_path):
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.parallel.broadcast import (
+        batches_from_ipc, collect_ipc, map_output_stats, plan_coalesced_partitions,
+    )
+
+    b = Batch.from_pydict({"x": [1, 2, 3]})
+    blocks = collect_ipc(MemoryScanExec.single([b]))
+    back = batches_from_ipc(blocks)
+    assert back[0].to_pydict() == {"x": [1, 2, 3]}
+
+    # AQE stats over real shuffle indexes
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.shuffle import HashPartitioning, ShuffleWriterExec
+    from auron_tpu.exprs.ir import col as c
+
+    idxs = []
+    for m in range(2):
+        scan = MemoryScanExec.single([Batch.from_pydict({"k": list(range(100))})])
+        d, i = str(tmp_path / f"m{m}.data"), str(tmp_path / f"m{m}.index")
+        list(ShuffleWriterExec(scan, HashPartitioning([c(0)], 8), d, i).execute(0, ExecutionContext()))
+        idxs.append(i)
+    stats = map_output_stats(idxs)
+    assert len(stats) == 8 and stats.sum() > 0
+    groups = plan_coalesced_partitions(stats, target_bytes=int(stats.sum() // 3))
+    assert sum(len(g) for g in groups) == 8
+    assert len(groups) <= 4
