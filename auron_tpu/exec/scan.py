@@ -104,6 +104,52 @@ class ParquetScanExec(ExecOperator):
                     yield Batch.from_arrow(tbl.combine_chunks().to_batches()[0])
 
 
+class OrcScanExec(ExecOperator):
+    """ORC scan: host decode (pyarrow.orc) with column projection +
+    post-read pruning, device upload (reference: orc_exec.rs via orc-rust)."""
+
+    def __init__(
+        self,
+        schema: T.Schema,
+        file_paths: list[str],
+        pruning_predicates: list[ir.Expr] | None = None,
+        fs_resource_id: str | None = None,
+    ):
+        super().__init__([], schema)
+        self.file_paths = file_paths
+        self.pruning_predicates = pruning_predicates or []
+        self.fs_resource_id = fs_resource_id
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        import pyarrow.orc as orc
+
+        cols = self.schema.names
+        filt = None
+        for p in self.pruning_predicates:
+            f = pruning_to_arrow_filter(p, self.schema)
+            if f is not None:
+                filt = f if filt is None else (filt & f)
+        bs = ctx.batch_size()
+        opener = ctx.resources.get(self.fs_resource_id) if self.fs_resource_id else None
+        for path in self.file_paths:
+            ctx.check_cancelled()
+            src = opener(path) if opener is not None else path
+            with ctx.metrics.timer("io_time"):
+                of = orc.ORCFile(src)
+            for stripe_i in range(of.nstripes):
+                ctx.check_cancelled()
+                with ctx.metrics.timer("io_time"):
+                    tbl = pa.Table.from_batches([of.read_stripe(stripe_i, columns=cols)])
+                if filt is not None:
+                    tbl = tbl.filter(filt)
+                ctx.metrics.add("bytes_scanned", tbl.nbytes)
+                for i in range(0, tbl.num_rows, bs):
+                    chunk = tbl.slice(i, bs).combine_chunks()
+                    if chunk.num_rows:
+                        with ctx.metrics.timer("upload_time"):
+                            yield Batch.from_arrow(chunk.to_batches()[0])
+
+
 class FFIReaderExec(ExecOperator):
     """Pulls host-exported Arrow batches from the resource map."""
 

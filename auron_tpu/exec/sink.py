@@ -59,6 +59,41 @@ class ParquetSinkExec(ExecOperator):
         yield  # pragma: no cover
 
 
+class OrcSinkExec(ExecOperator):
+    """ORC writer (reference: orc_sink_exec.rs)."""
+
+    def __init__(self, child: ExecOperator, output_path: str, props: dict | None = None):
+        super().__init__([child], child.schema)
+        self.output_path = output_path
+        self.props = props or {}
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        import os
+
+        import pyarrow.orc as orc
+
+        os.makedirs(self.output_path, exist_ok=True)
+        path = os.path.join(self.output_path, f"part-{partition:05d}.orc")
+        tables = []
+        rows = 0
+        for b in self.child_stream(0, partition, ctx):
+            ctx.check_cancelled()
+            rb = b.to_arrow()
+            if rb.num_rows:
+                tables.append(pa.Table.from_batches([rb]))
+                rows += rb.num_rows
+        with ctx.metrics.timer("io_time"):
+            tbl = (
+                pa.concat_tables(tables)
+                if tables
+                else pa.Table.from_batches([], schema=self.schema.to_arrow())
+            )
+            orc.write_table(tbl, path)
+        ctx.metrics.add("rows_written", rows)
+        return
+        yield  # pragma: no cover
+
+
 class IpcWriterExec(ExecOperator):
     """Streams the partition's batches as length-prefixed compressed IPC
     blocks into a host channel registered in the resource map (list-like

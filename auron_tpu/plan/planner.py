@@ -395,6 +395,23 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             elem_name=n.elem_name or "col",
             pos_name=n.pos_name or "pos",
         )
+    if which == "orc_scan":
+        from auron_tpu.exec.scan import OrcScanExec
+
+        return OrcScanExec(
+            schema_from_proto(p.orc_scan.schema),
+            list(p.orc_scan.file_paths),
+            [expr_from_proto(e) for e in p.orc_scan.pruning_predicates],
+            p.orc_scan.fs_resource_id or None,
+        )
+    if which == "orc_sink":
+        from auron_tpu.exec.sink import OrcSinkExec
+
+        return OrcSinkExec(
+            plan_from_proto(p.orc_sink.child),
+            p.orc_sink.output_path,
+            dict(p.orc_sink.props),
+        )
     if which == "parquet_sink":
         from auron_tpu.exec.sink import ParquetSinkExec
 

@@ -58,6 +58,9 @@ class TaskRuntime:
     # ------------------------------------------------------------------
 
     def _pump(self) -> None:
+        from auron_tpu.utils.logging import clear_task_context, set_task_context
+
+        set_task_context(self.ctx.stage_id, self.ctx.partition_id)
         try:
             with conf_scope(self.ctx.conf):
                 for batch in self.plan.execute(self.ctx.partition_id, self.ctx):
@@ -67,6 +70,7 @@ class TaskRuntime:
         except BaseException as e:  # noqa: BLE001 — relayed to the consumer
             self._error = e
         finally:
+            clear_task_context()
             self._queue.put(_END)
 
     def _check_error(self) -> None:

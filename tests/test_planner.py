@@ -144,3 +144,20 @@ def test_ipc_writer_collect_path():
 
     rows = sum(rb.num_rows for blk in chan for rb in decode_blocks(blk))
     assert rows == 3
+
+
+def test_orc_scan_sink_plan(tmp_path):
+    import pyarrow.orc as orc
+
+    df = pd.DataFrame({"a": np.arange(50), "s": [f"v{i%5}" for i in range(50)]})
+    src = str(tmp_path / "in.orc")
+    orc.write_table(pa.Table.from_pandas(df, preserve_index=False), src)
+    schema = T.Schema.of(T.Field("a", T.INT64), T.Field("s", T.STRING))
+    node = pb.PhysicalPlanNode(orc_scan=pb.OrcScanNode(
+        schema=__import__("auron_tpu.plan.planner", fromlist=["schema_to_proto"]).schema_to_proto(schema),
+        file_paths=[src]))
+    sink = pb.PhysicalPlanNode(orc_sink=pb.OrcSinkNode(child=node, output_path=str(tmp_path / "out")))
+    assert _run(sink) is None
+    back = orc.ORCFile(str(tmp_path / "out" / "part-00000.orc")).read().to_pandas()
+    assert back["a"].tolist() == list(range(50))
+    assert back["s"].tolist() == df["s"].tolist()
