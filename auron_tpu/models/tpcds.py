@@ -261,7 +261,9 @@ def run_q3_class(
             while (rb := api.next_batch(h)) is not None:
                 frames.append(rb.to_pandas())
             api.finalize_native(h)
-        merged = pd.concat(frames).reset_index(drop=True) if frames else pd.DataFrame()
+        if not frames:
+            return pd.DataFrame({"d_year": [], "i_brand_id": [], "s": []})
+        merged = pd.concat(frames).reset_index(drop=True)
         # global top-k (driver-side, like Spark's takeOrdered on collect)
         merged = merged.sort_values(
             ["d_year", "s"], ascending=[True, False], kind="stable"
