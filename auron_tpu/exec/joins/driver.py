@@ -78,10 +78,12 @@ class EquiJoinDriver:
         """Probe one batch; updates build.matched in place."""
         probe_keys = self.left_keys if self.probe_is_left else self.right_keys
         pvals = _key_columns(pb, probe_keys)
-        build_keys = self.left_keys if self.build_side == "left" else self.right_keys
-        bvals = _key_columns(build.batch, build_keys)
         has_dict_keys = any(v.dtype.is_dict_encoded for v in pvals)
         if has_dict_keys:
+            # only dict keys need the build side re-keyed (joint vocabulary);
+            # for fixed-width keys build.words from prepare_build are final
+            build_keys = self.left_keys if self.build_side == "left" else self.right_keys
+            bvals = _key_columns(build.batch, build_keys)
             bvals, pvals = unify_key_dicts(bvals, pvals)
             bwords, _ = _canon_words(bvals)
             build = PreparedBuild(build.batch, bwords, build.n_live, build.matched)

@@ -54,6 +54,11 @@ class SortExec(ExecOperator):
         self.specs = specs
         self.fetch = fetch
         self.spill_threshold_rows = spill_threshold_rows
+        # per-run dictionary ranks are not comparable across runs, so
+        # dict-encoded sort keys force a global re-sort at merge time
+        self._dict_keys = any(
+            e.dtype_of(child.schema).is_dict_encoded for e in sort_exprs
+        )
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         from auron_tpu.memory.memmgr import MemManager
@@ -82,6 +87,16 @@ class SortExec(ExecOperator):
             yield from self._emit(sorted_batch.batch, ctx)
             return
 
+        if self._dict_keys:
+            # string/list sort keys: run ranks are per-run-dictionary local;
+            # rebuild batches and re-sort globally (device_concat unifies the
+            # dictionaries). Costs one device round-trip of the spilled data
+            # — correctness over memory until global-rank dictionaries land.
+            batches = pending + [_run_to_batch(r, self.schema) for r in runs]
+            with ctx.metrics.timer("merge_time"):
+                merged = self._sort_run(batches, ctx).batch
+            yield from self._emit(merged, ctx)
+            return
         if pending:
             runs.append(self._sort_run(pending, ctx).to_host())
         with ctx.metrics.timer("merge_time"):
@@ -221,6 +236,19 @@ class _HostRun:
         self.n = n
 
 
+def _run_to_batch(r: "_HostRun", schema: T.Schema) -> Batch:
+    """Rehydrate a host-parked run as a device batch."""
+    return Batch(
+        schema,
+        DeviceBatch(
+            jnp.asarray(r.sel),
+            tuple(jnp.asarray(v) for v in r.values),
+            tuple(jnp.asarray(m) for m in r.validity),
+        ),
+        r.dicts,
+    )
+
+
 def _merge_runs(runs: list[_HostRun], schema: T.Schema) -> Batch:
     """K-way merge of sorted host runs by their uint64 key words.
 
@@ -248,20 +276,11 @@ def _merge_runs(runs: list[_HostRun], schema: T.Schema) -> Batch:
         order = np.lexsort(list(reversed(words)))  # last key primary
     import pyarrow as pa
 
-    from auron_tpu.columnar.batch import unify_dict
-
     total = order.shape[0]
     cap = bucket_capacity(max(total, 1))
     out_vals = []
     out_mask = []
     dicts: list = []
-    ncols = len(schema)
-
-    # dictionary columns need a unified dictionary across runs
-    class _D:  # minimal Batch-like shims for unify_dict
-        def __init__(self, r):
-            self.r = r
-            self.dicts = r.dicts
 
     for ci, f in enumerate(schema):
         vs = [r.values[ci][i] for r, i in zip(runs, live_idx)]

@@ -229,8 +229,10 @@ class WindowExec(ExecOperator):
             my_seg_start = seg_start[jnp.clip(seg_ids, 0, cap - 1)]
             src = my_seg_start + (wf.offset - 1)
             in_bounds = (wf.offset - 1) < n_part
-            # default frame is running: nth value visible only from row n on
-            visible = pos >= (wf.offset - 1)
+            # default RANGE frame: the nth row is visible once the row's
+            # peer-group frame end covers it (peers share visibility)
+            covered = peer_end - my_seg_start
+            visible = covered >= wf.offset
             srcc = jnp.clip(src, 0, cap - 1)
             return ColumnVal(
                 cv.values[srcc], cv.validity[srcc] & in_bounds & visible & sel,

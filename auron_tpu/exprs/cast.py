@@ -190,6 +190,10 @@ def cast_string_dict(d: pa.Array, dst: T.DataType) -> tuple[np.ndarray, np.ndarr
                 import datetime as dt
 
                 ts = dt.datetime.fromisoformat(t)
+                if ts.tzinfo is None:
+                    # session timezone is UTC (naive strings must not pick
+                    # up the host machine's local zone)
+                    ts = ts.replace(tzinfo=dt.timezone.utc)
                 vals[i], ok[i] = int(ts.timestamp() * 1e6), True
             else:
                 raise TypeError(f"cast string -> {dst}")

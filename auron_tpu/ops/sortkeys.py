@@ -41,11 +41,17 @@ def orderable_word(cv: ColumnVal) -> jnp.ndarray:
     if dt.is_integer or dt.kind in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP, T.TypeKind.DECIMAL):
         return v.astype(jnp.int64).view(jnp.uint64) ^ sign
     if dt.kind == T.TypeKind.FLOAT32:
-        b = v.astype(jnp.float32).view(jnp.uint32).astype(jnp.uint64) << jnp.uint64(32)
+        f = v.astype(jnp.float32)
+        f = jnp.where(f == 0, jnp.float32(0), f)  # -0.0 == 0.0
+        f = jnp.where(jnp.isnan(f), jnp.float32(jnp.nan), f)  # canonical NaN
+        b = f.view(jnp.uint32).astype(jnp.uint64) << jnp.uint64(32)
         neg = (b & sign) != 0
         return jnp.where(neg, ~b, b | sign)
     if dt.kind == T.TypeKind.FLOAT64:
-        b = v.astype(jnp.float64).view(jnp.uint64)
+        f = v.astype(jnp.float64)
+        f = jnp.where(f == 0, jnp.float64(0), f)
+        f = jnp.where(jnp.isnan(f), jnp.float64(jnp.nan), f)
+        b = f.view(jnp.uint64)
         neg = (b & sign) != 0
         return jnp.where(neg, ~b, b | sign)
     if dt.is_dict_encoded:

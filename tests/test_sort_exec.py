@@ -111,3 +111,37 @@ def test_emit_chunks_multiple_batches():
     for ob in out:
         allv += ob.to_pydict()["x"]
     assert allv == list(range(n))
+
+
+def test_spilled_sort_on_string_keys():
+    """Per-run dictionary ranks are not globally comparable; sorting BY a
+    string column across spilled runs must still produce global order."""
+    rng = np.random.default_rng(9)
+    words = [f"w{i:04d}" for i in range(400)]
+    vals = rng.choice(words, 2000)
+    df = pd.DataFrame({"s": vals, "x": np.arange(2000)})
+    batches = [
+        Batch.from_arrow(
+            pa.RecordBatch.from_pandas(df.iloc[i : i + 250], preserve_index=False)
+        )
+        for i in range(0, 2000, 250)
+    ]
+    got = _sort(batches, [col(0)], [SortSpec()], spill_rows=500)
+    want = df.sort_values("s", kind="stable").reset_index(drop=True)
+    assert got["s"].tolist() == want["s"].tolist()
+
+
+def test_negative_nan_bits_sort_greatest():
+    import jax.numpy as jnp
+
+    from auron_tpu.ops.sortkeys import orderable_word
+    from auron_tpu.exprs.eval import ColumnVal
+    from auron_tpu import types as T
+
+    neg_nan = np.array([0xFFF8000000000000], dtype=np.uint64).view(np.float64)[0]
+    vals = jnp.asarray([1.0, neg_nan, -np.inf, np.inf])
+    cv = ColumnVal(vals, jnp.ones(4, bool), T.FLOAT64)
+    w = np.asarray(orderable_word(cv))
+    order = np.argsort(w)
+    # ascending: -inf, 1.0, inf, NaN (greatest) — even for negative-bit NaN
+    assert order.tolist() == [2, 0, 3, 1]

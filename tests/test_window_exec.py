@@ -140,3 +140,12 @@ def test_no_partition_by():
     df = pd.DataFrame({"g": [0, 0], "o": [2, 1], "v": [5.0, 7.0]})
     got = _win(df, [(WindowFunc("row_number"), "rn")], part_cols=(), order_cols=(1,))
     assert got.sort_values("o")["rn"].tolist() == [1, 2]
+
+
+def test_nth_value_ties_share_visibility():
+    df = pd.DataFrame({"g": [1] * 4, "o": [1, 1, 2, 3], "v": [10.0, 20.0, 30.0, 40.0]})
+    got = _win(df, [(WindowFunc("nth_value", expr=col(2), offset=2), "n2")])
+    # rows 0,1 are peers; frame end covers position 1, so BOTH see the 2nd value
+    vals = got.sort_values("o")["n2"].tolist()
+    assert vals[0] == 20.0 and vals[1] == 20.0
+    assert vals[2] == 20.0 and vals[3] == 20.0
