@@ -29,6 +29,8 @@ from dataclasses import dataclass
 from typing import Iterator
 
 import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
 
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import (
@@ -84,6 +86,8 @@ def final_type(a: AggExpr, in_t: T.DataType | None) -> T.DataType:
         return sum_type(in_t)
     if a.func == "avg":
         return avg_type(in_t)
+    if a.func in ("collect_list", "collect_set"):
+        return T.DataType(T.TypeKind.LIST, inner=(in_t,))
     return in_t  # min/max/first
 
 
@@ -103,6 +107,14 @@ def intermediate_fields(a: AggExpr, in_t: T.DataType | None, prefix: str) -> lis
         return [
             T.Field(f"{prefix}#value", in_t, True),
             T.Field(f"{prefix}#seen", T.BOOL, False),
+        ]
+    if a.func in ("collect_list", "collect_set"):
+        return [
+            T.Field(
+                f"{prefix}#items",
+                T.DataType(T.TypeKind.LIST, inner=(in_t,)),
+                True,
+            )
         ]
     raise ValueError(a.func)
 
@@ -388,6 +400,8 @@ class HashAggExec(ExecOperator):
             fn = S.seg_min if a.func == "min" else S.seg_max
             mv, any_valid = fn(v, m, ids, cap)
             return [ColumnVal(mv, any_valid & group_valid, in_t, cols[0].dict)]
+        if a.func in ("collect_list", "collect_set"):
+            return self._reduce_collect(a, in_t, cols, order, seg, cap, raw, group_valid)
         if a.func in ("first", "first_ignores_null"):
             ignores = a.func == "first_ignores_null"
             v, m = sortg(cols[0])
@@ -411,6 +425,53 @@ class HashAggExec(ExecOperator):
                 ColumnVal(seen, group_valid, T.BOOL),
             ]
         raise ValueError(a.func)
+
+    def _reduce_collect(
+        self, a: AggExpr, in_t, cols, order, seg, cap, raw, group_valid
+    ) -> list[ColumnVal]:
+        """collect_list / collect_set (reference: agg/collect.rs).
+
+        Variable-length group state can't live in fixed device arrays, so
+        the collected lists ride the LIST dictionary representation: values
+        are decoded host-side segment-by-segment (one device->host pull of
+        the sorted column per reduce) and the per-group lists become the
+        dictionary; the device sees identity codes. Heavy by design — the
+        reference's native collect is its largest accumulator too.
+        """
+        import jax
+
+        from auron_tpu.columnar.batch import _device_to_arrow
+
+        cv = cols[0]
+        sv = cv.values[order]
+        sm = cv.validity[order] & seg.sel_sorted
+        ids_np = np.asarray(jax.device_get(seg.seg_ids))
+        sv_np = np.asarray(jax.device_get(sv))
+        sm_np = np.asarray(jax.device_get(sm))
+        n_groups = int(jax.device_get(seg.num_groups))
+
+        list_t = T.DataType(T.TypeKind.LIST, inner=(in_t,))
+        if raw:
+            decoded = _device_to_arrow(sv_np, sm_np, in_t, cv.dict).to_pylist()
+            lists: list[list] = [[] for _ in range(max(n_groups, 1))]
+            for gid, val, ok in zip(ids_np, decoded, sm_np):
+                if 0 <= gid < n_groups and ok:
+                    lists[gid].append(val)
+        else:
+            entries = cv.dict.to_pylist()
+            lists = [[] for _ in range(max(n_groups, 1))]
+            for gid, code, ok in zip(ids_np, sv_np, sm_np):
+                if 0 <= gid < n_groups and ok:
+                    sub = entries[code] if 0 <= code < len(entries) else None
+                    if sub:
+                        lists[gid].extend(sub)
+        if a.func == "collect_set":
+            lists = [
+                sorted(set(l), key=lambda x: (x is None, str(x))) for l in lists
+            ]
+        d = pa.array(lists, type=list_t.to_arrow())
+        codes = jnp.arange(cap, dtype=jnp.int32) % max(n_groups, 1)
+        return [ColumnVal(codes, group_valid, list_t, d)]
 
     # ------------------------------------------------------------------
 
@@ -465,6 +526,8 @@ class HashAggExec(ExecOperator):
         if a.func in ("min", "max"):
             return cols[0]
         if a.func in ("first", "first_ignores_null"):
+            return cols[0]
+        if a.func in ("collect_list", "collect_set"):
             return cols[0]
         raise ValueError(a.func)
 
@@ -576,6 +639,8 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
     t = first_field.dtype
     if a.func in ("count", "count_star"):
         return None
+    if a.func in ("collect_list", "collect_set"):
+        return t.inner[0]
     if a.func == "sum" or a.func == "avg":
         # sum_type is not invertible exactly; intermediate already carries
         # the sum type, which is all downstream logic needs

@@ -74,6 +74,37 @@ class WindowFunc:
         raise ValueError(self.kind)
 
 
+class WindowGroupLimitExec(ExecOperator):
+    """Keep only rows whose rank within (partition_by, order_by) is <= k —
+    the pushed-down top-k-per-group optimization (reference: window group
+    limit support, auron.proto:593-595). Implemented as one device sort +
+    rank compute + selection-mask refinement; no full window evaluation."""
+
+    def __init__(
+        self,
+        child: ExecOperator,
+        partition_by: list[ir.Expr],
+        order_by: list[tuple[ir.Expr, SortSpec]],
+        limit: int,
+        rank_like: str = "row_number",  # row_number | rank | dense_rank
+    ):
+        assert rank_like in ("row_number", "rank", "dense_rank")
+        super().__init__([child], child.schema)
+        self._win = WindowExec(
+            child, partition_by, order_by, [(WindowFunc(rank_like), "__rk")]
+        )
+        self.limit = limit
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        for b in self._win.execute(partition, ctx):
+            rk_i = len(b.schema) - 1
+            keep = b.device.sel & (b.col_values(rk_i) <= self.limit)
+            dev = DeviceBatch(
+                keep, b.device.values[:rk_i], b.device.validity[:rk_i]
+            )
+            yield Batch(self.schema, dev, b.dicts[:rk_i])
+
+
 class WindowExec(ExecOperator):
     def __init__(
         self,

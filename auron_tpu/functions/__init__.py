@@ -1,1 +1,2 @@
 from auron_tpu.functions.registry import registry  # noqa: F401
+import auron_tpu.functions.extended  # noqa: F401  (registers the long tail)

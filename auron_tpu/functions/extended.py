@@ -1,0 +1,317 @@
+"""Extended scalar functions (reference checklist: datafusion-ext-functions/src/lib.rs).
+
+Three execution styles:
+- device kernels (timestamps, decimal plumbing, bround);
+- dictionary transforms (value-dependent string/list functions — O(|dict|)
+  host work, device gathers);
+- host row-wise fallback (row-dependent builders like concat/make_array):
+  materialize argument columns to Arrow, compute, re-ingest — the built-in
+  sibling of the HostUDF path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu import types as T
+from auron_tpu.exprs import decimal_math as D
+from auron_tpu.functions.registry import (
+    _cv,
+    _dict_transform,
+    _scalar_arg,
+    registry,
+)
+
+# ---------------------------------------------------------------------------
+# host row-wise fallback helper
+# ---------------------------------------------------------------------------
+
+
+def _host_rowwise(name: str, py_fn, out_dtype_fn):
+    """Register fn(list_of_python_rows) evaluated on host per row."""
+
+    @registry.register(name, out_dtype_fn if callable(out_dtype_fn) else out_dtype_fn)
+    def _f(args, cap, py_fn=py_fn):
+        from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+
+        host_cols = []
+        for cv in args:
+            vals = np.asarray(jax.device_get(cv.values))
+            mask = np.asarray(jax.device_get(cv.validity))
+            host_cols.append(_device_to_arrow(vals, mask, cv.dtype, cv.dict).to_pylist())
+        out_rows = [py_fn(*row) for row in zip(*host_cols)] if host_cols else []
+        out_dt = (
+            out_dtype_fn([a.dtype for a in args]) if callable(out_dtype_fn) else out_dtype_fn
+        )
+        arr = pa.array(out_rows, type=out_dt.to_arrow())
+        v, m, d = _arrow_to_device(arr, out_dt, cap)
+        return _cv(v, m, out_dt, d)
+
+    return _f
+
+
+# ---------------------------------------------------------------------------
+# rounding / decimal plumbing
+# ---------------------------------------------------------------------------
+
+
+@registry.register("bround")
+def _bround(args, cap):
+    """HALF_EVEN (banker's) rounding — Spark's bround."""
+    a = args[0]
+    scale = int(_scalar_arg(args[1])) if len(args) > 1 else 0
+    if a.dtype.is_float:
+        m = 10.0**scale
+        r = jnp.round(a.values.astype(jnp.float64) * m) / m  # jnp.round is HALF_EVEN
+        return _cv(r.astype(a.values.dtype), a.validity, a.dtype)
+    if a.dtype.kind == T.TypeKind.DECIMAL:
+        k = a.dtype.scale - scale
+        if k <= 0:
+            return a
+        from jax import lax
+
+        p = jnp.int64(D.pow10(min(k, 18)))
+        q = lax.div(a.values, p)
+        r = lax.rem(a.values, p)
+        half = p // 2
+        odd = (q % 2) != 0
+        up = (jnp.abs(r) > half) | ((jnp.abs(r) == half) & odd)
+        adj = jnp.where(up, jnp.sign(r), 0)
+        out_t = T.decimal(a.dtype.precision, max(scale, 0))
+        return _cv(q + adj, a.validity, out_t)
+    return a
+
+
+@registry.register("unscaled_value", T.INT64)
+def _unscaled_value(args, cap):
+    a = args[0]
+    assert a.dtype.kind == T.TypeKind.DECIMAL
+    return _cv(a.values.astype(jnp.int64), a.validity, T.INT64)
+
+
+@registry.register("make_decimal")
+def _make_decimal(args, cap):
+    """long unscaled -> decimal(p,s); out dtype via extra literal args."""
+    a = args[0]
+    p = int(_scalar_arg(args[1])) if len(args) > 1 else 38
+    s = int(_scalar_arg(args[2])) if len(args) > 2 else 18
+    out = T.decimal(min(p, 38), s)
+    ok = D.precision_ok(a.values.astype(jnp.int64), out.precision)
+    return _cv(a.values.astype(jnp.int64), a.validity & ok, out)
+
+
+@registry.register("check_overflow")
+def _check_overflow(args, cap):
+    a = args[0]
+    assert a.dtype.kind == T.TypeKind.DECIMAL
+    ok = D.precision_ok(a.values, a.dtype.precision)
+    return _cv(a.values, a.validity & ok, a.dtype)
+
+
+# ---------------------------------------------------------------------------
+# timestamps
+# ---------------------------------------------------------------------------
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _ts_field(name, divisor, modulo):
+    @registry.register(name, T.INT32)
+    def _f(args, cap):
+        a = args[0]
+        us_in_day = jnp.mod(a.values, jnp.int64(_US_PER_DAY))
+        v = (us_in_day // divisor) % modulo
+        return _cv(v.astype(jnp.int32), a.validity, T.INT32)
+
+    return _f
+
+
+_ts_field("hour", 3_600_000_000, 24)
+_ts_field("minute", 60_000_000, 60)
+_ts_field("second", 1_000_000, 60)
+
+
+@registry.register("weekofyear", T.INT32)
+def _weekofyear(args, cap):
+    """ISO-8601 week number (Spark weekofyear)."""
+    from auron_tpu.functions.registry import _civil_from_days, _date_arg, _days_from_civil
+
+    d = _date_arg(args[0]).astype(jnp.int64)
+    # ISO week: week of the year containing the Thursday of d's week
+    dow = jnp.mod(d + 3, 7)  # 0 = Monday
+    thursday = d - dow + 3
+    y, _, _ = _civil_from_days(thursday)
+    jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    week = (thursday - jan1) // 7 + 1
+    return _cv(week.astype(jnp.int32), args[0].validity, T.INT32)
+
+
+@registry.register("months_between", T.FLOAT64)
+def _months_between(args, cap):
+    from auron_tpu.functions.registry import _civil_from_days, _date_arg, _days_from_civil
+
+    d1 = _date_arg(args[0])
+    d2 = _date_arg(args[1])
+    y1, m1, day1 = _civil_from_days(d1)
+    y2, m2, day2 = _civil_from_days(d2)
+
+    def last_dom(y, m):
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        return (_days_from_civil(ny, nm, jnp.ones_like(nm)) - 1) - _days_from_civil(
+            y, m, jnp.ones_like(m)
+        ) + 1
+
+    both_last = (day1 == last_dom(y1, m1)) & (day2 == last_dom(y2, m2))
+    months = (y1 - y2) * 12 + (m1 - m2)
+    frac = (day1 - day2).astype(jnp.float64) / 31.0
+    v = jnp.where(both_last | (day1 == day2), months.astype(jnp.float64),
+                  months.astype(jnp.float64) + frac)
+    v = jnp.round(v * 1e8) / 1e8
+    return _cv(v, args[0].validity & args[1].validity, T.FLOAT64)
+
+
+# ---------------------------------------------------------------------------
+# strings: dictionary transforms
+# ---------------------------------------------------------------------------
+
+
+def _initcap(s: str) -> str:
+    out = []
+    cap_next = True
+    for ch in s:
+        if ch.isalnum():
+            out.append(ch.upper() if cap_next else ch.lower())
+            cap_next = False
+        else:
+            out.append(ch)
+            cap_next = True
+    return "".join(out)
+
+
+_dict_transform("initcap", _initcap)
+_dict_transform("md5", lambda s: hashlib.md5(s.encode()).hexdigest())
+_dict_transform("sha224", lambda s: hashlib.sha224(s.encode()).hexdigest())
+_dict_transform("sha256", lambda s: hashlib.sha256(s.encode()).hexdigest())
+_dict_transform("sha384", lambda s: hashlib.sha384(s.encode()).hexdigest())
+_dict_transform("sha512", lambda s: hashlib.sha512(s.encode()).hexdigest())
+_dict_transform("replace", lambda s, find, rep: s.replace(find, rep))
+_dict_transform(
+    "translate",
+    # chars in `frm` beyond `to`'s length are deleted (Spark semantics)
+    lambda s, frm, to: s.translate(
+        str.maketrans(frm[: len(to)], to[: len(frm)], frm[len(to):])
+    ),
+)
+
+
+def _json_path_get(s: str, path: str):
+    """Spark get_json_object JSONPath subset: $.a.b[0].c"""
+    try:
+        obj = json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    if not path.startswith("$"):
+        return None
+    import re as _re
+
+    for tok in _re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]", path):
+        name, idx = tok
+        if name:
+            if not isinstance(obj, dict) or name not in obj:
+                return None
+            obj = obj[name]
+        else:
+            i = int(idx)
+            if not isinstance(obj, list) or i >= len(obj):
+                return None
+            obj = obj[i]
+        if obj is None:
+            return None
+    if isinstance(obj, str):
+        return obj
+    return json.dumps(obj)
+
+
+_dict_transform("get_json_object", _json_path_get)
+
+
+def _split(s: str, pattern: str, limit: int = -1) -> list[str]:
+    import re as _re
+
+    return _re.split(pattern, s, maxsplit=0 if limit <= 0 else limit - 1)
+
+
+@registry.register(
+    "split", lambda a: T.DataType(T.TypeKind.LIST, inner=(T.STRING,))
+)
+def _split_fn(args, cap):
+    a = args[0]
+    pattern = _scalar_arg(args[1])
+    entries = a.dict.to_pylist()
+    new = [(_split(s, pattern) if s is not None else None) for s in entries]
+    out_dt = T.DataType(T.TypeKind.LIST, inner=(T.STRING,))
+    d = pa.array([v if v is not None else [] for v in new], type=out_dt.to_arrow())
+    return _cv(jnp.clip(a.values, 0, len(new) - 1), a.validity, out_dt, d)
+
+
+# LIST dictionary transforms (reference: Spark_ArrayReverse/Flatten)
+@registry.register("array_reverse")
+def _array_reverse(args, cap):
+    a = args[0]
+    assert a.dtype.kind == T.TypeKind.LIST
+    entries = a.dict.to_pylist()
+    d = pa.array(
+        [(list(reversed(e)) if e is not None else []) for e in entries],
+        type=a.dtype.to_arrow(),
+    )
+    return _cv(a.values, a.validity, a.dtype, d)
+
+
+@registry.register("array_flatten")
+def _array_flatten(args, cap):
+    a = args[0]
+    assert a.dtype.kind == T.TypeKind.LIST and a.dtype.inner[0].kind == T.TypeKind.LIST
+    out_dt = a.dtype.inner[0]
+    entries = a.dict.to_pylist()
+    flat = [
+        ([x for sub in e for x in (sub or [])] if e is not None else [])
+        for e in entries
+    ]
+    d = pa.array(flat, type=out_dt.to_arrow())
+    return _cv(a.values, a.validity, out_dt, d)
+
+
+# brickhouse array_union analog: per-row union of two LIST columns
+_host_rowwise(
+    "array_union",
+    lambda a, b: sorted({*(a or []), *(b or [])}, key=lambda x: (x is None, x)),
+    lambda dts: dts[0],
+)
+
+# row-wise string builders
+_host_rowwise(
+    "concat",
+    lambda *parts: None if any(p is None for p in parts) else "".join(parts),
+    T.STRING,
+)
+_host_rowwise(
+    "concat_ws",
+    lambda sep, *parts: (sep or "").join(p for p in parts if p is not None),
+    T.STRING,
+)
+_host_rowwise(
+    "string_space", lambda n: " " * max(int(n), 0) if n is not None else None, T.STRING
+)
+_host_rowwise(
+    "make_array",
+    lambda *xs: list(xs),
+    lambda dts: T.DataType(T.TypeKind.LIST, inner=(dts[0] if dts else T.INT32,)),
+)
+_host_rowwise("null_if", lambda a, b: None if a == b else a, lambda dts: dts[0])

@@ -359,11 +359,15 @@ def _dict_transform(name: str, py_fn, out_dtype=T.STRING):
             new_entries = [py_fn(s, *extra) if s is not None else None for s in entries]
             vocab: dict = {}
             remap = np.empty(len(new_entries), dtype=np.int32)
+            ok_np = np.empty(len(new_entries), dtype=bool)
             for i, s in enumerate(new_entries):
+                ok_np[i] = s is not None
                 remap[i] = vocab.setdefault(s if s is not None else "", len(vocab))
             d = pa.array(list(vocab.keys()) or [""], type=pa.string())
-            codes = jnp.asarray(remap)[jnp.clip(a.values, 0, len(remap) - 1)]
-            return _cv(codes, a.validity, out_dtype, d)
+            idx = jnp.clip(a.values, 0, len(remap) - 1)
+            codes = jnp.asarray(remap)[idx]
+            valid = a.validity & jnp.asarray(ok_np)[idx]
+            return _cv(codes, valid, out_dtype, d)
         vals = np.array(
             [py_fn(s, *extra) if s is not None else 0 for s in entries],
             dtype=np.dtype(out_dtype.physical_dtype().name),

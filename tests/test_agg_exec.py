@@ -215,3 +215,30 @@ def test_partial_skipping_still_correct():
     want = _sorted(df.groupby("k").agg(s=("v", "sum")).reset_index(), "k")
     assert got["k"].tolist() == want["k"].tolist()
     assert got["s"].tolist() == want["s"].tolist()
+
+
+def test_collect_list_and_set():
+    data = {"k": [1, 1, 2, 1, 2], "v": [5, 3, 7, 3, None]}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.INT64))
+    )
+    got = _agg_pipeline(
+        [b], [(col(0), "k")],
+        [(AggExpr("collect_list", col(1)), "cl"),
+         (AggExpr("collect_set", col(1)), "cs")],
+    )
+    got = _sorted(got, "k")
+    assert sorted(got["cl"][0]) == [3, 3, 5]
+    assert list(got["cl"][1]) == [7]
+    assert list(got["cs"][0]) == [3, 5]
+    assert list(got["cs"][1]) == [7]
+
+
+def test_collect_list_multi_batch():
+    b1 = Batch.from_pydict({"k": [1, 2], "v": [1.0, 2.0]})
+    b2 = Batch.from_pydict({"k": [1, 1], "v": [3.0, 4.0]})
+    got = _agg_pipeline([b1, b2], [(col(0), "k")],
+                        [(AggExpr("collect_list", col(1)), "cl")])
+    got = _sorted(got, "k")
+    assert sorted(got["cl"][0]) == [1.0, 3.0, 4.0]
+    assert list(got["cl"][1]) == [2.0]

@@ -1,0 +1,105 @@
+"""Extended scalar function tests."""
+
+import datetime as dt
+import hashlib
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.basic import MemoryScanExec, ProjectExec
+from auron_tpu.exprs.ir import ScalarFunc, col, lit
+
+
+def _run(data, exprs, names, schema=None):
+    b = Batch.from_pydict(data, schema=schema)
+    p = ProjectExec(MemoryScanExec.single([b]), exprs, names)
+    return p.collect_pydict()
+
+
+def test_bround_half_even():
+    out = _run({"x": [2.5, 3.5, -2.5, 2.4]},
+               [ScalarFunc("bround", (col(0),))], ["r"])
+    assert out["r"] == [2.0, 4.0, -2.0, 2.0]
+
+
+def test_timestamp_fields():
+    ts = np.datetime64("2024-03-05T17:45:30.123456", "us")
+    out = _run({"t": pa.array([ts])},
+               [ScalarFunc("hour", (col(0),)), ScalarFunc("minute", (col(0),)),
+                ScalarFunc("second", (col(0),))],
+               ["h", "m", "s"])
+    assert (out["h"], out["m"], out["s"]) == ([17], [45], [30])
+
+
+def test_weekofyear_vs_python():
+    dates = [dt.date(2024, 1, 1), dt.date(2023, 1, 1), dt.date(2020, 12, 31),
+             dt.date(2021, 1, 4), dt.date(1999, 6, 15)]
+    days = [(d - dt.date(1970, 1, 1)).days for d in dates]
+    out = _run({"d": pa.array(days, type=pa.int32()).cast(pa.date32())},
+               [ScalarFunc("weekofyear", (col(0),))], ["w"])
+    assert out["w"] == [d.isocalendar()[1] for d in dates]
+
+
+def test_months_between():
+    d1 = (dt.date(2024, 3, 31) - dt.date(1970, 1, 1)).days
+    d2 = (dt.date(2024, 1, 31) - dt.date(1970, 1, 1)).days
+    d3 = (dt.date(2024, 2, 14) - dt.date(1970, 1, 1)).days
+    arr = pa.array([d1, d1], type=pa.int32()).cast(pa.date32())
+    arr2 = pa.array([d2, d3], type=pa.int32()).cast(pa.date32())
+    out = _run({"a": arr, "b": arr2},
+               [ScalarFunc("months_between", (col(0), col(1)))], ["mb"])
+    assert out["mb"][0] == 2.0  # both last day of month -> integral
+    assert out["mb"][1] == pytest.approx(1.0 + 17 / 31.0, abs=1e-8)
+
+
+def test_string_crypto_and_json():
+    out = _run({"s": ["hello world", None]},
+               [ScalarFunc("md5", (col(0),)), ScalarFunc("sha256", (col(0),)),
+                ScalarFunc("initcap", (col(0),))],
+               ["m", "h", "i"])
+    assert out["m"][0] == hashlib.md5(b"hello world").hexdigest()
+    assert out["h"][0] == hashlib.sha256(b"hello world").hexdigest()
+    assert out["i"] == ["Hello World", None]
+    j = _run({"j": ['{"a": {"b": [1, 2]}}', '{"a": 1}', "bad"]},
+             [ScalarFunc("get_json_object", (col(0), lit("$.a.b[1]")))], ["g"])
+    assert j["g"] == ["2", None, None]
+
+
+def test_replace_translate_concat():
+    out = _run({"s": ["banana", "abc"]},
+               [ScalarFunc("replace", (col(0), lit("an"), lit("AN")))], ["r"])
+    assert out["r"] == ["bANANa", "abc"]
+    out2 = _run({"s": ["abcd"]},
+                [ScalarFunc("translate", (col(0), lit("abc"), lit("xy")))], ["t"])
+    assert out2["t"] == ["xyd"]
+    out3 = _run({"a": ["x", None], "b": ["y", "z"]},
+                [ScalarFunc("concat", (col(0), col(1))),
+                 ScalarFunc("concat_ws", (lit("-"), col(0), col(1)))],
+                ["c", "cw"])
+    assert out3["c"] == ["xy", None]
+    assert out3["cw"] == ["x-y", "z"]
+
+
+def test_split_and_array_ops():
+    out = _run({"s": ["a,b,c", "x"]},
+               [ScalarFunc("split", (col(0), lit(",")))], ["l"])
+    assert out["l"] == [["a", "b", "c"], ["x"]]
+    rb = pa.record_batch({"l": pa.array([[3, 1], [7]], type=pa.list_(pa.int64()))})
+    b = Batch.from_arrow(rb)
+    p = ProjectExec(MemoryScanExec.single([b]),
+                    [ScalarFunc("array_reverse", (col(0),))], ["r"])
+    assert p.collect_pydict()["r"] == [[1, 3], [7]]
+
+
+def test_decimal_plumbing():
+    import decimal as d
+
+    data = {"x": pa.array([d.Decimal("12.34")], type=pa.decimal128(10, 2))}
+    out = _run(data, [ScalarFunc("unscaled_value", (col(0),))], ["u"])
+    assert out["u"] == [1234]
+    out2 = _run({"n": pa.array([1234], type=pa.int64())},
+                [ScalarFunc("make_decimal", (col(0), lit(10), lit(2)))], ["m"])
+    assert out2["m"] == [d.Decimal("12.34")]

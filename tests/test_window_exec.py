@@ -149,3 +149,19 @@ def test_nth_value_ties_share_visibility():
     vals = got.sort_values("o")["n2"].tolist()
     assert vals[0] == 20.0 and vals[1] == 20.0
     assert vals[2] == 20.0 and vals[3] == 20.0
+
+
+def test_window_group_limit():
+    from auron_tpu.exec.window_exec import WindowGroupLimitExec
+
+    df = _df(100, seed=33)
+    scan = MemoryScanExec.single(
+        [Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))]
+    )
+    op = WindowGroupLimitExec(scan, [col(0)], [(col(1), SortSpec())], limit=3)
+    got = op.collect().to_pandas().sort_values(["g", "o"]).reset_index(drop=True)
+    want = (
+        df.sort_values(["g", "o"]).groupby("g").head(3)
+        .sort_values(["g", "o"]).reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
