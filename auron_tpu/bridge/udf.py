@@ -33,3 +33,29 @@ def lookup_udf(name: str) -> Callable:
 
 def udf_names() -> list[str]:
     return sorted(_UDFS)
+
+
+# ---------------------------------------------------------------------------
+# UDAFs (aggregate fallback)
+# ---------------------------------------------------------------------------
+
+_UDAFS: dict[str, tuple[Callable, "object"]] = {}
+
+
+def register_udaf(name: str, fn: Callable, out_dtype) -> None:
+    """fn(values: list) -> python scalar, evaluated per group at final.
+
+    The aggregate fallback analog of the reference's
+    SparkUDAFWrapperContext (spark-extension .../SparkUDAFWrapperContext.scala:59-235):
+    the engine accumulates the group's inputs (LIST-dictionary state, same
+    machinery as collect_list) and the host callback computes the final
+    value. Heavier than native aggregation by design — it exists so *any*
+    host-engine UDAF keeps the plan on the accelerator path.
+    """
+    _UDAFS[name] = (fn, out_dtype)
+
+
+def lookup_udaf(name: str) -> tuple[Callable, "object"]:
+    if name not in _UDAFS:
+        raise KeyError(f"host UDAF '{name}' is not registered with the bridge")
+    return _UDAFS[name]

@@ -59,8 +59,9 @@ FINAL = "final"
 
 @dataclass(frozen=True)
 class AggExpr:
-    func: str  # sum|count|count_star|avg|min|max|first|first_ignores_null
+    func: str  # sum|count|count_star|avg|min|max|first|first_ignores_null|collect_list|collect_set|host_udaf
     expr: ir.Expr | None = None  # None only for count_star
+    udaf: str | None = None  # host_udaf: name registered with bridge.udf
 
 
 def sum_type(t: T.DataType) -> T.DataType:
@@ -88,6 +89,10 @@ def final_type(a: AggExpr, in_t: T.DataType | None) -> T.DataType:
         return avg_type(in_t)
     if a.func in ("collect_list", "collect_set"):
         return T.DataType(T.TypeKind.LIST, inner=(in_t,))
+    if a.func == "host_udaf":
+        from auron_tpu.bridge.udf import lookup_udaf
+
+        return lookup_udaf(a.udaf)[1]
     return in_t  # min/max/first
 
 
@@ -108,7 +113,7 @@ def intermediate_fields(a: AggExpr, in_t: T.DataType | None, prefix: str) -> lis
             T.Field(f"{prefix}#value", in_t, True),
             T.Field(f"{prefix}#seen", T.BOOL, False),
         ]
-    if a.func in ("collect_list", "collect_set"):
+    if a.func in ("collect_list", "collect_set", "host_udaf"):
         return [
             T.Field(
                 f"{prefix}#items",
@@ -400,7 +405,7 @@ class HashAggExec(ExecOperator):
             fn = S.seg_min if a.func == "min" else S.seg_max
             mv, any_valid = fn(v, m, ids, cap)
             return [ColumnVal(mv, any_valid & group_valid, in_t, cols[0].dict)]
-        if a.func in ("collect_list", "collect_set"):
+        if a.func in ("collect_list", "collect_set", "host_udaf"):
             return self._reduce_collect(a, in_t, cols, order, seg, cap, raw, group_valid)
         if a.func in ("first", "first_ignores_null"):
             ignores = a.func == "first_ignores_null"
@@ -473,6 +478,29 @@ class HashAggExec(ExecOperator):
         codes = jnp.arange(cap, dtype=jnp.int32) % max(n_groups, 1)
         return [ColumnVal(codes, group_valid, list_t, d)]
 
+    def _final_udaf(self, a: AggExpr, in_t, state_cv: ColumnVal) -> ColumnVal:
+        """Evaluate the host UDAF callback over each group's collected
+        inputs (bridge.udf.register_udaf)."""
+        import jax
+
+        from auron_tpu.bridge.udf import lookup_udaf
+        from auron_tpu.columnar.batch import _arrow_to_device
+
+        fn, out_dtype = lookup_udaf(a.udaf)
+        cap = int(state_cv.values.shape[0])
+        codes = np.asarray(jax.device_get(state_cv.values))
+        valid = np.asarray(jax.device_get(state_cv.validity))
+        entries = state_cv.dict.to_pylist()
+        out_rows = []
+        for i in range(cap):
+            if valid[i] and 0 <= codes[i] < len(entries):
+                out_rows.append(fn(entries[codes[i]] or []))
+            else:
+                out_rows.append(None)
+        arr = pa.array(out_rows, type=out_dtype.to_arrow())
+        v, m, d = _arrow_to_device(arr, out_dtype, cap)
+        return ColumnVal(v, m & state_cv.validity, out_dtype, d)
+
     # ------------------------------------------------------------------
 
     def _finalize(self, state: Batch) -> Batch:
@@ -529,6 +557,8 @@ class HashAggExec(ExecOperator):
             return cols[0]
         if a.func in ("collect_list", "collect_set"):
             return cols[0]
+        if a.func == "host_udaf":
+            return self._final_udaf(a, in_t, cols[0])
         raise ValueError(a.func)
 
     def _empty_global_agg(self, ctx: ExecutionContext) -> Batch:
@@ -639,7 +669,7 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
     t = first_field.dtype
     if a.func in ("count", "count_star"):
         return None
-    if a.func in ("collect_list", "collect_set"):
+    if a.func in ("collect_list", "collect_set", "host_udaf"):
         return t.inner[0]
     if a.func == "sum" or a.func == "avg":
         # sum_type is not invertible exactly; intermediate already carries

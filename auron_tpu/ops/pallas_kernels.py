@@ -1,0 +1,82 @@
+"""Pallas TPU kernels for hot host-independent primitives.
+
+The engine's default device path is XLA-compiled jnp (which already fuses
+elementwise chains well); these Pallas kernels exist for the hot spots
+where hand control over VMEM tiling pays: the murmur3 partition-id pass
+over shuffle batches is the first (every shuffled row pays it). The kernel
+computes Spark-exact murmur3(int64) + Pmod in one VMEM-resident pass:
+uint32 lane math on the VPU, 2D (rows, 128) tiling.
+
+Usage is gated: ``partition_ids_pallas`` runs the kernel on TPU and falls
+back to the jnp kernels elsewhere; CPU tests run it in interpret mode.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.ops import hashing as H
+
+_LANES = 128
+
+
+def _murmur3_pmod_kernel(lo_ref, hi_ref, out_ref, *, seed: int, n_parts: int):
+    c1 = jnp.uint32(0xCC9E2D51)
+    c2 = jnp.uint32(0x1B873593)
+
+    def rotl(x, r):
+        return (x << r) | (x >> (32 - r))
+
+    def mix(h1, k1):
+        k1 = k1 * c1
+        k1 = rotl(k1, 15)
+        k1 = k1 * c2
+        h1 = h1 ^ k1
+        h1 = rotl(h1, 13)
+        return h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+    lo = lo_ref[:]
+    hi = hi_ref[:]
+    h1 = jnp.full(lo.shape, jnp.uint32(seed))
+    h1 = mix(h1, lo)
+    h1 = mix(h1, hi)
+    h1 = h1 ^ jnp.uint32(8)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> 16)
+    signed = h1.astype(jnp.int32)
+    p = signed % jnp.int32(n_parts)
+    out_ref[:] = jnp.where(p < 0, p + jnp.int32(n_parts), p)
+
+
+@partial(jax.jit, static_argnames=("n_parts", "seed", "interpret"))
+def partition_ids_pallas(
+    values_i64: jnp.ndarray, n_parts: int, seed: int = 42, interpret: bool = False
+) -> jnp.ndarray:
+    """Spark Pmod(murmur3(long), n) as a Pallas kernel. 1-D input."""
+    from jax.experimental import pallas as pl
+
+    n = values_i64.shape[0]
+    rows = max((n + _LANES - 1) // _LANES, 8)
+    padded = rows * _LANES
+    u = jnp.zeros(padded, jnp.int64).at[:n].set(values_i64).view(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32).reshape(rows, _LANES)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32).reshape(rows, _LANES)
+    out = pl.pallas_call(
+        partial(_murmur3_pmod_kernel, seed=seed, n_parts=n_parts),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        interpret=interpret,
+    )(lo, hi)
+    return out.reshape(-1)[:n]
+
+
+def use_pallas() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False

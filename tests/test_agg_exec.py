@@ -242,3 +242,26 @@ def test_collect_list_multi_batch():
     got = _sorted(got, "k")
     assert sorted(got["cl"][0]) == [1.0, 3.0, 4.0]
     assert list(got["cl"][1]) == [2.0]
+
+
+def test_host_udaf_fallback():
+    from auron_tpu.bridge.udf import register_udaf
+
+    # geometric mean — something the native agg set doesn't provide
+    register_udaf(
+        "geomean",
+        lambda vals: float(np.exp(np.mean(np.log([v for v in vals if v is not None]))))
+        if any(v is not None for v in vals) else None,
+        T.FLOAT64,
+    )
+    data = {"k": [1, 1, 2, 1], "v": [2.0, 8.0, 5.0, 4.0]}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.FLOAT64))
+    )
+    got = _agg_pipeline(
+        [b], [(col(0), "k")],
+        [(AggExpr("host_udaf", col(1), udaf="geomean"), "g")],
+    )
+    got = _sorted(got, "k")
+    assert got["g"][0] == pytest.approx((2 * 8 * 4) ** (1 / 3))
+    assert got["g"][1] == pytest.approx(5.0)

@@ -65,3 +65,21 @@ def test_loser_tree_merge_matches_lexsort():
     packed = merged_w1 * 10_000 + merged_w2
     assert (np.diff(packed.astype(np.int64)) >= 0).all()
     assert len(out_run) == sum(len(r[0]) for r in runs)
+
+
+def test_pallas_partition_ids_interpret():
+    """Pallas murmur3+pmod kernel matches the jnp reference (interpret mode
+    on CPU; the same kernel compiles for TPU)."""
+    import jax.numpy as jnp
+
+    from auron_tpu.ops import hashing as H
+    from auron_tpu.ops.pallas_kernels import partition_ids_pallas
+
+    rng = np.random.default_rng(41)
+    v = jnp.asarray(rng.integers(-(2**62), 2**62, 1000))
+    try:
+        got = np.asarray(partition_ids_pallas(v, 16, interpret=True))
+    except NotImplementedError as e:
+        pytest.skip(f"pallas unavailable on this jaxlib build: {e}")
+    want = np.asarray(H.pmod(H.murmur3_i64(v, jnp.uint32(42)).view(jnp.int32), 16))
+    assert (got == want).all()
