@@ -71,11 +71,17 @@ class ProjectExec(ExecOperator):
         super().__init__([child], T.Schema(tuple(out)))
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
-        ev = Evaluator(self.children[0].schema)
+        ev = Evaluator(
+            self.children[0].schema,
+            partition_id=ctx.partition_id,
+            resources=ctx.resources,
+        )
         for b in self.child_stream(0, partition, ctx):
             with ctx.metrics.timer("elapsed_compute"):
                 vals = ev.evaluate(b, self.exprs)
-                yield batch_from_columns(vals, self.names, b.device.sel)
+                out = batch_from_columns(vals, self.names, b.device.sel)
+            ev.row_offset += b.num_rows()
+            yield out
 
 
 class FilterExec(ExecOperator):

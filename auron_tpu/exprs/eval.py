@@ -41,8 +41,17 @@ class ColumnVal:
 
 
 class Evaluator:
-    def __init__(self, schema: T.Schema):
+    def __init__(
+        self,
+        schema: T.Schema,
+        partition_id: int = 0,
+        row_offset: int = 0,
+        resources: dict | None = None,
+    ):
         self.schema = schema
+        self.partition_id = partition_id
+        self.row_offset = row_offset  # live rows already emitted upstream
+        self.resources = resources or {}
 
     # ---- public ----
 
@@ -102,6 +111,26 @@ class Evaluator:
             return registry.dispatch(e.name, args, b.capacity)
         if isinstance(e, ir.HostUDF):
             return self._host_udf(e, b, memo)
+        if isinstance(e, ir.SparkPartitionId):
+            return ColumnVal(
+                jnp.full(b.capacity, jnp.int32(self.partition_id)),
+                jnp.ones(b.capacity, bool), T.INT32,
+            )
+        if isinstance(e, ir.MonotonicId):
+            pos = jnp.cumsum(b.device.sel.astype(jnp.int64)) - 1
+            base = jnp.int64(self.partition_id) << jnp.int64(33)
+            return ColumnVal(
+                base + self.row_offset + jnp.maximum(pos, 0),
+                jnp.ones(b.capacity, bool), T.INT64,
+            )
+        if isinstance(e, ir.RowNum):
+            pos = jnp.cumsum(b.device.sel.astype(jnp.int64))
+            return ColumnVal(
+                self.row_offset + pos, jnp.ones(b.capacity, bool), T.INT64
+            )
+        if isinstance(e, ir.ScalarSubquery):
+            value = self.resources.get(e.resource_id)
+            return self._literal(ir.Literal(value, e.dtype), b.capacity)
         raise TypeError(f"unsupported expression {type(e).__name__}")
 
     def _host_udf(self, e: ir.HostUDF, b: Batch, memo: dict) -> ColumnVal:

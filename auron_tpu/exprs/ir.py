@@ -195,6 +195,45 @@ class Like(Expr):
 
 
 @dataclass(frozen=True)
+class SparkPartitionId(Expr):
+    """Current task partition id (reference: ext-exprs spark_partition_id)."""
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.INT32
+
+
+@dataclass(frozen=True)
+class MonotonicId(Expr):
+    """Spark monotonically_increasing_id: (partition_id << 33) | row index
+    within the partition (reference: ext-exprs monotonically_increasing_id)."""
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.INT64
+
+
+@dataclass(frozen=True)
+class RowNum(Expr):
+    """1-based row number within the task output stream
+    (reference: ext-exprs row_num)."""
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return T.INT64
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    """Value of an uncorrelated scalar subquery, delivered by the host
+    engine through the task resource map (reference: ext-exprs scalar
+    subquery wrapper — the JVM computes the subquery and ships the value)."""
+
+    resource_id: str
+    dtype: T.DataType
+
+    def dtype_of(self, schema: T.Schema) -> T.DataType:
+        return self.dtype
+
+
+@dataclass(frozen=True)
 class HostUDF(Expr):
     """Host-callback expression: the fallback for functions the device engine
     cannot evaluate (analog of the reference's JVM-callback UDF wrapper,

@@ -107,6 +107,15 @@ def expr_to_proto(e: ir.Expr) -> pb.PhysicalExprNode:
         for a in e.args:
             n.host_udf.args.add().CopyFrom(expr_to_proto(a))
         n.host_udf.out_dtype.CopyFrom(dtype_to_proto(e.out_dtype))
+    elif isinstance(e, ir.SparkPartitionId):
+        n.spark_partition_id.SetInParent()
+    elif isinstance(e, ir.MonotonicId):
+        n.monotonic_id.SetInParent()
+    elif isinstance(e, ir.RowNum):
+        n.row_num.SetInParent()
+    elif isinstance(e, ir.ScalarSubquery):
+        n.scalar_subquery.resource_id = e.resource_id
+        n.scalar_subquery.dtype.CopyFrom(dtype_to_proto(e.dtype))
     else:
         raise TypeError(f"cannot serialize {type(e).__name__}")
     return n

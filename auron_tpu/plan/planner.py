@@ -174,6 +174,16 @@ def expr_from_proto(p: pb.PhysicalExprNode) -> ir.Expr:
             tuple(expr_from_proto(a) for a in p.host_udf.args),
             dtype_from_proto(p.host_udf.out_dtype),
         )
+    if which == "spark_partition_id":
+        return ir.SparkPartitionId()
+    if which == "monotonic_id":
+        return ir.MonotonicId()
+    if which == "row_num":
+        return ir.RowNum()
+    if which == "scalar_subquery":
+        return ir.ScalarSubquery(
+            p.scalar_subquery.resource_id, dtype_from_proto(p.scalar_subquery.dtype)
+        )
     raise ValueError(f"unknown expr variant {which}")
 
 

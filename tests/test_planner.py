@@ -161,3 +161,29 @@ def test_orc_scan_sink_plan(tmp_path):
     back = orc.ORCFile(str(tmp_path / "out" / "part-00000.orc")).read().to_pandas()
     assert back["a"].tolist() == list(range(50))
     assert back["s"].tolist() == df["s"].tolist()
+
+
+def test_partition_context_exprs():
+    from auron_tpu.exprs.ir import MonotonicId, RowNum, ScalarSubquery, SparkPartitionId
+
+    b = Batch.from_pydict({"x": [10, 20, 30]})
+    scan = B.memory_scan(b.schema, "src")
+    res = {"src": (lambda p: [b])}
+    plan = B.project(scan, [
+        (SparkPartitionId(), "pid"),
+        (MonotonicId(), "mid"),
+        (RowNum(), "rn"),
+        (ScalarSubquery("subq_val", T.INT64), "sq"),
+    ])
+    t = B.task(plan, partition_id=2)
+    raw = t.SerializeToString()
+    t2 = pb.TaskDefinition(); t2.ParseFromString(raw)
+    op, _, part, conf = task_from_proto(t2)
+    res["subq_val"] = 99
+    ctx = ExecutionContext(partition_id=part, resources=res)
+    from auron_tpu.columnar.batch import concat_batches
+    got = concat_batches(list(op.execute(part, ctx))).to_pandas()
+    assert got["pid"].tolist() == [2, 2, 2]
+    assert got["mid"].tolist() == [(2 << 33), (2 << 33) + 1, (2 << 33) + 2]
+    assert got["rn"].tolist() == [1, 2, 3]
+    assert got["sq"].tolist() == [99, 99, 99]
