@@ -327,15 +327,25 @@ def device_concat(batches: Sequence[Batch]) -> Batch:
                 jnp.asarray(r)[jnp.clip(b.col_values(ci), 0, len(r) - 1)]
                 for b, r in zip(batches, remaps)
             ]
+    total = sum(b.capacity for b in batches)
+    cap = bucket_capacity(total)  # pad to a bucket so downstream jitted
+    pad = cap - total  # programs see few distinct shapes
     sel = jnp.concatenate([b.device.sel for b in batches])
+    if pad:
+        sel = jnp.pad(sel, (0, pad))
     values = []
     validity = []
     for ci in range(ncols):
         if ci in remapped:
-            values.append(jnp.concatenate(remapped[ci]))
+            v = jnp.concatenate(remapped[ci])
         else:
-            values.append(jnp.concatenate([b.col_values(ci) for b in batches]))
-        validity.append(jnp.concatenate([b.col_validity(ci) for b in batches]))
+            v = jnp.concatenate([b.col_values(ci) for b in batches])
+        m = jnp.concatenate([b.col_validity(ci) for b in batches])
+        if pad:
+            v = jnp.pad(v, (0, pad))
+            m = jnp.pad(m, (0, pad))
+        values.append(v)
+        validity.append(m)
     return Batch(schema, DeviceBatch(sel, tuple(values), tuple(validity)), tuple(new_dicts))
 
 

@@ -171,6 +171,14 @@ class HashAggExec(ExecOperator):
         super().__init__([child], T.Schema(tuple(out_fields)))
         self.n_keys = len(key_fields)
         self.inter_schema = T.Schema(tuple(key_fields + inter_fields))
+        self._has_host_aggs = any(
+            a.func in ("collect_list", "collect_set", "host_udaf") for a, _ in aggs
+        )
+        self._reduce_cfg = (
+            self.n_keys,
+            tuple(f.dtype for f in key_fields),
+            tuple((a, t) for (a, _), t in zip(aggs, self._agg_input_types)),
+        )
 
     # ------------------------------------------------------------------
 
@@ -318,118 +326,52 @@ class HashAggExec(ExecOperator):
         agg_cols: list[list[ColumnVal]],
         raw: bool,
     ) -> Batch:
-        cap = int(sel.shape[0])
-        if self.n_keys == 0:
-            # global aggregation: single segment containing all live rows
-            seg = S.Segmentation(
-                order=jnp.arange(cap, dtype=jnp.int32),
-                seg_ids=jnp.where(sel, 0, cap),
-                boundary=jnp.zeros(cap, bool),
-                group_of_slot=jnp.zeros(cap, jnp.int32),
-                num_groups=jnp.minimum(jnp.sum(sel), 1),
-                sel_sorted=sel,
+        """Group + reduce one batch. When every aggregate is device-native
+        the whole reduction runs as ONE jitted program (cached per shape
+        signature); host-side aggregates (collect/UDAF pull data to host)
+        keep the eager path."""
+        if not self._has_host_aggs:
+            key_v = tuple(k.values for k in keys)
+            key_m = tuple(k.validity for k in keys)
+            agg_v = tuple(tuple(c.values for c in cols) for cols in agg_cols)
+            agg_m = tuple(tuple(c.validity for c in cols) for cols in agg_cols)
+            out_v, out_m, group_valid = _reduce_arrays_jit(
+                sel, key_v, key_m, agg_v, agg_m, cfg=self._reduce_cfg, raw=raw
             )
-            order = seg.order
-        else:
-            words = S.key_words(keys)
-            seg = S.segment_by_keys(words, sel)
-            order = seg.order
+            out_vals = []
+            dict_map = self._output_dicts(keys, agg_cols)
+            for i, (v, m) in enumerate(zip(out_v, out_m)):
+                f = self.inter_schema[i]
+                out_vals.append(ColumnVal(v, m, f.dtype, dict_map[i]))
+            out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
+            return Batch(self.inter_schema, out.device, out.dicts)
+        return self._group_reduce_eager(sel, keys, agg_cols, raw)
 
-        out_vals: list[ColumnVal] = []
-        names: list[str] = []
-        # group key columns: value of each segment's first row
-        slot = jnp.clip(seg.group_of_slot, 0, cap - 1)
-        group_valid = jnp.arange(cap, dtype=jnp.int32) < seg.num_groups
-        if self.n_keys == 0:
-            group_valid = jnp.zeros(cap, bool).at[0].set(jnp.sum(sel) >= 0)
-            # a global agg always yields exactly one group, even over 0 rows
-        for i, kv in enumerate(keys):
-            sorted_vals = kv.values[order]
-            sorted_mask = kv.validity[order]
-            out_vals.append(
-                ColumnVal(sorted_vals[slot], sorted_mask[slot] & group_valid, kv.dtype, kv.dict)
-            )
-            names.append(self.inter_schema[i].name)
+    def _output_dicts(self, keys: list[ColumnVal], agg_cols: list[list[ColumnVal]]):
+        """Host dictionaries for each intermediate output column (positions
+        must mirror _reduce_arrays' output order)."""
+        dicts: list = [k.dict for k in keys]
+        for (a, _), cols in zip(self.aggs, agg_cols):
+            n_out = 2 if a.func in ("avg", "first", "first_ignores_null") else 1
+            src = cols[0].dict if (cols and a.func in ("min", "max", "first", "first_ignores_null")) else None
+            dicts.append(src)
+            if n_out == 2:
+                dicts.append(None)
+        return dicts
 
-        ofs = self.n_keys
-        for (a, name), in_t, cols in zip(self.aggs, self._agg_input_types, agg_cols):
-            reduced = self._reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid)
-            for j, rv in enumerate(reduced):
-                out_vals.append(rv)
-                names.append(self.inter_schema[ofs + j].name)
-            ofs += len(reduced)
-
-        out = batch_from_columns(out_vals, names, group_valid)
+    def _group_reduce_eager(
+        self,
+        sel: jnp.ndarray,
+        keys: list[ColumnVal],
+        agg_cols: list[list[ColumnVal]],
+        raw: bool,
+    ) -> Batch:
+        out_vals, group_valid = _reduce_columns(
+            sel, keys, agg_cols, raw, self._reduce_cfg, collect_cb=self._reduce_collect
+        )
+        out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
         return Batch(self.inter_schema, out.device, out.dicts)
 
-    def _reduce_one(
-        self, a: AggExpr, in_t, cols: list[ColumnVal], order, seg, cap, raw, group_valid
-    ) -> list[ColumnVal]:
-        ids = seg.seg_ids
-
-        def sortg(cv: ColumnVal):
-            return cv.values[order], cv.validity[order] & seg.sel_sorted
-
-        if a.func == "count_star":
-            if raw:
-                cnt = S.seg_count(seg.sel_sorted, ids, cap)
-            else:
-                v, m = sortg(cols[0])
-                cnt, _ = S.seg_sum(v, m, ids, cap)
-            return [ColumnVal(cnt, group_valid, T.INT64)]
-        if a.func == "count":
-            v, m = sortg(cols[0])
-            if raw:
-                cnt = S.seg_count(m, ids, cap)
-            else:
-                cnt, _ = S.seg_sum(v, m, ids, cap)
-            return [ColumnVal(cnt, group_valid, T.INT64)]
-        if a.func == "sum":
-            v, m = sortg(cols[0])
-            sm, any_valid = S.seg_sum(v, m, ids, cap)
-            return [ColumnVal(sm, any_valid & group_valid, sum_type(in_t))]
-        if a.func == "avg":
-            v, m = sortg(cols[0])
-            sm, any_valid = S.seg_sum(v, m, ids, cap)
-            if raw:
-                cnt = S.seg_count(m, ids, cap)
-            else:
-                cv, cm = sortg(cols[1])
-                cnt, _ = S.seg_sum(cv, cm, ids, cap)
-            return [
-                ColumnVal(sm, any_valid & group_valid, sum_type(in_t)),
-                ColumnVal(cnt, group_valid, T.INT64),
-            ]
-        if a.func in ("min", "max"):
-            v, m = sortg(cols[0])
-            fn = S.seg_min if a.func == "min" else S.seg_max
-            mv, any_valid = fn(v, m, ids, cap)
-            return [ColumnVal(mv, any_valid & group_valid, in_t, cols[0].dict)]
-        if a.func in ("collect_list", "collect_set", "host_udaf"):
-            return self._reduce_collect(a, in_t, cols, order, seg, cap, raw, group_valid)
-        if a.func in ("first", "first_ignores_null"):
-            ignores = a.func == "first_ignores_null"
-            v, m = sortg(cols[0])
-            if raw:
-                eligible = seg.sel_sorted & (m if ignores else jnp.ones_like(m))
-            else:
-                sv, smask = sortg(cols[1])
-                eligible = seg.sel_sorted & sv.astype(bool)
-            n = v.shape[0]
-            pos = jnp.arange(n, dtype=jnp.int32)
-            pos_or_inf = jnp.where(eligible, pos, n)
-            import jax
-
-            first_pos = jax.ops.segment_min(pos_or_inf, ids, num_segments=cap + 1)[:cap]
-            safe = jnp.clip(first_pos, 0, n - 1)
-            fv = v[safe]
-            fm = m[safe] & (first_pos < n)
-            seen = (first_pos < n) & group_valid
-            return [
-                ColumnVal(fv, fm & group_valid, in_t, cols[0].dict),
-                ColumnVal(seen, group_valid, T.BOOL),
-            ]
-        raise ValueError(a.func)
 
     def _reduce_collect(
         self, a: AggExpr, in_t, cols, order, seg, cap, raw, group_valid
@@ -678,3 +620,141 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
             return T.decimal(max(t.precision - 10, 1), t.scale)
         return T.INT64 if t.kind == T.TypeKind.INT64 else T.FLOAT64
     return t  # min/max/first carry the input type
+
+
+# ---------------------------------------------------------------------------
+# module-level reduce core (shared jit cache across all HashAggExec instances)
+# ---------------------------------------------------------------------------
+
+
+def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None):
+    """Segment + reduce already-evaluated columns.
+
+    cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...)) — pure values, so the
+    jitted wrapper's compile cache is shared by every operator instance with
+    the same aggregate signature."""
+    n_keys, key_dtypes, agg_specs = cfg
+    cap = int(sel.shape[0])
+    if n_keys == 0:
+        # global aggregation: single segment containing all live rows
+        seg = S.Segmentation(
+            order=jnp.arange(cap, dtype=jnp.int32),
+            seg_ids=jnp.where(sel, 0, cap),
+            boundary=jnp.zeros(cap, bool),
+            group_of_slot=jnp.zeros(cap, jnp.int32),
+            num_groups=jnp.minimum(jnp.sum(sel), 1),
+            sel_sorted=sel,
+        )
+    else:
+        words = S.key_words(keys)
+        seg = S.segment_by_keys(words, sel)
+    order = seg.order
+
+    out_vals: list[ColumnVal] = []
+    slot = jnp.clip(seg.group_of_slot, 0, cap - 1)
+    group_valid = jnp.arange(cap, dtype=jnp.int32) < seg.num_groups
+    if n_keys == 0:
+        # a global agg always yields exactly one group, even over 0 rows
+        group_valid = jnp.zeros(cap, bool).at[0].set(True)
+    for kv in keys:
+        sorted_vals = kv.values[order]
+        sorted_mask = kv.validity[order]
+        out_vals.append(
+            ColumnVal(sorted_vals[slot], sorted_mask[slot] & group_valid, kv.dtype, kv.dict)
+        )
+    for (a, in_t), cols in zip(agg_specs, agg_cols):
+        out_vals.extend(
+            _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb)
+        )
+    return out_vals, group_valid
+
+
+def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb=None):
+    import jax
+
+    ids = seg.seg_ids
+
+    def sortg(cv):
+        return cv.values[order], cv.validity[order] & seg.sel_sorted
+
+    if a.func == "count_star":
+        if raw:
+            cnt = S.seg_count(seg.sel_sorted, ids, cap)
+        else:
+            v, m = sortg(cols[0])
+            cnt, _ = S.seg_sum(v, m, ids, cap)
+        return [ColumnVal(cnt, group_valid, T.INT64)]
+    if a.func == "count":
+        v, m = sortg(cols[0])
+        if raw:
+            cnt = S.seg_count(m, ids, cap)
+        else:
+            cnt, _ = S.seg_sum(v, m, ids, cap)
+        return [ColumnVal(cnt, group_valid, T.INT64)]
+    if a.func == "sum":
+        v, m = sortg(cols[0])
+        sm, any_valid = S.seg_sum(v, m, ids, cap)
+        return [ColumnVal(sm, any_valid & group_valid, sum_type(in_t))]
+    if a.func == "avg":
+        v, m = sortg(cols[0])
+        sm, any_valid = S.seg_sum(v, m, ids, cap)
+        if raw:
+            cnt = S.seg_count(m, ids, cap)
+        else:
+            cv, cm = sortg(cols[1])
+            cnt, _ = S.seg_sum(cv, cm, ids, cap)
+        return [
+            ColumnVal(sm, any_valid & group_valid, sum_type(in_t)),
+            ColumnVal(cnt, group_valid, T.INT64),
+        ]
+    if a.func in ("min", "max"):
+        v, m = sortg(cols[0])
+        fn = S.seg_min if a.func == "min" else S.seg_max
+        mv, any_valid = fn(v, m, ids, cap)
+        return [ColumnVal(mv, any_valid & group_valid, in_t, cols[0].dict)]
+    if a.func in ("collect_list", "collect_set", "host_udaf"):
+        assert collect_cb is not None, "host aggregates need the eager path"
+        return collect_cb(a, in_t, cols, order, seg, cap, raw, group_valid)
+    if a.func in ("first", "first_ignores_null"):
+        ignores = a.func == "first_ignores_null"
+        v, m = sortg(cols[0])
+        if raw:
+            eligible = seg.sel_sorted & (m if ignores else jnp.ones_like(m))
+        else:
+            sv, smask = sortg(cols[1])
+            eligible = seg.sel_sorted & sv.astype(bool)
+        n = v.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        pos_or_inf = jnp.where(eligible, pos, n)
+        first_pos = jax.ops.segment_min(pos_or_inf, ids, num_segments=cap + 1)[:cap]
+        safe = jnp.clip(first_pos, 0, n - 1)
+        fv = v[safe]
+        fm = m[safe] & (first_pos < n)
+        seen = (first_pos < n) & group_valid
+        return [
+            ColumnVal(fv, fm & group_valid, in_t, cols[0].dict),
+            ColumnVal(seen, group_valid, T.BOOL),
+        ]
+    raise ValueError(a.func)
+
+
+def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, cfg, raw):
+    n_keys, key_dtypes, agg_specs = cfg
+    keys = [
+        ColumnVal(v, m, dt, None) for (v, m, dt) in zip(key_v, key_m, key_dtypes)
+    ]
+    agg_cols = [
+        [ColumnVal(v, m, T.NULL, None) for v, m in zip(vs, ms)]
+        for vs, ms in zip(agg_v, agg_m)
+    ]
+    out_vals, group_valid = _reduce_columns(sel, keys, agg_cols, raw, cfg)
+    return (
+        tuple(cv.values for cv in out_vals),
+        tuple(cv.validity for cv in out_vals),
+        group_valid,
+    )
+
+
+import jax as _jax  # noqa: E402
+
+_reduce_arrays_jit = _jax.jit(_reduce_arrays_impl, static_argnames=("cfg", "raw"))
