@@ -295,6 +295,98 @@ def q3_class_oracle(data: TpcdsData, moy=11, category_id=1, limit=100) -> pd.Dat
 
 
 # ---------------------------------------------------------------------------
+# q72/q95-class: shuffle both sides by key, sort-merge join, aggregate
+# ---------------------------------------------------------------------------
+
+
+def run_q72_class(
+    data: TpcdsData,
+    n_map: int = 3,
+    n_reduce: int = 3,
+    work_dir: str | None = None,
+) -> pd.DataFrame:
+    """SELECT ss.ss_item_sk, count(*) cnt, sum(ss.ss_quantity) qty,
+              avg(sr.ss_ext_sales_price) other_avg
+    FROM store_sales ss JOIN store_sales2 sr ON ss.ss_item_sk = sr.ss_item_sk
+                        AND ss.ss_sold_date_sk = sr.ss_sold_date_sk
+    GROUP BY ss_item_sk — the SMJ + shuffle-heavy shape (q72/q95 class):
+    both sides hash-shuffled on the join keys, reduce tasks sort and
+    sort-merge join their co-partitioned slices, then aggregate."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q72_")
+    # second "fact" = a shifted resample of store_sales (same schema)
+    rng = np.random.default_rng(7)
+    sr = data.store_sales.sample(frac=0.5, random_state=3).reset_index(drop=True)
+    fact_schema = _schema_of(data.store_sales)
+
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    left_parts = to_batches(data.store_sales, n_map)
+    right_parts = to_batches(sr, n_map)
+    api.put_resource("q72_l", left_parts)
+    api.put_resource("q72_r", right_parts)
+    try:
+        # ---- map stages: shuffle both inputs by (item_sk, date_sk)
+        pairs = {"l": [], "r": []}
+        for side, res in (("l", "q72_l"), ("r", "q72_r")):
+            scan = B.memory_scan(fact_schema, res)
+            # partition on item_sk alone: a subset of the join keys keeps the
+            # join co-partitioned AND aligns the downstream GROUP BY item
+            part = B.hash_partitioning([col(1)], n_reduce)
+            for p in range(n_map):
+                d = os.path.join(work, f"{side}{p}.data")
+                i = os.path.join(work, f"{side}{p}.index")
+                w = B.shuffle_writer(scan, part, d, i)
+                h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+                while api.next_batch(h) is not None:
+                    pass
+                api.finalize_native(h)
+                pairs[side].append((d, i))
+
+        # ---- reduce: read -> sort -> SMJ -> partial+final agg (co-partitioned)
+        api.put_resource("q72_lb", MultiMapBlockProvider(pairs["l"]))
+        api.put_resource("q72_rb", MultiMapBlockProvider(pairs["r"]))
+        specs = [(col(1), SortSpec()), (col(0), SortSpec())]
+        lread = B.sort(B.ipc_reader(fact_schema, "q72_lb"), specs)
+        rread = B.sort(B.ipc_reader(fact_schema, "q72_rb"), specs)
+        smj = B.sort_merge_join(
+            lread, rread, [col(1), col(0)], [col(1), col(0)], "inner"
+        )
+        # left cols 0-4, right cols 5-9; quantity at 3, right price at 9
+        proj = B.project(smj, [(col(1), "item"), (col(3), "qty"), (col(9), "price")])
+        agg_p = B.hash_agg(proj, [(col(0), "item")],
+                           [("count_star", None, "cnt"), ("sum", col(1), "qty"),
+                            ("avg", col(2), "p_avg")], "partial")
+        agg_f = B.hash_agg(agg_p, [(col(0), "item")],
+                           [("count_star", None, "cnt"), ("sum", col(1), "qty"),
+                            ("avg", col(2), "p_avg")], "final")
+        frames = []
+        for p in range(n_reduce):
+            h = api.call_native(B.task(agg_f, stage_id=2, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
+            api.finalize_native(h)
+        if not frames:
+            return pd.DataFrame({"item": [], "cnt": [], "qty": [], "p_avg": []})
+        return (
+            pd.concat(frames).sort_values("item").reset_index(drop=True)
+        ), sr
+    finally:
+        for k in ("q72_l", "q72_r", "q72_lb", "q72_rb"):
+            api.remove_resource(k)
+
+
+def q72_class_oracle(data: TpcdsData, sr: pd.DataFrame) -> pd.DataFrame:
+    m = data.store_sales.merge(
+        sr, on=["ss_item_sk", "ss_sold_date_sk"], suffixes=("", "_r")
+    )
+    g = (
+        m.groupby("ss_item_sk")
+        .agg(cnt=("ss_item_sk", "size"), qty=("ss_quantity", "sum"),
+             p_avg=("ss_ext_sales_price_r", "mean"))
+        .reset_index()
+        .rename(columns={"ss_item_sk": "item"})
+    )
+    return g.sort_values("item").reset_index(drop=True)
 
 
 def _agg_inter_schema(agg_plan) -> T.Schema:

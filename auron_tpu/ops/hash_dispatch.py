@@ -61,6 +61,22 @@ from functools import partial
 
 import jax
 
+# dictionaries are shared across the batches of a scan/exchange; cache their
+# byte-matrix expansion by object identity (bounded LRU-ish)
+_BM_CACHE: dict[int, tuple] = {}
+
+
+def _byte_matrix_cached(d) -> ByteMatrix:
+    key = id(d)
+    hit = _BM_CACHE.get(key)
+    if hit is not None and hit[0] is d:
+        return hit[1]
+    bm = ByteMatrix.from_arrow(d)
+    if len(_BM_CACHE) > 256:
+        _BM_CACHE.clear()
+    _BM_CACHE[key] = (d, bm)
+    return bm
+
 
 @partial(jax.jit, static_argnames=("dtypes", "algo", "seed"))
 def _hash_columns_jit(values, validity, dict_mats, dtypes, algo, seed):
@@ -116,7 +132,7 @@ def hash_batch(
         validity.append(dev.validity[ci])
         dtypes.append(dtype)
         if dtype.is_string_like:
-            bm = ByteMatrix.from_arrow(batch.dicts[ci])
+            bm = _byte_matrix_cached(batch.dicts[ci])
             dict_mats.append((bm.bytes, bm.lengths))
         else:
             dict_mats.append(None)

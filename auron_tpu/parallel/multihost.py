@@ -1,0 +1,57 @@
+"""Multi-host (DCN) runtime initialization.
+
+The reference scales out through Spark's executor fleet + netty/RSS shuffle
+(SURVEY.md §2.3). The TPU-native equivalent: ``jax.distributed`` joins every
+host's local devices into one global mesh; the same ``shard_map``
+collectives used intra-slice (parallel/exchange.py) then ride ICI within a
+slice and DCN across slices — XLA partitions the collectives, no separate
+communication backend is needed. The durable file shuffle remains available
+for cross-stage exchanges that must survive task retries.
+
+Environment contract (standard JAX multi-process):
+  AURON_COORDINATOR  host:port of process 0
+  AURON_NUM_PROCS    total process count
+  AURON_PROC_ID      this process's index
+
+On single-process runs this module is a no-op and ``global_mesh`` falls
+back to the local devices.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def initialize_from_env() -> bool:
+    """Join the multi-host cluster if the env vars are present."""
+    global _initialized
+    if _initialized:
+        return True
+    coord = os.environ.get("AURON_COORDINATOR")
+    if not coord:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["AURON_NUM_PROCS"]),
+        process_id=int(os.environ["AURON_PROC_ID"]),
+    )
+    _initialized = True
+    return True
+
+
+def global_mesh():
+    """Mesh over every device in the cluster (all hosts)."""
+    from auron_tpu.parallel.mesh import PARTITION_AXIS
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (PARTITION_AXIS,))
+
+
+def process_info() -> tuple[int, int]:
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,58 @@
+/*
+ * auron-tpu host-engine bridge — C ABI specification.
+ *
+ * The stable boundary a JVM (or any out-of-process) front-end binds against,
+ * mirroring the reference's 4 JNI entry points + resource registry
+ * (auron-core JniBridge.java:49-80). The python engine implements these in
+ * bridge/api.py; this header freezes the contract for a native embedding
+ * (e.g. a JNI shim that hosts the engine through the CPython C API — the
+ * runtime around XLA stays native, the compute path stays XLA).
+ *
+ * Memory: all buffers returned by the engine are owned by the engine and
+ * valid until the next call on the same handle; callers copy out. Batches
+ * cross the boundary as Arrow IPC stream bytes (the C-data-interface
+ * equivalent for out-of-process hosts).
+ */
+
+#ifndef AURON_BRIDGE_H
+#define AURON_BRIDGE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int64_t auron_task_handle;
+
+/* Start a task from a serialized TaskDefinition protobuf.
+ * Returns a positive handle, or a negative error code. */
+auron_task_handle auron_call_native(const uint8_t* task_def, size_t len);
+
+/* Pull the next output batch as an Arrow IPC stream.
+ * Returns 1 and sets (*data, *len) when a batch is available,
+ * 0 at end-of-stream, negative on error (auron_last_error has details). */
+int auron_next_batch(auron_task_handle h, const uint8_t** data, size_t* len);
+
+/* Cancel/drain/join the task; returns the metric tree as JSON. */
+int auron_finalize_native(auron_task_handle h, const uint8_t** metrics_json,
+                          size_t* len);
+
+/* Shut down every live task (host engine exit hook). */
+void auron_on_exit(void);
+
+/* Resource map: hand scan providers / shuffle block channels / UDF
+ * contexts to tasks. Values are opaque host callbacks registered through
+ * the embedding layer; file-backed resources use string payloads. */
+int auron_put_resource(const char* key, const uint8_t* value, size_t len);
+int auron_remove_resource(const char* key);
+
+/* Last error message for the calling thread (UTF-8, engine-owned). */
+const char* auron_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* AURON_BRIDGE_H */

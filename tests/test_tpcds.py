@@ -29,3 +29,14 @@ def test_q3_class_matches_oracle(data, tmp_path):
     assert got["i_brand_id"].tolist() == want["i_brand_id"].tolist()
     for g, w in zip(got["s"], want["s"]):
         assert g == pytest.approx(w, rel=1e-9)
+
+
+def test_q72_class_matches_oracle(data, tmp_path):
+    got, sr = tpcds.run_q72_class(data, n_map=2, n_reduce=3, work_dir=str(tmp_path))
+    want = tpcds.q72_class_oracle(data, sr)
+    assert len(got) == len(want)
+    assert got["item"].tolist() == want["item"].tolist()
+    assert got["cnt"].tolist() == want["cnt"].tolist()
+    assert got["qty"].tolist() == want["qty"].tolist()
+    for g, w in zip(got["p_avg"], want["p_avg"]):
+        assert g == pytest.approx(w, rel=1e-9)
