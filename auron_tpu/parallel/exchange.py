@@ -114,6 +114,49 @@ def _group_sum_i64(keys: jnp.ndarray, vals: jnp.ndarray, sel: jnp.ndarray):
     return gkeys, sums, counts, gvalid
 
 
+def batch_exchange_step(mesh: Mesh, slot_cap: int, n_hash_cols: int = 1):
+    """Generic mesh repartitioner: route rows of an arbitrary column set to
+    the shard owning murmur3(key columns) % P — the ICI path for ANY hash
+    shuffle (values+validity of every column travel together). Columns are
+    a pytree, so schemas of mixed dtypes compile into one program per
+    (shapes, dtypes) signature.
+
+    Inputs (sharded over p): key_cols tuple of int64 [P, cap]; payload
+    arrays pytree of [P, cap]; sel [P, cap]. Returns exchanged (key_cols,
+    payload, sel, overflow)."""
+    n_parts = mesh.shape[PARTITION_AXIS]
+
+    def step(key_cols, payload, sel):
+        key_cols = tuple(k[0] for k in key_cols)
+        payload = jax.tree.map(lambda a: a[0], payload)
+        sel = sel[0]
+        h = jnp.full(sel.shape, jnp.uint32(42))
+        for k in key_cols:
+            h = H.murmur3_i64(k, h)
+        pid = H.pmod(h.view(jnp.int32), n_parts)
+        flat, treedef = jax.tree.flatten(payload)
+        arrays = tuple(key_cols) + tuple(flat)
+        recv, rsel, overflow = all_to_all_rows(arrays, sel, pid, n_parts, slot_cap)
+        rkeys = recv[: len(key_cols)]
+        rpayload = jax.tree.unflatten(treedef, list(recv[len(key_cols):]))
+        add = lambda a: a[None]
+        return (
+            tuple(k[None] for k in rkeys),
+            jax.tree.map(add, rpayload),
+            rsel[None],
+            overflow,
+        )
+
+    spec = P(PARTITION_AXIS)
+    fn = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
 def sharded_agg_exchange_step(mesh: Mesh, slot_cap: int):
     """Build the jitted SPMD program: partial agg -> ICI all_to_all by key
     hash -> final agg. This is the engine's flagship distributed step — the

@@ -82,3 +82,52 @@ def test_exchange_overflow_detection():
         step(*(shard_rows(mesh, jnp.asarray(a)) for a in (keys, vals, sel)))
     )
     assert int(overflow) > 0
+
+
+def test_generic_batch_exchange_mixed_dtypes():
+    """Any column set rides the ICI exchange; co-location is murmur3-exact."""
+    from auron_tpu.parallel.exchange import batch_exchange_step
+
+    mesh = make_mesh(8)
+    Pn, cap = 8, 128
+    rng = np.random.default_rng(51)
+    keys = rng.integers(0, 30, (Pn, cap)).astype(np.int64)
+    vals_f = rng.normal(size=(Pn, cap))
+    vals_i = rng.integers(0, 100, (Pn, cap)).astype(np.int32)
+    valid = rng.random((Pn, cap)) < 0.8
+    sel = np.ones((Pn, cap), bool)
+
+    step = batch_exchange_step(mesh, slot_cap=cap)
+    (rk,), payload, rsel, overflow = jax.device_get(
+        step(
+            (shard_rows(mesh, jnp.asarray(keys)),),
+            {
+                "f": shard_rows(mesh, jnp.asarray(vals_f)),
+                "i": shard_rows(mesh, jnp.asarray(vals_i)),
+                "m": shard_rows(mesh, jnp.asarray(valid)),
+            },
+            shard_rows(mesh, jnp.asarray(sel)),
+        )
+    )
+    assert int(overflow) == 0
+    # all rows arrive, and each key lands only on its murmur3 owner
+    from auron_tpu.ops import hashing as H
+
+    total = int(rsel.sum())
+    assert total == Pn * cap
+    for p in range(Pn):
+        live = rsel[p].reshape(-1)
+        ks = rk[p].reshape(-1)[live]
+        if len(ks):
+            owners = np.asarray(
+                H.pmod(H.murmur3_i64(jnp.asarray(ks), jnp.uint32(42)).view(jnp.int32), Pn)
+            )
+            assert (owners == p).all()
+    # payload integrity: global multiset of (key, i-value) preserved
+    sent = sorted(zip(keys.reshape(-1).tolist(), vals_i.reshape(-1).tolist()))
+    got = []
+    for p in range(Pn):
+        live = rsel[p].reshape(-1)
+        got += list(zip(rk[p].reshape(-1)[live].tolist(),
+                        payload["i"][p].reshape(-1)[live].tolist()))
+    assert sorted(got) == sent
