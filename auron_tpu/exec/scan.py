@@ -82,11 +82,21 @@ class ParquetScanExec(ExecOperator):
                 filt = f if filt is None else (filt & f)
         bs = ctx.batch_size()
         opener = ctx.resources.get(self.fs_resource_id) if self.fs_resource_id else None
+        from auron_tpu.utils.config import IGNORE_CORRUPTED_FILES
+
+        tolerate = ctx.conf.get(IGNORE_CORRUPTED_FILES)
         for path in self.file_paths:
             ctx.check_cancelled()
             src = opener(path) if opener is not None else path
-            with ctx.metrics.timer("io_time"):
-                pf = pq.ParquetFile(src)
+            try:
+                with ctx.metrics.timer("io_time"):
+                    pf = pq.ParquetFile(src)
+            except (OSError, pa.ArrowInvalid) as e:
+                # IGNORE_CORRUPTED_FILES (conf.rs:37 analog): skip bad inputs
+                if tolerate:
+                    ctx.metrics.add("corrupted_files_skipped", 1)
+                    continue
+                raise
             # row-group pruning via statistics happens inside
             # pyarrow when reading with filters through dataset; for
             # ParquetFile we read row groups and post-filter via the same

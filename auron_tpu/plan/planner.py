@@ -370,6 +370,15 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             n.output_data_file,
             n.output_index_file,
         )
+    if which == "rss_shuffle_writer":
+        from auron_tpu.exec.shuffle.writer import RssShuffleWriterExec
+
+        n = p.rss_shuffle_writer
+        return RssShuffleWriterExec(
+            plan_from_proto(n.child),
+            partitioning_from_proto(n.partitioning),
+            n.rss_resource_id,
+        )
     if which == "ipc_reader":
         return IpcReaderExec(schema_from_proto(p.ipc_reader.schema), p.ipc_reader.resource_id)
     if which == "window":

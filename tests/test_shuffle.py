@@ -134,3 +134,65 @@ def test_empty_partition_regions(tmp_path):
     nonempty = [p for p, d in out.items() if len(d)]
     assert len(nonempty) == 1
     assert len(out[nonempty[0]]) == 3
+
+
+def test_rss_push_writer():
+    """RSS-style push shuffle: blocks pushed per partition to a registered
+    writer callable; reading them back reproduces the dataset."""
+    from auron_tpu.exec.shuffle.format import decode_blocks
+    from auron_tpu.exec.shuffle.writer import RssShuffleWriterExec
+
+    df = pd.DataFrame({"k": np.arange(200) % 7, "v": np.arange(200.0)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    scan = MemoryScanExec.single([b])
+
+    pushed: dict[int, list[bytes]] = {}
+    flushed = []
+
+    class FakeRssClient:
+        def write(self, pid, blk):
+            pushed.setdefault(pid, []).append(blk)
+
+        def flush(self):
+            flushed.append(True)
+
+    w = RssShuffleWriterExec(scan, HashPartitioning([col(0)], 5), "rss")
+    ctx = ExecutionContext(resources={"rss": FakeRssClient()})
+    assert list(w.execute(0, ctx)) == []
+    assert flushed == [True]
+    rows = 0
+    for pid, blocks in pushed.items():
+        for blk in blocks:
+            for rb in decode_blocks(blk):
+                rows += rb.num_rows
+                ks = set(rb.column("k").to_pylist())
+                from auron_tpu.ops.hash_dispatch import hash_batch
+                from auron_tpu.ops.hashing import pmod
+                kb = Batch.from_pydict({"k": sorted(ks)},
+                                       schema=T.Schema.of(T.Field("k", T.INT64)))
+                pids = np.asarray(pmod(hash_batch(kb, [0], "murmur3"), 5))[: len(ks)]
+                assert (pids == pid).all()
+    assert rows == 200
+
+
+def test_corrupted_file_tolerance(tmp_path):
+    import pyarrow.parquet as pq
+
+    from auron_tpu.exec.scan import ParquetScanExec
+    from auron_tpu.utils.config import Configuration, IGNORE_CORRUPTED_FILES
+
+    good = str(tmp_path / "good.parquet")
+    bad = str(tmp_path / "bad.parquet")
+    pq.write_table(pa.table({"x": [1, 2, 3]}), good)
+    with open(bad, "wb") as f:
+        f.write(b"not a parquet file")
+    schema = T.Schema.of(T.Field("x", T.INT64))
+    scan = ParquetScanExec(schema, [bad, good])
+    # default: corrupted file raises
+    with pytest.raises(Exception):
+        scan.collect()
+    # tolerant mode: skipped, good file still read
+    ctx = ExecutionContext(conf=Configuration().set(IGNORE_CORRUPTED_FILES, True))
+    out = [b.to_pydict()["x"] for b in scan.execute(0, ctx)]
+    assert out == [[1, 2, 3]]
+    assert ctx.metrics.total("corrupted_files_skipped") == 1
