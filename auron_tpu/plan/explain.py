@@ -1,0 +1,117 @@
+"""Plan explain rendering + stability checking.
+
+Analog of the reference's golden-plan gate (dev/auron-it
+PlanStabilityChecker.scala:30-110): render a normalized text form of the
+executable plan tree and diff it against checked-in goldens, so native-
+coverage regressions (an operator silently falling back or changing shape)
+fail tests instead of shipping.
+"""
+
+from __future__ import annotations
+
+import re
+
+from auron_tpu.exec.base import ExecOperator
+from auron_tpu.exprs import ir
+
+
+def expr_str(e: ir.Expr) -> str:
+    if isinstance(e, ir.Column):
+        return f"#{e.index}" + (f"({e.name})" if e.name else "")
+    if isinstance(e, ir.Literal):
+        return repr(e.value)
+    if isinstance(e, ir.BinaryOp):
+        return f"({expr_str(e.left)} {e.op} {expr_str(e.right)})"
+    if isinstance(e, ir.Cast):
+        return f"cast({expr_str(e.child)} as {e.to})"
+    if isinstance(e, ir.IsNull):
+        return f"isnull({expr_str(e.child)})"
+    if isinstance(e, ir.IsNotNull):
+        return f"isnotnull({expr_str(e.child)})"
+    if isinstance(e, ir.Not):
+        return f"not({expr_str(e.child)})"
+    if isinstance(e, ir.ScalarFunc):
+        return f"{e.name}({', '.join(expr_str(a) for a in e.args)})"
+    if isinstance(e, ir.HostUDF):
+        return f"host_udf:{e.name}({', '.join(expr_str(a) for a in e.args)})"
+    if isinstance(e, ir.In):
+        return f"{expr_str(e.child)} in {list(e.items)!r}"
+    if isinstance(e, ir.Like):
+        return f"{expr_str(e.child)} like {e.pattern!r}"
+    if isinstance(e, ir.Case):
+        return "case(...)"
+    if isinstance(e, ir.If):
+        return f"if({expr_str(e.cond)}, {expr_str(e.then)}, {expr_str(e.orelse)})"
+    if isinstance(e, ir.Coalesce):
+        return f"coalesce({', '.join(expr_str(a) for a in e.args)})"
+    return type(e).__name__
+
+
+def _node_detail(op: ExecOperator) -> str:
+    d = []
+    for attr in ("exprs", "predicates", "sort_exprs", "left_keys", "right_keys",
+                 "partition_by", "gen_expr"):
+        v = getattr(op, attr, None)
+        if v is None:
+            continue
+        if isinstance(v, list):
+            d.append(f"{attr}=[{', '.join(expr_str(e) for e in v)}]")
+        else:
+            d.append(f"{attr}={expr_str(v)}")
+    for attr in ("limit", "fetch", "mode", "generator", "outer", "build_side"):
+        v = getattr(op, attr, None)
+        if v is not None and v is not False:
+            d.append(f"{attr}={v}")
+    drv = getattr(op, "driver", None)
+    if drv is not None:
+        d.append(f"join_type={drv.join_type}")
+    part = getattr(op, "partitioning", None)
+    if part is not None:
+        d.append(f"partitioning={type(part).__name__}({part.num_partitions})")
+    groupings = getattr(op, "groupings", None)
+    if groupings:
+        d.append(f"groups=[{', '.join(expr_str(e) for e, _ in groupings)}]")
+    aggs = getattr(op, "aggs", None)
+    if aggs:
+        d.append(
+            "aggs=["
+            + ", ".join(
+                f"{a.func}({expr_str(a.expr) if a.expr is not None else '*'}) as {n}"
+                for a, n in aggs
+            )
+            + "]"
+        )
+    return " " + " ".join(d) if d else ""
+
+
+def explain(op: ExecOperator, indent: int = 0) -> str:
+    lines = ["  " * indent + op.name + _node_detail(op)]
+    for c in op.children:
+        lines.append(explain(c, indent + 1))
+    return "\n".join(lines)
+
+
+def normalize(plan_text: str) -> str:
+    """Strip run-specific detail (paths, resource ids) for golden diffs."""
+    t = re.sub(r"/[^\s]*\.(data|index|parquet|orc)", "<path>", plan_text)
+    t = re.sub(r"resource_id=\S+", "resource_id=<id>", t)
+    return t
+
+
+def check_stability(op: ExecOperator, golden_path: str, update: bool = False) -> None:
+    """Compare the normalized explain output to a golden file."""
+    import os
+
+    text = normalize(explain(op)) + "\n"
+    if update or not os.path.exists(golden_path):
+        os.makedirs(os.path.dirname(golden_path), exist_ok=True)
+        with open(golden_path, "w") as f:
+            f.write(text)
+        return
+    with open(golden_path) as f:
+        golden = f.read()
+    if golden != text:
+        raise AssertionError(
+            f"plan changed vs golden {golden_path}:\n--- golden ---\n{golden}"
+            f"--- current ---\n{text}"
+        )

@@ -13,11 +13,36 @@ BENCH_PARTS (map partitions, default 4).
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ensure_live_backend() -> None:
+    """The TPU tunnel can wedge (client init hangs forever). Probe it in a
+    subprocess with a timeout; if it doesn't come up, re-exec this script on
+    the CPU backend so the benchmark always completes."""
+    if os.environ.get("_AURON_BENCH_REEXEC"):
+        return
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "180")),
+            check=True, capture_output=True,
+        )
+        return  # backend healthy
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        sys.stderr.write(
+            "bench.py: accelerator backend unreachable; falling back to CPU\n"
+        )
+    env = dict(os.environ)
+    env["_AURON_BENCH_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)  # skip the axon sitecustomize
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 def main() -> None:
@@ -63,4 +88,5 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    _ensure_live_backend()
     main()

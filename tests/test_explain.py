@@ -1,0 +1,51 @@
+"""Plan explain + golden stability tests (PlanStabilityChecker analog)."""
+
+import os
+
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.basic import FilterExec, MemoryScanExec, ProjectExec
+from auron_tpu.exec.agg_exec import PARTIAL, AggExpr, HashAggExec
+from auron_tpu.exec.joins import BroadcastHashJoinExec
+from auron_tpu.exprs.ir import BinaryOp, col, lit
+from auron_tpu.plan.explain import check_stability, explain, normalize
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+def _plan():
+    b1 = Batch.from_pydict({"k": [1], "v": [1.0]})
+    b2 = Batch.from_pydict({"k2": [1], "w": [2.0]})
+    scan = MemoryScanExec.single([b1])
+    scan2 = MemoryScanExec.single([b2])
+    f = FilterExec(scan, [BinaryOp("gt", col(1, "v"), lit(0.5))])
+    j = BroadcastHashJoinExec(f, scan2, [col(0)], [col(0)], "inner",
+                              build_side="right")
+    p = ProjectExec(j, [col(0, "k"), BinaryOp("mul", col(1), col(3))], ["k", "vw"])
+    return HashAggExec(p, [(col(0), "k")], [(AggExpr("sum", col(1)), "s")], PARTIAL)
+
+
+def test_explain_renders_tree():
+    text = explain(_plan())
+    assert "HashAggExec" in text and "BroadcastHashJoinExec" in text
+    assert "groups=[#0]" in text
+    assert "aggs=[sum(#1) as s]" in text
+    assert "join_type=inner" in text
+    assert text.count("\n") >= 4  # nested tree
+
+
+def test_plan_stability_golden():
+    golden = os.path.join(GOLDEN_DIR, "agg_join_plan.txt")
+    check_stability(_plan(), golden)  # creates on first run, diffs after
+    check_stability(_plan(), golden)  # must match itself
+
+
+def test_plan_stability_detects_change(tmp_path):
+    golden = str(tmp_path / "g.txt")
+    check_stability(_plan(), golden)
+    b1 = Batch.from_pydict({"k": [1], "v": [1.0]})
+    other = FilterExec(MemoryScanExec.single([b1]), [BinaryOp("lt", col(0), lit(9))])
+    with pytest.raises(AssertionError, match="plan changed"):
+        check_stability(other, golden)
