@@ -389,6 +389,137 @@ def q72_class_oracle(data: TpcdsData, sr: pd.DataFrame) -> pd.DataFrame:
     return g.sort_values("item").reset_index(drop=True)
 
 
+def run_q95_class(
+    data: TpcdsData,
+    n_map: int = 2,
+    n_reduce: int = 2,
+    work_dir: str | None = None,
+) -> pd.DataFrame:
+    """EXISTS / NOT EXISTS shape (q95-class): customers that bought items in
+    category 1 but never in category 2 — semi join then anti join over
+    shuffled co-partitioned inputs, then count per customer."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q95_")
+    fact_schema = _schema_of(data.store_sales)
+    it_schema = _schema_of(data.item)
+
+    fact_parts = to_batches(data.store_sales, n_map)
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    api.put_resource("q95_fact", fact_parts)
+    api.put_resource("q95_item", [it] * max(n_map, n_reduce))
+    try:
+        # map: shuffle fact by customer
+        scan = B.memory_scan(fact_schema, "q95_fact")
+        part = B.hash_partitioning([col(2)], n_reduce)  # ss_customer_sk
+        pairs = []
+        for p in range(n_map):
+            d = os.path.join(work, f"f{p}.data")
+            i = os.path.join(work, f"f{p}.index")
+            w = B.shuffle_writer(scan, part, d, i)
+            h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+            while api.next_batch(h) is not None:
+                pass
+            api.finalize_native(h)
+            pairs.append((d, i))
+        api.put_resource("q95_blocks", MultiMapBlockProvider(pairs))
+
+        # reduce: sales rows for cat-1 items (semi), minus customers with
+        # cat-2 purchases (anti), grouped per customer
+        read = B.ipc_reader(fact_schema, "q95_blocks")
+        cat1 = B.filter_(B.memory_scan(it_schema, "q95_item"),
+                         [BinaryOp("eq", col(2), lit(1))])
+        cat2_sales = B.hash_join(
+            read,
+            B.filter_(B.memory_scan(it_schema, "q95_item"),
+                      [BinaryOp("eq", col(2), lit(2))]),
+            [col(1)], [col(0)], "left_semi", build_side="right",
+        )
+        # customers of cat2 purchases (projected to the key)
+        bad_customers = B.project(cat2_sales, [(col(2), "c")])
+        semi = B.hash_join(read, cat1, [col(1)], [col(0)], "left_semi",
+                           build_side="right")
+        anti = B.hash_join(semi, bad_customers, [col(2)], [col(0)], "left_anti",
+                           build_side="right")
+        agg_p = B.hash_agg(anti, [(col(2), "customer")],
+                           [("count_star", None, "cnt")], "partial")
+        agg_f = B.hash_agg(agg_p, [(col(2), "customer")],
+                           [("count_star", None, "cnt")], "final")
+        frames = []
+        for p in range(n_reduce):
+            h = api.call_native(B.task(agg_f, stage_id=2, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
+            api.finalize_native(h)
+        if not frames:
+            return pd.DataFrame({"customer": [], "cnt": []})
+        return pd.concat(frames).sort_values("customer").reset_index(drop=True)
+    finally:
+        for k in ("q95_fact", "q95_item", "q95_blocks"):
+            api.remove_resource(k)
+
+
+def q95_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    ss = data.store_sales
+    cat1_items = set(data.item[data.item.i_category_id == 1].i_item_sk)
+    cat2_items = set(data.item[data.item.i_category_id == 2].i_item_sk)
+    bad = set(ss[ss.ss_item_sk.isin(cat2_items)].ss_customer_sk.dropna())
+    keep = ss[ss.ss_item_sk.isin(cat1_items)]
+    keep = keep[~keep.ss_customer_sk.isin(bad)]
+    # SQL anti-join semantics: NULL customer keys never match -> kept
+    g = (
+        keep.groupby("ss_customer_sk", dropna=False)
+        .size().reset_index(name="cnt")
+        .rename(columns={"ss_customer_sk": "customer"})
+    )
+    return g.sort_values("customer").reset_index(drop=True)
+
+
+def run_windowed_query(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
+    """Rank items by revenue within each date (window function shape):
+    top-2 per date via window group limit."""
+    fact_schema = _schema_of(data.store_sales)
+    sample = data.store_sales.iloc[:5000]
+    parts = to_batches(sample, n_partitions)
+    from auron_tpu.ops.sortkeys import SortSpec
+    from auron_tpu.plan.planner import plan_from_proto
+
+    api.put_resource("qw_fact", [[b for bs in parts for b in bs]])
+    try:
+        scan = B.memory_scan(fact_schema, "qw_fact")
+        agg_p = B.hash_agg(scan, [(col(0), "d"), (col(1), "item")],
+                           [("sum", col(4), "rev")], "partial")
+        agg_f = B.hash_agg(agg_p, [(col(0), "d"), (col(1), "item")],
+                           [("sum", col(4), "rev")], "final")
+        w = B.window(agg_f, [col(0)], [(col(2), SortSpec(asc=False))],
+                     [("rank", None, None, 1, False, "rk")])
+        h = api.call_native(B.task(w).SerializeToString())
+        frames = []
+        while (rb := api.next_batch(h)) is not None:
+            frames.append(rb.to_pandas())
+        api.finalize_native(h)
+        out = pd.concat(frames)
+        return (
+            out[out.rk <= 2]
+            .sort_values(["d", "rk", "item"]).reset_index(drop=True)
+        )
+    finally:
+        api.remove_resource("qw_fact")
+
+
+def windowed_query_oracle(data: TpcdsData) -> pd.DataFrame:
+    sample = data.store_sales.iloc[:5000]
+    g = (
+        sample.groupby(["ss_sold_date_sk", "ss_item_sk"])
+        .agg(rev=("ss_ext_sales_price", "sum")).reset_index()
+    )
+    g["rk"] = g.groupby("ss_sold_date_sk")["rev"].rank(
+        method="min", ascending=False
+    ).astype(int)
+    out = g[g.rk <= 2].rename(
+        columns={"ss_sold_date_sk": "d", "ss_item_sk": "item"}
+    )
+    return out.sort_values(["d", "rk", "item"]).reset_index(drop=True)
+
+
 def _agg_inter_schema(agg_plan) -> T.Schema:
     """Intermediate schema of a partial agg plan node (host-side mirror)."""
     from auron_tpu.plan.planner import plan_from_proto

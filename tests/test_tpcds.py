@@ -40,3 +40,24 @@ def test_q72_class_matches_oracle(data, tmp_path):
     assert got["qty"].tolist() == want["qty"].tolist()
     for g, w in zip(got["p_avg"], want["p_avg"]):
         assert g == pytest.approx(w, rel=1e-9)
+
+
+def test_q95_class_matches_oracle(data, tmp_path):
+    got = tpcds.run_q95_class(data, n_map=2, n_reduce=2, work_dir=str(tmp_path))
+    want = tpcds.q95_class_oracle(data)
+    assert len(got) == len(want)
+    gk = [None if pd.isna(x) else int(x) for x in got["customer"]]
+    wk = [None if pd.isna(x) else int(x) for x in want["customer"]]
+    assert gk == wk
+    assert got["cnt"].tolist() == want["cnt"].tolist()
+
+
+def test_windowed_query_matches_oracle(data):
+    got = tpcds.run_windowed_query(data)
+    want = tpcds.windowed_query_oracle(data)
+    assert len(got) == len(want)
+    assert got["d"].tolist() == want["d"].tolist()
+    assert got["item"].tolist() == want["item"].tolist()
+    assert got["rk"].tolist() == want["rk"].tolist()
+    for g, w in zip(got["rev"], want["rev"]):
+        assert g == pytest.approx(w, rel=1e-9)
