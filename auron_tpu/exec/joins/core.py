@@ -183,13 +183,10 @@ def expand_pairs(
     starts = offsets - counts
     for cstart in range(0, total, _EXPAND_CHUNK):
         ccap = bucket_capacity(min(_EXPAND_CHUNK, total - cstart))
-        t = jnp.arange(ccap, dtype=jnp.int32) + cstart
-        pair_live = t < total
-        li = jnp.searchsorted(offsets, t, side="right").astype(jnp.int32)
-        li = jnp.clip(li, 0, pcap - 1)
-        within = t - starts[li]
-        ri = jnp.clip(lo[li] + within, 0, bcap - 1)
-        ok = pair_live
+        li, ri, ok = _decode_chunk(
+            offsets, starts, lo, jnp.int32(cstart), jnp.int32(total),
+            ccap=ccap, pcap=pcap, bcap=bcap,
+        )
         chunks.append((li, ri, ok))
 
     if condition is not None:
@@ -209,6 +206,20 @@ def expand_pairs(
         build_matched_delta = build_matched_delta.at[ri].max(ok, mode="drop")
 
     return chunks, probe_matched, build_matched_delta
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("ccap", "pcap", "bcap"))
+def _decode_chunk(offsets, starts, lo, cstart, total, ccap: int, pcap: int, bcap: int):
+    """Ragged-expansion slot decode for one output chunk (fused)."""
+    t = jnp.arange(ccap, dtype=jnp.int32) + cstart
+    ok = t < total
+    li = jnp.clip(jnp.searchsorted(offsets, t, side="right").astype(jnp.int32), 0, pcap - 1)
+    within = t - starts[li]
+    ri = jnp.clip(lo[li] + within, 0, bcap - 1)
+    return li, ri, ok
 
 
 @jax.jit
