@@ -41,7 +41,7 @@ from auron_tpu.exprs.eval import ColumnVal
 from auron_tpu.ops import segments as S
 from auron_tpu.ops.sortkeys import SortSpec, sort_operands
 
-RANK_FUNCS = ("row_number", "rank", "dense_rank", "percent_rank", "cume_dist")
+RANK_FUNCS = ("row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile")
 SHIFT_FUNCS = ("lead", "lag", "nth_value")
 AGG_FUNCS = ("sum", "count", "min", "max", "avg")
 
@@ -55,7 +55,7 @@ class WindowFunc:
     frame_whole: bool = False  # agg over the whole partition vs running
 
     def out_dtype(self, in_dtype: T.DataType | None) -> T.DataType:
-        if self.kind in ("row_number", "rank", "dense_rank"):
+        if self.kind in ("row_number", "rank", "dense_rank", "ntile"):
             return T.INT32
         if self.kind in ("percent_rank", "cume_dist"):
             return T.FLOAT64
@@ -248,6 +248,17 @@ class WindowExec(ExecOperator):
             my_seg_start = seg_start[jnp.clip(seg_ids, 0, cap - 1)]
             covered = (peer_end - my_seg_start).astype(jnp.float64)
             return ColumnVal(covered / jnp.maximum(n_part, 1), sel, T.FLOAT64)
+        if wf.kind == "ntile":
+            # Spark ntile(n): first (n_part % n) buckets get one extra row
+            nt = jnp.int64(wf.offset)
+            size = jnp.maximum(n_part.astype(jnp.int64) // nt, 1)
+            big = n_part.astype(jnp.int64) % nt
+            cut = big * (size + 1)
+            p64 = pos.astype(jnp.int64)
+            tile = jnp.where(
+                p64 < cut, p64 // (size + 1), big + (p64 - cut) // size
+            )
+            return ColumnVal((tile + 1).astype(jnp.int32), sel, T.INT32)
         if wf.kind in ("lead", "lag"):
             k = wf.offset if wf.kind == "lead" else -wf.offset
             src = iota + k

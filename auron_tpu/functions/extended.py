@@ -177,6 +177,124 @@ def _months_between(args, cap):
     return _cv(v, args[0].validity & args[1].validity, T.FLOAT64)
 
 
+@registry.register("unix_timestamp", T.INT64)
+def _unix_timestamp(args, cap):
+    a = args[0]
+    assert a.dtype.kind == T.TypeKind.TIMESTAMP
+    return _cv(jnp.floor_divide(a.values, 1_000_000), a.validity, T.INT64)
+
+
+@registry.register("from_unixtime_ts", T.TIMESTAMP)
+def _from_unixtime_ts(args, cap):
+    a = args[0]
+    return _cv(a.values.astype(jnp.int64) * 1_000_000, a.validity, T.TIMESTAMP)
+
+
+def _last_dom_days(y, m):
+    from auron_tpu.functions.registry import _days_from_civil
+
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    return _days_from_civil(ny, nm, jnp.ones_like(nm)) - 1
+
+
+@registry.register("add_months", T.DATE32)
+def _add_months(args, cap):
+    from auron_tpu.functions.registry import _civil_from_days, _date_arg, _days_from_civil
+
+    d = _date_arg(args[0])
+    n = args[1].values.astype(jnp.int64)
+    y, m, day = _civil_from_days(d)
+    m0 = m - 1 + n
+    y2 = y + jnp.floor_divide(m0, 12)
+    m2 = jnp.mod(m0, 12) + 1
+    first = _days_from_civil(y2, m2, jnp.ones_like(m2))
+    last = _last_dom_days(y2, m2)
+    out = jnp.minimum(first + (day - 1), last)
+    return _cv(out.astype(jnp.int32), args[0].validity & args[1].validity, T.DATE32)
+
+
+@registry.register("trunc_date", T.DATE32)
+def _trunc_date(args, cap):
+    from auron_tpu.functions.registry import _civil_from_days, _days_from_civil
+
+    fmt = str(_scalar_arg(args[1])).lower()
+    d = args[0].values.astype(jnp.int64)
+    y, m, day = _civil_from_days(d)
+    if fmt in ("year", "yyyy", "yy"):
+        out = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(day))
+    elif fmt in ("quarter",):
+        qm = ((m - 1) // 3) * 3 + 1
+        out = _days_from_civil(y, qm, jnp.ones_like(day))
+    elif fmt in ("month", "mon", "mm"):
+        out = _days_from_civil(y, m, jnp.ones_like(day))
+    elif fmt in ("week",):
+        dow = jnp.mod(d + 3, 7)  # 0 = Monday
+        out = d - dow
+    else:
+        out = d
+    return _cv(out.astype(jnp.int32), args[0].validity, T.DATE32)
+
+
+_DAYNAMES = {"MO": 0, "TU": 1, "WE": 2, "TH": 3, "FR": 4, "SA": 5, "SU": 6}
+
+
+@registry.register("next_day", T.DATE32)
+def _next_day(args, cap):
+    d = args[0].values.astype(jnp.int64)
+    name = str(_scalar_arg(args[1]))[:2].upper()
+    target = _DAYNAMES.get(name)
+    if target is None:
+        return _cv(jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool), T.DATE32)
+    dow = jnp.mod(d + 3, 7)  # 0 = Monday
+    delta = jnp.mod(target - dow + 7, 7)
+    delta = jnp.where(delta == 0, 7, delta)
+    return _cv((d + delta).astype(jnp.int32), args[0].validity, T.DATE32)
+
+
+def _minmax_skip_nulls(args, cap, is_least):
+    op = jnp.minimum if is_least else jnp.maximum
+    out_v = None
+    out_m = None
+    for cv in args:
+        v = cv.values
+        m = cv.validity
+        if out_v is None:
+            out_v, out_m = v, m
+            continue
+        take_new = m & (~out_m | (op(v, out_v) == v))
+        out_v = jnp.where(take_new, v, out_v)
+        out_m = out_m | m
+    return out_v, out_m
+
+
+@registry.register("least", lambda dts: dts[0])
+def _least(args, cap):
+    v, m = _minmax_skip_nulls(args, cap, True)
+    return _cv(v, m, args[0].dtype)
+
+
+@registry.register("greatest", lambda dts: dts[0])
+def _greatest(args, cap):
+    v, m = _minmax_skip_nulls(args, cap, False)
+    return _cv(v, m, args[0].dtype)
+
+
+def _java_fmt_to_strftime(fmt: str) -> str:
+    out = fmt
+    for a, b in (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+                 ("mm", "%M"), ("ss", "%S")):
+        out = out.replace(a, b)
+    return out
+
+
+_host_rowwise(
+    "date_format",
+    lambda d, fmt: d.strftime(_java_fmt_to_strftime(fmt)) if d is not None else None,
+    T.STRING,
+)
+
+
 # ---------------------------------------------------------------------------
 # strings: dictionary transforms
 # ---------------------------------------------------------------------------

@@ -103,3 +103,47 @@ def test_decimal_plumbing():
     out2 = _run({"n": pa.array([1234], type=pa.int64())},
                 [ScalarFunc("make_decimal", (col(0), lit(10), lit(2)))], ["m"])
     assert out2["m"] == [d.Decimal("12.34")]
+
+
+def test_date_arithmetic():
+    base = dt.date(2024, 1, 31)
+    days = (base - dt.date(1970, 1, 1)).days
+    arr = pa.array([days, days], type=pa.int32()).cast(pa.date32())
+    out = _run({"d": arr, "n": pa.array([1, 13], type=pa.int32())},
+               [ScalarFunc("add_months", (col(0), col(1)))], ["am"])
+    assert out["am"] == [dt.date(2024, 2, 29), dt.date(2025, 2, 28)]
+    out2 = _run({"d": arr},
+                [ScalarFunc("trunc_date", (col(0), lit("month"))),
+                 ScalarFunc("trunc_date", (col(0), lit("year"))),
+                 ScalarFunc("next_day", (col(0), lit("Mon")))],
+                ["tm", "ty", "nd"])
+    assert out2["tm"][0] == dt.date(2024, 1, 1)
+    assert out2["ty"][0] == dt.date(2024, 1, 1)
+    assert out2["nd"][0] == dt.date(2024, 2, 5)  # next Monday after Wed Jan 31
+
+
+def test_least_greatest_skip_nulls():
+    out = _run({"a": pa.array([1, None, 5], type=pa.int64()),
+                "b": pa.array([3, 2, None], type=pa.int64())},
+               [ScalarFunc("least", (col(0), col(1))),
+                ScalarFunc("greatest", (col(0), col(1)))],
+               ["l", "g"])
+    assert out["l"] == [1, 2, 5]
+    assert out["g"] == [3, 2, 5]
+
+
+def test_unix_timestamp_roundtrip():
+    ts = np.datetime64("2024-03-05T17:45:30", "us")
+    out = _run({"t": pa.array([ts])},
+               [ScalarFunc("unix_timestamp", (col(0),))], ["u"])
+    import calendar
+    want = calendar.timegm(dt.datetime(2024, 3, 5, 17, 45, 30).timetuple())
+    assert out["u"] == [want]
+
+
+def test_date_format():
+    days = (dt.date(2024, 3, 5) - dt.date(1970, 1, 1)).days
+    arr = pa.array([days], type=pa.int32()).cast(pa.date32())
+    out = _run({"d": arr},
+               [ScalarFunc("date_format", (col(0), lit("yyyy-MM-dd")))], ["f"])
+    assert out["f"] == ["2024-03-05"]

@@ -165,3 +165,10 @@ def test_window_group_limit():
         .sort_values(["g", "o"]).reset_index(drop=True)
     )
     pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_ntile():
+    df = pd.DataFrame({"g": [1] * 7, "o": list(range(7)), "v": [0.0] * 7})
+    got = _win(df, [(WindowFunc("ntile", offset=3), "nt")])
+    # 7 rows, 3 tiles -> sizes 3,2,2
+    assert got.sort_values("o")["nt"].tolist() == [1, 1, 1, 2, 2, 3, 3]
