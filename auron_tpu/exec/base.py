@@ -32,6 +32,19 @@ class TaskCancelled(Exception):
     pass
 
 
+_ctx_local = threading.local()
+
+
+def current_context() -> "ExecutionContext | None":
+    """The ExecutionContext of the operator currently executing on this
+    thread. Set by ExecOperator.execute so expression evaluation anywhere in
+    the tree (filters, join conditions, groupings, ...) can resolve
+    partition-context expressions (spark_partition_id, scalar subqueries)
+    without explicit plumbing — all operators of one task share the same
+    partition identity and resource map."""
+    return getattr(_ctx_local, "ctx", None)
+
+
 @dataclass
 class ExecutionContext:
     stage_id: int = 0
@@ -70,6 +83,7 @@ class ExecOperator:
 
     def execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         """Stream output batches, maintaining per-operator metrics."""
+        _ctx_local.ctx = ctx
         node = ctx.metrics
         rows = 0
         for batch in self._execute(partition, ctx):

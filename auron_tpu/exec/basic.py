@@ -27,6 +27,12 @@ from auron_tpu.exprs import Evaluator, ir
 from auron_tpu.exprs.eval import ColumnVal
 
 
+def _uses_row_offset(e: ir.Expr) -> bool:
+    if isinstance(e, (ir.RowNum, ir.MonotonicId)):
+        return True
+    return any(_uses_row_offset(c) for c in e.children())
+
+
 def batch_from_columns(
     vals: Sequence[ColumnVal], names: Sequence[str], sel: jnp.ndarray
 ) -> Batch:
@@ -76,11 +82,15 @@ class ProjectExec(ExecOperator):
             partition_id=ctx.partition_id,
             resources=ctx.resources,
         )
+        # row_offset maintenance costs a device->host sync per batch; only
+        # pay it when an expression actually consumes the running offset
+        track_offset = any(_uses_row_offset(e) for e in self.exprs)
         for b in self.child_stream(0, partition, ctx):
             with ctx.metrics.timer("elapsed_compute"):
                 vals = ev.evaluate(b, self.exprs)
                 out = batch_from_columns(vals, self.names, b.device.sel)
-            ev.row_offset += b.num_rows()
+            if track_offset:
+                ev.row_offset += b.num_rows()
             yield out
 
 

@@ -249,14 +249,16 @@ class WindowExec(ExecOperator):
             covered = (peer_end - my_seg_start).astype(jnp.float64)
             return ColumnVal(covered / jnp.maximum(n_part, 1), sel, T.FLOAT64)
         if wf.kind == "ntile":
-            # Spark ntile(n): first (n_part % n) buckets get one extra row
+            # Spark ntile(n): first (n_part % n) buckets get one extra row;
+            # with fewer rows than buckets every row is its own bucket
+            # (size=0 -> cut covers the whole partition, p // 1 = p)
             nt = jnp.int64(wf.offset)
-            size = jnp.maximum(n_part.astype(jnp.int64) // nt, 1)
+            size = n_part.astype(jnp.int64) // nt
             big = n_part.astype(jnp.int64) % nt
             cut = big * (size + 1)
             p64 = pos.astype(jnp.int64)
             tile = jnp.where(
-                p64 < cut, p64 // (size + 1), big + (p64 - cut) // size
+                p64 < cut, p64 // (size + 1), big + (p64 - cut) // jnp.maximum(size, 1)
             )
             return ColumnVal((tile + 1).astype(jnp.int32), sel, T.INT32)
         if wf.kind in ("lead", "lag"):

@@ -44,11 +44,21 @@ class Evaluator:
     def __init__(
         self,
         schema: T.Schema,
-        partition_id: int = 0,
+        partition_id: int | None = None,
         row_offset: int = 0,
         resources: dict | None = None,
     ):
         self.schema = schema
+        if partition_id is None or resources is None:
+            # default to the executing task's context (exec/base.py) so
+            # partition-context expressions work at every evaluation site
+            from auron_tpu.exec.base import current_context
+
+            ctx = current_context()
+            if partition_id is None:
+                partition_id = ctx.partition_id if ctx is not None else 0
+            if resources is None and ctx is not None:
+                resources = ctx.resources
         self.partition_id = partition_id
         self.row_offset = row_offset  # live rows already emitted upstream
         self.resources = resources or {}
@@ -129,7 +139,12 @@ class Evaluator:
                 self.row_offset + pos, jnp.ones(b.capacity, bool), T.INT64
             )
         if isinstance(e, ir.ScalarSubquery):
-            value = self.resources.get(e.resource_id)
+            if e.resource_id not in self.resources:
+                raise KeyError(
+                    f"scalar subquery value '{e.resource_id}' not in the task "
+                    "resource map (host engine must ship it before the task runs)"
+                )
+            value = self.resources[e.resource_id]
             return self._literal(ir.Literal(value, e.dtype), b.capacity)
         raise TypeError(f"unsupported expression {type(e).__name__}")
 

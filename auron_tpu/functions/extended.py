@@ -36,7 +36,7 @@ from auron_tpu.functions.registry import (
 def _host_rowwise(name: str, py_fn, out_dtype_fn):
     """Register fn(list_of_python_rows) evaluated on host per row."""
 
-    @registry.register(name, out_dtype_fn if callable(out_dtype_fn) else out_dtype_fn)
+    @registry.register(name, out_dtype_fn)
     def _f(args, cap, py_fn=py_fn):
         from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
 
@@ -82,9 +82,13 @@ def _bround(args, cap):
         half = p // 2
         odd = (q % 2) != 0
         up = (jnp.abs(r) > half) | ((jnp.abs(r) == half) & odd)
-        adj = jnp.where(up, jnp.sign(r), 0)
+        v = q + jnp.where(up, jnp.sign(r), 0)
+        if scale < 0:
+            # negative target scale: result is at scale 0, re-expand the
+            # rounded magnitude (bround(123.45, -1) = 120)
+            v = v * jnp.int64(D.pow10(min(-scale, 18)))
         out_t = T.decimal(a.dtype.precision, max(scale, 0))
-        return _cv(q + adj, a.validity, out_t)
+        return _cv(v, a.validity, out_t)
     return a
 
 
@@ -372,8 +376,9 @@ def _split(s: str, pattern: str, limit: int = -1) -> list[str]:
 def _split_fn(args, cap):
     a = args[0]
     pattern = _scalar_arg(args[1])
+    limit = int(_scalar_arg(args[2])) if len(args) > 2 else -1
     entries = a.dict.to_pylist()
-    new = [(_split(s, pattern) if s is not None else None) for s in entries]
+    new = [(_split(s, pattern, limit) if s is not None else None) for s in entries]
     out_dt = T.DataType(T.TypeKind.LIST, inner=(T.STRING,))
     d = pa.array([v if v is not None else [] for v in new], type=out_dt.to_arrow())
     return _cv(jnp.clip(a.values, 0, len(new) - 1), a.validity, out_dt, d)

@@ -187,3 +187,23 @@ def test_partition_context_exprs():
     assert got["mid"].tolist() == [(2 << 33), (2 << 33) + 1, (2 << 33) + 2]
     assert got["rn"].tolist() == [1, 2, 3]
     assert got["sq"].tolist() == [99, 99, 99]
+
+
+def test_context_exprs_in_filter():
+    """Partition-context expressions must work at every evaluation site,
+    not just projections."""
+    from auron_tpu.exprs.ir import ScalarSubquery, SparkPartitionId
+
+    b = Batch.from_pydict({"x": [1, 2, 3, 4]})
+    scan = B.memory_scan(b.schema, "src")
+    plan = B.filter_(scan, [BinaryOp("gt", col(0), ScalarSubquery("threshold", T.INT64))])
+    op = _roundtrip(plan)
+    ctx = ExecutionContext(resources={"src": [[b]], "threshold": 2})
+    from auron_tpu.columnar.batch import concat_batches
+    got = concat_batches(list(op.execute(0, ctx))).to_pydict()
+    assert got["x"] == [3, 4]
+    # missing subquery value raises instead of silently dropping rows
+    ctx2 = ExecutionContext(resources={"src": [[b]]})
+    op2 = _roundtrip(plan)
+    with pytest.raises(Exception):
+        list(op2.execute(0, ctx2))

@@ -172,3 +172,9 @@ def test_ntile():
     got = _win(df, [(WindowFunc("ntile", offset=3), "nt")])
     # 7 rows, 3 tiles -> sizes 3,2,2
     assert got.sort_values("o")["nt"].tolist() == [1, 1, 1, 2, 2, 3, 3]
+
+
+def test_ntile_fewer_rows_than_buckets():
+    df = pd.DataFrame({"g": [1, 1], "o": [0, 1], "v": [0.0, 0.0]})
+    got = _win(df, [(WindowFunc("ntile", offset=4), "nt")])
+    assert got.sort_values("o")["nt"].tolist() == [1, 2]
