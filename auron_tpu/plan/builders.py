@@ -180,21 +180,27 @@ def union(children: list[pb.PhysicalPlanNode]) -> pb.PhysicalPlanNode:
 
 
 def hash_agg(child: pb.PhysicalPlanNode, groupings: list[tuple[ir.Expr, str]],
-             aggs: list[tuple[str, ir.Expr | None, str]], mode: str) -> pb.PhysicalPlanNode:
+             aggs: list[tuple], mode: str) -> pb.PhysicalPlanNode:
+    """aggs: (func, expr, name) or (func, expr, name, udaf_name) tuples."""
     m = {"partial": pb.AGG_PARTIAL, "partial_merge": pb.AGG_PARTIAL_MERGE,
          "final": pb.AGG_FINAL}[mode]
     fmap = {"sum": pb.AGG_SUM, "count": pb.AGG_COUNT, "count_star": pb.AGG_COUNT_STAR,
             "avg": pb.AGG_AVG, "min": pb.AGG_MIN, "max": pb.AGG_MAX,
-            "first": pb.AGG_FIRST, "first_ignores_null": pb.AGG_FIRST_IGNORES_NULL}
+            "first": pb.AGG_FIRST, "first_ignores_null": pb.AGG_FIRST_IGNORES_NULL,
+            "collect_list": pb.AGG_COLLECT_LIST, "collect_set": pb.AGG_COLLECT_SET,
+            "host_udaf": pb.AGG_HOST_UDAF}
     n = pb.HashAggNode(child=child, mode=m)
     for e, name in groupings:
         g = n.groupings.add()
         g.expr.CopyFrom(expr_to_proto(e))
         g.name = name
-    for func, e, name in aggs:
+    for spec in aggs:
+        func, e, name = spec[0], spec[1], spec[2]
         a = n.aggs.add()
         a.func = fmap[func]
         a.name = name
+        if len(spec) > 3 and spec[3]:
+            a.udaf = spec[3]
         if e is not None:
             a.expr.CopyFrom(expr_to_proto(e))
             a.has_expr = True

@@ -216,6 +216,9 @@ _AGG_FUNC = {
     pb.AGG_MAX: "max",
     pb.AGG_FIRST: "first",
     pb.AGG_FIRST_IGNORES_NULL: "first_ignores_null",
+    pb.AGG_COLLECT_LIST: "collect_list",
+    pb.AGG_COLLECT_SET: "collect_set",
+    pb.AGG_HOST_UDAF: "host_udaf",
 }
 
 _AGG_MODE = {
@@ -324,6 +327,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
                     AggExpr(
                         _AGG_FUNC[a.func],
                         expr_from_proto(a.expr) if a.has_expr else None,
+                        udaf=a.udaf or None,
                     ),
                     a.name,
                 )

@@ -207,3 +207,25 @@ def test_context_exprs_in_filter():
     op2 = _roundtrip(plan)
     with pytest.raises(Exception):
         list(op2.execute(0, ctx2))
+
+
+def test_collect_and_udaf_over_wire():
+    from auron_tpu.bridge.udf import register_udaf
+
+    register_udaf(
+        "p90",
+        lambda vs: float(np.percentile([v for v in vs if v is not None], 90)) if vs else None,
+        T.FLOAT64,
+    )
+    b = Batch.from_pydict({"k": [1, 1, 1, 2], "v": [1.0, 9.0, 5.0, 2.0]})
+    scan = B.memory_scan(b.schema, "src")
+    p1 = B.hash_agg(scan, [(col(0), "k")],
+                    [("host_udaf", col(1), "p", "p90"),
+                     ("collect_list", col(1), "cl")], "partial")
+    f1 = B.hash_agg(p1, [(col(0), "k")],
+                    [("host_udaf", col(1), "p", "p90"),
+                     ("collect_list", col(1), "cl")], "final")
+    got = _run(f1, {"src": [[b]]}).sort_values("k").reset_index(drop=True)
+    assert got["p"][0] == pytest.approx(np.percentile([1.0, 9.0, 5.0], 90))
+    assert sorted(got["cl"][0]) == [1.0, 5.0, 9.0]
+    assert list(got["cl"][1]) == [2.0]
