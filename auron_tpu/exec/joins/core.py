@@ -51,7 +51,7 @@ EXISTENCE = "existence"
 
 JOIN_TYPES = (INNER, LEFT, RIGHT, FULL, LEFT_SEMI, LEFT_ANTI, EXISTENCE)
 
-_EXPAND_CHUNK = 1 << 16  # pair slots per emitted chunk
+_EXPAND_CHUNK = 1 << 18  # pair slots per emitted chunk
 
 
 def join_output_schema(

@@ -48,3 +48,18 @@ class MetricNode:
 
     def total(self, metric: str) -> int:
         return self.values.get(metric, 0) + sum(c.total(metric) for c in self.children)
+
+    def render(self, indent: int = 0) -> str:
+        """Human-readable metric tree (the engine-side analog of the
+        reference's Spark-UI metric surfacing, auron-spark-ui)."""
+
+        def fmt(k: str, v: int) -> str:
+            if k.endswith("_time") or k.endswith("_nanos"):
+                return f"{k}={v / 1e6:.1f}ms"
+            return f"{k}={v}"
+
+        vals = " ".join(fmt(k, v) for k, v in sorted(self.values.items()))
+        lines = ["  " * indent + (self.name or "<node>") + (": " + vals if vals else "")]
+        for c in self.children:
+            lines.append(c.render(indent + 1))
+        return "\n".join(lines)

@@ -182,3 +182,15 @@ def test_sort_spill_under_pressure():
         assert ctx.metrics.total("spilled_runs") > 0
     finally:
         MemManager.init()
+
+
+def test_metric_render():
+    b = Batch.from_pydict({"x": [1, 2, 3]})
+    plan = B.filter_(B.memory_scan(b.schema, "src"), [BinaryOp("lt", col(0), lit(3))])
+    rt = TaskRuntime(_task_bytes(plan), resources={"src": [[b]]})
+    while rt.next_batch() is not None:
+        pass
+    rt.finalize()
+    text = rt.ctx.metrics.render()
+    assert "FilterExec" in text and "output_rows=2" in text
+    assert "ResourceScanExec" in text
