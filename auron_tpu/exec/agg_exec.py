@@ -96,12 +96,36 @@ def final_type(a: AggExpr, in_t: T.DataType | None) -> T.DataType:
     return in_t  # min/max/first
 
 
+def is_wide_sum(in_t: T.DataType | None) -> bool:
+    """Wide decimal sums (result precision > 18) would silently wrap int64
+    during accumulation; they accumulate as base-1e6 limbs instead (linear,
+    so per-limb segment sums stay exact; carries only at reconstruction)."""
+    if in_t is None or in_t.kind != T.TypeKind.DECIMAL:
+        return False
+    return sum_type(in_t).precision > 18
+
+
+def _wide_sum_fields(in_t: T.DataType, prefix: str) -> list[T.Field]:
+    st = sum_type(in_t)
+    return [
+        T.Field(f"{prefix}#sum0", st, True),  # limb0 carries the logical type
+        T.Field(f"{prefix}#sum1", T.INT64, True),
+        T.Field(f"{prefix}#sum2", T.INT64, True),
+    ]
+
+
 def intermediate_fields(a: AggExpr, in_t: T.DataType | None, prefix: str) -> list[T.Field]:
     if a.func in ("count", "count_star"):
         return [T.Field(f"{prefix}#count", T.INT64, False)]
     if a.func == "sum":
+        if is_wide_sum(in_t):
+            return _wide_sum_fields(in_t, prefix)
         return [T.Field(f"{prefix}#sum", sum_type(in_t), True)]
     if a.func == "avg":
+        if is_wide_sum(in_t):
+            return _wide_sum_fields(in_t, prefix) + [
+                T.Field(f"{prefix}#count", T.INT64, False)
+            ]
         return [
             T.Field(f"{prefix}#sum", sum_type(in_t), True),
             T.Field(f"{prefix}#count", T.INT64, False),
@@ -153,10 +177,14 @@ class HashAggExec(ExecOperator):
             if mode == PARTIAL:
                 in_t = a.expr.dtype_of(in_schema) if a.expr is not None else None
             else:
-                # recover input type from the intermediate schema
-                n_inter = len(intermediate_fields(a, T.INT64, name))
+                # recover input type from the intermediate schema (the
+                # first field carries the logical type, so the layout
+                # width — e.g. wide-sum limbs — derives from it)
                 first_f = in_schema[ofs]
                 in_t = _input_type_from_intermediate(a, first_f)
+                n_inter = len(
+                    intermediate_fields(a, in_t if in_t is not None else T.INT64, name)
+                )
                 ofs += n_inter
             self._agg_input_types.append(in_t)
             inter_fields += intermediate_fields(a, in_t, name)
@@ -351,12 +379,13 @@ class HashAggExec(ExecOperator):
         """Host dictionaries for each intermediate output column (positions
         must mirror _reduce_arrays' output order)."""
         dicts: list = [k.dict for k in keys]
-        for (a, _), cols in zip(self.aggs, agg_cols):
-            n_out = 2 if a.func in ("avg", "first", "first_ignores_null") else 1
+        for (a, _), in_t, cols in zip(self.aggs, self._agg_input_types, agg_cols):
+            n_out = len(
+                intermediate_fields(a, in_t if in_t is not None else T.INT64, "x")
+            )
             src = cols[0].dict if (cols and a.func in ("min", "max", "first", "first_ignores_null")) else None
             dicts.append(src)
-            if n_out == 2:
-                dicts.append(None)
+            dicts.extend([None] * (n_out - 1))
         return dicts
 
     def _group_reduce_eager(
@@ -476,12 +505,16 @@ class HashAggExec(ExecOperator):
         if a.func in ("count", "count_star"):
             return ColumnVal(cols[0].values, jnp.ones_like(cols[0].validity), T.INT64)
         if a.func == "sum":
+            if is_wide_sum(in_t):
+                return self._final_wide(a, in_t, cols)
             st = sum_type(in_t)
             if st.kind == T.TypeKind.DECIMAL:
                 ok = D.precision_ok(cols[0].values, st.precision)
                 return ColumnVal(cols[0].values, cols[0].validity & ok, st)
             return cols[0]
         if a.func == "avg":
+            if is_wide_sum(in_t):
+                return self._final_wide(a, in_t, cols)
             st = sum_type(in_t)
             at = avg_type(in_t)
             sm, cnt = cols[0], cols[1]
@@ -502,6 +535,54 @@ class HashAggExec(ExecOperator):
         if a.func == "host_udaf":
             return self._final_udaf(a, in_t, cols[0])
         raise ValueError(a.func)
+
+    def _final_wide(self, a: AggExpr, in_t, cols: list[ColumnVal]) -> ColumnVal:
+        """Reconstruct wide decimal sums from base-1e6 limbs (host-side
+        exact integer math; values beyond the decimal64 emit domain become
+        NULL instead of silently wrapping)."""
+        import decimal as pydec
+
+        import jax
+
+        st = sum_type(in_t)
+        l0 = np.asarray(jax.device_get(cols[0].values)).tolist()
+        l1 = np.asarray(jax.device_get(cols[1].values)).tolist()
+        l2 = np.asarray(jax.device_get(cols[2].values)).tolist()
+        valid = np.asarray(jax.device_get(cols[0].validity))
+        n = len(l0)
+        out_vals = np.zeros(n, dtype=np.int64)
+        out_ok = np.zeros(n, dtype=bool)
+        if a.func == "sum":
+            emit_t = st
+            bound = 10 ** min(emit_t.precision, 18)
+            for i in range(n):
+                if not valid[i]:
+                    continue
+                total = l2[i] * (_LIMB * _LIMB) + l1[i] * _LIMB + l0[i]
+                if -bound < total < bound and -(2**63) <= total < 2**63:
+                    out_vals[i] = total
+                    out_ok[i] = True
+        else:  # avg
+            emit_t = avg_type(in_t)
+            cnt = np.asarray(jax.device_get(cols[3].values)).tolist()
+            bound = 10 ** min(emit_t.precision, 18)
+            q = pydec.Decimal(1)
+            for i in range(n):
+                if not valid[i] or cnt[i] == 0:
+                    continue
+                total = l2[i] * (_LIMB * _LIMB) + l1[i] * _LIMB + l0[i]
+                scaled = total * (10 ** (emit_t.scale - st.scale))
+                av = int(
+                    (pydec.Decimal(scaled) / pydec.Decimal(cnt[i])).quantize(
+                        q, rounding=pydec.ROUND_HALF_UP
+                    )
+                )
+                if -bound < av < bound and -(2**63) <= av < 2**63:
+                    out_vals[i] = av
+                    out_ok[i] = True
+        return ColumnVal(
+            jnp.asarray(out_vals), jnp.asarray(out_ok) & cols[0].validity, emit_t
+        )
 
     def _empty_global_agg(self, ctx: ExecutionContext) -> Batch:
         """Global aggregation over empty input: one row (count=0, sum=null)."""
@@ -692,10 +773,21 @@ def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb=Non
             cnt, _ = S.seg_sum(v, m, ids, cap)
         return [ColumnVal(cnt, group_valid, T.INT64)]
     if a.func == "sum":
+        if is_wide_sum(in_t):
+            return _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid)
         v, m = sortg(cols[0])
         sm, any_valid = S.seg_sum(v, m, ids, cap)
         return [ColumnVal(sm, any_valid & group_valid, sum_type(in_t))]
     if a.func == "avg":
+        if is_wide_sum(in_t):
+            limbs = _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid)
+            if raw:
+                _, m0 = sortg(cols[0])
+                cnt = S.seg_count(m0, ids, cap)
+            else:
+                cv, cm = sortg(cols[3])
+                cnt, _ = S.seg_sum(cv, cm, ids, cap)
+            return limbs + [ColumnVal(cnt, group_valid, T.INT64)]
         v, m = sortg(cols[0])
         sm, any_valid = S.seg_sum(v, m, ids, cap)
         if raw:
@@ -736,6 +828,39 @@ def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb=Non
             ColumnVal(seen, group_valid, T.BOOL),
         ]
     raise ValueError(a.func)
+
+
+_LIMB = 1_000_000
+
+
+def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid):
+    """Base-1e6 limb accumulation for wide decimal sums (exact; int64
+    wrap-free for any realistic row count)."""
+    st = sum_type(in_t)
+    if raw:
+        v, m = sortg(cols[0])
+        u = jnp.where(m, v.astype(jnp.int64), jnp.int64(0))
+        l0 = jnp.mod(u, _LIMB)
+        l1 = jnp.mod(jnp.floor_divide(u, _LIMB), _LIMB)
+        l2 = jnp.floor_divide(u, _LIMB * _LIMB)
+        masks = [m, m, m]
+        limb_vals = [l0, l1, l2]
+    else:
+        limb_vals, masks = [], []
+        for i in range(3):
+            v, m = sortg(cols[i])
+            limb_vals.append(jnp.where(m, v.astype(jnp.int64), jnp.int64(0)))
+            masks.append(m)
+    out = []
+    any_valid = None
+    for i, (lv, m) in enumerate(zip(limb_vals, masks)):
+        sm, av = S.seg_sum(lv, m, ids, cap)
+        any_valid = av if any_valid is None else any_valid
+        out.append(
+            ColumnVal(sm, (av if any_valid is None else any_valid) & group_valid,
+                      st if i == 0 else T.INT64)
+        )
+    return out
 
 
 def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, cfg, raw):

@@ -265,3 +265,40 @@ def test_host_udaf_fallback():
     got = _sorted(got, "k")
     assert got["g"][0] == pytest.approx((2 * 8 * 4) ** (1 / 3))
     assert got["g"][1] == pytest.approx(5.0)
+
+
+def test_wide_decimal_sum_no_wrap():
+    """sum(decimal(18,0)) over values near int64 range: plain int64
+    accumulation would silently wrap; limb accumulation stays exact."""
+    import decimal as d
+
+    big = d.Decimal(5 * 10**13)  # 200k rows -> sum 1e19 > int64 max (wraps)
+    n = 200_000
+    data = {"k": [1] * n + [2] * 3,
+            "v": [big] * n + [d.Decimal(5)] * 3}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.decimal(18, 0)))
+    )
+    got = _agg_pipeline([b], [(col(0), "k")],
+                        [(AggExpr("sum", col(1)), "s"), (AggExpr("avg", col(1)), "a")])
+    got = _sorted(got, "k")
+    # group 1: exact sum 1e19 exceeds both int64 and the 18-digit decimal64
+    # emit domain -> NULL (not a silently wrapped wrong number); the avg is
+    # computed from the exact limb sum -> exactly 5e13
+    assert pd.isna(got["s"][0])
+    assert int(got["a"][0]) == 5 * 10**13
+    # group 2 small values flow through exactly
+    assert got["s"][1] == d.Decimal(15)
+    assert int(got["a"][1]) == 5
+
+
+def test_wide_sum_within_domain_is_exact():
+    import decimal as d
+
+    vals = [d.Decimal(10**16 + i) for i in range(50)]  # sum ~5e17, fits
+    data = {"k": [1] * 50, "v": vals}
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.decimal(18, 0)))
+    )
+    got = _agg_pipeline([b], [(col(0), "k")], [(AggExpr("sum", col(1)), "s")])
+    assert got["s"][0] == sum(vals)
