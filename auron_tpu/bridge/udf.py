@@ -59,3 +59,28 @@ def lookup_udaf(name: str) -> tuple[Callable, "object"]:
     if name not in _UDAFS:
         raise KeyError(f"host UDAF '{name}' is not registered with the bridge")
     return _UDAFS[name]
+
+
+# ---------------------------------------------------------------------------
+# UDTFs (table-generating fallback)
+# ---------------------------------------------------------------------------
+
+_UDTFS: dict[str, tuple[Callable, "object"]] = {}
+
+
+def register_udtf(name: str, fn: Callable, out_schema) -> None:
+    """fn(row_value) -> list of output-row tuples (possibly empty).
+
+    The table-function fallback analog of the reference's UDTF wrapper
+    (generate/spark_udtf_wrapper.rs + SparkUDTFWrapperContext.scala):
+    GenerateExec materializes the generator argument, the host callback
+    expands each row, and the generated columns rejoin the device pipeline.
+    out_schema: types.Schema of the generated columns.
+    """
+    _UDTFS[name] = (fn, out_schema)
+
+
+def lookup_udtf(name: str) -> tuple[Callable, "object"]:
+    if name not in _UDTFS:
+        raise KeyError(f"host UDTF '{name}' is not registered with the bridge")
+    return _UDTFS[name]

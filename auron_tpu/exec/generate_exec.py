@@ -35,24 +35,31 @@ class GenerateExec(ExecOperator):
     def __init__(
         self,
         child: ExecOperator,
-        generator: str,  # "explode" | "pos_explode" | "json_tuple"
+        generator: str,  # "explode" | "pos_explode" | "json_tuple" | "host_udtf"
         gen_expr: ir.Expr,
         required_cols: list[int],
         outer: bool = False,
         json_fields: list[str] | None = None,
         elem_name: str = "col",
         pos_name: str = "pos",
+        udtf: str | None = None,  # bridge-registered table function
     ):
-        assert generator in ("explode", "pos_explode", "json_tuple")
+        assert generator in ("explode", "pos_explode", "json_tuple", "host_udtf")
         self.generator = generator
         self.gen_expr = gen_expr
         self.required_cols = required_cols
         self.outer = outer
         self.json_fields = json_fields or []
+        self.udtf = udtf
         fields = [child.schema[i] for i in required_cols]
         gen_dtype = gen_expr.dtype_of(child.schema)
         if generator == "json_tuple":
             fields += [T.Field(f, T.STRING, True) for f in self.json_fields]
+        elif generator == "host_udtf":
+            from auron_tpu.bridge.udf import lookup_udtf
+
+            _, out_schema = lookup_udtf(udtf)
+            fields += list(out_schema.fields)
         else:
             assert gen_dtype.kind == T.TypeKind.LIST, "explode requires a LIST input"
             if generator == "pos_explode":
@@ -69,6 +76,8 @@ class GenerateExec(ExecOperator):
             cv = ev.evaluate(b, [self.gen_expr])[0]
             if self.generator == "json_tuple":
                 yield self._json_tuple(b, cv)
+            elif self.generator == "host_udtf":
+                yield from self._host_udtf(b, cv, ctx)
             else:
                 yield from self._explode(b, cv, ctx)
 
@@ -136,6 +145,47 @@ class GenerateExec(ExecOperator):
             names.append(self.schema[-1].name)
             out = batch_from_columns(cols, names, ok)
             yield Batch(self.schema, out.device, out.dicts)
+
+    def _host_udtf(self, b: Batch, cv: ColumnVal, ctx) -> Iterator[Batch]:
+        """Arbitrary table functions via the bridge callback: the generator
+        argument materializes to host, the callback expands each row, the
+        required columns repeat per generated row (JVM-UDTF wrapper analog)."""
+        import jax
+
+        from auron_tpu.bridge.udf import lookup_udtf
+        from auron_tpu.columnar.batch import _device_to_arrow
+
+        fn, out_schema = lookup_udtf(self.udtf)
+        vals = np.asarray(jax.device_get(cv.values))
+        mask = np.asarray(jax.device_get(cv.validity))
+        sel = np.asarray(jax.device_get(b.device.sel))
+        host_arg = _device_to_arrow(vals, mask, cv.dtype, cv.dict).to_pylist()
+
+        # required columns, materialized once for repetition
+        req = b.to_arrow(compact=False)
+        out_rows: dict[str, list] = {f.name: [] for f in self.schema}
+        req_names = [self.schema[i].name for i in range(len(self.required_cols))]
+        gen_names = [f.name for f in out_schema]
+        n_emitted = 0
+        for i in range(b.capacity):
+            if not sel[i]:
+                continue
+            generated = fn(host_arg[i]) if mask[i] else []
+            if not generated and self.outer:
+                generated = [tuple([None] * len(gen_names))]
+            for tup in generated:
+                for ri, ci in enumerate(self.required_cols):
+                    out_rows[req_names[ri]].append(req.column(ci)[i].as_py())
+                for gi, gname in enumerate(gen_names):
+                    out_rows[gname].append(tup[gi])
+                n_emitted += 1
+        if n_emitted == 0:
+            return
+        rb = pa.RecordBatch.from_arrays(
+            [pa.array(out_rows[f.name], type=f.dtype.to_arrow()) for f in self.schema],
+            schema=self.schema.to_arrow(),
+        )
+        yield Batch.from_arrow(rb)
 
     def _json_tuple(self, b: Batch, cv: ColumnVal) -> Batch:
         import json

@@ -417,6 +417,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             json_fields=list(n.json_fields),
             elem_name=n.elem_name or "col",
             pos_name=n.pos_name or "pos",
+            udtf=n.udtf or None,
         )
     if which == "orc_scan":
         from auron_tpu.exec.scan import OrcScanExec

@@ -92,3 +92,24 @@ def test_host_udf_numeric():
     rb = pa.record_batch({"x": pa.array([1, 2]), "y": pa.array([10, None])})
     p = ProjectExec(_scan(rb), [HostUDF("add2", (col(0), col(1)), T.INT64)], ["z"])
     assert p.collect_pydict() == {"z": [11, None]}
+
+
+def test_host_udtf():
+    from auron_tpu.bridge.udf import register_udtf
+
+    register_udtf(
+        "ngrams",
+        lambda s: [(s[i : i + 2], i) for i in range(len(s) - 1)] if s else [],
+        T.Schema.of(T.Field("gram", T.STRING), T.Field("ofs", T.INT32)),
+    )
+    rb = pa.record_batch({"id": pa.array([1, 2, 3]),
+                          "s": pa.array(["abc", "x", None])})
+    g = GenerateExec(_scan(rb), "host_udtf", col(1), required_cols=[0], udtf="ngrams")
+    out = g.collect_pydict()
+    assert out == {"id": [1, 1], "gram": ["ab", "bc"], "ofs": [0, 1]}
+    # outer mode emits a null row for non-generating inputs
+    g2 = GenerateExec(_scan(rb), "host_udtf", col(1), required_cols=[0],
+                      udtf="ngrams", outer=True)
+    out2 = g2.collect_pydict()
+    assert out2["id"] == [1, 1, 2, 3]
+    assert out2["gram"] == ["ab", "bc", None, None]
