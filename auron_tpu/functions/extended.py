@@ -438,3 +438,106 @@ _host_rowwise(
     lambda dts: T.DataType(T.TypeKind.LIST, inner=(dts[0] if dts else T.INT32,)),
 )
 _host_rowwise("null_if", lambda a, b: None if a == b else a, lambda dts: dts[0])
+
+
+# ---------------------------------------------------------------------------
+# nested (LIST/MAP) value transforms — reference: spark_map.rs,
+# spark_make_array.rs, get_map_value / get_indexed_field exprs
+# ---------------------------------------------------------------------------
+
+
+def _dict_value_transform(name: str, py_fn, out_dtype_fn):
+    """Like _dict_transform but for any dictionary-encoded input (LIST/MAP/
+    STRING): transforms the dictionary entries host-side, result re-enters
+    as a dictionary or a gathered fixed-width column."""
+
+    @registry.register(name, out_dtype_fn)
+    def _f(args, cap, py_fn=py_fn, out_dtype_fn=out_dtype_fn):
+        a = args[0]
+        assert a.dtype.is_dict_encoded, f"{name} needs a dict-encoded arg"
+        extra = [_scalar_arg(x) for x in args[1:]]
+        out_dt = (
+            out_dtype_fn([x.dtype for x in args]) if callable(out_dtype_fn) else out_dtype_fn
+        )
+        entries = a.dict.to_pylist()
+        new = [py_fn(e, *extra) if e is not None else None for e in entries]
+        ok_np = np.array([v is not None for v in new], dtype=bool)
+        idx = jnp.clip(a.values, 0, max(len(new) - 1, 0))
+        valid = a.validity & jnp.asarray(ok_np)[idx]
+        if out_dt.is_dict_encoded:
+            if out_dt.kind in (T.TypeKind.LIST, T.TypeKind.MAP):
+                filler = []
+            else:
+                filler = ""
+            d = pa.array([v if v is not None else filler for v in new],
+                         type=out_dt.to_arrow())
+            return _cv(idx.astype(jnp.int32), valid, out_dt, d)
+        phys = np.dtype(out_dt.physical_dtype().name)
+        vals = np.zeros(len(new), dtype=phys)
+        for i, v in enumerate(new):
+            if v is not None:
+                if out_dt.kind == T.TypeKind.DECIMAL:
+                    import decimal as pd_
+
+                    vals[i] = int(pd_.Decimal(str(v)).scaleb(out_dt.scale))
+                else:
+                    vals[i] = v
+        return _cv(jnp.asarray(vals)[idx], valid, out_dt)
+
+    return _f
+
+
+_dict_value_transform(
+    "map_keys",
+    lambda m: [k for k, _ in m],
+    lambda dts: T.DataType(T.TypeKind.LIST, inner=(dts[0].inner[0],)),
+)
+_dict_value_transform(
+    "map_values",
+    lambda m: [v for _, v in m],
+    lambda dts: T.DataType(T.TypeKind.LIST, inner=(dts[0].inner[1],)),
+)
+_dict_value_transform(
+    "get_map_value",
+    lambda m, key: next((v for k, v in m if k == key), None),
+    lambda dts: dts[0].inner[1],
+)
+
+
+def _element_at(e, idx_or_key):
+    if isinstance(e, list) and e and isinstance(e[0], tuple):
+        return next((v for k, v in e if k == idx_or_key), None)
+    if isinstance(e, list):
+        i = int(idx_or_key)
+        if i == 0 or abs(i) > len(e):
+            return None
+        return e[i - 1] if i > 0 else e[i]
+    return None
+
+
+_dict_value_transform(
+    "element_at",
+    _element_at,
+    lambda dts: dts[0].inner[1] if dts[0].kind == T.TypeKind.MAP else dts[0].inner[0],
+)
+_dict_value_transform(
+    "array_size", lambda e: len(e), T.INT32
+)
+_dict_value_transform(
+    "str_to_map",
+    lambda s, pd_=",", kd=":": [
+        tuple((kv.split(kd, 1) + [None])[:2]) for kv in s.split(pd_)
+    ] if s else [],
+    lambda dts: T.DataType(T.TypeKind.MAP, inner=(T.STRING, T.STRING)),
+)
+
+_host_rowwise(
+    "map_concat",
+    lambda a, b: list({**dict(a or []), **dict(b or [])}.items()),
+    lambda dts: dts[0],
+)
+_host_rowwise(
+    "map_from_arrays",
+    lambda ks, vs: list(zip(ks or [], vs or [])),
+    lambda dts: T.DataType(T.TypeKind.MAP, inner=(dts[0].inner[0], dts[1].inner[0])),
+)

@@ -42,6 +42,7 @@ class TypeKind(enum.Enum):
     STRING = "string"
     BINARY = "binary"
     LIST = "list"  # dict-encoded on device (codes); dictionary holds lists
+    MAP = "map"  # dict-encoded on device (codes); dictionary holds maps
 
 
 _INT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)
@@ -81,7 +82,7 @@ class DataType:
 
     @property
     def is_dict_encoded(self) -> bool:
-        return self.is_string_like or self.kind == TypeKind.LIST
+        return self.is_string_like or self.kind in (TypeKind.LIST, TypeKind.MAP)
 
     # ---- physical mapping ----
     def physical_dtype(self) -> jnp.dtype:
@@ -129,6 +130,8 @@ class DataType:
             return pa.decimal128(self.precision, self.scale)
         if k == TypeKind.LIST:
             return pa.list_(self.inner[0].to_arrow())
+        if k == TypeKind.MAP:
+            return pa.map_(self.inner[0].to_arrow(), self.inner[1].to_arrow())
         return m[k]
 
     @staticmethod
@@ -171,6 +174,11 @@ class DataType:
             return DataType.from_arrow(t.value_type)
         if pa.types.is_list(t) or pa.types.is_large_list(t):
             return DataType(TypeKind.LIST, inner=(DataType.from_arrow(t.value_type),))
+        if pa.types.is_map(t):
+            return DataType(
+                TypeKind.MAP,
+                inner=(DataType.from_arrow(t.key_type), DataType.from_arrow(t.item_type)),
+            )
         raise TypeError(f"unsupported arrow type {t}")
 
     def __repr__(self) -> str:

@@ -147,3 +147,53 @@ def test_date_format():
     out = _run({"d": arr},
                [ScalarFunc("date_format", (col(0), lit("yyyy-MM-dd")))], ["f"])
     assert out["f"] == ["2024-03-05"]
+
+
+def test_map_functions():
+    mt = pa.map_(pa.string(), pa.int64())
+    rb = pa.record_batch({
+        "m": pa.array([[("a", 1), ("b", 2)], [("x", 9)], None], type=mt),
+    })
+    b = Batch.from_arrow(rb)
+    p = ProjectExec(
+        MemoryScanExec.single([b]),
+        [ScalarFunc("map_keys", (col(0),)),
+         ScalarFunc("map_values", (col(0),)),
+         ScalarFunc("get_map_value", (col(0), lit("b"))),
+         ScalarFunc("element_at", (col(0), lit("x")))],
+        ["ks", "vs", "gb", "ex"],
+    )
+    out = p.collect_pydict()
+    assert out["ks"] == [["a", "b"], ["x"], None]
+    assert out["vs"] == [[1, 2], [9], None]
+    assert out["gb"] == [2, None, None]
+    assert out["ex"] == [None, 9, None]
+
+
+def test_str_to_map_and_concat():
+    out = _run({"s": ["a:1,b:2", "k:v"]},
+               [ScalarFunc("str_to_map", (col(0),))], ["m"])
+    assert out["m"] == [[("a", "1"), ("b", "2")], [("k", "v")]]
+    mt = pa.map_(pa.string(), pa.int64())
+    rb = pa.record_batch({
+        "m1": pa.array([[("a", 1)]], type=mt),
+        "m2": pa.array([[("a", 7), ("b", 2)]], type=mt),
+    })
+    b = Batch.from_arrow(rb)
+    p = ProjectExec(MemoryScanExec.single([b]),
+                    [ScalarFunc("map_concat", (col(0), col(1)))], ["mc"])
+    assert p.collect_pydict()["mc"] == [[("a", 7), ("b", 2)]]
+
+
+def test_element_at_list():
+    rb = pa.record_batch({"l": pa.array([[10, 20, 30], [5]], type=pa.list_(pa.int64()))})
+    b = Batch.from_arrow(rb)
+    p = ProjectExec(MemoryScanExec.single([b]),
+                    [ScalarFunc("element_at", (col(0), lit(2))),
+                     ScalarFunc("element_at", (col(0), lit(-1))),
+                     ScalarFunc("array_size", (col(0),))],
+                    ["e2", "em1", "sz"])
+    out = p.collect_pydict()
+    assert out["e2"] == [20, None]
+    assert out["em1"] == [30, 5]
+    assert out["sz"] == [3, 1]
