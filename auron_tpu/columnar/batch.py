@@ -164,6 +164,10 @@ def _empty_dict(dtype: T.DataType) -> pa.Array:
     """One-entry sentinel dictionary (code 0 must always be decodable)."""
     if dtype.kind == T.TypeKind.BINARY:
         return pa.array([b""], type=pa.binary())
+    if dtype.kind == T.TypeKind.STRUCT:
+        return pa.array(
+            [{n: None for n in dtype.struct_names}], type=dtype.to_arrow()
+        )
     if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP):
         return pa.array([[]], type=dtype.to_arrow())
     return pa.array([""], type=pa.string())
@@ -191,7 +195,7 @@ def _arrow_to_device(arr: pa.Array, dtype: T.DataType, cap: int):
     vals_np = np.zeros(cap, dtype=phys)
     d: pa.Array | None = None
 
-    if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP):
+    if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT):
         # nested values ride as identity codes into a per-batch dictionary
         vals_np[:n] = np.arange(n, dtype=np.int32)
         d = arr
@@ -260,7 +264,7 @@ def _device_to_arrow(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType,
         assert d is not None
         codes = np.where(mask, vals, 0).astype(np.int32)
         taken = d.take(pa.array(codes, type=pa.int32()))
-        if k in (T.TypeKind.LIST, T.TypeKind.MAP):
+        if k in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT):
             pl = taken.to_pylist()
             return pa.array(
                 [v if m else None for v, m in zip(pl, mask)], type=dtype.to_arrow()
@@ -390,7 +394,7 @@ def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.nd
                 r[i] = vocab[k] = len(values)
                 values.append(s)
         remaps.append(r)
-    if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP):
+    if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT):
         value_type = dtype.to_arrow()
     elif dtype.kind == T.TypeKind.BINARY:
         value_type = pa.binary()

@@ -541,3 +541,56 @@ _host_rowwise(
     lambda ks, vs: list(zip(ks or [], vs or [])),
     lambda dts: T.DataType(T.TypeKind.MAP, inner=(dts[0].inner[0], dts[1].inner[0])),
 )
+
+
+# ---------------------------------------------------------------------------
+# structs (reference: named_struct / get_indexed_field exprs in ext-exprs)
+# ---------------------------------------------------------------------------
+
+
+@registry.register("named_struct")
+def _named_struct(args, cap):
+    """named_struct(name1, col1, name2, col2, ...) — names are literals."""
+    from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+
+    names = [_scalar_arg(args[i]) for i in range(0, len(args), 2)]
+    val_cvs = [args[i] for i in range(1, len(args), 2)]
+    out_dt = T.DataType(
+        T.TypeKind.STRUCT,
+        inner=tuple(cv.dtype for cv in val_cvs),
+        struct_names=tuple(names),
+    )
+    host_cols = []
+    for cv in val_cvs:
+        v = np.asarray(jax.device_get(cv.values))
+        m = np.asarray(jax.device_get(cv.validity))
+        host_cols.append(_device_to_arrow(v, m, cv.dtype, cv.dict).to_pylist())
+    rows = [dict(zip(names, vals)) for vals in zip(*host_cols)]
+    arr = pa.array(rows, type=out_dt.to_arrow())
+    v, m, d = _arrow_to_device(arr, out_dt, cap)
+    return _cv(v, jnp.ones(cap, bool), out_dt, d)
+
+
+@registry.register("get_struct_field")
+def _get_struct_field_fn(args, cap):
+    a = args[0]
+    name = str(_scalar_arg(args[1]))
+    assert a.dtype.kind == T.TypeKind.STRUCT
+    fi = a.dtype.struct_names.index(name)
+    out_dt = a.dtype.inner[fi]
+    entries = a.dict.to_pylist()
+    new = [(e.get(name) if isinstance(e, dict) else None) for e in entries]
+    ok_np = np.array([v is not None for v in new], dtype=bool)
+    idx = jnp.clip(a.values, 0, max(len(new) - 1, 0))
+    valid = a.validity & jnp.asarray(ok_np)[idx]
+    if out_dt.is_dict_encoded:
+        filler = [] if out_dt.kind in (T.TypeKind.LIST, T.TypeKind.MAP) else ""
+        d = pa.array([v if v is not None else filler for v in new],
+                     type=out_dt.to_arrow())
+        return _cv(idx.astype(jnp.int32), valid, out_dt, d)
+    phys = np.dtype(out_dt.physical_dtype().name)
+    vals = np.zeros(len(new), dtype=phys)
+    for i, v in enumerate(new):
+        if v is not None:
+            vals[i] = v
+    return _cv(jnp.asarray(vals)[idx], valid, out_dt)

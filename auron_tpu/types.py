@@ -43,6 +43,7 @@ class TypeKind(enum.Enum):
     BINARY = "binary"
     LIST = "list"  # dict-encoded on device (codes); dictionary holds lists
     MAP = "map"  # dict-encoded on device (codes); dictionary holds maps
+    STRUCT = "struct"  # dict-encoded; inner = (field DataTypes); names in struct_names
 
 
 _INT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)
@@ -56,7 +57,8 @@ class DataType:
     kind: TypeKind
     precision: int = 0  # DECIMAL only
     scale: int = 0  # DECIMAL only
-    inner: tuple = ()  # LIST: (element DataType,)
+    inner: tuple = ()  # LIST: (element,); MAP: (key, value); STRUCT: field types
+    struct_names: tuple = ()  # STRUCT field names
 
     def __post_init__(self):
         if self.kind == TypeKind.DECIMAL:
@@ -82,7 +84,9 @@ class DataType:
 
     @property
     def is_dict_encoded(self) -> bool:
-        return self.is_string_like or self.kind in (TypeKind.LIST, TypeKind.MAP)
+        return self.is_string_like or self.kind in (
+            TypeKind.LIST, TypeKind.MAP, TypeKind.STRUCT
+        )
 
     # ---- physical mapping ----
     def physical_dtype(self) -> jnp.dtype:
@@ -132,6 +136,10 @@ class DataType:
             return pa.list_(self.inner[0].to_arrow())
         if k == TypeKind.MAP:
             return pa.map_(self.inner[0].to_arrow(), self.inner[1].to_arrow())
+        if k == TypeKind.STRUCT:
+            return pa.struct(
+                [pa.field(n, t.to_arrow()) for n, t in zip(self.struct_names, self.inner)]
+            )
         return m[k]
 
     @staticmethod
@@ -178,6 +186,12 @@ class DataType:
             return DataType(
                 TypeKind.MAP,
                 inner=(DataType.from_arrow(t.key_type), DataType.from_arrow(t.item_type)),
+            )
+        if pa.types.is_struct(t):
+            return DataType(
+                TypeKind.STRUCT,
+                inner=tuple(DataType.from_arrow(t.field(i).type) for i in range(t.num_fields)),
+                struct_names=tuple(t.field(i).name for i in range(t.num_fields)),
             )
         raise TypeError(f"unsupported arrow type {t}")
 

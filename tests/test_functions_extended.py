@@ -197,3 +197,26 @@ def test_element_at_list():
     assert out["e2"] == [20, None]
     assert out["em1"] == [30, 5]
     assert out["sz"] == [3, 1]
+
+
+def test_struct_roundtrip_and_access():
+    st = pa.struct([pa.field("a", pa.int64()), pa.field("s", pa.string())])
+    rb = pa.record_batch({"r": pa.array([{"a": 1, "s": "x"}, {"a": 2, "s": None}, None],
+                                        type=st)})
+    b = Batch.from_arrow(rb)
+    assert b.to_arrow().column("r").to_pylist() == [
+        {"a": 1, "s": "x"}, {"a": 2, "s": None}, None]
+    p = ProjectExec(MemoryScanExec.single([b]),
+                    [ScalarFunc("get_struct_field", (col(0), lit("a"))),
+                     ScalarFunc("get_struct_field", (col(0), lit("s")))],
+                    ["a", "s"])
+    out = p.collect_pydict()
+    assert out["a"] == [1, 2, None]
+    assert out["s"] == ["x", None, None]
+
+
+def test_named_struct():
+    out = _run({"x": [1, 2], "y": ["p", "q"]},
+               [ScalarFunc("named_struct", (lit("n"), col(0), lit("t"), col(1)))],
+               ["st"])
+    assert out["st"] == [{"n": 1, "t": "p"}, {"n": 2, "t": "q"}]
