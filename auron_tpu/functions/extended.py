@@ -504,22 +504,45 @@ _dict_value_transform(
 )
 
 
-def _element_at(e, idx_or_key):
-    if isinstance(e, list) and e and isinstance(e[0], tuple):
-        return next((v for k, v in e if k == idx_or_key), None)
-    if isinstance(e, list):
-        i = int(idx_or_key)
-        if i == 0 or abs(i) > len(e):
-            return None
-        return e[i - 1] if i > 0 else e[i]
-    return None
+def _element_at_list(e, idx):
+    i = int(idx)
+    if i == 0 or abs(i) > len(e):
+        return None
+    return e[i - 1] if i > 0 else e[i]
 
 
-_dict_value_transform(
+@registry.register(
     "element_at",
-    _element_at,
     lambda dts: dts[0].inner[1] if dts[0].kind == T.TypeKind.MAP else dts[0].inner[0],
 )
+def _element_at_fn(args, cap):
+    """element_at(map, key) / element_at(array, 1-based-index) — dispatch on
+    the COLUMN type (an empty map is indistinguishable from an empty list
+    by value)."""
+    a = args[0]
+    key = _scalar_arg(args[1])
+    if a.dtype.kind == T.TypeKind.MAP:
+        fn = lambda e: next((v for k, v in e if k == key), None)
+        out_dt = a.dtype.inner[1]
+    else:
+        fn = lambda e: _element_at_list(e, key)
+        out_dt = a.dtype.inner[0]
+    entries = a.dict.to_pylist()
+    new = [fn(e) if e is not None else None for e in entries]
+    ok_np = np.array([v is not None for v in new], dtype=bool)
+    idx = jnp.clip(a.values, 0, max(len(new) - 1, 0))
+    valid = a.validity & jnp.asarray(ok_np)[idx]
+    if out_dt.is_dict_encoded:
+        filler = [] if out_dt.kind in (T.TypeKind.LIST, T.TypeKind.MAP) else ""
+        d = pa.array([v if v is not None else filler for v in new],
+                     type=out_dt.to_arrow())
+        return _cv(idx.astype(jnp.int32), valid, out_dt, d)
+    phys = np.dtype(out_dt.physical_dtype().name)
+    vals = np.zeros(len(new), dtype=phys)
+    for i, v in enumerate(new):
+        if v is not None:
+            vals[i] = v
+    return _cv(jnp.asarray(vals)[idx], valid, out_dt)
 _dict_value_transform(
     "array_size", lambda e: len(e), T.INT32
 )
