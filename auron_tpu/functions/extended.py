@@ -617,3 +617,38 @@ def _get_struct_field_fn(args, cap):
         if v is not None:
             vals[i] = v
     return _cv(jnp.asarray(vals)[idx], valid, out_dt)
+
+
+# array utilities (dictionary transforms over LIST entries)
+_dict_value_transform(
+    "array_contains",
+    lambda e, item: item in e,
+    T.BOOL,
+)
+_dict_value_transform(
+    "array_join",
+    lambda e, sep: sep.join(str(x) for x in e if x is not None),
+    T.STRING,
+)
+_dict_value_transform(
+    "array_distinct",
+    lambda e: list(dict.fromkeys(e)),
+    lambda dts: dts[0],
+)
+_dict_value_transform(
+    "sort_array",
+    lambda e, asc=True: sorted(
+        (x for x in e if x is not None), reverse=not asc
+    ) + [x for x in e if x is None],
+    lambda dts: dts[0],
+)
+_dict_value_transform(
+    "array_min",
+    lambda e: min((x for x in e if x is not None), default=None),
+    lambda dts: dts[0].inner[0],
+)
+_dict_value_transform(
+    "array_max",
+    lambda e: max((x for x in e if x is not None), default=None),
+    lambda dts: dts[0].inner[0],
+)

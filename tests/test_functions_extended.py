@@ -220,3 +220,26 @@ def test_named_struct():
                [ScalarFunc("named_struct", (lit("n"), col(0), lit("t"), col(1)))],
                ["st"])
     assert out["st"] == [{"n": 1, "t": "p"}, {"n": 2, "t": "q"}]
+
+
+def test_array_utilities():
+    rb = pa.record_batch({"l": pa.array([[3, 1, 3, None], [7], []],
+                                        type=pa.list_(pa.int64()))})
+    b = Batch.from_arrow(rb)
+    p = ProjectExec(
+        MemoryScanExec.single([b]),
+        [ScalarFunc("array_contains", (col(0), lit(3))),
+         ScalarFunc("array_join", (col(0), lit(","))),
+         ScalarFunc("array_distinct", (col(0),)),
+         ScalarFunc("sort_array", (col(0),)),
+         ScalarFunc("array_min", (col(0),)),
+         ScalarFunc("array_max", (col(0),))],
+        ["has3", "j", "d", "s", "mn", "mx"],
+    )
+    out = p.collect_pydict()
+    assert out["has3"] == [True, False, False]
+    assert out["j"] == ["3,1,3", "7", ""]
+    assert out["d"] == [[3, 1, None], [7], []]
+    assert out["s"] == [[1, 3, 3, None], [7], []]
+    assert out["mn"] == [1, 7, None]
+    assert out["mx"] == [3, 7, None]
