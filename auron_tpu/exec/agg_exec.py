@@ -363,8 +363,12 @@ class HashAggExec(ExecOperator):
             key_m = tuple(k.validity for k in keys)
             agg_v = tuple(tuple(c.values for c in cols) for cols in agg_cols)
             agg_m = tuple(tuple(c.validity for c in cols) for cols in agg_cols)
+            agg_aux = tuple(
+                _minmax_rank_aux(a, cols) for (a, _), cols in zip(self.aggs, agg_cols)
+            )
             out_v, out_m, group_valid = _reduce_arrays_jit(
-                sel, key_v, key_m, agg_v, agg_m, cfg=self._reduce_cfg, raw=raw
+                sel, key_v, key_m, agg_v, agg_m, agg_aux,
+                cfg=self._reduce_cfg, raw=raw,
             )
             out_vals = []
             dict_map = self._output_dicts(keys, agg_cols)
@@ -708,7 +712,24 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
 # ---------------------------------------------------------------------------
 
 
-def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None):
+def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
+    """(rank, inv) device arrays for dict-encoded min/max inputs, else None.
+
+    Dict codes are first-occurrence ordered; min/max must reduce in
+    lexicographic rank space. The tables are traced jit arguments since
+    host dictionaries can't enter the fused reduce program."""
+    if a.func not in ("min", "max") or not cols:
+        return None
+    d = cols[0].dict
+    if d is None or len(d) == 0:
+        return None
+    from auron_tpu.ops.sortkeys import dict_rank_maps
+
+    rank, inv = dict_rank_maps(d)
+    return jnp.asarray(rank), jnp.asarray(inv)
+
+
+def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None):
     """Segment + reduce already-evaluated columns.
 
     cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...)) — pure values, so the
@@ -743,14 +764,18 @@ def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None):
         out_vals.append(
             ColumnVal(sorted_vals[slot], sorted_mask[slot] & group_valid, kv.dtype, kv.dict)
         )
-    for (a, in_t), cols in zip(agg_specs, agg_cols):
+    if agg_aux is None:
+        agg_aux = (None,) * len(agg_specs)
+    for (a, in_t), cols, aux in zip(agg_specs, agg_cols, agg_aux):
         out_vals.extend(
-            _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb)
+            _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid,
+                        collect_cb, aux)
         )
     return out_vals, group_valid
 
 
-def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb=None):
+def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid,
+                collect_cb=None, aux=None):
     import jax
 
     ids = seg.seg_ids
@@ -802,6 +827,17 @@ def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid, collect_cb=Non
     if a.func in ("min", "max"):
         v, m = sortg(cols[0])
         fn = S.seg_min if a.func == "min" else S.seg_max
+        if aux is None and cols[0].dict is not None and len(cols[0].dict) > 0:
+            aux = _minmax_rank_aux(a, cols)  # eager path: build from the dict
+        if aux is not None:
+            # codes are in first-occurrence order: reduce in lexicographic
+            # rank space, then invert the winning rank back to a code
+            rank, inv = aux
+            nd = rank.shape[0]
+            vr = rank[jnp.clip(v, 0, nd - 1)]
+            mr, any_valid = fn(vr, m, ids, cap)
+            mv = inv[jnp.clip(mr, 0, nd - 1)].astype(v.dtype)
+            return [ColumnVal(mv, any_valid & group_valid, in_t, cols[0].dict)]
         mv, any_valid = fn(v, m, ids, cap)
         return [ColumnVal(mv, any_valid & group_valid, in_t, cols[0].dict)]
     if a.func in ("collect_list", "collect_set", "host_udaf"):
@@ -863,7 +899,7 @@ def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid):
     return out
 
 
-def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, cfg, raw):
+def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, cfg, raw):
     n_keys, key_dtypes, agg_specs = cfg
     keys = [
         ColumnVal(v, m, dt, None) for (v, m, dt) in zip(key_v, key_m, key_dtypes)
@@ -872,7 +908,9 @@ def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, cfg, raw):
         [ColumnVal(v, m, T.NULL, None) for v, m in zip(vs, ms)]
         for vs, ms in zip(agg_v, agg_m)
     ]
-    out_vals, group_valid = _reduce_columns(sel, keys, agg_cols, raw, cfg)
+    out_vals, group_valid = _reduce_columns(
+        sel, keys, agg_cols, raw, cfg, agg_aux=agg_aux
+    )
     return (
         tuple(cv.values for cv in out_vals),
         tuple(cv.validity for cv in out_vals),

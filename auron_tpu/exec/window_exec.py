@@ -335,8 +335,23 @@ class WindowExec(ExecOperator):
 
         # min/max: segmented scan (running) or segment reduce (whole)
         assert wf.agg in ("min", "max")
-        ident = S._max_identity(cv.values.dtype) if wf.agg == "min" else S._min_identity(cv.values.dtype)
-        masked = jnp.where(valid, cv.values, jnp.asarray(ident, cv.values.dtype))
+        work = cv.values
+        inv_arr = None
+        if cv.dict is not None and len(cv.dict) > 0:
+            # reduce dict codes in lexicographic rank space, invert at exit
+            from auron_tpu.ops.sortkeys import dict_rank_maps
+
+            rank, inv = dict_rank_maps(cv.dict)
+            work = jnp.asarray(rank)[jnp.clip(cv.values, 0, len(rank) - 1)]
+            inv_arr = jnp.asarray(inv)
+
+        def back(x):
+            if inv_arr is None:
+                return x
+            return inv_arr[jnp.clip(x, 0, inv_arr.shape[0] - 1)].astype(cv.values.dtype)
+
+        ident = S._max_identity(work.dtype) if wf.agg == "min" else S._min_identity(work.dtype)
+        masked = jnp.where(valid, work, jnp.asarray(ident, work.dtype))
         if wf.frame_whole:
             fn = jax.ops.segment_min if wf.agg == "min" else jax.ops.segment_max
             red = fn(masked, seg_ids, num_segments=cap + 1)[:cap]
@@ -344,7 +359,7 @@ class WindowExec(ExecOperator):
             anyv = jax.ops.segment_max(valid.astype(jnp.int32), seg_ids, num_segments=cap + 1)[
                 :cap
             ][jnp.clip(seg_ids, 0, cap - 1)].astype(bool)
-            return ColumnVal(v, anyv & sel, cv.dtype, cv.dict)
+            return ColumnVal(back(v), anyv & sel, cv.dtype, cv.dict)
         # segmented running scan with boundary resets
         boundary = seg_start[jnp.clip(seg_ids, 0, cap - 1)] == iota
 
@@ -361,4 +376,4 @@ class WindowExec(ExecOperator):
         anyv = (anyv_run > 0) if wf.agg == "max" else (anyv_run < 0)
         # ties (peers) must share the frame end value: take value at peer end
         pe = jnp.clip(peer_end - 1, 0, cap - 1)
-        return ColumnVal(scanned[pe], anyv[pe] & sel, cv.dtype, cv.dict)
+        return ColumnVal(back(scanned[pe]), anyv[pe] & sel, cv.dtype, cv.dict)

@@ -257,31 +257,39 @@ def _next_day(args, cap):
 
 
 def _minmax_skip_nulls(args, cap, is_least):
-    op = jnp.minimum if is_least else jnp.maximum
-    out_v = None
-    out_m = None
-    for cv in args:
-        v = cv.values
-        m = cv.validity
-        if out_v is None:
-            out_v, out_m = v, m
-            continue
-        take_new = m & (~out_m | (op(v, out_v) == v))
-        out_v = jnp.where(take_new, v, out_v)
-        out_m = out_m | m
-    return out_v, out_m
+    """Spark least/greatest: nulls skipped; comparison uses the SQL total
+    order (NaN greater than any non-NaN; strings by byte order, so dict
+    codes go through the unified lexicographic rank, not raw code order)."""
+    from auron_tpu.exprs.eval import _unify_vals
+    from auron_tpu.ops.sortkeys import dict_rank_maps, orderable_word
+
+    args = _unify_vals(args)  # common dtype; strings share one dictionary
+    if args[0].dtype.is_dict_encoded:
+        rank, _ = dict_rank_maps(args[0].dict)
+        r = jnp.asarray(rank)
+        keys = [r[jnp.clip(a.values, 0, r.shape[0] - 1)] for a in args]
+    else:
+        keys = [orderable_word(a) for a in args]
+    out_v, out_k, out_m = args[0].values, keys[0], args[0].validity
+    for cv, k in zip(args[1:], keys[1:]):
+        better = (k < out_k) if is_least else (k > out_k)
+        take_new = cv.validity & (~out_m | better)
+        out_v = jnp.where(take_new, cv.values, out_v)
+        out_k = jnp.where(take_new, k, out_k)
+        out_m = out_m | cv.validity
+    return out_v, out_m, args[0]
 
 
 @registry.register("least", lambda dts: dts[0])
 def _least(args, cap):
-    v, m = _minmax_skip_nulls(args, cap, True)
-    return _cv(v, m, args[0].dtype)
+    v, m, proto = _minmax_skip_nulls(args, cap, True)
+    return _cv(v, m, proto.dtype, proto.dict)
 
 
 @registry.register("greatest", lambda dts: dts[0])
 def _greatest(args, cap):
-    v, m = _minmax_skip_nulls(args, cap, False)
-    return _cv(v, m, args[0].dtype)
+    v, m, proto = _minmax_skip_nulls(args, cap, False)
+    return _cv(v, m, proto.dtype, proto.dict)
 
 
 def _java_fmt_to_strftime(fmt: str) -> str:
@@ -426,7 +434,9 @@ _host_rowwise(
 )
 _host_rowwise(
     "concat_ws",
-    lambda sep, *parts: (sep or "").join(p for p in parts if p is not None),
+    lambda sep, *parts: (
+        None if sep is None else sep.join(p for p in parts if p is not None)
+    ),
     T.STRING,
 )
 _host_rowwise(
@@ -637,9 +647,13 @@ _dict_value_transform(
 )
 _dict_value_transform(
     "sort_array",
-    lambda e, asc=True: sorted(
-        (x for x in e if x is not None), reverse=not asc
-    ) + [x for x in e if x is None],
+    # Spark null placement: nulls first ascending, last descending
+    lambda e, asc=True: (
+        [x for x in e if x is None] + sorted(x for x in e if x is not None)
+        if asc
+        else sorted((x for x in e if x is not None), reverse=True)
+        + [x for x in e if x is None]
+    ),
     lambda dts: dts[0],
 )
 _dict_value_transform(

@@ -73,6 +73,29 @@ def _dict_rank(d) -> np.ndarray:
     return rank
 
 
+def dict_rank_maps(d) -> tuple[np.ndarray, np.ndarray]:
+    """(rank, inv) for a dictionary: ``rank[code]`` is the code's
+    lexicographic (UTF-8 byte order) rank, ``inv[rank]`` recovers the code.
+
+    min/max reductions over dictionary codes must run in rank space — codes
+    are in first-occurrence order, which has no relation to SQL string order.
+
+    Both arrays are zero-padded to a power-of-two capacity bucket so jitted
+    consumers see a stable shape signature across batches with different
+    dictionary cardinalities (real codes/ranks never index the padding).
+    """
+    rank = _dict_rank(d).astype(np.int64)
+    n = len(rank)
+    inv = np.empty_like(rank)
+    inv[rank] = np.arange(n, dtype=np.int64)
+    cap = max(8, 1 << (n - 1).bit_length()) if n else 8
+    if cap > n:
+        pad = np.zeros(cap - n, dtype=np.int64)
+        rank = np.concatenate([rank, pad])
+        inv = np.concatenate([inv, pad])
+    return rank, inv
+
+
 def sort_operands(
     keys: list[ColumnVal], specs: list[SortSpec]
 ) -> list[jnp.ndarray]:

@@ -302,3 +302,23 @@ def test_wide_sum_within_domain_is_exact():
     )
     got = _agg_pipeline([b], [(col(0), "k")], [(AggExpr("sum", col(1)), "s")])
     assert got["s"][0] == sum(vals)
+
+
+def test_min_max_over_strings_lexicographic():
+    # ADVICE r1 (high): dict codes are first-occurrence ordered; min/max
+    # must reduce in lexicographic rank space
+    data = {
+        "k": [1, 1, 1, 2, 2],
+        "s": ["zebra", "apple", "mango", "pear", None],
+    }
+    b = Batch.from_pydict(
+        data, schema=T.Schema.of(T.Field("k", T.INT64), T.Field("s", T.STRING))
+    )
+    got = _agg_pipeline(
+        [b],
+        [(col(0), "k")],
+        [(AggExpr("min", col(1)), "mn"), (AggExpr("max", col(1)), "mx")],
+    )
+    got = _sorted(got, ["k"])
+    assert list(got["mn"]) == ["apple", "pear"]
+    assert list(got["mx"]) == ["zebra", "pear"]

@@ -240,6 +240,52 @@ def test_array_utilities():
     assert out["has3"] == [True, False, False]
     assert out["j"] == ["3,1,3", "7", ""]
     assert out["d"] == [[3, 1, None], [7], []]
-    assert out["s"] == [[1, 3, 3, None], [7], []]
+    assert out["s"] == [[None, 1, 3, 3], [7], []]  # Spark: nulls first asc
     assert out["mn"] == [1, 7, None]
     assert out["mx"] == [3, 7, None]
+
+
+def test_least_greatest_strings_lexicographic():
+    out = _run(
+        {"a": ["zebra", "mango", None], "b": ["apple", "pear", "kiwi"]},
+        [ScalarFunc("least", (col(0), col(1))),
+         ScalarFunc("greatest", (col(0), col(1)))],
+        ["l", "g"],
+    )
+    assert out["l"] == ["apple", "mango", "kiwi"]
+    assert out["g"] == ["zebra", "pear", "kiwi"]
+
+
+def test_least_greatest_nan_ordering():
+    # Spark: NaN is greater than any non-NaN value
+    out = _run(
+        {"a": [1.0, float("nan"), float("nan")], "b": [float("nan"), 2.0, None]},
+        [ScalarFunc("least", (col(0), col(1))),
+         ScalarFunc("greatest", (col(0), col(1)))],
+        ["l", "g"],
+    )
+    assert out["l"][0] == 1.0 and out["l"][1] == 2.0
+    assert np.isnan(out["g"][0]) and np.isnan(out["g"][1])
+    assert np.isnan(out["l"][2]) and np.isnan(out["g"][2])
+
+
+def test_concat_ws_null_separator():
+    out = _run(
+        {"sep": [",", None], "x": ["a", "a"], "y": ["b", "b"]},
+        [ScalarFunc("concat_ws", (col(0), col(1), col(2)))],
+        ["r"],
+    )
+    assert out["r"] == ["a,b", None]
+
+
+def test_sort_array_null_placement():
+    arrs = pa.array([[3, None, 1, 2]], type=pa.list_(pa.int64()))
+    lt = T.DataType(T.TypeKind.LIST, inner=(T.INT64,))
+    out = _run({"a": arrs},
+               [ScalarFunc("sort_array", (col(0),))], ["asc"],
+               schema=T.Schema.of(T.Field("a", lt)))
+    assert out["asc"] == [[None, 1, 2, 3]]
+    out = _run({"a": arrs},
+               [ScalarFunc("sort_array", (col(0), lit(False)))], ["dsc"],
+               schema=T.Schema.of(T.Field("a", lt)))
+    assert out["dsc"] == [[3, 2, 1, None]]

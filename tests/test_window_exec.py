@@ -178,3 +178,29 @@ def test_ntile_fewer_rows_than_buckets():
     df = pd.DataFrame({"g": [1, 1], "o": [0, 1], "v": [0.0, 0.0]})
     got = _win(df, [(WindowFunc("ntile", offset=4), "nt")])
     assert got.sort_values("o")["nt"].tolist() == [1, 2]
+
+
+def test_window_min_max_strings_lexicographic():
+    # ADVICE r1 (high): dict-code min/max must use lexicographic rank
+    df = pd.DataFrame(
+        {
+            "g": [1, 1, 1, 2, 2],
+            "o": [0, 1, 2, 0, 1],
+            "s": ["zebra", "apple", "mango", "pear", "fig"],
+        }
+    )
+    got = _win(
+        df,
+        [
+            (WindowFunc("agg", agg="min", expr=col(2), frame_whole=True), "mn"),
+            (WindowFunc("agg", agg="max", expr=col(2), frame_whole=True), "mx"),
+            (WindowFunc("agg", agg="min", expr=col(2)), "rmn"),
+            (WindowFunc("agg", agg="max", expr=col(2)), "rmx"),
+        ],
+    )
+    got = got.sort_values(["g", "o"]).reset_index(drop=True)
+    assert list(got["mn"]) == ["apple"] * 3 + ["fig"] * 2
+    assert list(got["mx"]) == ["zebra"] * 3 + ["pear"] * 2
+    # running frame: prefix min/max in order o
+    assert list(got["rmn"]) == ["zebra", "apple", "apple", "pear", "fig"]
+    assert list(got["rmx"]) == ["zebra", "zebra", "zebra", "pear", "pear"]
