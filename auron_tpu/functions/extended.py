@@ -261,15 +261,10 @@ def _minmax_skip_nulls(args, cap, is_least):
     order (NaN greater than any non-NaN; strings by byte order, so dict
     codes go through the unified lexicographic rank, not raw code order)."""
     from auron_tpu.exprs.eval import _unify_vals
-    from auron_tpu.ops.sortkeys import dict_rank_maps, orderable_word
+    from auron_tpu.ops.sortkeys import orderable_word
 
     args = _unify_vals(args)  # common dtype; strings share one dictionary
-    if args[0].dtype.is_dict_encoded:
-        rank, _ = dict_rank_maps(args[0].dict)
-        r = jnp.asarray(rank)
-        keys = [r[jnp.clip(a.values, 0, r.shape[0] - 1)] for a in args]
-    else:
-        keys = [orderable_word(a) for a in args]
+    keys = [orderable_word(a) for a in args]  # handles dict rank + NaN order
     out_v, out_k, out_m = args[0].values, keys[0], args[0].validity
     for cv, k in zip(args[1:], keys[1:]):
         better = (k < out_k) if is_least else (k > out_k)

@@ -60,7 +60,18 @@ def orderable_word(cv: ColumnVal) -> jnp.ndarray:
     raise TypeError(f"unsortable type {dt}")
 
 
+# Bounded memo of per-dictionary rank tables: consecutive batches usually
+# share the identical dictionary object, and the Python sort is O(d log d)
+# host work on the per-batch hot path. Keyed by id() with the dictionary
+# kept referenced so ids can't be recycled; FIFO-evicted at _RANK_CACHE_MAX.
+_RANK_CACHE: dict[int, tuple] = {}
+_RANK_CACHE_MAX = 64
+
+
 def _dict_rank(d) -> np.ndarray:
+    hit = _RANK_CACHE.get(id(d))
+    if hit is not None and hit[0] is d:
+        return hit[1]
     entries = d.to_pylist()
     keyed = [
         (e.encode("utf-8") if isinstance(e, str) else (e if e is not None else b""))
@@ -70,6 +81,9 @@ def _dict_rank(d) -> np.ndarray:
     rank = np.empty(len(keyed), dtype=np.uint64)
     for r, i in enumerate(order):
         rank[i] = r
+    if len(_RANK_CACHE) >= _RANK_CACHE_MAX:
+        _RANK_CACHE.pop(next(iter(_RANK_CACHE)))
+    _RANK_CACHE[id(d)] = (d, rank)
     return rank
 
 

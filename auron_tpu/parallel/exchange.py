@@ -157,6 +157,42 @@ def batch_exchange_step(mesh: Mesh, slot_cap: int, n_hash_cols: int = 1):
     return jax.jit(fn)
 
 
+def pid_exchange_step(mesh: Mesh, slot_cap: int):
+    """Mesh repartitioner routed by PRECOMPUTED partition ids.
+
+    The planned-query driver computes pids host-side with the same
+    ``Partitioning`` code the file shuffle writer uses (spark-exact murmur3
+    incl. dictionary-string hashing, range bounds, round-robin cursors), so
+    a mesh exchange and a file shuffle route rows bit-identically — this
+    step only moves them. Inputs (sharded over p): ``arrays`` pytree of
+    [P, cap] row arrays, ``sel`` [P, cap] liveness, ``pids`` [P, cap] int32
+    destinations. Returns (arrays [P, P*slot_cap], sel, overflow)."""
+    n_parts = mesh.shape[PARTITION_AXIS]
+
+    def step(arrays, sel, pids):
+        arrays = jax.tree.map(lambda a: a[0], arrays)
+        sel, pids = sel[0], pids[0]
+        flat, treedef = jax.tree.flatten(arrays)
+        recv, rsel, overflow = all_to_all_rows(
+            tuple(flat), sel, pids, n_parts, slot_cap
+        )
+        out = jax.tree.unflatten(treedef, list(recv))
+        return (
+            jax.tree.map(lambda a: a[None], out),
+            rsel[None],
+            overflow,
+        )
+
+    spec = P(PARTITION_AXIS)
+    fn = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
 def sharded_agg_exchange_step(mesh: Mesh, slot_cap: int):
     """Build the jitted SPMD program: partial agg -> ICI all_to_all by key
     hash -> final agg. This is the engine's flagship distributed step — the

@@ -268,6 +268,14 @@ def shuffle_writer(child, partitioning: pb.Partitioning,
         output_data_file=data_file, output_index_file=index_file))
 
 
+def mesh_exchange(child, partitioning: pb.Partitioning,
+                  exchange_id: str = "") -> pb.PhysicalPlanNode:
+    """Device-resident repartition boundary (ICI all_to_all or file
+    fallback, decided by the mesh driver per exchange.mode/statistics)."""
+    return _wrap(mesh_exchange=pb.MeshExchangeNode(
+        child=child, partitioning=partitioning, exchange_id=exchange_id))
+
+
 def ipc_reader(schema: T.Schema, resource_id: str) -> pb.PhysicalPlanNode:
     return _wrap(ipc_reader=pb.IpcReaderNode(
         schema=schema_to_proto(schema), resource_id=resource_id))

@@ -450,6 +450,12 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
         return IpcWriterExec(plan_from_proto(p.ipc_writer.child), p.ipc_writer.resource_id)
     if which == "debug":
         return basic.DebugExec(plan_from_proto(p.debug.child), p.debug.tag)
+    if which == "mesh_exchange":
+        raise ValueError(
+            "mesh_exchange is a stage boundary resolved by "
+            "parallel.mesh_driver.MeshQueryDriver, not a streaming operator; "
+            "run the plan through the driver"
+        )
     raise ValueError(f"unknown plan variant {which}")
 
 

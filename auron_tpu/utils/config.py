@@ -180,6 +180,17 @@ AGG_SPILL_BUCKETS = int_conf(
 SHUFFLE_COMPRESSION_TARGET_BUF_SIZE = int_conf(
     "shuffle.compression.target.buf.size", 4 << 20, "shuffle", ""
 )
+EXCHANGE_MODE = str_conf(
+    "exchange.mode", "auto", "shuffle",
+    "transport for planned mesh_exchange nodes: mesh (ICI all_to_all) | "
+    "file (durable compacted shuffle files) | auto (mesh when the payload "
+    "fits exchange.mesh.max.bytes per shard)",
+)
+EXCHANGE_MESH_MAX_BYTES = int_conf(
+    "exchange.mesh.max.bytes", 2 << 30, "shuffle",
+    "auto-mode ceiling for device-resident exchange payload per shard; "
+    "larger exchanges take the durable file path",
+)
 IGNORE_CORRUPTED_FILES = bool_conf(
     "files.ignore.corrupted", False, "scan", "tolerate unreadable input files (conf.rs:37)"
 )

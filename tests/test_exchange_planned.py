@@ -1,0 +1,162 @@
+"""Planned mesh exchange: proto-built two-stage queries over an 8-device mesh.
+
+VERDICT r1 item 2: the ICI exchange must be reachable from the plan IR.
+These tests build q3-class plans (partial agg -> mesh_exchange -> final agg)
+through the protobuf builders, run them with MeshQueryDriver, and check
+
+- mesh and file transports produce identical results (bit-for-bit on
+  integer sums/counts — routing and grouping are spark-exact in both);
+- results match a pandas oracle;
+- the auto transport rule switches on the statistics/conf;
+- dict-encoded (string) keys route correctly (murmur3 over bytes, not codes);
+- full skew (every row to one reducer) sizes slots without overflow.
+
+Reference analog: NativeShuffleExchangeBase.scala:187-296 + shuffle/mod.rs.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exprs.ir import col
+from auron_tpu.parallel.mesh import make_mesh
+from auron_tpu.parallel.mesh_driver import MeshQueryDriver
+from auron_tpu.plan import builders as B
+from auron_tpu.utils.config import (
+    EXCHANGE_MESH_MAX_BYTES,
+    EXCHANGE_MODE,
+    Configuration,
+)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N_DEV)
+
+
+def _fact(n=4000, seed=0, str_keys=False, skew=False):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame(
+        {
+            "k": np.zeros(n, np.int64) if skew else rng.integers(0, 97, n),
+            "g2": rng.integers(0, 7, n).astype(np.int64),
+            "v": rng.integers(-1000, 1000, n).astype(np.int64),
+        }
+    )
+    if str_keys:
+        df["k"] = df["k"].map(lambda x: f"key_{x}")
+    return df
+
+
+def _partitioned(df: pd.DataFrame, n_parts: int) -> list[list[Batch]]:
+    per = (len(df) + n_parts - 1) // n_parts
+    return [
+        [
+            Batch.from_arrow(
+                pa.RecordBatch.from_pandas(
+                    df.iloc[p * per : (p + 1) * per], preserve_index=False
+                )
+            )
+        ]
+        for p in range(n_parts)
+    ]
+
+
+def _two_stage_plan(schema: T.Schema, res_id: str):
+    """SELECT k, g2, sum(v) s FROM fact GROUP BY k, g2 with a planned
+    exchange between partial and final aggregation."""
+    scan = B.memory_scan(schema, res_id)
+    partial = B.hash_agg(
+        scan, [(col(0), "k"), (col(1), "g2")], [("sum", col(2), "s")], "partial"
+    )
+    ex = B.mesh_exchange(
+        partial, B.hash_partitioning([col(0), col(1)], N_DEV), "ex0"
+    )
+    return B.hash_agg(
+        ex, [(col(0), "k"), (col(1), "g2")], [("sum", col(2), "s")], "final"
+    )
+
+
+def _oracle(df: pd.DataFrame) -> pd.DataFrame:
+    return (
+        df.groupby(["k", "g2"]).agg(s=("v", "sum")).reset_index()
+        .sort_values(["k", "g2"]).reset_index(drop=True)
+    )
+
+
+def _run(mesh, df, mode: str, **conf_extra):
+    schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(df.iloc[:1], preserve_index=False).schema
+    )
+    conf = Configuration().set(EXCHANGE_MODE, mode)
+    for k, v in conf_extra.items():
+        conf.set(k, v)
+    driver = MeshQueryDriver(mesh, conf=conf)
+    resources = {"fact": _partitioned(df, N_DEV)}
+    out = driver.collect(_two_stage_plan(schema, "fact"), resources)
+    out = out.sort_values(["k", "g2"]).reset_index(drop=True)
+    return out, driver
+
+
+def test_mesh_matches_file_bit_for_bit(mesh):
+    df = _fact()
+    got_mesh, d_mesh = _run(mesh, df, "mesh")
+    got_file, d_file = _run(mesh, df, "file")
+    assert d_mesh.stats[0].mode == "mesh"
+    assert d_file.stats[0].mode == "file"
+    pd.testing.assert_frame_equal(got_mesh, got_file)  # int sums: exact
+    pd.testing.assert_frame_equal(
+        got_mesh.astype({"k": np.int64, "g2": np.int64, "s": np.int64}),
+        _oracle(df).astype({"k": np.int64, "g2": np.int64, "s": np.int64}),
+    )
+    # routing statistics recorded for AQE
+    assert d_mesh.stats[0].rows.sum() > 0
+    assert d_mesh.stats[0].rows.shape == (N_DEV, N_DEV)
+
+
+def test_string_keys_route_by_bytes(mesh):
+    df = _fact(n=2000, seed=3, str_keys=True)
+    got_mesh, _ = _run(mesh, df, "mesh")
+    got_file, _ = _run(mesh, df, "file")
+    pd.testing.assert_frame_equal(got_mesh, got_file)
+    want = _oracle(df)
+    assert got_mesh["k"].tolist() == want["k"].tolist()
+    assert got_mesh["s"].astype(np.int64).tolist() == want["s"].astype(np.int64).tolist()
+
+
+def test_skewed_exchange_no_overflow(mesh):
+    # single-key grouping with one hot key: every partial-agg row lands on
+    # the same reducer, exercising the slot-capacity sizing under full skew.
+    # NO partial aggregation benefit here — partial yields 1 group per shard,
+    # so the exchange itself is tiny; route the RAW rows instead to stress it.
+    df = _fact(n=3000, seed=5, skew=True)
+    schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(df.iloc[:1], preserve_index=False).schema
+    )
+    scan = B.memory_scan(schema, "fact")
+    ex = B.mesh_exchange(scan, B.hash_partitioning([col(0)], N_DEV), "ex_skew")
+    final = B.hash_agg(
+        ex, [(col(0), "k")], [("sum", col(2), "s"), ("count_star", None, "c")],
+        "partial",
+    )
+    driver = MeshQueryDriver(mesh, conf=Configuration().set(EXCHANGE_MODE, "mesh"))
+    resources = {"fact": _partitioned(df, N_DEV)}
+    out = driver.collect(final, resources)
+    assert int(out["c#count"].sum()) == len(df)
+    assert int(out["s#sum"].sum()) == int(df["v"].sum())
+    # all raw rows routed to a single reducer
+    sizes = driver.stats[0].partition_sizes()
+    assert (sizes > 0).sum() == 1 and sizes.sum() == len(df)
+
+
+def test_auto_mode_statistics_rule(mesh):
+    df = _fact(n=1000, seed=7)
+    _, d_small = _run(mesh, df, "auto")
+    assert d_small.stats[0].mode == "mesh"  # tiny payload rides ICI
+    _, d_forced = _run(mesh, df, "auto", **{EXCHANGE_MESH_MAX_BYTES.key: 1})
+    assert d_forced.stats[0].mode == "file"  # over budget -> durable path
