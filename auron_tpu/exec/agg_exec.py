@@ -28,6 +28,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Iterator
 
+import threading
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -232,12 +235,17 @@ class HashAggExec(ExecOperator):
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
-                n = b.num_rows()
-                if n == 0:
-                    continue
                 with ctx.metrics.timer("elapsed_compute"):
                     inter = self._to_intermediate(b, ctx)
-                g = inter.num_rows()
+                # one combined transfer for both counters
+                n, g = (
+                    int(x)
+                    for x in jax.device_get(
+                        (b.device.num_rows(), inter.device.num_rows())
+                    )
+                )
+                if n == 0:
+                    continue
                 seen_rows += n
                 seen_groups += g
                 if skipping:
@@ -631,61 +639,71 @@ class _AggTableConsumer:
         self.staged: list[Batch] = []
         self.staged_rows = 0
         self.parked: list = []  # DiskSpill objects
+        # tasks run concurrently; MemManager.acquire may spill this consumer
+        # from ANOTHER task's thread. Lock order is manager -> consumer (the
+        # owner never holds this lock while calling acquire), so no deadlock.
+        self._lock = threading.RLock()
 
     def add(self, inter: Batch, groups: int) -> None:
-        self.staged.append(inter)
-        self.staged_rows += groups
+        with self._lock:
+            self.staged.append(inter)
+            self.staged_rows += groups
 
     def compact(self) -> None:
-        self.state = self.exec._merge(
-            [self.state] if self.state is not None else [], self.staged
-        )
-        self.staged, self.staged_rows = [], 0
+        with self._lock:
+            self.state = self.exec._merge(
+                [self.state] if self.state is not None else [], self.staged
+            )
+            self.staged, self.staged_rows = [], 0
 
     def mem_used(self) -> int:
         from auron_tpu.exec.sort_exec import batch_nbytes
 
-        total = sum(batch_nbytes(b) for b in self.staged)
-        if self.state is not None:
-            total += batch_nbytes(self.state)
-        return total
+        with self._lock:
+            total = sum(batch_nbytes(b) for b in self.staged)
+            if self.state is not None:
+                total += batch_nbytes(self.state)
+            return total
 
     def spill(self) -> int:
         """Park the merged state as a compressed disk run."""
         from auron_tpu.memory.memmgr import DiskSpill
 
-        freed = self.mem_used()
-        if freed == 0:
-            return 0
-        with self.ctx.metrics.timer("spill_time"):
-            self.compact()
-            if self.state is not None:
-                ds = DiskSpill()
-                ds.write_table(self.state.to_arrow())
-                self.parked.append(ds)
-        self.ctx.metrics.add("spilled_aggs", 1)
-        self.state = None
-        return freed
+        with self._lock:
+            freed = self.mem_used()
+            if freed == 0:
+                return 0
+            with self.ctx.metrics.timer("spill_time"):
+                self.compact()
+                if self.state is not None:
+                    ds = DiskSpill()
+                    ds.write_table(self.state.to_arrow())
+                    self.parked.append(ds)
+            self.ctx.metrics.add("spilled_aggs", 1)
+            self.state = None
+            return freed
 
     def drain(self):
         """Yield current contents without merging (partial-skip path)."""
-        for s in self.staged:
-            yield s
-        if self.state is not None:
-            yield self.state
-        self.staged, self.staged_rows, self.state = [], 0, None
+        with self._lock:
+            staged, state = self.staged, self.state
+            self.staged, self.staged_rows, self.state = [], 0, None
+        yield from staged
+        if state is not None:
+            yield state
 
     def collect_state(self) -> Batch | None:
         """Merge staged + state + parked disk runs into the final state."""
-        parts: list[Batch] = list(self.staged)
-        if self.state is not None:
-            parts.append(self.state)
-        for ds in self.parked:
+        with self._lock:
+            parts: list[Batch] = list(self.staged)
+            if self.state is not None:
+                parts.append(self.state)
+            parked, self.parked = self.parked, []
+            self.staged, self.staged_rows, self.state = [], 0, None
+        for ds in parked:
             for rb in ds.read_tables():
                 parts.append(Batch.from_arrow(rb))
             ds.release()
-        self.parked = []
-        self.staged, self.staged_rows, self.state = [], 0, None
         if not parts:
             return None
         return self.exec._merge([], parts)

@@ -25,7 +25,7 @@ from typing import Iterable, Iterator
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch, bucket_capacity, concat_batches
 from auron_tpu.exec.metrics import MetricNode
-from auron_tpu.utils.config import BATCH_SIZE, Configuration, active_conf
+from auron_tpu.utils.config import BATCH_SIZE, METRICS_ROW_COUNTS, Configuration, active_conf
 
 
 class TaskCancelled(Exception):
@@ -82,17 +82,29 @@ class ExecOperator:
         return type(self).__name__
 
     def execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
-        """Stream output batches, maintaining per-operator metrics."""
+        """Stream output batches, maintaining per-operator metrics.
+
+        Row metrics are conf-gated: a device row count costs a reduction
+        kernel + (deferred) sync per operator boundary, unlike the
+        reference's free Arrow-metadata counters. When enabled they
+        accumulate as a device scalar and sync ONCE at stream end."""
         _ctx_local.ctx = ctx
         node = ctx.metrics
-        rows = 0
-        for batch in self._execute(partition, ctx):
-            ctx.check_cancelled()
-            n = batch.num_rows()
-            rows += n
-            node.add("output_rows", n)
-            node.add("output_batches", 1)
-            yield batch
+        count_rows = ctx.conf.get(METRICS_ROW_COUNTS)
+        rows_dev = None
+        try:
+            for batch in self._execute(partition, ctx):
+                ctx.check_cancelled()
+                if count_rows:
+                    r = batch.device.num_rows()
+                    rows_dev = r if rows_dev is None else rows_dev + r
+                node.add("output_batches", 1)
+                yield batch
+        finally:
+            if rows_dev is not None:
+                import jax
+
+                node.add("output_rows", int(jax.device_get(rows_dev)))
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         raise NotImplementedError

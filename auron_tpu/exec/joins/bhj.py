@@ -31,11 +31,12 @@ class BroadcastHashJoinExec(ExecOperator):
         condition: ir.Expr | None = None,
         cached_build_id: str | None = None,
         exists_col: str = "exists",
+        projection: list[int] | None = None,
     ):
         self.driver = EquiJoinDriver(
             left.schema, right.schema, left_keys, right_keys,
             join_type, build_side=build_side, condition=condition,
-            exists_col=exists_col,
+            exists_col=exists_col, projection=projection,
         )
         self.build_side = build_side
         self.cached_build_id = cached_build_id
@@ -65,8 +66,8 @@ class BroadcastHashJoinExec(ExecOperator):
         probe_child = 1 if self.build_side == "left" else 0
         for pb in self.child_stream(probe_child, partition, ctx):
             ctx.check_cancelled()
-            if pb.num_rows() == 0:
-                continue
+            # no empty-batch pre-check: it costs a host sync per batch, and
+            # the probe itself already syncs once on the match total
             with ctx.metrics.timer("probe_time"):
                 yield from self.driver.probe_batch(build, pb)
         yield from self.driver.finish(build)

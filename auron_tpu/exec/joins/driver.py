@@ -36,6 +36,7 @@ class EquiJoinDriver:
         build_side: str,  # "left" | "right"
         condition: ir.Expr | None = None,
         exists_col: str = "exists",
+        projection: list[int] | None = None,
     ):
         assert join_type in core.JOIN_TYPES
         assert build_side in ("left", "right")
@@ -47,9 +48,20 @@ class EquiJoinDriver:
         self.build_side = build_side
         self.condition = condition
         self.exists_col = exists_col
-        self.out_schema = core.join_output_schema(
+        full_schema = core.join_output_schema(
             left_schema, right_schema, join_type, exists_col
         )
+        # column-pruning projection (indices into the full output schema):
+        # pair gathers move only the projected columns — on TPU the join
+        # cost is gather bytes, so this is the reference's column_pruning.rs
+        # analog with a direct roofline payoff
+        self.projection = list(projection) if projection is not None else None
+        if self.projection is None:
+            self.out_schema = full_schema
+        else:
+            self.out_schema = T.Schema(
+                tuple(full_schema[i] for i in self.projection)
+            )
         self.probe_is_left = build_side == "right"
         jt = join_type
         self.wants_pairs = jt in (INNER, LEFT, RIGHT, FULL)
@@ -179,8 +191,40 @@ class EquiJoinDriver:
         return Batch(comb, out.device, out.dicts)
 
     def _emit_pairs(self, probe_b, build_b, li, ri, ok) -> Batch:
-        b = self._assemble_pairs_batch(probe_b, build_b, li, ri, ok)
-        return Batch(self.out_schema, b.device, b.dicts)
+        if self.projection is None:
+            b = self._assemble_pairs_batch(probe_b, build_b, li, ri, ok)
+            return Batch(self.out_schema, b.device, b.dicts)
+        # projected pair gather: move only the pruned column set
+        nl = len(self.left_schema)
+        lb, rb = (probe_b, build_b) if self.probe_is_left else (build_b, probe_b)
+        lidx = li if self.probe_is_left else ri
+        ridx = ri if self.probe_is_left else li
+        lcols = [i for i in self.projection if i < nl]
+        rcols = [i - nl for i in self.projection if i >= nl]
+        lv, lm, rv, rm = core.gather_pair_arrays(
+            tuple(lb.col_values(c) for c in lcols),
+            tuple(lb.col_validity(c) for c in lcols),
+            tuple(rb.col_values(c) for c in rcols),
+            tuple(rb.col_validity(c) for c in rcols),
+            lidx, ridx, ok,
+        )
+        l_at = {c: k for k, c in enumerate(lcols)}
+        r_at = {c: k for k, c in enumerate(rcols)}
+        out_cols = []
+        for oi in self.projection:
+            if oi < nl:
+                k = l_at[oi]
+                out_cols.append(
+                    ColumnVal(lv[k], lm[k], lb.schema[oi].dtype, lb.dicts[oi])
+                )
+            else:
+                c = oi - nl
+                k = r_at[c]
+                out_cols.append(
+                    ColumnVal(rv[k], rm[k], rb.schema[c].dtype, rb.dicts[c])
+                )
+        out = batch_from_columns(out_cols, self.out_schema.names, ok)
+        return Batch(self.out_schema, out.device, out.dicts)
 
     def _emit_probe_extended(self, pb: Batch, sel) -> Batch:
         probe_cols = [
@@ -233,5 +277,9 @@ class EquiJoinDriver:
         return self._finish_batch(cols, pb.device.sel)
 
     def _finish_batch(self, cols: list[ColumnVal], sel) -> Batch:
+        """cols arrive in full-output-schema order; projection subsets them
+        (free — ColumnVals are views, the gather happened upstream)."""
+        if self.projection is not None:
+            cols = [cols[i] for i in self.projection]
         out = batch_from_columns(cols, self.out_schema.names, sel)
         return Batch(self.out_schema, out.device, out.dicts)

@@ -29,11 +29,12 @@ class SortMergeJoinExec(ExecOperator):
         join_type: str,
         condition: ir.Expr | None = None,
         exists_col: str = "exists",
+        projection: list[int] | None = None,
     ):
         self.driver = EquiJoinDriver(
             left.schema, right.schema, left_keys, right_keys,
             join_type, build_side="right", condition=condition,
-            exists_col=exists_col,
+            exists_col=exists_col, projection=projection,
         )
         super().__init__([left, right], self.driver.out_schema)
 
@@ -43,8 +44,8 @@ class SortMergeJoinExec(ExecOperator):
             build = self.driver.prepare(build_batches)
         for pb in self.child_stream(0, partition, ctx):
             ctx.check_cancelled()
-            if pb.num_rows() == 0:
-                continue
+            # no empty-batch pre-check: it costs a host sync per batch, and
+            # the probe itself already syncs once on the match total
             with ctx.metrics.timer("probe_time"):
                 yield from self.driver.probe_batch(build, pb)
         yield from self.driver.finish(build)

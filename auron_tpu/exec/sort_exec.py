@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Iterator
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -183,26 +185,33 @@ class _SorterConsumer:
         self.runs: list["_HostRun"] = []
         self.pending_rows = 0
         self._bytes = 0
+        # tasks run concurrently; MemManager.acquire may spill this consumer
+        # from ANOTHER task's thread. Lock order is manager -> consumer (the
+        # owner never holds this lock while calling acquire), so no deadlock.
+        self._lock = threading.RLock()
 
     def add(self, b: Batch, n: int) -> None:
-        self.pending.append(b)
-        self.pending_rows += n
-        self._bytes += batch_nbytes(b)
+        with self._lock:
+            self.pending.append(b)
+            self.pending_rows += n
+            self._bytes += batch_nbytes(b)
 
     def mem_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def spill(self) -> int:
-        if not self.pending:
-            return 0
-        freed = self._bytes
-        with self.ctx.metrics.timer("spill_time"):
-            self.runs.append(self.exec._sort_run(self.pending, self.ctx).to_host())
-        self.ctx.metrics.add("spilled_runs", 1)
-        self.pending = []
-        self.pending_rows = 0
-        self._bytes = 0
-        return freed
+        with self._lock:
+            if not self.pending:
+                return 0
+            freed = self._bytes
+            with self.ctx.metrics.timer("spill_time"):
+                self.runs.append(self.exec._sort_run(self.pending, self.ctx).to_host())
+            self.ctx.metrics.add("spilled_runs", 1)
+            self.pending = []
+            self.pending_rows = 0
+            self._bytes = 0
+            return freed
 
 
 class _SortedRun:

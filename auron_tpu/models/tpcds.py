@@ -99,7 +99,7 @@ def _schema_of(df: pd.DataFrame) -> T.Schema:
     return T.Schema.from_arrow(rb.schema)
 
 
-def to_batches(df: pd.DataFrame, n_partitions: int, batch_rows: int = 65536) -> list[list[Batch]]:
+def to_batches(df: pd.DataFrame, n_partitions: int, batch_rows: int = 1 << 20) -> list[list[Batch]]:
     """Split a table into per-partition batch lists."""
     parts: list[list[Batch]] = []
     n = len(df)
@@ -237,15 +237,23 @@ def run_q3_class(
         )
         part = B.hash_partitioning([col(0), col(1)], n_reduce)
         pairs = []
+        handles = []
+        from auron_tpu.plan.optimizer import prune_columns
+
         for p in range(n_map):
             data_f = os.path.join(work, f"map{p}.data")
             index_f = os.path.join(work, f"map{p}.index")
-            w = B.shuffle_writer(partial, part, data_f, index_f)
-            h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+            w = prune_columns(B.shuffle_writer(partial, part, data_f, index_f))
+            # start every map task before draining: each task pumps on its
+            # own thread (Spark executor slots; XLA releases the GIL)
+            handles.append(
+                api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+            )
+            pairs.append((data_f, index_f))
+        for h in handles:
             while api.next_batch(h) is not None:
                 pass
             api.finalize_native(h)
-            pairs.append((data_f, index_f))
 
         # ---- reduce stage: ipc read -> final agg -> sort desc -> limit
         inter_schema = _agg_inter_schema(partial)

@@ -100,7 +100,9 @@ class MeshQueryDriver:
 
         Returns per-partition batch lists (the reduce-stage outputs)."""
         try:
-            resolved = self._rewrite(plan, resources)
+            from auron_tpu.plan.optimizer import prune_columns
+
+            resolved = self._rewrite(prune_columns(plan), resources)
             outs: list[list[Batch]] = []
             for p in range(self.n_parts):
                 op = plan_from_proto(resolved)

@@ -352,6 +352,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             _JOIN_TYPE[n.join_type],
             condition=expr_from_proto(n.condition) if n.has_condition else None,
             exists_col=n.exists_col or "exists",
+            projection=list(n.projection) if n.has_projection else None,
         )
     if which == "hash_join":
         n = p.hash_join
@@ -365,6 +366,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             condition=expr_from_proto(n.condition) if n.has_condition else None,
             cached_build_id=n.cached_build_id or None,
             exists_col=n.exists_col or "exists",
+            projection=list(n.projection) if n.has_projection else None,
         )
     if which == "shuffle_writer":
         n = p.shuffle_writer

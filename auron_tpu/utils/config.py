@@ -208,3 +208,9 @@ TOKIO_EQUIV_PREFETCH_DEPTH = int_conf(
     "batches prefetched by the task pump (analog of the 1-slot sync_channel + tokio workers, rt.rs:108-140)",
 )
 NATIVE_LOG_LEVEL = str_conf("log.level", "info", "runtime", "engine log level (conf.rs:64)")
+METRICS_ROW_COUNTS = bool_conf(
+    "metrics.row.counts", False, "runtime",
+    "per-operator output_rows metrics; unlike the reference (free host-side "
+    "Arrow metadata) a device row count costs a reduction kernel per batch, "
+    "so production runs keep it off and read row counts at task boundaries",
+)

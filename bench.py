@@ -5,10 +5,12 @@ compute -> file shuffle -> final agg -> top-k) on the available accelerator
 and compares against a pandas single-thread baseline of the same query.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "backend": ..., "fact_gb_per_s": N, "sf": N, "cpu_fallback": bool}
 
-Env knobs: BENCH_SF (scale factor, default 0.05 ~ 144k fact rows),
-BENCH_PARTS (map partitions, default 4).
+Env knobs: BENCH_SF (scale factor, default 8 ~ 23M fact rows — sized to
+amortize compile/ingest overheads per VERDICT r1), BENCH_PARTS (map
+partitions, default 2), BENCH_TPU_PROBE_TIMEOUT (seconds, default 180).
 """
 
 import json
@@ -49,10 +51,11 @@ def main() -> None:
     import auron_tpu  # noqa: F401
     from auron_tpu.models import tpcds
 
-    sf = float(os.environ.get("BENCH_SF", "0.5"))
+    sf = float(os.environ.get("BENCH_SF", "8"))
     n_parts = int(os.environ.get("BENCH_PARTS", "2"))
     data = tpcds.generate(sf=sf, seed=42)
     n_rows = data.fact_rows()
+    n_bytes = int(data.store_sales.memory_usage(index=False, deep=False).sum())
 
     # --- pandas baseline (single-thread CPU) ---
     t0 = time.perf_counter()
@@ -75,6 +78,8 @@ def main() -> None:
 
     rows_per_s = n_rows / engine_s
     baseline_rows_per_s = n_rows / baseline_s
+    import jax
+
     print(
         json.dumps(
             {
@@ -82,6 +87,10 @@ def main() -> None:
                 "value": round(rows_per_s, 1),
                 "unit": "fact_rows/s",
                 "vs_baseline": round(rows_per_s / baseline_rows_per_s, 4),
+                "backend": jax.devices()[0].platform,
+                "fact_gb_per_s": round(n_bytes / engine_s / 1e9, 3),
+                "sf": sf,
+                "cpu_fallback": bool(os.environ.get("_AURON_BENCH_REEXEC")),
             }
         )
     )

@@ -2,6 +2,7 @@
 
 import pyarrow as pa
 
+import pytest
 from auron_tpu import types as T
 from auron_tpu.columnar import Batch
 from auron_tpu.exec.base import ExecutionContext
@@ -104,3 +105,11 @@ def test_project_string_function():
     scan = _scan({"s": ["a", "bb", None]})
     proj = ProjectExec(scan, [ScalarFunc("upper", (col(0),))], ["u"])
     assert proj.collect_pydict() == {"u": ["A", "BB", None]}
+
+
+@pytest.fixture(autouse=True)
+def _row_metrics_on(monkeypatch):
+    # these suites assert per-operator output_rows metrics
+    from auron_tpu.utils.config import METRICS_ROW_COUNTS
+
+    monkeypatch.setenv("AURON_TPU_" + METRICS_ROW_COUNTS.key.upper().replace(".", "_"), "true")

@@ -5,6 +5,7 @@ import time
 import pyarrow as pa
 import pytest
 
+
 from auron_tpu import types as T
 from auron_tpu.bridge import api
 from auron_tpu.columnar import Batch
@@ -194,3 +195,11 @@ def test_metric_render():
     text = rt.ctx.metrics.render()
     assert "FilterExec" in text and "output_rows=2" in text
     assert "ResourceScanExec" in text
+
+
+@pytest.fixture(autouse=True)
+def _row_metrics_on(monkeypatch):
+    # these suites assert per-operator output_rows metrics
+    from auron_tpu.utils.config import METRICS_ROW_COUNTS
+
+    monkeypatch.setenv("AURON_TPU_" + METRICS_ROW_COUNTS.key.upper().replace(".", "_"), "true")

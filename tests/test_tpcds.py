@@ -1,6 +1,8 @@
 """TPC-DS-class differential integration tests (the in-process analog of the
 reference's TPC-DS result-check gate, QueryResultComparator.scala:39-110)."""
 
+import tempfile
+
 import pandas as pd
 import pytest
 
@@ -61,3 +63,26 @@ def test_windowed_query_matches_oracle(data):
     assert got["rk"].tolist() == want["rk"].tolist()
     for g, w in zip(got["rev"], want["rev"]):
         assert g == pytest.approx(w, rel=1e-9)
+
+
+def test_q3_concurrent_maps_with_spills():
+    """Map tasks run concurrently; a tiny memory budget forces cross-thread
+    spill cascades through MemManager — results must stay exact (regression
+    for the per-consumer locking added in round 2)."""
+    from auron_tpu.memory.memmgr import MemManager
+
+    data = tpcds.generate(sf=0.05, seed=9)
+    MemManager.init(budget_bytes=4096)  # tiny: every staged inter spills
+    orig = tpcds.to_batches
+    tpcds.to_batches = lambda df, n, batch_rows=4096, _o=orig: _o(df, n, batch_rows)
+    try:
+        with tempfile.TemporaryDirectory() as wd:
+            got = tpcds.run_q3_class(data, n_map=4, n_reduce=2, work_dir=wd)
+        want = tpcds.q3_class_oracle(data)
+        assert len(got) == len(want)
+        for g, w in zip(got["s"], want["s"]):
+            assert abs(float(g) - float(w)) <= 1e-6 * max(1.0, abs(float(w)))
+        assert MemManager.get().num_spills > 0
+    finally:
+        tpcds.to_batches = orig
+        MemManager.init()  # restore default budget
