@@ -1,0 +1,22 @@
+"""Host-engine plan conversion layer (L2).
+
+The reference's reason to exist is intercepting a host engine's physical
+plan and rewriting maximal convertible subtrees into native plans
+(AuronConvertStrategy.scala:49-283, AuronConverters.scala:189-305,
+NativeConverters.scala:329-1200). This package is that layer for the TPU
+engine, driven by a *serialized host-plan description* (JSON) instead of
+live JVM objects — a thin JVM/engine shim only needs to dump its physical
+plan in this format and ship the resulting TaskDefinitions.
+
+- hostplan:   the neutral host-plan tree format
+- exprs:      host expression -> engine IR, with host-UDF fallback wrapping
+- strategy:   bottom-up convertibility tagging + per-operator enable flags
+              + removeInefficientConverts fixpoint
+- converters: per-operator proto builders + maximal-subtree segmentation
+"""
+
+from auron_tpu.convert.converters import ConversionResult, convert_plan
+from auron_tpu.convert.hostplan import HostNode
+from auron_tpu.convert.strategy import ConvertTags
+
+__all__ = ["HostNode", "ConversionResult", "ConvertTags", "convert_plan"]

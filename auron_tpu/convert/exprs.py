@@ -1,0 +1,126 @@
+"""Host expression -> engine IR conversion with UDF-fallback wrapping.
+
+Analog of NativeConverters.convertExpr (NativeConverters.scala:329-1200):
+every host expression either translates to a native ir.Expr, or — when
+``udf.fallback.enable`` is on — is wrapped as a HostUDF evaluated through
+the bridge callback (SparkUDFWrapper analog). If fallback is off, the
+failure propagates and marks the owning operator unconvertible.
+"""
+
+from __future__ import annotations
+
+from auron_tpu import types as T
+from auron_tpu.convert.hostplan import parse_type
+from auron_tpu.exprs import ir
+from auron_tpu.functions import registry  # loads the full function registry
+from auron_tpu.utils.config import UDF_FALLBACK_ENABLE, Configuration
+
+
+class UnsupportedExpr(Exception):
+    pass
+
+
+_BINOPS = {
+    "add": "add", "subtract": "sub", "multiply": "mul", "divide": "div",
+    "remainder": "mod", "pmod": "mod",
+    "equalto": "eq", "lessthan": "lt", "lessthanorequal": "lteq",
+    "greaterthan": "gt", "greaterthanorequal": "gteq",
+    "and": "and", "or": "or",
+}
+
+# host expression names -> engine scalar function names (identity unless
+# listed); anything the function registry knows converts directly
+_FN_RENAME = {
+    "stringtrim": "trim",
+    "stringtrimleft": "ltrim",
+    "stringtrimright": "rtrim",
+    "lower": "lower",
+    "upper": "upper",
+    "dateadd": "date_add",
+    "datesub": "date_sub",
+    "dayofmonth": "day",
+}
+
+
+def convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None) -> ir.Expr:
+    """Convert one host expression dict; raises UnsupportedExpr on failure
+    (the caller decides whole-node fallback vs HostUDF wrapping)."""
+    kind = e.get("kind")
+    if kind == "attr":
+        return ir.Column(int(e["index"]), e.get("name", ""))
+    if kind == "lit":
+        dt = parse_type(e.get("type", "null"))
+        return ir.Literal(e.get("value"), dt)
+    if kind != "call":
+        raise UnsupportedExpr(f"unknown expression kind {kind!r}")
+
+    name = e["name"].lower()
+    kids = e.get("children", [])
+
+    def sub(i):
+        return convert_expr(kids[i], conf, udf_registry)
+
+    def subs():
+        return [convert_expr(k, conf, udf_registry) for k in kids]
+
+    if name in _BINOPS:
+        return ir.BinaryOp(_BINOPS[name], sub(0), sub(1))
+    if name == "not":
+        return ir.Not(sub(0))
+    if name == "isnull":
+        return ir.IsNull(sub(0))
+    if name == "isnotnull":
+        return ir.IsNotNull(sub(0))
+    if name == "cast":
+        return ir.Cast(sub(0), parse_type(e["to"]), bool(e.get("try", False)))
+    if name == "if":
+        return ir.If(sub(0), sub(1), sub(2))
+    if name == "casewhen":
+        branches = tuple(
+            (convert_expr(w, conf, udf_registry), convert_expr(t, conf, udf_registry))
+            for w, t in e.get("branches", [])
+        )
+        orelse = (
+            convert_expr(e["else"], conf, udf_registry) if e.get("else") else None
+        )
+        return ir.Case(branches, orelse)
+    if name == "in":
+        return ir.In(sub(0), tuple(e.get("values", [])), bool(e.get("negated")))
+    if name == "coalesce":
+        return ir.Coalesce(tuple(subs()))
+    if name == "like":
+        return ir.Like(sub(0), e["pattern"], bool(e.get("negated")),
+                       e.get("escape", "\\"))
+    if name == "sparkpartitionid":
+        return ir.SparkPartitionId()
+    if name == "monotonicallyincreasingid":
+        return ir.MonotonicId()
+    if name == "scalarsubquery":
+        return ir.ScalarSubquery(e["resource_id"], parse_type(e["type"]))
+
+    fn = _FN_RENAME.get(name, name)
+    if registry.lookup(fn) is not None:
+        return ir.ScalarFunc(fn, tuple(subs()))
+
+    # ---- host-UDF fallback (SparkUDFWrapper analog) ----
+    if udf_registry is not None and name in udf_registry and conf.get(UDF_FALLBACK_ENABLE):
+        out_t = parse_type(e.get("type", "string"))
+        return ir.HostUDF(name, tuple(subs()), out_t)
+    raise UnsupportedExpr(f"expression {e['name']!r} is not supported")
+
+
+def convert_sort_fields(fields: list[dict], conf, udf_registry=None):
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    out = []
+    for f in fields:
+        out.append(
+            (
+                convert_expr(f["expr"], conf, udf_registry),
+                SortSpec(
+                    asc=bool(f.get("asc", True)),
+                    nulls_first=bool(f.get("nulls_first", f.get("asc", True))),
+                ),
+            )
+        )
+    return out

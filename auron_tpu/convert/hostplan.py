@@ -1,0 +1,92 @@
+"""Neutral serialized host-plan format.
+
+A host-engine shim (Spark/Flink) serializes its fully-optimized physical
+plan into this JSON-able tree; the conversion layer consumes it. Shape:
+
+    {"op": "ProjectExec",
+     "schema": [["name", "long", true], ...],       # output schema
+     "args": {"projections": [<expr>, ...], ...},   # op-specific payload
+     "children": [<node>, ...]}
+
+Expressions are dicts: {"kind": "attr", "index": i} bound references,
+{"kind": "lit", "value": v, "type": t}, and {"kind": "call",
+"name": <spark-expression-name>, "children": [...], ...} — the same
+bound-reference + expression-class model NativeConverters translates
+(NativeConverters.scala:329).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from auron_tpu import types as T
+
+
+def parse_type(s: str) -> T.DataType:
+    s = s.strip().lower()
+    simple = {
+        "boolean": T.BOOL,
+        "byte": T.INT8,
+        "tinyint": T.INT8,
+        "short": T.INT16,
+        "smallint": T.INT16,
+        "int": T.INT32,
+        "integer": T.INT32,
+        "long": T.INT64,
+        "bigint": T.INT64,
+        "float": T.FLOAT32,
+        "double": T.FLOAT64,
+        "string": T.STRING,
+        "binary": T.BINARY,
+        "date": T.DATE32,
+        "timestamp": T.TIMESTAMP,
+        "null": T.NULL,
+    }
+    if s in simple:
+        return simple[s]
+    if s.startswith("decimal"):
+        if "(" in s:
+            p, sc = s[s.index("(") + 1 : s.index(")")].split(",")
+            return T.decimal(int(p), int(sc))
+        return T.decimal(10, 0)
+    if s.startswith("array<") and s.endswith(">"):
+        return T.DataType(T.TypeKind.LIST, inner=(parse_type(s[6:-1]),))
+    raise ValueError(f"unsupported host type {s!r}")
+
+
+@dataclass
+class HostNode:
+    """One operator of the host engine's physical plan."""
+
+    op: str  # host exec class name, e.g. "ProjectExec"
+    schema: T.Schema  # output schema
+    args: dict = field(default_factory=dict)
+    children: list["HostNode"] = field(default_factory=list)
+
+    @staticmethod
+    def from_json(data: dict | str) -> "HostNode":
+        if isinstance(data, str):
+            data = json.loads(data)
+        fields = tuple(
+            T.Field(name, parse_type(t), bool(nullable))
+            for name, t, nullable in data.get("schema", [])
+        )
+        return HostNode(
+            op=data["op"],
+            schema=T.Schema(fields),
+            args=data.get("args", {}),
+            children=[HostNode.from_json(c) for c in data.get("children", [])],
+        )
+
+    def walk_up(self):
+        """Post-order (children first) — the tagging order of
+        AuronConvertStrategy.apply's foreachUp."""
+        for c in self.children:
+            yield from c.walk_up()
+        yield self
+
+    def walk_down(self):
+        yield self
+        for c in self.children:
+            yield from c.walk_down()

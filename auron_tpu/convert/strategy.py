@@ -1,0 +1,170 @@
+"""Bottom-up convertibility tagging + inefficient-convert removal.
+
+Analog of AuronConvertStrategy (AuronConvertStrategy.scala:49-283):
+
+1. every node is trial-converted bottom-up; failures tag NeverConvert with
+   a reason (per-operator enable flags gate conversion exactly like the
+   reference's SparkAuronConfiguration.ENABLE_* keys,
+   AuronConverters.scala:98-128);
+2. a fixpoint pass reverts conversions that would force expensive
+   row<->columnar boundaries for little native benefit — the same rule set
+   as removeInefficientConverts (AuronConvertStrategy.scala:205-283):
+   filter/agg over a non-native child, shuffle over a non-native agg,
+   native expand/scan/sort feeding a non-native parent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from auron_tpu.convert.hostplan import HostNode
+from auron_tpu.utils.config import Configuration, bool_conf
+
+# per-operator enable flags (reference: ENABLE_* keys); registered once
+_OP_KEYS = [
+    "scan", "project", "filter", "sort", "union", "smj", "shj", "bhj",
+    "local_limit", "global_limit", "take_ordered_and_project", "aggr",
+    "expand", "window", "window_group_limit", "generate",
+    "local_table_scan", "data_writing", "broadcast_exchange",
+    "shuffle_exchange",
+]
+ENABLE_FLAGS = {
+    k: bool_conf(f"convert.enable.{k}", True, "convert",
+                 f"convert host {k} operators to native plans")
+    for k in _OP_KEYS
+}
+
+# host exec class -> enable-flag key
+OP_FLAG = {
+    "FileSourceScanExec": "scan",
+    "OrcScanExec": "scan",
+    "LocalTableScanExec": "local_table_scan",
+    "ProjectExec": "project",
+    "FilterExec": "filter",
+    "SortExec": "sort",
+    "UnionExec": "union",
+    "SortMergeJoinExec": "smj",
+    "ShuffledHashJoinExec": "shj",
+    "BroadcastHashJoinExec": "bhj",
+    "LocalLimitExec": "local_limit",
+    "GlobalLimitExec": "global_limit",
+    "TakeOrderedAndProjectExec": "take_ordered_and_project",
+    "HashAggregateExec": "aggr",
+    "ObjectHashAggregateExec": "aggr",
+    "SortAggregateExec": "aggr",
+    "ExpandExec": "expand",
+    "WindowExec": "window",
+    "WindowGroupLimitExec": "window_group_limit",
+    "GenerateExec": "generate",
+    "DataWritingCommandExec": "data_writing",
+    "BroadcastExchangeExec": "broadcast_exchange",
+    "ShuffleExchangeExec": "shuffle_exchange",
+}
+
+_AGG_OPS = {"HashAggregateExec", "ObjectHashAggregateExec", "SortAggregateExec"}
+
+
+@dataclass
+class ConvertTags:
+    """Per-node conversion verdicts, keyed by node identity."""
+
+    convertible: dict[int, bool] = field(default_factory=dict)
+    reason: dict[int, str] = field(default_factory=dict)
+
+    def ok(self, node: HostNode) -> bool:
+        return self.convertible.get(id(node), False)
+
+    def never(self, node: HostNode, reason: str) -> None:
+        self.convertible[id(node)] = False
+        self.reason.setdefault(id(node), reason)
+
+    def why(self, node: HostNode) -> str | None:
+        return self.reason.get(id(node))
+
+    def summary(self, root: HostNode) -> list[tuple[str, bool, str | None]]:
+        return [
+            (n.op, self.ok(n), self.why(n)) for n in root.walk_down()
+        ]
+
+
+def tag_plan(root: HostNode, conf: Configuration, try_convert) -> ConvertTags:
+    """Bottom-up trial conversion (AuronConvertStrategy.apply).
+
+    ``try_convert(node, tags)`` must raise with a reason when the node (with
+    its children assumed converted where tagged) cannot convert."""
+    tags = ConvertTags()
+    for node in root.walk_up():
+        flag_key = OP_FLAG.get(node.op)
+        if flag_key is None:
+            tags.never(node, f"{node.op} is not supported yet.")
+            continue
+        if not conf.get(ENABLE_FLAGS[flag_key]):
+            tags.never(node, f"{node.op} disabled by convert.enable.{flag_key}")
+            continue
+        try:
+            try_convert(node, tags)
+            tags.convertible[id(node)] = True
+        except Exception as e:  # noqa: BLE001 — reason captured like the reference
+            tags.never(node, f"{node.op}: {e}")
+    _remove_inefficient_converts(root, tags)
+    return tags
+
+
+def _remove_inefficient_converts(root: HostNode, tags: ConvertTags) -> None:
+    """Fixpoint rule set of AuronConvertStrategy.removeInefficientConverts."""
+    parent_of: dict[int, HostNode | None] = {id(root): None}
+    for n in root.walk_down():
+        for c in n.children:
+            parent_of[id(c)] = n
+
+    finished = False
+    while not finished:
+        finished = True
+
+        def dont_convert(node: HostNode, cond: bool, reason: str):
+            nonlocal finished
+            if cond and tags.ok(node):
+                tags.never(node, reason)
+                finished = False
+
+        for e in root.walk_down():
+            # NonNative -> NativeFilter / NativeAgg: converting would force
+            # a row->columnar conversion of a large input
+            if tags.ok(e) and e.op == "FilterExec":
+                dont_convert(
+                    e, e.children and not tags.ok(e.children[0]),
+                    f"{e.op}, children is not native.",
+                )
+            if tags.ok(e) and e.op in _AGG_OPS:
+                dont_convert(
+                    e, e.children and not tags.ok(e.children[0]),
+                    f"{e.op}, children is not native.",
+                )
+            # Agg -> NativeShuffle: next stage likely reads non-natively
+            if tags.ok(e) and e.op == "ShuffleExchangeExec":
+                c = e.children[0] if e.children else None
+                dont_convert(
+                    e, c is not None and c.op in _AGG_OPS and not tags.ok(c),
+                    f"{e.op}, children is not native and children is agg.",
+                )
+            # native Expand/Scan feeding a non-native parent forces C2R of
+            # a large output
+            if not tags.ok(e):
+                for c in e.children:
+                    if c.op == "ExpandExec":
+                        dont_convert(
+                            c, tags.ok(c), f"{e.op}, children is nativeExpand."
+                        )
+                    if c.op in ("FileSourceScanExec", "OrcScanExec"):
+                        dont_convert(
+                            c, tags.ok(c), f"{e.op}, children is nativeParquetScan."
+                        )
+                    # NonNative -> NativeSort -> NonNative sandwich
+                    if c.op == "SortExec":
+                        dont_convert(
+                            c,
+                            tags.ok(c)
+                            and c.children
+                            and not tags.ok(c.children[0]),
+                            f"{e.op}, children and parent both are not native.",
+                        )

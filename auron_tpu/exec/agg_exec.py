@@ -684,13 +684,22 @@ class _AggTableConsumer:
             return freed
 
     def drain(self):
-        """Yield current contents without merging (partial-skip path)."""
+        """Yield ALL contents without merging (partial-skip path).
+
+        Atomically takes staged + state + parked under the lock: a
+        concurrent cross-thread spill between the caller's decision and
+        this drain parks batches on disk, and those must still be emitted
+        (they are decoded back here) or rows would silently vanish."""
         with self._lock:
-            staged, state = self.staged, self.state
-            self.staged, self.staged_rows, self.state = [], 0, None
+            staged, state, parked = self.staged, self.state, self.parked
+            self.staged, self.staged_rows, self.state, self.parked = [], 0, None, []
         yield from staged
         if state is not None:
             yield state
+        for ds in parked:
+            for rb in ds.read_tables():
+                yield Batch.from_arrow(rb)
+            ds.release()
 
     def collect_state(self) -> Batch | None:
         """Merge staged + state + parked disk runs into the final state."""

@@ -51,6 +51,9 @@ class Registry:
     def names(self) -> list[str]:
         return sorted(self._fns)
 
+    def lookup(self, name: str) -> Callable | None:
+        return self._fns.get(name)
+
     def dispatch(self, name: str, args: list, cap: int):
         if name not in self._fns:
             raise KeyError(

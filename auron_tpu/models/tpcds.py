@@ -240,10 +240,12 @@ def run_q3_class(
         handles = []
         from auron_tpu.plan.optimizer import prune_columns
 
+        # prune once — per-partition writers differ only in file paths
+        partial = prune_columns(partial)
         for p in range(n_map):
             data_f = os.path.join(work, f"map{p}.data")
             index_f = os.path.join(work, f"map{p}.index")
-            w = prune_columns(B.shuffle_writer(partial, part, data_f, index_f))
+            w = B.shuffle_writer(partial, part, data_f, index_f)
             # start every map task before draining: each task pumps on its
             # own thread (Spark executor slots; XLA releases the GIL)
             handles.append(

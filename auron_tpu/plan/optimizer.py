@@ -18,8 +18,10 @@ from __future__ import annotations
 
 from auron_tpu.proto import plan_pb2 as pb
 
-# nodes whose output schema is exactly their (single) child's schema
-_PASSTHROUGH = ("limit", "coalesce_batches", "debug", "rename_columns")
+# nodes whose output schema is exactly their (single) child's schema;
+# rename_columns is NOT here — its names list is sized to the unpruned
+# child, so it acts as a pruning barrier
+_PASSTHROUGH = ("limit", "coalesce_batches", "debug")
 
 
 def prune_columns(plan: pb.PhysicalPlanNode) -> pb.PhysicalPlanNode:

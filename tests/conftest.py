@@ -17,3 +17,15 @@ from auron_tpu.jaxenv import force_cpu_backend  # noqa: E402
 force_cpu_backend(8)
 
 import auron_tpu  # noqa: F401,E402  (enables x64)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def enable_row_metrics(monkeypatch):
+    """Turn on per-operator output_rows metrics (conf-gated, default off)."""
+    from auron_tpu.utils.config import METRICS_ROW_COUNTS
+
+    env_key = "AURON_TPU_" + METRICS_ROW_COUNTS.key.upper().replace(".", "_")
+    monkeypatch.setenv(env_key, "true")

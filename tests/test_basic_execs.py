@@ -108,8 +108,6 @@ def test_project_string_function():
 
 
 @pytest.fixture(autouse=True)
-def _row_metrics_on(monkeypatch):
+def _row_metrics_on(enable_row_metrics):
     # these suites assert per-operator output_rows metrics
-    from auron_tpu.utils.config import METRICS_ROW_COUNTS
-
-    monkeypatch.setenv("AURON_TPU_" + METRICS_ROW_COUNTS.key.upper().replace(".", "_"), "true")
+    pass
