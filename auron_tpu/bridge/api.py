@@ -33,6 +33,19 @@ def put_resource(key: str, value: Any) -> None:
         _resources[key] = value
 
 
+def put_resource_ipc(key: str, payload: bytes) -> None:
+    """C-ABI batch-resource entry: the payload MUST be an Arrow IPC
+    stream; it registers as a list of RecordBatches (consumable by
+    ffi_reader / scan providers). Raw opaque payloads go through
+    ``auron_put_resource_bytes`` -> plain put_resource instead — an
+    explicit type split, no content sniffing."""
+    import io
+
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        batches = list(r)
+    put_resource(key, batches)
+
+
 def get_resource(key: str) -> Any:
     with _lock:
         return _resources.get(key)
@@ -81,6 +94,13 @@ def finalize_native(handle: int) -> dict:
     if rt is None:
         return {}
     return rt.finalize()
+
+
+def finalize_native_json(handle: int) -> bytes:
+    """C-ABI variant: metrics tree serialized as JSON bytes."""
+    import json
+
+    return json.dumps(finalize_native(handle)).encode("utf-8")
 
 
 def on_exit() -> None:

@@ -1,0 +1,243 @@
+/*
+ * auron-tpu host-engine bridge — C ABI implementation.
+ *
+ * Implements auron_bridge.h by embedding CPython: the engine (planner,
+ * runtime, XLA dispatch) runs in-process, and batches cross the boundary
+ * as Arrow IPC stream bytes. This is the out-of-process analog of the
+ * reference's JNI entry points (auron-core JniBridge.java:49-80 native
+ * methods implemented by auron/src/exec.rs:42-122): a JVM shim binds
+ * these five symbols instead of JNI natives.
+ *
+ * Threading: every entry point acquires the GIL via PyGILState_Ensure, so
+ * the ABI is callable from any host thread (the engine's own pump threads
+ * run under the embedded interpreter as usual). Returned buffers are
+ * per-handle and stay valid until the next call on the same handle,
+ * matching the header contract.
+ */
+
+#include "auron_bridge.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+static PyObject* g_api = nullptr; /* auron_tpu.bridge.api module */
+static std::once_flag g_init_once;
+
+static thread_local std::string tl_error;
+
+/* per-handle buffers: the header promises pointers stay valid until the
+ * NEXT CALL ON THE SAME HANDLE, so they cannot live in thread-local
+ * storage (another handle's call on the same thread must not clobber
+ * them). Batch buffers are dropped at finalize; metrics buffers at the
+ * next finalize on the handle or at on_exit. */
+static std::mutex g_buf_mutex;
+static std::unordered_map<int64_t, std::string> g_batch_buf;
+static std::unordered_map<int64_t, std::string> g_metrics_buf;
+
+static void capture_python_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tl_error = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) tl_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+static void init_interpreter() {
+  bool was_initialized = Py_IsInitialized();
+  if (!was_initialized) {
+    Py_InitializeEx(0);
+  }
+  PyGILState_STATE st = PyGILState_LOCKED;
+  if (was_initialized) st = PyGILState_Ensure();
+
+  /* engine root: AURON_TPU_ROOT (shim-provided) else cwd */
+  PyRun_SimpleString(
+      "import os, sys\n"
+      "_root = os.environ.get('AURON_TPU_ROOT') or os.getcwd()\n"
+      "sys.path.insert(0, _root)\n");
+  g_api = PyImport_ImportModule("auron_tpu.bridge.api");
+  if (g_api == nullptr) capture_python_error();
+
+  if (was_initialized) {
+    PyGILState_Release(st);
+  } else {
+    /* release the GIL held since Py_InitializeEx so any host thread can
+       enter through PyGILState_Ensure */
+    PyEval_SaveThread();
+  }
+}
+
+static bool ensure_init() {
+  std::call_once(g_init_once, init_interpreter);
+  return g_api != nullptr;
+}
+
+extern "C" {
+
+auron_task_handle auron_call_native(const uint8_t* task_def, size_t len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  auron_task_handle h = -1;
+  PyObject* res = PyObject_CallMethod(
+      g_api, "call_native", "y#", reinterpret_cast<const char*>(task_def),
+      static_cast<Py_ssize_t>(len));
+  if (res != nullptr) {
+    h = PyLong_AsLongLong(res);
+    Py_DECREF(res);
+    if (PyErr_Occurred() != nullptr) {
+      capture_python_error(); /* non-int / overflowing result */
+      h = -1;
+    } else if (h < 0) {
+      tl_error = "call_native returned a negative handle";
+    }
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return h;
+}
+
+int auron_next_batch(auron_task_handle h, const uint8_t** data, size_t* len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res =
+      PyObject_CallMethod(g_api, "next_batch_ipc", "L", (long long)h);
+  if (res != nullptr) {
+    if (res == Py_None) {
+      rc = 0; /* end of stream */
+    } else {
+      char* buf = nullptr;
+      Py_ssize_t n = 0;
+      if (PyBytes_AsStringAndSize(res, &buf, &n) == 0) {
+        std::lock_guard<std::mutex> lk(g_buf_mutex);
+        std::string& slot = g_batch_buf[h];
+        slot.assign(buf, static_cast<size_t>(n));
+        *data = reinterpret_cast<const uint8_t*>(slot.data());
+        *len = slot.size();
+        rc = 1;
+      } else {
+        capture_python_error();
+      }
+    }
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int auron_finalize_native(auron_task_handle h, const uint8_t** metrics_json,
+                          size_t* len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res =
+      PyObject_CallMethod(g_api, "finalize_native_json", "L", (long long)h);
+  if (res != nullptr) {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(res, &buf, &n) == 0) {
+      std::lock_guard<std::mutex> lk(g_buf_mutex);
+      g_batch_buf.erase(h); /* stream is over */
+      std::string& slot = g_metrics_buf[h];
+      slot.assign(buf, static_cast<size_t>(n));
+      if (metrics_json != nullptr) {
+        *metrics_json = reinterpret_cast<const uint8_t*>(slot.data());
+        *len = slot.size();
+      }
+      rc = 0;
+    } else {
+      capture_python_error();
+    }
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+void auron_on_exit(void) {
+  if (!ensure_init()) return;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(g_api, "on_exit", nullptr);
+  if (res == nullptr) {
+    capture_python_error();
+  } else {
+    Py_DECREF(res);
+  }
+  PyGILState_Release(st);
+  std::lock_guard<std::mutex> lk(g_buf_mutex);
+  g_batch_buf.clear();
+  g_metrics_buf.clear();
+}
+
+int auron_put_resource(const char* key, const uint8_t* value, size_t len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyObject_CallMethod(
+      g_api, "put_resource_ipc", "sy#", key,
+      reinterpret_cast<const char*>(value), static_cast<Py_ssize_t>(len));
+  if (res != nullptr) {
+    rc = 0;
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int auron_put_resource_bytes(const char* key, const uint8_t* value,
+                             size_t len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyObject_CallMethod(
+      g_api, "put_resource", "sy#", key,
+      reinterpret_cast<const char*>(value), static_cast<Py_ssize_t>(len));
+  if (res != nullptr) {
+    rc = 0;
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int auron_remove_resource(const char* key) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyObject_CallMethod(g_api, "remove_resource", "s", key);
+  if (res != nullptr) {
+    rc = 0;
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+const char* auron_last_error(void) { return tl_error.c_str(); }
+
+} /* extern "C" */

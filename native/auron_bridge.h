@@ -43,9 +43,13 @@ int auron_finalize_native(auron_task_handle h, const uint8_t** metrics_json,
 void auron_on_exit(void);
 
 /* Resource map: hand scan providers / shuffle block channels / UDF
- * contexts to tasks. Values are opaque host callbacks registered through
- * the embedding layer; file-backed resources use string payloads. */
+ * contexts to tasks. auron_put_resource ships batch data as an Arrow IPC
+ * stream (decoded into a batch list for scan/ffi readers — payloads MUST
+ * be valid IPC); auron_put_resource_bytes ships opaque raw bytes (file
+ * paths, conf blobs) with no interpretation. */
 int auron_put_resource(const char* key, const uint8_t* value, size_t len);
+int auron_put_resource_bytes(const char* key, const uint8_t* value,
+                             size_t len);
 int auron_remove_resource(const char* key);
 
 /* Last error message for the calling thread (UTF-8, engine-owned). */

@@ -1,0 +1,97 @@
+/*
+ * C test harness for the auron bridge ABI — a stand-in host engine.
+ *
+ * Drives a TaskDefinition end-to-end through libauron_bridge.so exactly
+ * like a JVM shim would: register resources, start the task, pump
+ * batches, finalize, exit. Usage:
+ *
+ *   bridge_harness <taskdef.bin> <out.bin> [<key> <resource.bin>]...
+ *
+ * out.bin: sequence of [u64 little-endian length][arrow IPC stream bytes]
+ * per pulled batch. The finalize metrics JSON goes to stdout.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "auron_bridge.h"
+
+static uint8_t* read_file(const char* path, size_t* out_len) {
+  FILE* f = fopen(path, "rb");
+  if (f == NULL) {
+    fprintf(stderr, "cannot open %s\n", path);
+    exit(2);
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  uint8_t* buf = (uint8_t*)malloc((size_t)n);
+  if (fread(buf, 1, (size_t)n, f) != (size_t)n) {
+    fprintf(stderr, "short read on %s\n", path);
+    exit(2);
+  }
+  fclose(f);
+  *out_len = (size_t)n;
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3 || (argc - 3) % 2 != 0) {
+    fprintf(stderr, "usage: %s <taskdef.bin> <out.bin> [<key> <file>]...\n",
+            argv[0]);
+    return 2;
+  }
+
+  for (int i = 3; i + 1 < argc; i += 2) {
+    size_t len = 0;
+    uint8_t* payload = read_file(argv[i + 1], &len);
+    if (auron_put_resource(argv[i], payload, len) != 0) {
+      fprintf(stderr, "put_resource(%s) failed: %s\n", argv[i],
+              auron_last_error());
+      return 3;
+    }
+    free(payload);
+  }
+
+  size_t task_len = 0;
+  uint8_t* task = read_file(argv[1], &task_len);
+  auron_task_handle h = auron_call_native(task, task_len);
+  free(task);
+  if (h < 0) {
+    fprintf(stderr, "call_native failed: %s\n", auron_last_error());
+    return 4;
+  }
+
+  FILE* out = fopen(argv[2], "wb");
+  if (out == NULL) {
+    fprintf(stderr, "cannot open %s\n", argv[2]);
+    return 2;
+  }
+  for (;;) {
+    const uint8_t* data = NULL;
+    size_t len = 0;
+    int rc = auron_next_batch(h, &data, &len);
+    if (rc == 0) break;
+    if (rc < 0) {
+      fprintf(stderr, "next_batch failed: %s\n", auron_last_error());
+      return 5;
+    }
+    uint64_t n = (uint64_t)len;
+    fwrite(&n, sizeof(n), 1, out);
+    fwrite(data, 1, len, out);
+  }
+  fclose(out);
+
+  const uint8_t* metrics = NULL;
+  size_t mlen = 0;
+  if (auron_finalize_native(h, &metrics, &mlen) != 0) {
+    fprintf(stderr, "finalize failed: %s\n", auron_last_error());
+    return 6;
+  }
+  fwrite(metrics, 1, mlen, stdout);
+  fputc('\n', stdout);
+
+  auron_on_exit();
+  return 0;
+}
