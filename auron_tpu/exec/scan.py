@@ -60,6 +60,183 @@ def pruning_to_arrow_filter(e: ir.Expr, schema: T.Schema):
     return None
 
 
+class CoalescedReadFile:
+    """File-like wrapper amortizing small reads into over-read windows.
+
+    Parquet metadata/page reads are many tiny ranges; through a remote-FS
+    opener each would be one host round trip. Reads are served from
+    window-aligned cached chunks (PARQUET_MAX_OVER_READ_SIZE), the analog
+    of the reference's read coalescing (scan/internal_file_reader.rs:47-52,
+    conf PARQUET_MAX_OVER_READ_SIZE conf.rs:44)."""
+
+    _MAX_CACHED_CHUNKS = 4  # footer + dictionary + current data window(s)
+
+    def __init__(self, raw, window: int):
+        self._raw = raw
+        self._window = max(window, 1 << 16)
+        raw.seek(0, 2)
+        self._size = raw.tell()
+        self._pos = 0
+        self._chunks: dict[int, bytes] = {}  # insertion-ordered LRU
+        self.raw_reads = 0
+        self.bytes_fetched = 0
+        self.closed = False
+
+    # -- python file protocol (what pyarrow needs) --
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return True
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        else:
+            self._pos = self._size + offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def size(self) -> int:
+        return self._size
+
+    def _chunk(self, idx: int) -> bytes:
+        c = self._chunks.pop(idx, None)
+        if c is None:
+            start = idx * self._window
+            want = min(self._window, self._size - start)
+            self._raw.seek(start)
+            parts = []
+            got = 0
+            while got < want:  # io protocol permits short reads
+                piece = self._raw.read(want - got)
+                if not piece:
+                    break
+                parts.append(piece)
+                got += len(piece)
+            c = b"".join(parts)
+            self.raw_reads += 1
+            self.bytes_fetched += len(c)
+            # bounded cache: whole-file residency would defeat the point
+            while len(self._chunks) >= self._MAX_CACHED_CHUNKS:
+                self._chunks.pop(next(iter(self._chunks)))
+        self._chunks[idx] = c  # (re)insert as most recent
+        return c
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self._size - self._pos
+        n = max(0, min(n, self._size - self._pos))
+        out = bytearray()
+        while n > 0:
+            idx, ofs = divmod(self._pos, self._window)
+            c = self._chunk(idx)
+            take = min(n, len(c) - ofs)
+            if take <= 0:
+                break
+            out += c[ofs : ofs + take]
+            self._pos += take
+            n -= take
+        return bytes(out)
+
+    def close(self) -> None:
+        self.closed = True
+        if hasattr(self._raw, "close"):
+            self._raw.close()
+
+
+def _rg_stats(md_rg, name_to_idx):
+    """{column name: (min, max, null_count, num_values)} where stats exist."""
+    out = {}
+    for name, j in name_to_idx.items():
+        cc = md_rg.column(j)
+        st = cc.statistics
+        if st is None:
+            continue
+        mn = st.min if st.has_min_max else None
+        mx = st.max if st.has_min_max else None
+        nc = st.null_count if st.has_null_count else None
+        out[name] = (mn, mx, nc, cc.num_values)
+    return out
+
+
+def _pred_false_for_stats(e: ir.Expr, schema: T.Schema, stats: dict) -> bool:
+    """True when the row-group statistics PROVE the predicate matches no
+    row — the skip decision of the reference's row-group-level pruning
+    (parquet_exec.rs:172-197 pushdown)."""
+    if isinstance(e, ir.BinaryOp):
+        if e.op == "and":
+            return _pred_false_for_stats(e.left, schema, stats) or _pred_false_for_stats(
+                e.right, schema, stats
+            )
+        if e.op == "or":
+            return _pred_false_for_stats(e.left, schema, stats) and _pred_false_for_stats(
+                e.right, schema, stats
+            )
+        cmp_ops = ("eq", "lt", "lteq", "gt", "gteq")
+        if (
+            e.op in cmp_ops
+            and isinstance(e.left, ir.Column)
+            and isinstance(e.right, ir.Literal)
+            and e.right.value is not None
+        ):
+            st = stats.get(schema[e.left.index].name)
+            if st is None:
+                return False
+            mn, mx, _, _ = st
+            if mn is None or mx is None:
+                return False
+            v = e.right.value
+            try:
+                if e.op == "eq":
+                    return v < mn or v > mx
+                if e.op == "lt":
+                    return mn >= v
+                if e.op == "lteq":
+                    return mn > v
+                if e.op == "gt":
+                    return mx <= v
+                if e.op == "gteq":
+                    return mx < v
+            except TypeError:
+                return False  # incomparable stat types: never skip
+    if isinstance(e, ir.IsNotNull) and isinstance(e.child, ir.Column):
+        st = stats.get(schema[e.child.index].name)
+        # num_values counts all values incl. nulls: all-null group -> skip
+        return st is not None and st[2] is not None and st[2] == st[3]
+    if isinstance(e, ir.In) and isinstance(e.child, ir.Column) and not e.negated:
+        st = stats.get(schema[e.child.index].name)
+        if st is None or st[0] is None or st[1] is None:
+            return False
+        mn, mx = st[0], st[1]
+        try:
+            return all(
+                (i is not None) and (i < mn or i > mx) for i in e.items
+            ) and not any(i is None for i in e.items)
+        except TypeError:
+            return False
+    return False
+
+
+def _pred_columns(preds: list[ir.Expr]) -> set[int]:
+    out: set[int] = set()
+
+    def rec(e: ir.Expr):
+        if isinstance(e, ir.Column):
+            out.add(e.index)
+        for c in e.children():
+            rec(c)
+
+    for p in preds:
+        rec(p)
+    return out
+
+
 class ParquetScanExec(ExecOperator):
     def __init__(
         self,
@@ -75,43 +252,88 @@ class ParquetScanExec(ExecOperator):
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         cols = self.schema.names
+        preds = self.pruning_predicates
         filt = None
-        for p in self.pruning_predicates:
+        for p in preds:
             f = pruning_to_arrow_filter(p, self.schema)
             if f is not None:
                 filt = f if filt is None else (filt & f)
         bs = ctx.batch_size()
         opener = ctx.resources.get(self.fs_resource_id) if self.fs_resource_id else None
-        from auron_tpu.utils.config import IGNORE_CORRUPTED_FILES
+        from auron_tpu.utils.config import (
+            IGNORE_CORRUPTED_FILES,
+            PARQUET_LATE_MATERIALIZATION,
+            PARQUET_MAX_OVER_READ_SIZE,
+        )
 
         tolerate = ctx.conf.get(IGNORE_CORRUPTED_FILES)
+        late_enabled = ctx.conf.get(PARQUET_LATE_MATERIALIZATION) and filt is not None
+        pred_cols = sorted(_pred_columns(preds)) if late_enabled else []
+        pred_names = [self.schema[i].name for i in pred_cols]
+
         for path in self.file_paths:
             ctx.check_cancelled()
-            src = opener(path) if opener is not None else path
             try:
+                if opener is not None:
+                    src = CoalescedReadFile(
+                        opener(path), ctx.conf.get(PARQUET_MAX_OVER_READ_SIZE)
+                    )
+                else:
+                    src = path
                 with ctx.metrics.timer("io_time"):
                     pf = pq.ParquetFile(src)
-            except (OSError, pa.ArrowInvalid) as e:
+            except (OSError, pa.ArrowInvalid):
                 # IGNORE_CORRUPTED_FILES (conf.rs:37 analog): skip bad inputs
                 if tolerate:
                     ctx.metrics.add("corrupted_files_skipped", 1)
                     continue
                 raise
-            # row-group pruning via statistics happens inside
-            # pyarrow when reading with filters through dataset; for
-            # ParquetFile we read row groups and post-filter via the same
-            # expression (exactness is guaranteed by FilterExec upstream).
-            for rg_batch in pf.iter_batches(batch_size=bs, columns=cols):
+            md = pf.metadata
+            name_to_idx = {
+                md.row_group(0).column(j).path_in_schema: j
+                for j in range(md.num_columns)
+            } if md.num_row_groups else {}
+            ctx.metrics.add("row_groups_total", md.num_row_groups)
+
+            for rg in range(md.num_row_groups):
                 ctx.check_cancelled()
-                tbl = pa.Table.from_batches([rg_batch])
+                md_rg = md.row_group(rg)
+                # 1) statistics pruning BEFORE any decode
+                if preds:
+                    stats = _rg_stats(md_rg, name_to_idx)
+                    if any(
+                        _pred_false_for_stats(p, self.schema, stats) for p in preds
+                    ):
+                        ctx.metrics.add("row_groups_pruned", 1)
+                        continue
+                # 2) late materialization: decode only the predicate
+                #    columns; a provably-empty group skips the wide decode
+                #    (dictionary/page-check analog at row-group granularity)
+                if late_enabled and pred_names:
+                    with ctx.metrics.timer("pruning_time"):
+                        ptbl = pf.read_row_group(rg, columns=pred_names)
+                        if ptbl.filter(filt).num_rows == 0:
+                            # count the probe only when it's all we read:
+                            # surviving groups count the full decode below
+                            ctx.metrics.add("bytes_scanned", ptbl.nbytes)
+                            ctx.metrics.add("row_groups_pruned_late", 1)
+                            continue
+                with ctx.metrics.timer("io_time"):
+                    tbl = pf.read_row_group(rg, columns=cols)
+                ctx.metrics.add("bytes_scanned", tbl.nbytes)
                 if filt is not None:
                     with ctx.metrics.timer("pruning_time"):
                         tbl = tbl.filter(filt)
-                ctx.metrics.add("bytes_scanned", tbl.nbytes)
                 if tbl.num_rows == 0:
                     continue
-                with ctx.metrics.timer("upload_time"):
-                    yield Batch.from_arrow(tbl.combine_chunks().to_batches()[0])
+                for i in range(0, tbl.num_rows, bs):
+                    chunk = tbl.slice(i, bs).combine_chunks()
+                    if chunk.num_rows:
+                        with ctx.metrics.timer("upload_time"):
+                            yield Batch.from_arrow(chunk.to_batches()[0])
+            if isinstance(src, CoalescedReadFile):
+                ctx.metrics.add("fs_raw_reads", src.raw_reads)
+                ctx.metrics.add("fs_bytes_fetched", src.bytes_fetched)
 
 
 class OrcScanExec(ExecOperator):

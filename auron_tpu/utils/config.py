@@ -198,6 +198,11 @@ PARQUET_MAX_OVER_READ_SIZE = int_conf(
     "parquet.max.over.read.size", 16 << 20, "scan",
     "read coalescing window for remote-FS parquet reads (conf.rs:44)",
 )
+PARQUET_LATE_MATERIALIZATION = bool_conf(
+    "parquet.late.materialization", True, "scan",
+    "decode predicate columns first and skip the wide decode for row "
+    "groups with zero matches (page/dictionary-check analog)",
+)
 CASE_SENSITIVE = bool_conf("case.sensitive", False, "sql", "identifier resolution")
 UDF_FALLBACK_ENABLE = bool_conf(
     "udf.fallback.enable", True, "expr",

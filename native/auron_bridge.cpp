@@ -20,6 +20,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <deque>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -37,6 +38,12 @@ static thread_local std::string tl_error;
 static std::mutex g_buf_mutex;
 static std::unordered_map<int64_t, std::string> g_batch_buf;
 static std::unordered_map<int64_t, std::string> g_metrics_buf;
+/* handles are never reused, so metrics buffers need bounded retention:
+ * oldest entries (beyond what any sane host still references) drop first */
+static std::deque<int64_t> g_metrics_order;
+static const size_t kMaxMetricsBufs = 64;
+/* init failure message; immutable after call_once, readable by any thread */
+static std::string g_init_error;
 
 static void capture_python_error() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
@@ -70,7 +77,10 @@ static void init_interpreter() {
       "_root = os.environ.get('AURON_TPU_ROOT') or os.getcwd()\n"
       "sys.path.insert(0, _root)\n");
   g_api = PyImport_ImportModule("auron_tpu.bridge.api");
-  if (g_api == nullptr) capture_python_error();
+  if (g_api == nullptr) {
+    capture_python_error();
+    g_init_error = tl_error;
+  }
 
   if (was_initialized) {
     PyGILState_Release(st);
@@ -83,7 +93,11 @@ static void init_interpreter() {
 
 static bool ensure_init() {
   std::call_once(g_init_once, init_interpreter);
-  return g_api != nullptr;
+  if (g_api == nullptr) {
+    tl_error = g_init_error; /* visible from every calling thread */
+    return false;
+  }
+  return true;
 }
 
 extern "C" {
@@ -155,6 +169,13 @@ int auron_finalize_native(auron_task_handle h, const uint8_t** metrics_json,
     if (PyBytes_AsStringAndSize(res, &buf, &n) == 0) {
       std::lock_guard<std::mutex> lk(g_buf_mutex);
       g_batch_buf.erase(h); /* stream is over */
+      if (g_metrics_buf.find(h) == g_metrics_buf.end()) {
+        g_metrics_order.push_back(h);
+        while (g_metrics_order.size() > kMaxMetricsBufs) {
+          g_metrics_buf.erase(g_metrics_order.front());
+          g_metrics_order.pop_front();
+        }
+      }
       std::string& slot = g_metrics_buf[h];
       slot.assign(buf, static_cast<size_t>(n));
       if (metrics_json != nullptr) {
@@ -186,6 +207,7 @@ void auron_on_exit(void) {
   std::lock_guard<std::mutex> lk(g_buf_mutex);
   g_batch_buf.clear();
   g_metrics_buf.clear();
+  g_metrics_order.clear();
 }
 
 int auron_put_resource(const char* key, const uint8_t* value, size_t len) {

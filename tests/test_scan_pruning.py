@@ -1,0 +1,143 @@
+"""Parquet scan pruning: row-group statistics + late materialization +
+coalesced remote reads (VERDICT r1 item 6; reference parquet_exec.rs:172-197,
+scan/internal_file_reader.rs:47-52)."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exec.scan import CoalescedReadFile, ParquetScanExec
+from auron_tpu.exprs.ir import BinaryOp, col, lit
+
+
+@pytest.fixture(scope="module")
+def pq_file(tmp_path_factory):
+    """4 row groups with disjoint k ranges (sorted -> tight stats)."""
+    path = str(tmp_path_factory.mktemp("scan") / "t.parquet")
+    n = 4000
+    df = pd.DataFrame(
+        {
+            "k": np.arange(n, dtype=np.int64),
+            "v": (np.arange(n, dtype=np.int64) % 100) * 2,  # evens 0..198
+            "s": [f"val_{i % 50}" for i in range(n)],
+        }
+    )
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path,
+                   row_group_size=1000)
+    return path, df
+
+
+def _scan(path, preds, **conf):
+    schema = T.Schema.of(
+        T.Field("k", T.INT64), T.Field("v", T.INT64), T.Field("s", T.STRING)
+    )
+    op = ParquetScanExec(schema, [path], preds)
+    ctx = ExecutionContext()
+    for k, v in conf.items():
+        ctx.conf.set(k, v)
+    batches = list(op.execute(0, ctx))
+    rows = []
+    for b in batches:
+        rows.extend(b.to_arrow().to_pylist())
+    return rows, ctx.metrics.snapshot()["values"]
+
+
+def test_row_group_stats_pruning(pq_file):
+    path, df = pq_file
+    # k in [1200, 1800): only row group 1 (rows 1000-2000) can match
+    preds = [BinaryOp("and", BinaryOp("gteq", col(0), lit(1200)),
+                      BinaryOp("lt", col(0), lit(1800)))]
+    rows, m = _scan(path, preds)
+    want = df[(df.k >= 1200) & (df.k < 1800)]
+    assert len(rows) == len(want)
+    assert m["row_groups_total"] == 4
+    assert m["row_groups_pruned"] == 3  # decoded only 1 of 4 groups
+    # bytes_scanned drops vs an unpruned scan
+    _, m_full = _scan(path, [])
+    assert m["bytes_scanned"] < m_full["bytes_scanned"] / 2
+
+
+def test_late_materialization_prunes_stat_blind_groups(pq_file):
+    path, df = pq_file
+    # v == 51 is inside every group's stats range [0, 198] but absent
+    # (v is always even) -> stats can't prune; the pre-scan must
+    preds = [BinaryOp("eq", col(1), lit(51))]
+    rows, m = _scan(path, preds)
+    assert rows == []
+    assert m.get("row_groups_pruned", 0) == 0
+    assert m["row_groups_pruned_late"] == 4
+    # only the narrow predicate column was decoded
+    _, m_full = _scan(path, [])
+    assert m["bytes_scanned"] < m_full["bytes_scanned"] / 3
+
+    # disabling the conf goes back to wide decode (still correct)
+    rows2, m2 = _scan(path, preds, **{"parquet.late.materialization": False})
+    assert rows2 == []
+    assert m2.get("row_groups_pruned_late", 0) == 0
+
+
+def test_pruned_scan_matches_exact_filter(pq_file):
+    path, df = pq_file
+    preds = [BinaryOp("and", BinaryOp("gt", col(0), lit(2500)),
+                      BinaryOp("eq", col(1), lit(14)))]
+    rows, m = _scan(path, preds)
+    want = df[(df.k > 2500) & (df.v == 14)]
+    assert [r["k"] for r in rows] == want.k.tolist()
+    assert m["row_groups_pruned"] >= 2
+
+
+def test_coalesced_reader_through_opener(pq_file):
+    path, df = pq_file
+
+    class CountingRaw(io.FileIO):
+        reads = 0
+
+        def read(self, n=-1):
+            CountingRaw.reads += 1
+            return super().read(n)
+
+    schema = T.Schema.of(
+        T.Field("k", T.INT64), T.Field("v", T.INT64), T.Field("s", T.STRING)
+    )
+    op = ParquetScanExec(
+        schema, [path],
+        [BinaryOp("lt", col(0), lit(500))],
+        fs_resource_id="fs",
+    )
+    ctx = ExecutionContext(resources={"fs": lambda p: CountingRaw(p, "rb")})
+    rows = []
+    for b in op.execute(0, ctx):
+        rows.extend(b.to_arrow().to_pylist())
+    assert len(rows) == 500
+    m = ctx.metrics.snapshot()["values"]
+    # the whole file fits one over-read window: a handful of raw reads
+    assert m["fs_raw_reads"] <= 4, m
+    assert CountingRaw.reads <= 4
+    assert m["row_groups_pruned"] == 3
+
+
+def test_all_null_group_pruned_by_isnotnull(tmp_path):
+    from auron_tpu.exprs.ir import IsNotNull
+
+    path = str(tmp_path / "nulls.parquet")
+    tbl = pa.table({"a": pa.array([None] * 100 + list(range(100)), pa.int64())})
+    pq.write_table(tbl, path, row_group_size=100)
+    schema = T.Schema.of(T.Field("a", T.INT64))
+    rows, m = _scan_one(path, schema, [IsNotNull(col(0))])
+    assert len(rows) == 100
+    assert m["row_groups_pruned"] == 1
+
+
+def _scan_one(path, schema, preds):
+    op = ParquetScanExec(schema, [path], preds)
+    ctx = ExecutionContext()
+    rows = []
+    for b in op.execute(0, ctx):
+        rows.extend(b.to_arrow().to_pylist())
+    return rows, ctx.metrics.snapshot()["values"]
