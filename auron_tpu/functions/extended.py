@@ -661,3 +661,261 @@ _dict_value_transform(
     lambda e: max((x for x in e if x is not None), default=None),
     lambda dts: dts[0].inner[0],
 )
+
+
+# ---------------------------------------------------------------------------
+# function long tail (VERDICT r1 item 7): regexp family, hex/base64, conv,
+# hash functions in SQL form, parse_json, map_from_entries
+# (reference checklist: datafusion-ext-functions/src/lib.rs:28-100 +
+# spark_strings.rs / spark_hash.rs / spark_get_json_object.rs)
+# ---------------------------------------------------------------------------
+
+import base64 as _b64
+import re as _re
+
+
+def _java_regex(p: str):
+    """Java-flavored pattern -> python re (close subset; documented gap:
+    possessive quantifiers and \\p{...} unicode classes)."""
+    return _re.compile(p)
+
+
+def _rlike(s: str, p: str) -> bool:
+    return _java_regex(p).search(s) is not None
+
+
+def _regexp_extract(s: str, p: str, idx=1):
+    m = _java_regex(p).search(s)
+    if m is None:
+        return ""  # Spark: no match -> empty string (nulls handled outside)
+    idx = int(idx)
+    if idx < 0 or idx > (m.re.groups or 0):
+        return None  # invalid group index -> NULL (ANSI-off analog)
+    g = m.group(idx)
+    return g if g is not None else ""
+
+
+def _java_replacement(r: str) -> str:
+    """Java Matcher replacement -> python re template: $N / $0 become
+    \g<N> (octal-safe), java backslash escapes the next char literally."""
+    out: list[str] = []
+    i, n = 0, len(r)
+    while i < n:
+        c = r[i]
+        if c == "\\":
+            if i + 1 < n:
+                nxt = r[i + 1]
+                out.append("\\\\" if nxt == "\\" else nxt)
+                i += 2
+                continue
+            out.append("\\\\")
+            i += 1
+            continue
+        if c == "$" and i + 1 < n and r[i + 1].isdigit():
+            j = i + 1
+            while j < n and r[j].isdigit():
+                j += 1
+            out.append(f"\\g<{r[i + 1 : j]}>")
+            i = j
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _regexp_replace(s: str, p: str, r: str) -> str:
+    return _java_regex(p).sub(_java_replacement(r), s)
+
+
+# regex patterns/replacements are foldable in Spark plans, so these run as
+# O(|dict|) dictionary transforms (module policy), not per-row host calls
+_dict_transform(
+    "rlike",
+    lambda s, p: None if p is None else _rlike(s, p),
+    T.BOOL,
+)
+_dict_transform(
+    "regexp_extract",
+    lambda s, p, idx=1: (
+        None if p is None or idx is None else _regexp_extract(s, p, idx)
+    ),
+    T.STRING,
+)
+_dict_transform(
+    "regexp_replace",
+    lambda s, p, r: (
+        None if p is None or r is None else _regexp_replace(s, p, r)
+    ),
+    T.STRING,
+)
+
+
+@registry.register("hex", T.STRING)
+def _hex(args, cap):
+    from auron_tpu.functions.registry import dict_apply
+
+    a = args[0]
+    if a.dtype.is_string_like:
+        return dict_apply(
+            a, cap,
+            lambda s: (s.encode("utf-8") if isinstance(s, str) else s).hex().upper(),
+            T.STRING,
+        )
+    # integral: uppercase hex of the unsigned 64-bit two's complement
+    v = a.values.astype(jnp.int64)
+    host = np.asarray(jax.device_get(v)).astype(np.uint64)
+    mask = np.asarray(jax.device_get(a.validity))
+    ss = [format(int(x), "X") for x in host]
+    arr = pa.array([s if m else None for s, m in zip(ss, mask)], type=pa.string())
+    from auron_tpu.columnar.batch import _arrow_to_device
+
+    vv, mm, d = _arrow_to_device(arr, T.STRING, cap)
+    return _cv(vv, mm, T.STRING, d)
+
+
+def _unhex(s: str):
+    if len(s) % 2:
+        s = "0" + s  # Spark pads odd-length inputs
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        return None
+
+
+_dict_transform("unhex", _unhex, T.BINARY)
+_dict_transform(
+    "base64",
+    lambda s: _b64.b64encode(s.encode("utf-8") if isinstance(s, str) else s).decode(),
+    T.STRING,
+)
+
+
+def _unbase64(s: str):
+    try:
+        return _b64.b64decode(s, validate=False)
+    except Exception:
+        return None
+
+
+_dict_transform("unbase64", _unbase64, T.BINARY)
+
+_CONV_DIGITS = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+def _conv(num: str, from_base: int, to_base: int):
+    """Hive/Spark conv(): parse leading valid digits, unsigned 64-bit
+    wraparound for negative values when to_base > 0."""
+    fb, tb = int(from_base), int(to_base)
+    if not (2 <= abs(fb) <= 36 and 2 <= abs(tb) <= 36):
+        return None
+    s = num.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    val = 0
+    seen = False
+    overflow = False
+    bound = (1 << 64) - 1
+    for ch in s.upper():
+        d = _CONV_DIGITS.find(ch)
+        if d < 0 or d >= abs(fb):
+            break
+        val = val * abs(fb) + d
+        if val > bound:
+            overflow = True  # Hive clamps to unsigned max, never wraps
+        seen = True
+    if not seen:
+        return "0" if s else None
+    if overflow:
+        val = bound
+        neg = False
+    if neg:
+        val = -val
+    if tb > 0:
+        val &= (1 << 64) - 1  # two's complement unsigned view
+        if val == 0:
+            return "0"
+        out = []
+        while val:
+            out.append(_CONV_DIGITS[val % tb])
+            val //= tb
+        return "".join(reversed(out))
+    # negative to_base: signed output
+    tb = -tb
+    if val == 0:
+        return "0"
+    sign = "-" if val < 0 else ""
+    val = abs(val)
+    out = []
+    while val:
+        out.append(_CONV_DIGITS[val % tb])
+        val //= tb
+    return sign + "".join(reversed(out))
+
+
+_dict_transform(
+    "conv",
+    lambda n, f, t: None if f is None or t is None else _conv(n, f, t),
+    T.STRING,
+)
+
+
+def _register_hash_fn(name: str, algo: str, out_t):
+    @registry.register(name, out_t)
+    def _f(args, cap, algo=algo, out_t=out_t):
+        from auron_tpu.exec.basic import batch_from_columns
+        from auron_tpu.ops.hash_dispatch import hash_batch
+
+        sel = jnp.ones(cap, bool)
+        kb = batch_from_columns(list(args), [f"c{i}" for i in range(len(args))], sel)
+        seed = 42
+        h = hash_batch(kb, list(range(len(args))), algo, seed=seed)
+        return _cv(h, jnp.ones(cap, bool), out_t)
+
+    return _f
+
+
+# Spark: hash() == murmur3 (int32 result), xxhash64() (int64), both never null
+_register_hash_fn("hash", "murmur3", T.INT32)
+_register_hash_fn("murmur3_hash", "murmur3", T.INT32)
+_register_hash_fn("xxhash64", "xxhash64", T.INT64)
+
+
+def _canon_json(s: str):
+    try:
+        return json.dumps(json.loads(s), separators=(",", ":"))
+    except (ValueError, TypeError):
+        return None
+
+
+_dict_transform("parse_json", _canon_json, T.STRING)
+
+
+@registry.register("get_parsed_json_object", T.STRING)
+def _get_parsed_json_object(args, cap):
+    # parsed representation == canonical JSON string; same path semantics
+    return registry.dispatch("get_json_object", args, cap)
+
+
+def _entry_kv(e):
+    if e is None:
+        # Spark 3.x: runtime error, not a silent null
+        raise ValueError("map_from_entries does not allow null entries")
+    if isinstance(e, (list, tuple)):
+        return {"key": e[0], "value": e[1]}
+    return {"key": e["key"], "value": e["value"]}
+
+
+_host_rowwise(
+    "map_from_entries",
+    lambda entries: (
+        None if entries is None else [_entry_kv(e) for e in entries]
+    ),
+    lambda dts: T.DataType(
+        T.TypeKind.MAP,
+        inner=(
+            dts[0].inner[0].inner[0] if dts and dts[0].inner else T.STRING,
+            dts[0].inner[0].inner[1] if dts and dts[0].inner else T.STRING,
+        ),
+    ),
+)

@@ -351,32 +351,47 @@ def _scalar_arg(cv):
     return np.asarray(cv.values)[0].item()
 
 
+def dict_apply(a, cap, py_fn, out_dtype, extra=()):
+    """Apply a per-value transform over a dict-encoded column's dictionary
+    (O(|dict|) host work, device gathers only)."""
+    entries = a.dict.to_pylist()
+    if out_dtype.is_string_like:
+        is_bin = out_dtype.kind == T.TypeKind.BINARY
+        filler = b"" if is_bin else ""
+        new_entries = [py_fn(s, *extra) if s is not None else None for s in entries]
+        vocab: dict = {}
+        remap = np.empty(len(new_entries), dtype=np.int32)
+        ok_np = np.empty(len(new_entries), dtype=bool)
+        for i, s in enumerate(new_entries):
+            ok_np[i] = s is not None
+            remap[i] = vocab.setdefault(s if s is not None else filler, len(vocab))
+        d = pa.array(
+            list(vocab.keys()) or [filler],
+            type=pa.binary() if is_bin else pa.string(),
+        )
+        idx = jnp.clip(a.values, 0, len(remap) - 1)
+        codes = jnp.asarray(remap)[idx]
+        valid = a.validity & jnp.asarray(ok_np)[idx]
+        return _cv(codes, valid, out_dtype, d)
+    new_vals = [py_fn(s, *extra) if s is not None else None for s in entries]
+    vals = np.array(
+        [v if v is not None else 0 for v in new_vals],
+        dtype=np.dtype(out_dtype.physical_dtype().name),
+    )
+    ok = np.array([v is not None for v in new_vals], dtype=bool)
+    idx = jnp.clip(a.values, 0, len(vals) - 1)
+    v = jnp.asarray(vals)[idx]
+    valid = a.validity & jnp.asarray(ok)[idx]
+    return _cv(v, valid, out_dtype)
+
+
 def _dict_transform(name: str, py_fn, out_dtype=T.STRING):
     @registry.register(name, out_dtype)
     def _f(args, cap, py_fn=py_fn, out_dtype=out_dtype):
         a = args[0]
         assert a.dtype.is_string_like, f"{name} needs a string arg"
         extra = [_scalar_arg(x) for x in args[1:]]
-        entries = a.dict.to_pylist()
-        if out_dtype.is_string_like:
-            new_entries = [py_fn(s, *extra) if s is not None else None for s in entries]
-            vocab: dict = {}
-            remap = np.empty(len(new_entries), dtype=np.int32)
-            ok_np = np.empty(len(new_entries), dtype=bool)
-            for i, s in enumerate(new_entries):
-                ok_np[i] = s is not None
-                remap[i] = vocab.setdefault(s if s is not None else "", len(vocab))
-            d = pa.array(list(vocab.keys()) or [""], type=pa.string())
-            idx = jnp.clip(a.values, 0, len(remap) - 1)
-            codes = jnp.asarray(remap)[idx]
-            valid = a.validity & jnp.asarray(ok_np)[idx]
-            return _cv(codes, valid, out_dtype, d)
-        vals = np.array(
-            [py_fn(s, *extra) if s is not None else 0 for s in entries],
-            dtype=np.dtype(out_dtype.physical_dtype().name),
-        )
-        v = jnp.asarray(vals)[jnp.clip(a.values, 0, len(vals) - 1)]
-        return _cv(v, a.validity, out_dtype)
+        return dict_apply(a, cap, py_fn, out_dtype, extra)
 
     return _f
 
