@@ -695,9 +695,11 @@ def _regexp_extract(s: str, p: str, idx=1):
     return g if g is not None else ""
 
 
-def _java_replacement(r: str) -> str:
-    """Java Matcher replacement -> python re template: $N / $0 become
-    \g<N> (octal-safe), java backslash escapes the next char literally."""
+def _java_replacement(r: str, n_groups: int) -> str:
+    r"""Java Matcher replacement -> python re template: $N becomes
+    \g<N> (octal-safe). Java takes the LONGEST group number that is a
+    valid group of the pattern ($12 with one group = group 1 + literal
+    '2'); backslash escapes the next char literally."""
     out: list[str] = []
     i, n = 0, len(r)
     while i < n:
@@ -712,9 +714,16 @@ def _java_replacement(r: str) -> str:
             i += 1
             continue
         if c == "$" and i + 1 < n and r[i + 1].isdigit():
+            # greedy longest VALID group number (Matcher.appendReplacement)
             j = i + 1
-            while j < n and r[j].isdigit():
+            while (
+                j < n
+                and r[j].isdigit()
+                and int(r[i + 1 : j + 1]) <= max(n_groups, 0)
+            ):
                 j += 1
+            if j == i + 1:  # first digit already exceeds the group count
+                j = i + 2   # Java errors here; degrade to that single digit
             out.append(f"\\g<{r[i + 1 : j]}>")
             i = j
             continue
@@ -724,7 +733,8 @@ def _java_replacement(r: str) -> str:
 
 
 def _regexp_replace(s: str, p: str, r: str) -> str:
-    return _java_regex(p).sub(_java_replacement(r), s)
+    rx = _java_regex(p)
+    return rx.sub(_java_replacement(r, rx.groups), s)
 
 
 # regex patterns/replacements are foldable in Spark plans, so these run as
@@ -757,7 +767,7 @@ def _hex(args, cap):
     a = args[0]
     if a.dtype.is_string_like:
         return dict_apply(
-            a, cap,
+            a,
             lambda s: (s.encode("utf-8") if isinstance(s, str) else s).hex().upper(),
             T.STRING,
         )
@@ -827,29 +837,31 @@ def _conv(num: str, from_base: int, to_base: int):
     if not seen:
         return "0" if s else None
     if overflow:
-        val = bound
+        val = bound  # Hive clamps to unsigned max (signed view: -1)
         neg = False
     if neg:
         val = -val
+    u = val & bound  # the 64-bit two's complement image
     if tb > 0:
-        val &= (1 << 64) - 1  # two's complement unsigned view
-        if val == 0:
+        # positive to_base: unsigned view
+        if u == 0:
             return "0"
         out = []
-        while val:
-            out.append(_CONV_DIGITS[val % tb])
-            val //= tb
+        while u:
+            out.append(_CONV_DIGITS[u % tb])
+            u //= tb
         return "".join(reversed(out))
-    # negative to_base: signed output
+    # negative to_base: SIGNED reinterpretation of the 64-bit image
     tb = -tb
-    if val == 0:
+    sv = u - (1 << 64) if u >= (1 << 63) else u
+    if sv == 0:
         return "0"
-    sign = "-" if val < 0 else ""
-    val = abs(val)
+    sign = "-" if sv < 0 else ""
+    sv = abs(sv)
     out = []
-    while val:
-        out.append(_CONV_DIGITS[val % tb])
-        val //= tb
+    while sv:
+        out.append(_CONV_DIGITS[sv % tb])
+        sv //= tb
     return sign + "".join(reversed(out))
 
 

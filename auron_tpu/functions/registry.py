@@ -351,7 +351,7 @@ def _scalar_arg(cv):
     return np.asarray(cv.values)[0].item()
 
 
-def dict_apply(a, cap, py_fn, out_dtype, extra=()):
+def dict_apply(a, py_fn, out_dtype, extra=()):
     """Apply a per-value transform over a dict-encoded column's dictionary
     (O(|dict|) host work, device gathers only)."""
     entries = a.dict.to_pylist()
@@ -391,7 +391,7 @@ def _dict_transform(name: str, py_fn, out_dtype=T.STRING):
         a = args[0]
         assert a.dtype.is_string_like, f"{name} needs a string arg"
         extra = [_scalar_arg(x) for x in args[1:]]
-        return dict_apply(a, cap, py_fn, out_dtype, extra)
+        return dict_apply(a, py_fn, out_dtype, extra)
 
     return _f
 

@@ -66,6 +66,7 @@ def generate(sf: float = 0.01, seed: int = 42) -> TpcdsData:
         }
     )
 
+    tag_pool = np.array(["new", "sale", "clearance", "eco", "import", "bulk"])
     item = pd.DataFrame(
         {
             "i_item_sk": np.arange(1, n_items + 1, dtype=np.int64),
@@ -74,6 +75,12 @@ def generate(sf: float = 0.01, seed: int = 42) -> TpcdsData:
             "i_category": rng.choice(
                 ["Books", "Home", "Electronics", "Music", "Sports"], n_items
             ),
+            # comma-joined tag list (appended last: earlier pipelines index
+            # item columns positionally)
+            "i_tags": [
+                ",".join(rng.choice(tag_pool, rng.integers(1, 4), replace=False))
+                for _ in range(n_items)
+            ],
         }
     )
 
@@ -205,6 +212,7 @@ def run_q3_class(
     WHERE d_moy = <moy> AND i_category_id = <cat>
     GROUP BY d_year, i_brand_id ORDER BY d_year, s DESC LIMIT <k>."""
     work = work_dir or tempfile.mkdtemp(prefix="auron_q3_")
+    os.makedirs(work, exist_ok=True)
     fact_schema = _schema_of(data.store_sales)
     dd_schema = _schema_of(data.date_dim)
     it_schema = _schema_of(data.item)
@@ -323,6 +331,7 @@ def run_q72_class(
     both sides hash-shuffled on the join keys, reduce tasks sort and
     sort-merge join their co-partitioned slices, then aggregate."""
     work = work_dir or tempfile.mkdtemp(prefix="auron_q72_")
+    os.makedirs(work, exist_ok=True)
     # second "fact" = a shifted resample of store_sales (same schema)
     rng = np.random.default_rng(7)
     sr = data.store_sales.sample(frac=0.5, random_state=3).reset_index(drop=True)
@@ -409,6 +418,7 @@ def run_q95_class(
     category 1 but never in category 2 — semi join then anti join over
     shuffled co-partitioned inputs, then count per customer."""
     work = work_dir or tempfile.mkdtemp(prefix="auron_q95_")
+    os.makedirs(work, exist_ok=True)
     fact_schema = _schema_of(data.store_sales)
     it_schema = _schema_of(data.item)
 
@@ -536,3 +546,389 @@ def _agg_inter_schema(agg_plan) -> T.Schema:
 
     op = plan_from_proto(agg_plan)
     return op.inter_schema
+
+
+# ---------------------------------------------------------------------------
+# q6-class: broadcast of a COMPUTED aggregate + join condition
+# ---------------------------------------------------------------------------
+
+
+def run_q6_class(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
+    """SELECT d_year, count(*) FROM fact JOIN date JOIN item
+       JOIN (SELECT i_category_id, avg(price) cat_avg
+             FROM fact JOIN item GROUP BY i_category_id) ca
+         ON item.i_category_id = ca.i_category_id
+    WHERE price > 1.2 * cat_avg GROUP BY d_year — the q6 shape: an
+    aggregate computed in stage A is broadcast into stage B's join with a
+    residual condition."""
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    it_schema = _schema_of(data.item)
+    fact_parts = to_batches(data.store_sales, n_partitions)
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+
+    api.put_resource("q6_fact", fact_parts)
+    api.put_resource("q6_dd", [dd] * n_partitions)
+    api.put_resource("q6_item", [it] * n_partitions)
+    try:
+        # ---- stage A: per-category avg price (collected to the driver,
+        # rebroadcast — NativeBroadcastExchange collect analog)
+        scan = B.memory_scan(fact_schema, "q6_fact")
+        iscan = B.memory_scan(it_schema, "q6_item")
+        j = B.hash_join(scan, iscan, [col(1)], [col(0)], "inner",
+                        build_side="right", cached_build_id="q6_itA_b")
+        proj = B.project(j, [(col(7), "cat"), (col(4), "price")])
+        partial = B.hash_agg(proj, [(col(0), "cat")],
+                             [("avg", col(1), "cat_avg")], "partial")
+        frames = []
+        for p in range(n_partitions):
+            h = api.call_native(B.task(partial, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(Batch.from_arrow(rb))
+            api.finalize_native(h)
+        api.put_resource("q6_inter", [frames])
+        final = B.hash_agg(
+            B.memory_scan(_agg_inter_schema(partial), "q6_inter"),
+            [(col(0), "cat")], [("avg", col(1), "cat_avg")], "final",
+        )
+        h = api.call_native(B.task(final).SerializeToString())
+        cat_avg_batches = []
+        while (rb := api.next_batch(h)) is not None:
+            cat_avg_batches.append(Batch.from_arrow(rb))
+        api.finalize_native(h)
+        api.put_resource("q6_catavg", [cat_avg_batches] * n_partitions)
+        ca_schema = T.Schema.of(
+            T.Field("cat", T.INT32), T.Field("cat_avg", T.FLOAT64)
+        )
+
+        # ---- stage B: fact joins with the broadcast averages + condition
+        dscan = B.memory_scan(dd_schema, "q6_dd")
+        ca_scan = B.memory_scan(ca_schema, "q6_catavg")
+        j1 = B.hash_join(scan, dscan, [col(0)], [col(0)], "inner",
+                         build_side="right", cached_build_id="q6_dd_b")
+        j2 = B.hash_join(j1, iscan, [col(1)], [col(0)], "inner",
+                         build_side="right", cached_build_id="q6_it_b")
+        # fact(5)+date(3)+item(5): price at 4, d_year 6, i_category_id 10
+        j3 = B.hash_join(
+            j2, ca_scan, [col(10)], [col(0)], "inner", build_side="right",
+            condition=BinaryOp(
+                "gt", col(4),
+                BinaryOp("mul", lit(1.2), col(14)),  # cat_avg after concat
+            ),
+            cached_build_id="q6_ca_b",
+        )
+        agg_p = B.hash_agg(B.project(j3, [(col(6), "d_year")]),
+                           [(col(0), "d_year")],
+                           [("count_star", None, "cnt")], "partial")
+        agg_f = B.hash_agg(agg_p, [(col(0), "d_year")],
+                           [("count_star", None, "cnt")], "final")
+        from auron_tpu.plan.optimizer import prune_columns
+
+        agg_f = prune_columns(agg_f)
+        frames = []
+        for p in range(n_partitions):
+            h = api.call_native(B.task(agg_f, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
+            api.finalize_native(h)
+        out = pd.concat(frames).groupby("d_year").agg(cnt=("cnt", "sum")).reset_index()
+        return out.sort_values("d_year").reset_index(drop=True)
+    finally:
+        for k in ("q6_fact", "q6_dd", "q6_item", "q6_inter", "q6_catavg",
+                  "q6_dd_b", "q6_it_b", "q6_ca_b", "q6_itA_b"):
+            api.remove_resource(k)
+
+
+def q6_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    m = data.store_sales.merge(data.item, left_on="ss_item_sk", right_on="i_item_sk")
+    ca = m.groupby("i_category_id")["ss_ext_sales_price"].mean().rename("cat_avg")
+    m2 = (
+        data.store_sales
+        .merge(data.date_dim, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(data.item, left_on="ss_item_sk", right_on="i_item_sk")
+        .join(ca, on="i_category_id")
+    )
+    keep = m2[m2.ss_ext_sales_price > 1.2 * m2.cat_avg]
+    return (
+        keep.groupby("d_year").size().reset_index(name="cnt")
+        .sort_values("d_year").reset_index(drop=True)
+    )
+
+
+# ---------------------------------------------------------------------------
+# q18-class: agg-heavy (many aggregates, multi-key grouping, shuffled)
+# ---------------------------------------------------------------------------
+
+
+def run_q18_class(
+    data: TpcdsData, n_map: int = 2, n_reduce: int = 2,
+    work_dir: str | None = None,
+) -> pd.DataFrame:
+    """SELECT i_category_id, d_year, avg(qty), avg(price), sum(price),
+    count(*) FROM fact JOIN date JOIN item GROUP BY i_category_id, d_year
+    — the agg-heavy q18 shape with a real file shuffle between stages."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q18_")
+    os.makedirs(work, exist_ok=True)
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    it_schema = _schema_of(data.item)
+    fact_parts = to_batches(data.store_sales, n_map)
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    api.put_resource("q18_fact", fact_parts)
+    api.put_resource("q18_dd", [dd] * n_map)
+    api.put_resource("q18_item", [it] * n_map)
+    try:
+        scan = B.memory_scan(fact_schema, "q18_fact")
+        j1 = B.hash_join(scan, B.memory_scan(dd_schema, "q18_dd"),
+                         [col(0)], [col(0)], "inner", build_side="right",
+                         cached_build_id="q18_dd_b")
+        j2 = B.hash_join(j1, B.memory_scan(it_schema, "q18_item"),
+                         [col(1)], [col(0)], "inner", build_side="right",
+                         cached_build_id="q18_it_b")
+        proj = B.project(j2, [(col(10), "cat"), (col(6), "d_year"),
+                              (col(3), "qty"), (col(4), "price")])
+        aggs = [("avg", col(2), "q_avg"), ("avg", col(3), "p_avg"),
+                ("sum", col(3), "p_sum"), ("count_star", None, "cnt")]
+        partial = B.hash_agg(proj, [(col(0), "cat"), (col(1), "d_year")],
+                             aggs, "partial")
+        from auron_tpu.plan.optimizer import prune_columns
+
+        partial = prune_columns(partial)
+        part = B.hash_partitioning([col(0), col(1)], n_reduce)
+        pairs = []
+        handles = []
+        for p in range(n_map):
+            d = os.path.join(work, f"q18_{p}.data")
+            i = os.path.join(work, f"q18_{p}.index")
+            handles.append(api.call_native(
+                B.task(B.shuffle_writer(partial, part, d, i),
+                       stage_id=1, partition_id=p).SerializeToString()))
+            pairs.append((d, i))
+        for h in handles:
+            while api.next_batch(h) is not None:
+                pass
+            api.finalize_native(h)
+        api.put_resource("q18_blocks", MultiMapBlockProvider(pairs))
+        final = B.hash_agg(
+            B.ipc_reader(_agg_inter_schema(partial), "q18_blocks"),
+            [(col(0), "cat"), (col(1), "d_year")], aggs, "final",
+        )
+        frames = []
+        for p in range(n_reduce):
+            h = api.call_native(B.task(final, stage_id=2, partition_id=p).SerializeToString())
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
+            api.finalize_native(h)
+        return (
+            pd.concat(frames).sort_values(["cat", "d_year"]).reset_index(drop=True)
+        )
+    finally:
+        for k in ("q18_fact", "q18_dd", "q18_item", "q18_blocks",
+                  "q18_dd_b", "q18_it_b"):
+            api.remove_resource(k)
+
+
+def q18_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    m = (
+        data.store_sales
+        .merge(data.date_dim, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(data.item, left_on="ss_item_sk", right_on="i_item_sk")
+    )
+    g = (
+        m.groupby(["i_category_id", "d_year"])
+        .agg(q_avg=("ss_quantity", "mean"), p_avg=("ss_ext_sales_price", "mean"),
+             p_sum=("ss_ext_sales_price", "sum"), cnt=("ss_item_sk", "size"))
+        .reset_index()
+        .rename(columns={"i_category_id": "cat"})
+    )
+    return g.sort_values(["cat", "d_year"]).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# generate-class: split + explode + aggregate (UDTF-bearing shape)
+# ---------------------------------------------------------------------------
+
+
+def run_generate_class(data: TpcdsData) -> pd.DataFrame:
+    """SELECT tag, count(*) FROM item LATERAL VIEW
+    explode(split(i_tags, ',')) GROUP BY tag."""
+    from auron_tpu.exprs.ir import ScalarFunc
+
+    it_schema = _schema_of(data.item)
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    api.put_resource("qg_item", [it])
+    try:
+        scan = B.memory_scan(it_schema, "qg_item")
+        gen = B.generate(
+            scan, "explode",
+            ScalarFunc("split", (col(4), lit(","))),
+            required_cols=[0], elem_name="tag",
+        )
+        agg = B.hash_agg(gen, [(col(1), "tag")],
+                         [("count_star", None, "cnt")], "partial")
+        agg_f = B.hash_agg(agg, [(col(0), "tag")],
+                           [("count_star", None, "cnt")], "final")
+        h = api.call_native(B.task(agg_f).SerializeToString())
+        frames = []
+        while (rb := api.next_batch(h)) is not None:
+            frames.append(rb.to_pandas())
+        api.finalize_native(h)
+        return pd.concat(frames).sort_values("tag").reset_index(drop=True)
+    finally:
+        api.remove_resource("qg_item")
+
+
+def generate_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    tags = data.item.i_tags.str.split(",").explode()
+    return (
+        tags.value_counts().rename_axis("tag").reset_index(name="cnt")
+        .sort_values("tag").reset_index(drop=True)
+    )
+
+
+# ---------------------------------------------------------------------------
+# windowed2-class: shift (lag) + running aggregate windows
+# ---------------------------------------------------------------------------
+
+
+def run_windowed2_class(data: TpcdsData) -> pd.DataFrame:
+    """Per item ordered by date: lag(price) and a running sum(price) —
+    the shift + running-frame window shape."""
+    # unique (item, date) keys: Spark's default window frame is RANGE
+    # (peer-inclusive) and lag over order ties is nondeterministic, so the
+    # pipeline uses a de-duplicated sample for an exact oracle
+    sample = data.store_sales.iloc[:4000].drop_duplicates(
+        ["ss_item_sk", "ss_sold_date_sk"]
+    ).reset_index(drop=True)
+    fact_schema = _schema_of(sample)
+    api.put_resource("qw2_fact", [[Batch.from_arrow(
+        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    try:
+        w = B.window(
+            B.memory_scan(fact_schema, "qw2_fact"),
+            [col(1)],  # partition by item
+            [(col(0), SortSpec())],  # order by date
+            [("lag", None, col(4), 1, False, "prev_price"),
+             ("agg", "sum", col(4), 1, False, "run_sum")],
+        )
+        h = api.call_native(B.task(w).SerializeToString())
+        frames = []
+        while (rb := api.next_batch(h)) is not None:
+            frames.append(rb.to_pandas())
+        api.finalize_native(h)
+        out = pd.concat(frames)
+        return (
+            out.sort_values(["ss_item_sk", "ss_sold_date_sk"])
+            .reset_index(drop=True)[
+                ["ss_item_sk", "ss_sold_date_sk", "prev_price", "run_sum"]
+            ]
+        )
+    finally:
+        api.remove_resource("qw2_fact")
+
+
+def windowed2_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    sample = data.store_sales.iloc[:4000].drop_duplicates(
+        ["ss_item_sk", "ss_sold_date_sk"]
+    ).reset_index(drop=True).copy()
+    sample = sample.sort_values(
+        ["ss_item_sk", "ss_sold_date_sk"], kind="stable"
+    )
+    g = sample.groupby("ss_item_sk")
+    sample["prev_price"] = g["ss_ext_sales_price"].shift(1)
+    sample["run_sum"] = g["ss_ext_sales_price"].cumsum()
+    return sample.reset_index(drop=True)[
+        ["ss_item_sk", "ss_sold_date_sk", "prev_price", "run_sum"]
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the gate runner (QueryRunner + QueryResultComparator analog)
+# ---------------------------------------------------------------------------
+
+
+def _is_null_scalar(x) -> bool:
+    if isinstance(x, (list, tuple, dict, np.ndarray)):
+        return False
+    try:
+        return bool(pd.isna(x))
+    except (TypeError, ValueError):
+        return False
+
+
+def _cmp_frames(got: pd.DataFrame, want: pd.DataFrame, float_tol=1e-6) -> str | None:
+    """Row-level comparison with double tolerance
+    (QueryResultComparator.scala:39-110 analog). None = match."""
+    if len(got) != len(want):
+        return f"row count {len(got)} != {len(want)}"
+    for c in want.columns:
+        if c not in got.columns:
+            return f"missing column {c}"
+        g, w = got[c].tolist(), want[c].tolist()
+        for i, (a, b) in enumerate(zip(g, w)):
+            a_null = _is_null_scalar(a)
+            b_null = _is_null_scalar(b)
+            if a_null or b_null:
+                if a_null != b_null:
+                    return f"{c}[{i}]: {a!r} != {b!r}"
+                continue
+            if isinstance(b, float):
+                if abs(float(a) - b) > float_tol * max(1.0, abs(b)):
+                    return f"{c}[{i}]: {a!r} != {b!r}"
+            elif a != b:
+                return f"{c}[{i}]: {a!r} != {b!r}"
+    return None
+
+
+def run_gate(sf: float = 0.05, seed: int = 42, verbose: bool = True):
+    """Run every query class with its oracle; returns [(name, ok, error,
+    seconds)]. The single pass/fail gate VERDICT r1 item 8 asks for."""
+    import time as _time
+
+    data = generate(sf=sf, seed=seed)
+    ws = tempfile.mkdtemp(prefix="auron_gate_")
+
+    def _q72():
+        got, sr = run_q72_class(data, work_dir=os.path.join(ws, "q72"))
+        return got, q72_class_oracle(data, sr)
+
+    cases = [
+        ("q1_agg_join", lambda: (run_q1_class(data), q1_class_oracle(data))),
+        ("q3_star_join_topk", lambda: (
+            run_q3_class(data, work_dir=os.path.join(ws, "q3")),
+            q3_class_oracle(data))),
+        ("q6_bcast_avg_condition", lambda: (run_q6_class(data), q6_class_oracle(data))),
+        ("q18_multi_agg_shuffle", lambda: (
+            run_q18_class(data, work_dir=os.path.join(ws, "q18")),
+            q18_class_oracle(data))),
+        ("q72_smj_shuffle", _q72),
+        ("q95_semi_anti", lambda: (
+            run_q95_class(data, work_dir=os.path.join(ws, "q95")),
+            q95_class_oracle(data))),
+        ("window_rank_limit", lambda: (run_windowed_query(data),
+                                       windowed_query_oracle(data))),
+        ("window_lag_runsum", lambda: (run_windowed2_class(data),
+                                       windowed2_class_oracle(data))),
+        ("generate_explode", lambda: (run_generate_class(data),
+                                      generate_class_oracle(data))),
+    ]
+    results = []
+    for name, fn in cases:
+        t0 = _time.perf_counter()
+        try:
+            got, want = fn()
+            err = _cmp_frames(got, want)
+        except Exception as e:  # noqa: BLE001 — the gate reports, not raises
+            err = f"{type(e).__name__}: {e}"
+        results.append((name, err is None, err, _time.perf_counter() - t0))
+    if verbose:
+        width = max(len(n) for n, *_ in results)
+        for name, ok, err, secs in results:
+            mark = "PASS" if ok else "FAIL"
+            line = f"{name:<{width}}  {mark}  {secs:6.2f}s"
+            if err:
+                line += f"  {err}"
+            print(line)
+    return results

@@ -428,3 +428,16 @@ def test_conv_overflow_clamps_to_unsigned_max():
     out = _run({"s": ["10000000000000000FF"]},
                [ScalarFunc("conv", (col(0), lit(16), lit(10)))], ["r"])
     assert out["r"] == ["18446744073709551615"]  # Hive clamp, no wraparound
+
+
+def test_conv_negative_to_base_signed_view():
+    out = _run({"s": ["18446744073709551615", "9223372036854775808"]},
+               [ScalarFunc("conv", (col(0), lit(10), lit(-10)))], ["r"])
+    assert out["r"] == ["-1", "-9223372036854775808"]  # signed 64-bit view
+
+
+def test_regexp_replace_longest_valid_group():
+    out = _run({"s": ["ab"]},
+               [ScalarFunc("regexp_replace", (col(0), lit("(a)"), lit("$12")))],
+               ["r"])
+    assert out["r"] == ["a2b"]  # java: group 1 + literal '2'

@@ -86,3 +86,64 @@ def test_q3_concurrent_maps_with_spills():
     finally:
         tpcds.to_batches = orig
         MemManager.init()  # restore default budget
+
+
+def test_q6_class_matches_oracle(data):
+    got = tpcds.run_q6_class(data)
+    want = tpcds.q6_class_oracle(data)
+    assert tpcds._cmp_frames(got, want) is None
+
+
+def test_q18_class_matches_oracle(data, tmp_path):
+    got = tpcds.run_q18_class(data, work_dir=str(tmp_path))
+    want = tpcds.q18_class_oracle(data)
+    assert tpcds._cmp_frames(got, want) is None
+
+
+def test_generate_class_matches_oracle(data):
+    got = tpcds.run_generate_class(data)
+    want = tpcds.generate_class_oracle(data)
+    assert tpcds._cmp_frames(got, want) is None
+
+
+def test_windowed2_class_matches_oracle(data):
+    got = tpcds.run_windowed2_class(data)
+    want = tpcds.windowed2_class_oracle(data)
+    assert tpcds._cmp_frames(got, want) is None
+
+
+def test_gate_runs_all_classes():
+    """The single-command differential gate (QueryRunner analog): every
+    query class executes and matches its oracle."""
+    res = tpcds.run_gate(sf=0.02, verbose=False)
+    assert len(res) >= 9
+    failures = [(n, e) for n, ok, e, _ in res if not ok]
+    assert not failures, failures
+
+
+def test_q18_plan_stability_golden(data, tmp_path):
+    """Golden explain for the q18 map-stage plan (pruned): native-coverage
+    regressions in the agg+join pipeline fail here."""
+    import os as _os
+
+    from auron_tpu.plan.explain import check_stability
+    from auron_tpu.plan.planner import plan_from_proto
+    from auron_tpu.plan.optimizer import prune_columns
+    from auron_tpu.plan import builders as B
+    from auron_tpu.exprs.ir import col
+
+    fact_schema = tpcds._schema_of(data.store_sales)
+    dd_schema = tpcds._schema_of(data.date_dim)
+    it_schema = tpcds._schema_of(data.item)
+    scan = B.memory_scan(fact_schema, "g_fact")
+    j1 = B.hash_join(scan, B.memory_scan(dd_schema, "g_dd"),
+                     [col(0)], [col(0)], "inner", build_side="right")
+    j2 = B.hash_join(j1, B.memory_scan(it_schema, "g_item"),
+                     [col(1)], [col(0)], "inner", build_side="right")
+    proj = B.project(j2, [(col(10), "cat"), (col(6), "d_year"),
+                          (col(3), "qty"), (col(4), "price")])
+    partial = prune_columns(B.hash_agg(
+        proj, [(col(0), "cat"), (col(1), "d_year")],
+        [("avg", col(2), "q_avg"), ("sum", col(3), "p_sum")], "partial"))
+    golden = _os.path.join(_os.path.dirname(__file__), "goldens", "q18_map_plan.txt")
+    check_stability(plan_from_proto(partial), golden)
