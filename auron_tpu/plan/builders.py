@@ -322,6 +322,27 @@ def ipc_writer(child, resource_id: str) -> pb.PhysicalPlanNode:
     return _wrap(ipc_writer=pb.IpcWriterNode(child=child, resource_id=resource_id))
 
 
+def kafka_scan(schema: T.Schema, topic: str, source_resource_id: str,
+               startup_mode: str = "earliest", start_offsets: dict | None = None,
+               data_format: str = "json", on_error: str = "skip",
+               pb_field_ids: list[int] | None = None,
+               max_batch_records: int = 0,
+               zigzag_cols: list[int] | None = None) -> pb.PhysicalPlanNode:
+    n = pb.KafkaScanNode(
+        schema=schema_to_proto(schema), topic=topic,
+        startup_mode=startup_mode, format=data_format, on_error=on_error,
+        source_resource_id=source_resource_id,
+        max_batch_records=max_batch_records,
+    )
+    for k, v in (start_offsets or {}).items():
+        n.start_offsets[int(k)] = int(v)
+    if pb_field_ids:
+        n.pb_field_ids.extend(pb_field_ids)
+    if zigzag_cols:
+        n.zigzag_cols.extend(zigzag_cols)
+    return _wrap(kafka_scan=n)
+
+
 def task(plan: pb.PhysicalPlanNode, stage_id=0, partition_id=0,
          conf: dict | None = None) -> pb.TaskDefinition:
     t = pb.TaskDefinition(plan=plan, stage_id=stage_id, partition_id=partition_id)

@@ -452,6 +452,22 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
         return IpcWriterExec(plan_from_proto(p.ipc_writer.child), p.ipc_writer.resource_id)
     if which == "debug":
         return basic.DebugExec(plan_from_proto(p.debug.child), p.debug.tag)
+    if which == "kafka_scan":
+        from auron_tpu.exec.streaming import KafkaScanExec
+
+        n = p.kafka_scan
+        return KafkaScanExec(
+            schema_from_proto(n.schema),
+            n.topic,
+            n.source_resource_id,
+            startup_mode=n.startup_mode or "earliest",
+            start_offsets={int(k): int(v) for k, v in n.start_offsets.items()},
+            data_format=n.format or "json",
+            on_error=n.on_error or "skip",
+            pb_field_ids=list(n.pb_field_ids) or None,
+            max_batch_records=n.max_batch_records or 8192,
+            zigzag_cols=set(n.zigzag_cols) or None,
+        )
     if which == "mesh_exchange":
         raise ValueError(
             "mesh_exchange is a stage boundary resolved by "

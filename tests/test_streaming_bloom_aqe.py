@@ -128,3 +128,183 @@ def test_broadcast_and_aqe(tmp_path):
     groups = plan_coalesced_partitions(stats, target_bytes=int(stats.sum() // 3))
     assert sum(len(g) for g in groups) == 8
     assert len(groups) <= 4
+
+
+# ---------------------------------------------------------------------------
+# round 2: protobuf serde, error policies, kafka_scan in the plan IR
+# ---------------------------------------------------------------------------
+
+
+def _pb_varint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_record(id_v=None, price=None, name=None) -> bytes:
+    """Encode {1: int64 id, 2: double price, 3: string name}."""
+    import struct
+
+    out = bytearray()
+    if id_v is not None:
+        out += _pb_varint((1 << 3) | 0) + _pb_varint(id_v)
+    if price is not None:
+        out += _pb_varint((2 << 3) | 1) + struct.pack("<d", price)
+    if name is not None:
+        nb = name.encode()
+        out += _pb_varint((3 << 3) | 2) + _pb_varint(len(nb)) + nb
+    return bytes(out)
+
+
+PB_SCHEMA = T.Schema.of(T.Field("id", T.INT64), T.Field("price", T.FLOAT64),
+                        T.Field("name", T.STRING))
+
+
+def test_protobuf_row_deserializer():
+    from auron_tpu.exec.streaming import ProtobufRowDeserializer
+
+    de = ProtobufRowDeserializer(PB_SCHEMA)
+    rb = de.deserialize([
+        _pb_record(1, 9.5, "a"),
+        _pb_record(-2, None, "b"),   # missing field -> NULL
+        _pb_record(3, 0.25, None),
+    ])
+    got = rb.to_pydict()
+    assert got["id"] == [1, -2, 3]
+    assert got["price"] == [9.5, None, 0.25]
+    assert got["name"] == ["a", "b", None]
+    assert de.errors == 0
+
+
+def test_deserializer_error_policies():
+    from auron_tpu.exec.streaming import (
+        DeserializeError, ProtobufRowDeserializer,
+    )
+
+    bad = b"\xff\xff\xff"  # truncated varint
+    rows = [_pb_record(1, 1.0, "x"), bad, _pb_record(2, 2.0, "y")]
+
+    de = ProtobufRowDeserializer(PB_SCHEMA, on_error="skip")
+    rb = de.deserialize(rows)
+    assert rb.to_pydict()["id"] == [1, 2] and de.errors == 1
+
+    de2 = ProtobufRowDeserializer(PB_SCHEMA, on_error="null")
+    rb2 = de2.deserialize(rows)
+    assert rb2.to_pydict()["id"] == [1, None, 2] and de2.errors == 1
+
+    de3 = ProtobufRowDeserializer(PB_SCHEMA, on_error="fail")
+    with pytest.raises(DeserializeError):
+        de3.deserialize(rows)
+
+
+def test_planned_kafka_scan_calc_query():
+    """kafka_scan is a first-class plan node: a streaming Calc query built
+    from proto bytes runs through the normal task runtime."""
+    from auron_tpu.bridge import api
+    from auron_tpu.exec.streaming import MockKafkaSource
+    from auron_tpu.plan import builders as B
+
+    records = [_pb_record(i, i * 2.0, f"n{i}") for i in range(10)]
+    api.put_resource(
+        "kafka_src",
+        lambda topic, mode, offsets: MockKafkaSource(
+            [records], startup_mode=mode, start_offsets=offsets
+        ),
+    )
+    try:
+        scan = B.kafka_scan(PB_SCHEMA, "orders", "kafka_src",
+                            data_format="protobuf", on_error="skip")
+        calc = B.project(
+            B.filter_(scan, [BinaryOp("gteq", col(0), lit(5))]),
+            [(col(0), "id"), (BinaryOp("mul", col(1), lit(10.0)), "p10")],
+        )
+        h = api.call_native(B.task(calc).SerializeToString())
+        ids, p10 = [], []
+        while (rb := api.next_batch(h)) is not None:
+            d = rb.to_pydict()
+            ids += d["id"]
+            p10 += d["p10"]
+        metrics = api.finalize_native(h)
+        assert ids == list(range(5, 10))
+        assert p10 == [i * 20.0 for i in range(5, 10)]
+        # checkpoint offsets surfaced for resume
+        assert api.get_resource("kafka_src.offsets") is None  # task-scoped
+    finally:
+        api.remove_resource("kafka_src")
+
+
+def test_planned_kafka_scan_offset_resume_and_error_metric():
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.streaming import KafkaScanExec, MockKafkaSource
+
+    records = [_pb_record(i, float(i), "x") for i in range(6)] + [b"\xff"]
+    src = MockKafkaSource([records], startup_mode="offsets", start_offsets={0: 4})
+    op = KafkaScanExec(PB_SCHEMA, "t", "src", startup_mode="offsets",
+                       start_offsets={0: 4}, data_format="protobuf",
+                       on_error="skip")
+    ctx = ExecutionContext(resources={"src": src})
+    got = []
+    for b in op.execute(0, ctx):
+        got += b.to_pydict()["id"]
+    assert got == [4, 5]  # resumed from offset 4; bad record skipped
+    m = ctx.metrics.snapshot()["values"]
+    assert m["deserialize_errors"] == 1
+    assert ctx.resources["src.offsets"] == {0: 7}
+
+
+def test_zigzag_sint_columns_via_plan():
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.streaming import MockKafkaSource
+    from auron_tpu.plan import builders as B
+    from auron_tpu.plan.planner import plan_from_proto
+
+    def zz(v):  # zigzag encode
+        return (v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1
+
+    recs = [_pb_varint((1 << 3) | 0) + _pb_varint(zz(v)) for v in (-1, -2, 3)]
+    schema = T.Schema.of(T.Field("d", T.INT64))
+    plan = B.kafka_scan(schema, "t", "zz_src", data_format="protobuf",
+                        zigzag_cols=[0])
+    op = plan_from_proto(plan)
+    ctx = ExecutionContext(resources={"zz_src": MockKafkaSource([recs])})
+    got = []
+    for b in op.execute(0, ctx):
+        got += b.to_pydict()["d"]
+    assert got == [-1, -2, 3]
+
+
+def test_offsets_surfaced_on_fail_abort():
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.streaming import DeserializeError, KafkaScanExec, MockKafkaSource
+
+    src = MockKafkaSource([[_pb_record(1, 1.0, "a"), b"\xff", _pb_record(2, 2.0, "b")]])
+    op = KafkaScanExec(PB_SCHEMA, "t", "src", data_format="protobuf",
+                       on_error="fail")
+    ctx = ExecutionContext(resources={"src": src})
+    with pytest.raises(RuntimeError):  # wrapped by execute/pump? direct: DeserializeError
+        try:
+            list(op.execute(0, ctx))
+        except DeserializeError as e:
+            raise RuntimeError(str(e)) from e
+    # abort path still surfaces checkpoint offsets + error count
+    assert "src.offsets" in ctx.resources
+    m = ctx.metrics.snapshot()["values"]
+    assert m["deserialize_errors"] == 1
+
+
+def test_unknown_format_fails_fast():
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.streaming import KafkaScanExec, MockKafkaSource
+
+    op = KafkaScanExec(PB_SCHEMA, "t", "src", data_format="avro")
+    ctx = ExecutionContext(resources={"src": MockKafkaSource([[b"{}"]])})
+    with pytest.raises(ValueError, match="unsupported streaming format"):
+        list(op.execute(0, ctx))
