@@ -164,6 +164,10 @@ def _empty_dict(dtype: T.DataType) -> pa.Array:
     """One-entry sentinel dictionary (code 0 must always be decodable)."""
     if dtype.kind == T.TypeKind.BINARY:
         return pa.array([b""], type=pa.binary())
+    if dtype.kind == T.TypeKind.DECIMAL:
+        import decimal as pydec
+
+        return pa.array([pydec.Decimal(0)], type=dtype.to_arrow())
     if dtype.kind == T.TypeKind.STRUCT:
         return pa.array(
             [{n: None for n in dtype.struct_names}], type=dtype.to_arrow()
@@ -205,6 +209,10 @@ def _arrow_to_device(arr: pa.Array, dtype: T.DataType, cap: int):
     if dtype.is_dict_encoded:
         if pa.types.is_dictionary(arr.type):
             denc = arr
+        elif dtype.kind == T.TypeKind.DECIMAL:
+            # wide decimal: exact Decimal128 dictionary, codes on device
+            wide = arr.cast(pa.decimal128(dtype.precision, dtype.scale))
+            denc = pc.dictionary_encode(wide.fill_null(0))
         else:
             denc = pc.dictionary_encode(arr.fill_null("" if dtype.kind == T.TypeKind.STRING else b""))
         codes = denc.indices.fill_null(0).to_numpy(zero_copy_only=False).astype(np.int32)
@@ -394,7 +402,8 @@ def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.nd
                 r[i] = vocab[k] = len(values)
                 values.append(s)
         remaps.append(r)
-    if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT):
+    if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT,
+                      T.TypeKind.DECIMAL):
         value_type = dtype.to_arrow()
     elif dtype.kind == T.TypeKind.BINARY:
         value_type = pa.binary()

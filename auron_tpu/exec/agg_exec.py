@@ -108,13 +108,22 @@ def is_wide_sum(in_t: T.DataType | None) -> bool:
     return sum_type(in_t).precision > 18
 
 
+def _n_limbs(sum_precision: int) -> int:
+    """Base-1e9 limbs covering the sum's digit budget (<= 5 for p38)."""
+    return -(-sum_precision // 9)
+
+
 def _wide_sum_fields(in_t: T.DataType, prefix: str) -> list[T.Field]:
     st = sum_type(in_t)
-    return [
-        T.Field(f"{prefix}#sum0", st, True),  # limb0 carries the logical type
-        T.Field(f"{prefix}#sum1", T.INT64, True),
-        T.Field(f"{prefix}#sum2", T.INT64, True),
+    k = _n_limbs(st.precision)
+    # limb0 carries the scale plus (via its name) the exact input
+    # precision, so merge/final modes reconstruct the layout and output
+    # type from the shuffled schema alone
+    fields = [
+        T.Field(f"{prefix}#sum0p{in_t.precision}", T.decimal(18, in_t.scale), True)
     ]
+    fields += [T.Field(f"{prefix}#sum{i}", T.INT64, True) for i in range(1, k)]
+    return fields
 
 
 def intermediate_fields(a: AggExpr, in_t: T.DataType | None, prefix: str) -> list[T.Field]:
@@ -298,7 +307,10 @@ class HashAggExec(ExecOperator):
                     agg_inputs.append([])
                 else:
                     cv = ev.evaluate(b, [a.expr])[0]
-                    if a.func in ("sum", "avg"):
+                    if a.func in ("sum", "avg") and not is_wide_sum(in_t):
+                        # wide sums consume the raw input (limb machinery);
+                        # a cast to the (dict-encoded) wide sum type is
+                        # neither needed nor representable here
                         cv = ev._cast(cv, sum_type(in_t))
                     agg_inputs.append([cv])
             return self._group_reduce(b.device.sel, keys, agg_inputs, raw=True)
@@ -372,7 +384,10 @@ class HashAggExec(ExecOperator):
             agg_v = tuple(tuple(c.values for c in cols) for cols in agg_cols)
             agg_m = tuple(tuple(c.validity for c in cols) for cols in agg_cols)
             agg_aux = tuple(
-                _minmax_rank_aux(a, cols) for (a, _), cols in zip(self.aggs, agg_cols)
+                _agg_aux(a, in_t, cols)
+                for ((a, _), in_t), cols in zip(
+                    zip(self.aggs, self._agg_input_types), agg_cols
+                )
             )
             out_v, out_m, group_valid = _reduce_arrays_jit(
                 sel, key_v, key_m, agg_v, agg_m, agg_aux,
@@ -549,49 +564,68 @@ class HashAggExec(ExecOperator):
         raise ValueError(a.func)
 
     def _final_wide(self, a: AggExpr, in_t, cols: list[ColumnVal]) -> ColumnVal:
-        """Reconstruct wide decimal sums from base-1e6 limbs (host-side
-        exact integer math; values beyond the decimal64 emit domain become
-        NULL instead of silently wrapping)."""
+        """Reconstruct exact wide sums from base-1e9 limbs (vectorized
+        host-side object math — one transfer, no per-group python loop).
+        Wide result types emit as dict-encoded Decimal128 columns, so
+        p>18 values survive downstream exactly; narrow results emit as
+        scaled int64 with out-of-domain values going NULL."""
         import decimal as pydec
 
         import jax
 
         st = sum_type(in_t)
-        l0 = np.asarray(jax.device_get(cols[0].values)).tolist()
-        l1 = np.asarray(jax.device_get(cols[1].values)).tolist()
-        l2 = np.asarray(jax.device_get(cols[2].values)).tolist()
+        k = _n_limbs(st.precision)
+        limbs = jax.device_get(tuple(c.values for c in cols[:k]))
         valid = np.asarray(jax.device_get(cols[0].validity))
-        n = len(l0)
-        out_vals = np.zeros(n, dtype=np.int64)
-        out_ok = np.zeros(n, dtype=bool)
+        # exact totals: vectorized python-int accumulation over k arrays
+        total = np.zeros(len(valid), dtype=object)
+        base = 1
+        for limb in limbs:
+            total = total + np.asarray(limb).astype(object) * base
+            base *= _LIMB_BASE
         if a.func == "sum":
             emit_t = st
-            bound = 10 ** min(emit_t.precision, 18)
-            for i in range(n):
-                if not valid[i]:
-                    continue
-                total = l2[i] * (_LIMB * _LIMB) + l1[i] * _LIMB + l0[i]
-                if -bound < total < bound and -(2**63) <= total < 2**63:
-                    out_vals[i] = total
-                    out_ok[i] = True
-        else:  # avg
+            unscaled = total
+            ok = valid.copy()
+        else:  # avg: exact HALF_UP division at the avg scale
             emit_t = avg_type(in_t)
-            cnt = np.asarray(jax.device_get(cols[3].values)).tolist()
-            bound = 10 ** min(emit_t.precision, 18)
+            cnt = np.asarray(jax.device_get(cols[k].values))
+            ok = valid & (cnt > 0)
+            shift = 10 ** (emit_t.scale - st.scale)
             q = pydec.Decimal(1)
-            for i in range(n):
-                if not valid[i] or cnt[i] == 0:
-                    continue
-                total = l2[i] * (_LIMB * _LIMB) + l1[i] * _LIMB + l0[i]
-                scaled = total * (10 ** (emit_t.scale - st.scale))
-                av = int(
-                    (pydec.Decimal(scaled) / pydec.Decimal(cnt[i])).quantize(
-                        q, rounding=pydec.ROUND_HALF_UP
-                    )
+            unscaled = np.zeros(len(valid), dtype=object)
+            for i in np.nonzero(ok)[0]:
+                unscaled[i] = int(
+                    (pydec.Decimal(int(total[i]) * shift) / pydec.Decimal(int(cnt[i])))
+                    .quantize(q, rounding=pydec.ROUND_HALF_UP)
                 )
-                if -bound < av < bound and -(2**63) <= av < 2**63:
-                    out_vals[i] = av
-                    out_ok[i] = True
+        if emit_t.is_wide_decimal:
+            # dict-encoded exact emission (identity codes); totals beyond
+            # the precision budget go NULL (Spark non-ANSI overflow)
+            bound = 10 ** emit_t.precision
+            decs = [
+                T.decimal_from_unscaled(int(u), emit_t.scale)
+                if o and -bound < int(u) < bound
+                else None
+                for u, o in zip(unscaled, ok)
+            ]
+            import pyarrow as pa
+
+            d = pa.array(
+                [x if x is not None else pydec.Decimal(0) for x in decs],
+                type=pa.decimal128(emit_t.precision, emit_t.scale),
+            )
+            codes = jnp.arange(len(decs), dtype=jnp.int32)
+            ok_dev = jnp.asarray(np.array([x is not None for x in decs]))
+            return ColumnVal(codes, ok_dev & cols[0].validity, emit_t, d)
+        bound = 10 ** min(emit_t.precision, 18)
+        out_vals = np.zeros(len(valid), dtype=np.int64)
+        out_ok = np.zeros(len(valid), dtype=bool)
+        for i in np.nonzero(ok)[0]:
+            u = int(unscaled[i])
+            if -bound < u < bound and -(2**63) <= u < 2**63:
+                out_vals[i] = u
+                out_ok[i] = True
         return ColumnVal(
             jnp.asarray(out_vals), jnp.asarray(out_ok) & cols[0].validity, emit_t
         )
@@ -616,9 +650,9 @@ class HashAggExec(ExecOperator):
             valid = jnp.zeros(cap, bool).at[0].set(bool(is_count))
             d = None
             if f.dtype.is_dict_encoded:
-                import pyarrow as pa
+                from auron_tpu.columnar.batch import _empty_dict
 
-                d = pa.array([""], type=pa.string())
+                d = _empty_dict(f.dtype)
             vals.append(ColumnVal(zero, valid, f.dtype, d))
             names.append(f.name)
         sel = jnp.zeros(cap, bool).at[0].set(True)
@@ -726,8 +760,11 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
     if a.func in ("collect_list", "collect_set", "host_udaf"):
         return t.inner[0]
     if a.func == "sum" or a.func == "avg":
-        # sum_type is not invertible exactly; intermediate already carries
-        # the sum type, which is all downstream logic needs
+        if "#sum0p" in first_field.name:
+            # wide-sum limb layout: the exact input precision rides in
+            # the field name (see _wide_sum_fields)
+            p = int(first_field.name.rsplit("#sum0p", 1)[1])
+            return T.decimal(p, t.scale)
         if t.kind == T.TypeKind.DECIMAL:
             return T.decimal(max(t.precision - 10, 1), t.scale)
         return T.INT64 if t.kind == T.TypeKind.INT64 else T.FLOAT64
@@ -739,21 +776,37 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
 # ---------------------------------------------------------------------------
 
 
-def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
-    """(rank, inv) device arrays for dict-encoded min/max inputs, else None.
+def _agg_aux(a: AggExpr, in_t, cols: list[ColumnVal]):
+    """Per-agg device-array side tables for dict-encoded inputs, traced
+    into the fused reduce program (host dictionaries can't enter jit):
 
-    Dict codes are first-occurrence ordered; min/max must reduce in
-    lexicographic rank space. The tables are traced jit arguments since
-    host dictionaries can't enter the fused reduce program."""
-    if a.func not in ("min", "max") or not cols:
+    - min/max over dict codes: (rank, inv) lexicographic tables;
+    - sum/avg over wide-decimal dicts: base-1e9 limb tables."""
+    if not cols or cols[0].dict is None or len(cols[0].dict) == 0:
         return None
     d = cols[0].dict
-    if d is None or len(d) == 0:
-        return None
-    from auron_tpu.ops.sortkeys import dict_rank_maps
+    if a.func in ("min", "max"):
+        from auron_tpu.ops.sortkeys import dict_rank_maps
 
-    rank, inv = dict_rank_maps(d)
-    return jnp.asarray(rank), jnp.asarray(inv)
+        rank, inv = dict_rank_maps(d)
+        return jnp.asarray(rank), jnp.asarray(inv)
+    if (
+        a.func in ("sum", "avg")
+        and in_t is not None
+        and in_t.is_wide_decimal
+    ):
+        k = _n_limbs(sum_type(in_t).precision)
+        return tuple(
+            jnp.asarray(t) for t in _decimal_limb_tables(d, in_t.scale, k)
+        )
+    return None
+
+
+# backward-compat alias used by the eager min/max fallback
+def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
+    if a.func not in ("min", "max"):
+        return None
+    return _agg_aux(a, None, cols)
 
 
 def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None):
@@ -826,18 +879,20 @@ def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid,
         return [ColumnVal(cnt, group_valid, T.INT64)]
     if a.func == "sum":
         if is_wide_sum(in_t):
-            return _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid)
+            return _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw,
+                                    group_valid, aux)
         v, m = sortg(cols[0])
         sm, any_valid = S.seg_sum(v, m, ids, cap)
         return [ColumnVal(sm, any_valid & group_valid, sum_type(in_t))]
     if a.func == "avg":
         if is_wide_sum(in_t):
-            limbs = _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid)
+            limbs = _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw,
+                                     group_valid, aux)
             if raw:
                 _, m0 = sortg(cols[0])
                 cnt = S.seg_count(m0, ids, cap)
             else:
-                cv, cm = sortg(cols[3])
+                cv, cm = sortg(cols[len(limbs)])  # count rides after the limbs
                 cnt, _ = S.seg_sum(cv, cm, ids, cap)
             return limbs + [ColumnVal(cnt, group_valid, T.INT64)]
         v, m = sortg(cols[0])
@@ -893,35 +948,83 @@ def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid,
     raise ValueError(a.func)
 
 
-_LIMB = 1_000_000
+_LIMB_BASE = 1_000_000_000
+
+# bounded memo of per-dictionary limb tables (wide decimal inputs): the
+# decomposition of every dictionary entry is pure host work shared across
+# batches with the same dictionary object
+_LIMB_TABLE_CACHE: dict[int, tuple] = {}
 
 
-def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid):
-    """Base-1e6 limb accumulation for wide decimal sums (exact; int64
-    wrap-free for any realistic row count)."""
+def _decimal_limb_tables(d, scale: int, k: int):
+    """k base-1e9 limb tables (np.int64, bucket-padded) for a wide-decimal
+    dictionary: entry e decomposes as sum(limb_i * 1e9^i) of its unscaled
+    value (floored division; the top limb carries the sign)."""
+    key = (id(d), k)
+    hit = _LIMB_TABLE_CACHE.get(key)
+    if hit is not None and hit[0] is d:
+        return hit[1]
+    entries = d.to_pylist()
+    n = len(entries)
+    cap = max(8, 1 << (n - 1).bit_length()) if n else 8
+    tabs = [np.zeros(cap, dtype=np.int64) for _ in range(k)]
+    for i, e in enumerate(entries):
+        if e is None:
+            continue
+        u = T.unscaled_int(e, scale)
+        for j in range(k - 1):
+            u, r = divmod(u, _LIMB_BASE)
+            tabs[j][i] = r
+        tabs[k - 1][i] = u
+    if len(_LIMB_TABLE_CACHE) >= 64:
+        _LIMB_TABLE_CACHE.pop(next(iter(_LIMB_TABLE_CACHE)))
+    _LIMB_TABLE_CACHE[key] = (d, tabs)
+    return tabs
+
+
+def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid, aux=None):
+    """Base-1e9 limb accumulation for wide decimal sums (exact; per-limb
+    int64 sums stay wrap-free for any realistic group size). Wide INPUT
+    columns (dict-encoded Decimal128) gather per-row limbs from host
+    tables; narrow scaled-int64 inputs decompose on device."""
     st = sum_type(in_t)
+    k = _n_limbs(st.precision)
+    limb0_t = T.decimal(18, in_t.scale)
     if raw:
         v, m = sortg(cols[0])
-        u = jnp.where(m, v.astype(jnp.int64), jnp.int64(0))
-        l0 = jnp.mod(u, _LIMB)
-        l1 = jnp.mod(jnp.floor_divide(u, _LIMB), _LIMB)
-        l2 = jnp.floor_divide(u, _LIMB * _LIMB)
-        masks = [m, m, m]
-        limb_vals = [l0, l1, l2]
+        if in_t.is_wide_decimal:
+            tabs = (
+                list(aux)
+                if aux is not None
+                else [
+                    jnp.asarray(t)
+                    for t in _decimal_limb_tables(cols[0].dict, in_t.scale, k)
+                ]
+            )
+            idx = jnp.clip(v, 0, tabs[0].shape[0] - 1)
+            limb_vals = [t[idx] for t in tabs]
+        else:
+            cur = jnp.where(m, v.astype(jnp.int64), jnp.int64(0))
+            limb_vals = []
+            for _ in range(k - 1):
+                limb_vals.append(jnp.mod(cur, _LIMB_BASE))
+                cur = jnp.floor_divide(cur, _LIMB_BASE)
+            limb_vals.append(cur)
+        masks = [m] * k
     else:
         limb_vals, masks = [], []
-        for i in range(3):
+        for i in range(k):
             v, m = sortg(cols[i])
             limb_vals.append(jnp.where(m, v.astype(jnp.int64), jnp.int64(0)))
             masks.append(m)
     out = []
     any_valid = None
     for i, (lv, m) in enumerate(zip(limb_vals, masks)):
-        sm, av = S.seg_sum(lv, m, ids, cap)
+        sm, av = S.seg_sum(jnp.where(m, lv, jnp.int64(0)), m, ids, cap)
         any_valid = av if any_valid is None else any_valid
         out.append(
-            ColumnVal(sm, (av if any_valid is None else any_valid) & group_valid,
-                      st if i == 0 else T.INT64)
+            ColumnVal(sm, any_valid & group_valid,
+                      limb0_t if i == 0 else T.INT64)
         )
     return out
 

@@ -110,7 +110,14 @@ def unify_key_dicts(
             remaps.append(m)
         nb = jnp.asarray(remaps[0])[jnp.clip(bv.values, 0, len(remaps[0]) - 1)]
         np_ = jnp.asarray(remaps[1])[jnp.clip(pv.values, 0, len(remaps[1]) - 1)]
-        joint = pa.array(list(vocab.keys()) or [""], type=pa.string())
+        if bv.dtype.kind == T.TypeKind.DECIMAL:
+            joint_type = bv.dtype.to_arrow()
+            filler = []
+        elif bv.dtype.kind == T.TypeKind.BINARY:
+            joint_type, filler = pa.binary(), [b""]
+        else:
+            joint_type, filler = pa.string(), [""]
+        joint = pa.array(list(vocab.keys()) or filler, type=joint_type)
         out_b.append(ColumnVal(nb.astype(jnp.int32), bv.validity, bv.dtype, joint))
         out_p.append(ColumnVal(np_.astype(jnp.int32), pv.validity, pv.dtype, joint))
     return out_b, out_p

@@ -232,8 +232,10 @@ class EquiJoinDriver:
             for i, f in enumerate(pb.schema)
         ]
         other_schema = self.right_schema if self.probe_is_left else self.left_schema
+        from auron_tpu.columnar.batch import _empty_dict
+
         other_dicts = tuple(
-            (core.pa.array([""], type=core.pa.string()) if f.dtype.is_dict_encoded else None)
+            (_empty_dict(f.dtype) if f.dtype.is_dict_encoded else None)
             for f in other_schema
         )
         nulls = null_columns(other_schema, pb.capacity, other_dicts)
@@ -246,8 +248,10 @@ class EquiJoinDriver:
             for i, f in enumerate(bb.schema)
         ]
         other_schema = self.right_schema if self.build_side == "left" else self.left_schema
+        from auron_tpu.columnar.batch import _empty_dict
+
         other_dicts = tuple(
-            (core.pa.array([""], type=core.pa.string()) if f.dtype.is_dict_encoded else None)
+            (_empty_dict(f.dtype) if f.dtype.is_dict_encoded else None)
             for f in other_schema
         )
         nulls = null_columns(other_schema, bb.capacity, other_dicts)

@@ -291,6 +291,17 @@ class WindowExec(ExecOperator):
         valid = cv.validity & sel
         if wf.agg in ("sum", "avg", "count"):
             in_sum_t = sum_type(cv.dtype) if wf.agg != "count" else None
+            if cv.dtype.is_wide_decimal:
+                raise NotImplementedError(
+                    "window sum/avg over decimal(p>18) inputs is not "
+                    "supported yet (group aggregation handles them exactly)"
+                )
+            if in_sum_t is not None and in_sum_t.is_wide_decimal:
+                # window sums compute in the decimal64 domain: clamp the
+                # nominal wide sum type, overflow -> NULL via precision_ok
+                from auron_tpu import types as _T
+
+                in_sum_t = _T.decimal(18, min(in_sum_t.scale, 18))
             if wf.agg != "count":
                 ev = Evaluator(T.Schema())
                 cvs = ev._cast(cv, in_sum_t)

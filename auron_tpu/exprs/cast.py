@@ -178,7 +178,10 @@ def cast_string_dict(d: pa.Array, dst: T.DataType) -> tuple[np.ndarray, np.ndarr
             elif dst.kind == T.TypeKind.DECIMAL:
                 import decimal as pd
 
-                u = int(pd.Decimal(t).scaleb(dst.scale).quantize(pd.Decimal(1), rounding=pd.ROUND_HALF_UP))
+                with pd.localcontext() as _hp:
+                    _hp.prec = 100  # scaleb rounds at context precision
+                    u = int(pd.Decimal(t).scaleb(dst.scale).quantize(
+                        pd.Decimal(1), rounding=pd.ROUND_HALF_UP))
                 if -(2**63) <= u < 2**63 and (dst.precision >= 19 or abs(u) < 10**dst.precision):
                     vals[i], ok[i] = u, True
             elif dst.kind == T.TypeKind.DATE32:

@@ -175,7 +175,7 @@ class Evaluator:
             phys = dt.physical_dtype() if dt.kind != T.TypeKind.NULL else jnp.int8
             return ColumnVal(
                 jnp.zeros(cap, phys), jnp.zeros(cap, bool), dt,
-                _single_dict(dt, "") if dt.is_dict_encoded else None,
+                _single_dict(dt, None) if dt.is_dict_encoded else None,
             )
         if dt.is_dict_encoded:
             return ColumnVal(
@@ -245,6 +245,8 @@ class Evaluator:
         if l.dtype.is_string_like or r.dtype.is_string_like:
             return self._compare_strings(op, l, r)
         valid = l.validity & r.validity
+        if l.dtype.is_wide_decimal or r.dtype.is_wide_decimal:
+            return self._compare_wide_decimal(op, l, r)
         if l.dtype.kind == T.TypeKind.DECIMAL or r.dtype.kind == T.TypeKind.DECIMAL:
             lv, rv, fallback = self._align_decimals(l, r)
             res = _cmp_apply(op, lv, rv)
@@ -268,6 +270,101 @@ class Evaluator:
         rf = rd.values.astype(jnp.float64) * (10.0 ** (-rd.dtype.scale))
         return lv, rv, (bad, lf, rf)
 
+    # 13-digit words: 5 of them cover any wide unscaled value after scale
+    # alignment (<= 38 + 18 shift digits), each word int64-safe
+    _DEC_WORD_BASE = 10**13
+    _DEC_WORDS = 5
+
+    def _compare_wide_decimal(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        """Exact comparison when either operand is a wide (dict-encoded)
+        decimal: both sides decompose into base-1e13 words of the unscaled
+        value at the common scale (wide via host tables, narrow via exact
+        device div/mod), compared lexicographically. Floats compare via a
+        float64 view of the dictionary."""
+        valid = l.validity & r.validity
+        if l.dtype.is_float or r.dtype.is_float:
+            lf = self._wide_as_float(l)
+            rf = self._wide_as_float(r)
+            return ColumnVal(_cmp_apply(op, lf, rf), valid, T.BOOL)
+        ls = l.dtype.scale if l.dtype.kind == T.TypeKind.DECIMAL else 0
+        rs = r.dtype.scale if r.dtype.kind == T.TypeKind.DECIMAL else 0
+        s = max(ls, rs)
+        lw = self._decimal_words(l, s)
+        rw = self._decimal_words(r, s)
+        lt = jnp.zeros(l.values.shape, bool)
+        eq = jnp.ones(l.values.shape, bool)
+        for j in reversed(range(self._DEC_WORDS)):  # big-endian compare
+            lt = lt | (eq & (lw[j] < rw[j]))
+            eq = eq & (lw[j] == rw[j])
+        res = {
+            "eq": eq, "neq": ~eq, "lt": lt, "lteq": lt | eq,
+            "gt": ~lt & ~eq, "gteq": ~lt,
+        }[op]
+        return ColumnVal(res, valid, T.BOOL)
+
+    def _wide_as_float(self, cv: ColumnVal) -> jnp.ndarray:
+        if not cv.dtype.is_wide_decimal:
+            if cv.dtype.kind == T.TypeKind.DECIMAL:
+                return cv.values.astype(jnp.float64) * (10.0 ** -cv.dtype.scale)
+            return cv.values.astype(jnp.float64)
+        tab = np.zeros(max(len(cv.dict), 1), dtype=np.float64)
+        for i, e in enumerate(cv.dict.to_pylist()):
+            if e is not None:
+                tab[i] = float(e)
+        return jnp.asarray(tab)[jnp.clip(cv.values, 0, len(tab) - 1)]
+
+    def _decimal_words(self, cv: ColumnVal, s: int) -> list[jnp.ndarray]:
+        """Base-1e13 little-endian words of the unscaled value at scale s
+        (floored decomposition: lower words in [0, 1e13), top word signed)."""
+        W, BASE = self._DEC_WORDS, self._DEC_WORD_BASE
+        if cv.dtype.is_wide_decimal:
+            entries = cv.dict.to_pylist()
+            n = max(len(entries), 1)
+            tabs = np.zeros((W, n), dtype=np.int64)
+            shift = 10 ** (s - cv.dtype.scale)
+            for i, e in enumerate(entries):
+                if e is None:
+                    continue
+                u = T.unscaled_int(e, cv.dtype.scale) * shift
+                for j in range(W - 1):
+                    u, rem = divmod(u, BASE)
+                    tabs[j, i] = rem
+                tabs[W - 1, i] = u
+            idx = jnp.clip(cv.values, 0, n - 1)
+            return [jnp.asarray(tabs[j])[idx] for j in range(W)]
+        # narrow side: scaled int64 at its own scale, shifted up by
+        # k = s - ns digits. word j = floor(v * 10^(k-13j)) mod 1e13,
+        # computed without overflow via exact div/mod identities
+        dv = cv if cv.dtype.kind == T.TypeKind.DECIMAL else self._cast(
+            cv, ir._as_decimal(cv.dtype)
+        )
+        v = dv.values.astype(jnp.int64)
+        k = s - dv.dtype.scale
+        words = []
+        sign_lo = jnp.where(v < 0, jnp.int64(BASE - 1), jnp.int64(0))
+        sign_top = jnp.where(v < 0, jnp.int64(-1), jnp.int64(0))
+        for j in range(W):
+            e = k - 13 * j
+            if -e > 18:
+                # shift beyond int64's 10^18 range: the word is pure
+                # floored sign extension
+                words.append(sign_top if j == W - 1 else sign_lo)
+            elif j == W - 1:
+                # top word carries the sign: pure floored division
+                words.append(
+                    jnp.floor_divide(v, jnp.int64(10 ** (-e)))
+                    if e < 0 else v * jnp.int64(10**e)
+                )
+            elif e >= 13:
+                words.append(jnp.zeros_like(v))
+            elif e >= 0:
+                words.append(jnp.mod(v, jnp.int64(10 ** (13 - e))) * jnp.int64(10**e))
+            else:
+                words.append(
+                    jnp.mod(jnp.floor_divide(v, jnp.int64(10 ** (-e))), jnp.int64(BASE))
+                )
+        return words
+
     def _compare_strings(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
         assert l.dtype.is_string_like and r.dtype.is_string_like, (l.dtype, r.dtype)
         lmap, rmap, rank = _unify_two_dicts(l.dict, r.dict)
@@ -281,6 +378,12 @@ class Evaluator:
         return ColumnVal(_cmp_apply(op, rk[lu], rk[ru]), valid, T.BOOL)
 
     def _arith(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        if l.dtype.is_wide_decimal or r.dtype.is_wide_decimal:
+            raise NotImplementedError(
+                "arithmetic over decimal(p>18) operands is not device-"
+                "representable yet (values are dictionary codes); cast to "
+                "decimal(18,s) or aggregate instead"
+            )
         out = ir.arith_result_type(op, l.dtype, r.dtype)
         valid = l.validity & r.validity
         if out.kind == T.TypeKind.DECIMAL:
@@ -431,6 +534,13 @@ def _cmp_apply(op: str, l: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
 def _single_dict(dtype: T.DataType, value) -> pa.Array:
     if dtype.kind == T.TypeKind.BINARY:
         return pa.array([value if value is not None else b""], type=pa.binary())
+    if dtype.kind == T.TypeKind.DECIMAL:
+        import decimal as pydec
+
+        return pa.array(
+            [value if value is not None else pydec.Decimal(0)],
+            type=dtype.to_arrow(),
+        )
     return pa.array([value if value is not None else ""], type=pa.string())
 
 

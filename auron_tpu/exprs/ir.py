@@ -345,18 +345,29 @@ def arith_result_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
             return T.FLOAT64
         ld, rd = _as_decimal(lt), _as_decimal(rt)
         p1, s1, p2, s2 = ld.precision, ld.scale, rd.precision, rd.scale
+
+        def emit(p, s):
+            t = _bounded(p, s)
+            # arithmetic over NARROW (int64-scaled) operands computes in
+            # the decimal64 domain: clamp nominally-wide result types to
+            # 18 digits with overflow -> NULL. Wide-OPERAND arithmetic is
+            # the (loudly unsupported) gap, not wide-result typing.
+            if t.precision > 18 and not (lt.is_wide_decimal or rt.is_wide_decimal):
+                return T.decimal(18, min(t.scale, 18))
+            return t
+
         if op in ("add", "sub"):
             s = max(s1, s2)
             p = max(p1 - s1, p2 - s2) + s + 1
-            return _bounded(p, s)
+            return emit(p, s)
         if op == "mul":
-            return _bounded(p1 + p2 + 1, s1 + s2)
+            return emit(p1 + p2 + 1, s1 + s2)
         if op == "div":
             s = max(6, s1 + p2 + 1)
             p = p1 - s1 + s2 + s
-            return _bounded(p, s)
+            return emit(p, s)
         if op == "mod":
-            return _bounded(min(p1 - s1, p2 - s2) + max(s1, s2), max(s1, s2))
+            return emit(min(p1 - s1, p2 - s2) + max(s1, s2), max(s1, s2))
         raise ValueError(op)
     if op == "div":
         # Spark's `/` on integers yields double

@@ -54,10 +54,24 @@ class Registry:
     def lookup(self, name: str) -> Callable | None:
         return self._fns.get(name)
 
+    # functions that handle dict-encoded wide decimals correctly (rank
+    # orders, byte-exact hashes); everything else would silently operate
+    # on dictionary codes, so dispatch fails loudly instead
+    _WIDE_DECIMAL_SAFE = frozenset(
+        {"hash", "murmur3_hash", "xxhash64", "least", "greatest"}
+    )
+
     def dispatch(self, name: str, args: list, cap: int):
         if name not in self._fns:
             raise KeyError(
                 f"scalar function '{name}' not registered (host-fallback handles it)"
+            )
+        if name not in self._WIDE_DECIMAL_SAFE and any(
+            a.dtype.is_wide_decimal for a in args
+        ):
+            raise NotImplementedError(
+                f"scalar function '{name}' over decimal(p>18) arguments is "
+                "not supported yet (values are dictionary codes)"
             )
         return self._fns[name](args, cap)
 

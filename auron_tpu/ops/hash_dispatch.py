@@ -78,6 +78,35 @@ def _byte_matrix_cached(d) -> ByteMatrix:
     return bm
 
 
+def _decimal_byte_matrix_cached(d, scale: int) -> ByteMatrix:
+    """Wide-decimal dictionary -> per-entry minimal big-endian
+    two's-complement bytes of the unscaled value (exactly what Spark
+    hashes for precision > 18: BigInteger.toByteArray)."""
+    import pyarrow as pa
+
+    key = id(d)
+    hit = _BM_CACHE.get(key)
+    if hit is not None and hit[0] is d:
+        return hit[1]
+    rows = []
+    for e in d.to_pylist():
+        if e is None:
+            rows.append(b"\x00")
+            continue
+        from auron_tpu.types import unscaled_int
+
+        u = unscaled_int(e, scale)
+        # Java BigInteger.bitLength: two's-complement length minus sign bit
+        bl = u.bit_length() if u >= 0 else (-u - 1).bit_length()
+        n = bl // 8 + 1  # toByteArray: bitLength/8 + 1 (minimal + sign)
+        rows.append(u.to_bytes(n, "big", signed=True))
+    bm = ByteMatrix.from_arrow(pa.array(rows, type=pa.binary()))
+    if len(_BM_CACHE) > 256:
+        _BM_CACHE.clear()
+    _BM_CACHE[key] = (d, bm)
+    return bm
+
+
 @partial(jax.jit, static_argnames=("dtypes", "algo", "seed"))
 def _hash_columns_jit(values, validity, dict_mats, dtypes, algo, seed):
     """Jitted chained hash over prepared column arrays.
@@ -133,6 +162,9 @@ def hash_batch(
         dtypes.append(dtype)
         if dtype.is_string_like:
             bm = _byte_matrix_cached(batch.dicts[ci])
+            dict_mats.append((bm.bytes, bm.lengths))
+        elif dtype.is_wide_decimal:
+            bm = _decimal_byte_matrix_cached(batch.dicts[ci], dtype.scale)
             dict_mats.append((bm.bytes, bm.lengths))
         else:
             dict_mats.append(None)

@@ -52,6 +52,10 @@ def _canonical_word(cv: ColumnVal) -> jnp.ndarray:
     v = cv.values
     if dt.kind == T.TypeKind.BOOL:
         return v.astype(jnp.uint64)
+    if dt.is_dict_encoded:
+        # codes are equality keys within a unified-dictionary context
+        # (wide decimals included — must beat the DECIMAL branch below)
+        return v.astype(jnp.int64).view(jnp.uint64)
     if dt.is_integer or dt.kind in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP, T.TypeKind.DECIMAL):
         return v.astype(jnp.int64).view(jnp.uint64)
     if dt.kind == T.TypeKind.FLOAT32:

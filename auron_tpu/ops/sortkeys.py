@@ -38,6 +38,10 @@ def orderable_word(cv: ColumnVal) -> jnp.ndarray:
     sign = jnp.uint64(1) << jnp.uint64(63)
     if dt.kind == T.TypeKind.BOOL:
         return v.astype(jnp.uint64)
+    if dt.is_dict_encoded:
+        # incl. wide decimals: order via the (numeric/lexicographic) rank
+        rank = _dict_rank(cv.dict)
+        return jnp.asarray(rank)[jnp.clip(v, 0, len(rank) - 1)].astype(jnp.uint64)
     if dt.is_integer or dt.kind in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP, T.TypeKind.DECIMAL):
         return v.astype(jnp.int64).view(jnp.uint64) ^ sign
     if dt.kind == T.TypeKind.FLOAT32:
@@ -54,9 +58,6 @@ def orderable_word(cv: ColumnVal) -> jnp.ndarray:
         b = f.view(jnp.uint64)
         neg = (b & sign) != 0
         return jnp.where(neg, ~b, b | sign)
-    if dt.is_dict_encoded:
-        rank = _dict_rank(cv.dict)
-        return jnp.asarray(rank)[jnp.clip(v, 0, len(rank) - 1)].astype(jnp.uint64)
     raise TypeError(f"unsortable type {dt}")
 
 
@@ -72,11 +73,17 @@ def _dict_rank(d) -> np.ndarray:
     hit = _RANK_CACHE.get(id(d))
     if hit is not None and hit[0] is d:
         return hit[1]
+    import decimal as pydec
+
     entries = d.to_pylist()
-    keyed = [
-        (e.encode("utf-8") if isinstance(e, str) else (e if e is not None else b""))
-        for e in entries
-    ]
+    if any(isinstance(e, pydec.Decimal) for e in entries):
+        # wide-decimal dictionaries order numerically, not by bytes
+        keyed = [e if e is not None else pydec.Decimal(0) for e in entries]
+    else:
+        keyed = [
+            (e.encode("utf-8") if isinstance(e, str) else (e if e is not None else b""))
+            for e in entries
+        ]
     order = sorted(range(len(keyed)), key=lambda i: keyed[i])
     rank = np.empty(len(keyed), dtype=np.uint64)
     for r, i in enumerate(order):

@@ -6,9 +6,12 @@ datafusion-ext-commons/src/arrow/cast.rs), but the *physical* mapping is
 TPU-first — XLA requires static shapes and has no pointer-rich layouts:
 
 - fixed-width types map 1:1 onto dense jnp arrays + a validity mask;
-- DECIMAL(p<=18) is a scaled int64 ("decimal64"); precision 19..38 is
-  currently computed in the decimal64 domain too (documented limitation,
-  int128-limb emulation is planned);
+- DECIMAL(p<=18) is a scaled int64 ("decimal64"); DECIMAL(19..38) is
+  dictionary-encoded (exact Decimal128 dictionary host-side, int32 codes
+  on device): scans, joins, group-bys, min/max, sort and limb-based
+  sum/avg are exact; arithmetic over wide OPERANDS is the remaining
+  (loudly unsupported) gap, and narrow-operand arithmetic clamps its
+  result type to the decimal64 domain with overflow -> NULL;
 - DATE is int32 days since epoch, TIMESTAMP is int64 microseconds — same
   physical encoding Arrow uses;
 - STRING/BINARY are dictionary-encoded: the device sees int32 codes, the
@@ -83,9 +86,18 @@ class DataType:
         return self.kind in (TypeKind.STRING, TypeKind.BINARY)
 
     @property
+    def is_wide_decimal(self) -> bool:
+        """precision 19..38: exact values live in a host-side Decimal128
+        dictionary, the device carries codes (the decimal64 int64 scaling
+        cannot represent them)."""
+        return self.kind == TypeKind.DECIMAL and self.precision > 18
+
+    @property
     def is_dict_encoded(self) -> bool:
-        return self.is_string_like or self.kind in (
-            TypeKind.LIST, TypeKind.MAP, TypeKind.STRUCT
+        return (
+            self.is_string_like
+            or self.is_wide_decimal
+            or self.kind in (TypeKind.LIST, TypeKind.MAP, TypeKind.STRUCT)
         )
 
     # ---- physical mapping ----
@@ -106,10 +118,10 @@ class DataType:
             return jnp.dtype(jnp.float32)
         if k == TypeKind.FLOAT64:
             return jnp.dtype(jnp.float64)
+        if self.is_dict_encoded:
+            return jnp.dtype(jnp.int32)  # dictionary codes (incl. wide decimal)
         if k == TypeKind.DECIMAL:
             return jnp.dtype(jnp.int64)  # scaled decimal64
-        if self.is_dict_encoded:
-            return jnp.dtype(jnp.int32)  # dictionary codes
         if k == TypeKind.NULL:
             return jnp.dtype(jnp.int8)
         raise TypeError(f"no physical dtype for {self}")
@@ -218,6 +230,33 @@ BINARY = DataType(TypeKind.BINARY)
 
 def decimal(precision: int, scale: int) -> DataType:
     return DataType(TypeKind.DECIMAL, precision, scale)
+
+
+def unscaled_int(value, scale: int) -> int:
+    """Exact unscaled integer of a Decimal at the given scale.
+
+    NEVER use Decimal.scaleb for this: it rounds to the active context's
+    precision (28 significant digits by default), silently corrupting
+    decimal(38,x) values."""
+    sign, digits, exp = value.as_tuple()
+    u = int("".join(map(str, digits)))
+    shift = exp + scale
+    if shift >= 0:
+        u *= 10**shift
+    else:
+        q, r = divmod(u, 10 ** (-shift))
+        if r:
+            raise ValueError(f"{value} does not fit scale {scale}")
+        u = q
+    return -u if sign else u
+
+
+def decimal_from_unscaled(u: int, scale: int):
+    """Exact Decimal for an unscaled integer (string construction is the
+    only context-independent path)."""
+    import decimal as pydec
+
+    return pydec.Decimal(f"{int(u)}E-{scale}")
 
 
 #: Spark's default decimal for literals / sums

@@ -282,10 +282,9 @@ def test_wide_decimal_sum_no_wrap():
     got = _agg_pipeline([b], [(col(0), "k")],
                         [(AggExpr("sum", col(1)), "s"), (AggExpr("avg", col(1)), "a")])
     got = _sorted(got, "k")
-    # group 1: exact sum 1e19 exceeds both int64 and the 18-digit decimal64
-    # emit domain -> NULL (not a silently wrapped wrong number); the avg is
-    # computed from the exact limb sum -> exactly 5e13
-    assert pd.isna(got["s"][0])
+    # round 2: sums beyond the decimal64 domain emit EXACTLY through the
+    # wide-decimal dictionary representation (previously NULL)
+    assert got["s"][0] == d.Decimal(10) ** 19
     assert int(got["a"][0]) == 5 * 10**13
     # group 2 small values flow through exactly
     assert got["s"][1] == d.Decimal(15)

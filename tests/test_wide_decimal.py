@@ -1,0 +1,268 @@
+"""Wide decimal(38,x) columns end-to-end (VERDICT r1 item 10).
+
+p>18 values ride as dictionary codes on device with exact Decimal128
+dictionaries host-side, and must survive scan -> shuffle -> join ->
+group-by -> aggregation with exact results (reference decimal paths:
+ext-commons/src/arrow/cast.rs)."""
+
+import decimal as pydec
+
+# python Decimal arithmetic rounds at the context precision (28 significant
+# digits by default) — the ORACLE must be exact, the engine is
+pydec.getcontext().prec = 100
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exprs.ir import col
+from auron_tpu.plan import builders as B
+from auron_tpu.plan.planner import plan_from_proto
+
+
+def _dec38(rng, n, scale=4):
+    """decimal(38, scale) values spanning far beyond int64."""
+    out = []
+    for _ in range(n):
+        # magnitudes sized so per-group EXACT sums stay inside decimal(38)
+        # (overflowing sums go NULL per Spark non-ANSI; tested separately)
+        mag = int(rng.integers(0, 22))
+        u = int(rng.integers(1, 10**9)) * (10**mag) * int(rng.choice([-1, 1]))
+        out.append(pydec.Decimal(u).scaleb(-scale))
+    return out
+
+
+@pytest.fixture(scope="module")
+def wide_data():
+    rng = np.random.default_rng(77)
+    n = 500
+    fact = {
+        "fk": rng.integers(0, 12, n).astype(np.int64).tolist(),
+        "amount": _dec38(rng, n),
+    }
+    dim = {
+        "dk": np.arange(12, dtype=np.int64).tolist(),
+        "grp": (np.arange(12) % 3).astype(np.int64).tolist(),
+    }
+    return fact, dim
+
+
+FACT_SCHEMA = T.Schema.of(T.Field("fk", T.INT64), T.Field("amount", T.decimal(38, 4)))
+DIM_SCHEMA = T.Schema.of(T.Field("dk", T.INT64), T.Field("grp", T.INT64))
+
+
+def _oracle(fact, dim):
+    rows = {}
+    grp_of = dict(zip(dim["dk"], dim["grp"]))
+    for fk, amt in zip(fact["fk"], fact["amount"]):
+        g = grp_of[fk]
+        s, c, mn, mx = rows.get(g, (pydec.Decimal(0), 0, None, None))
+        rows[g] = (
+            s + amt, c + 1,
+            amt if mn is None or amt < mn else mn,
+            amt if mx is None or amt > mx else mx,
+        )
+    return {
+        g: (s, c, mn, mx) for g, (s, c, mn, mx) in sorted(rows.items())
+    }
+
+
+def test_wide_decimal_scan_join_agg_exact(wide_data, tmp_path):
+    """parquet scan -> broadcast join -> two-stage group aggregation with
+    sum/count/min/max over decimal(38,4), checked EXACTLY vs python
+    Decimal arithmetic (no float tolerance)."""
+    fact, dim = wide_data
+    path = str(tmp_path / "fact.parquet")
+    pq.write_table(
+        pa.table({
+            "fk": pa.array(fact["fk"], pa.int64()),
+            "amount": pa.array(fact["amount"], pa.decimal128(38, 4)),
+        }),
+        path, row_group_size=128,
+    )
+    dim_b = Batch.from_pydict(dim, schema=DIM_SCHEMA)
+
+    scan = B.parquet_scan(FACT_SCHEMA, [path])
+    j = B.hash_join(scan, B.memory_scan(DIM_SCHEMA, "wd_dim"),
+                    [col(0)], [col(0)], "inner", build_side="right")
+    proj = B.project(j, [(col(3), "grp"), (col(1), "amount")])
+    aggs = [("sum", col(1), "s"), ("count", col(1), "c"),
+            ("min", col(1), "mn"), ("max", col(1), "mx")]
+    partial = B.hash_agg(proj, [(col(0), "grp")], aggs, "partial")
+    final = B.hash_agg(partial, [(col(0), "grp")], aggs, "final")
+
+    op = plan_from_proto(final)
+    ctx = ExecutionContext(resources={"wd_dim": [[dim_b]]})
+    got = op.collect(ctx=ctx).to_arrow().to_pylist()
+    got = {r["grp"]: r for r in got}
+
+    want = _oracle(fact, dim)
+    assert sorted(got) == sorted(want)
+    for g, (s, c, mn, mx) in want.items():
+        r = got[g]
+        assert r["c"] == c
+        assert pydec.Decimal(str(r["s"])) == s, (g, r["s"], s)
+        assert pydec.Decimal(str(r["mn"])) == mn
+        assert pydec.Decimal(str(r["mx"])) == mx
+
+
+def test_wide_decimal_shuffle_roundtrip(wide_data, tmp_path):
+    """wide decimal columns survive the compacted file shuffle bit-exactly
+    (dictionary re-encode at IPC boundaries)."""
+    from auron_tpu.bridge import api
+    from auron_tpu.exec.shuffle.reader import MultiMapBlockProvider
+
+    fact, _ = wide_data
+    b = Batch.from_pydict(fact, schema=FACT_SCHEMA)
+    api.put_resource("wd_fact", [[b]])
+    try:
+        part = B.hash_partitioning([col(0)], 3)
+        w = B.shuffle_writer(
+            B.memory_scan(FACT_SCHEMA, "wd_fact"), part,
+            str(tmp_path / "m.data"), str(tmp_path / "m.index"),
+        )
+        h = api.call_native(B.task(w).SerializeToString())
+        while api.next_batch(h) is not None:
+            pass
+        api.finalize_native(h)
+        api.put_resource(
+            "wd_blocks",
+            MultiMapBlockProvider([(str(tmp_path / "m.data"), str(tmp_path / "m.index"))]),
+        )
+        got = []
+        for p in range(3):
+            h = api.call_native(
+                B.task(B.ipc_reader(FACT_SCHEMA, "wd_blocks"), partition_id=p).SerializeToString()
+            )
+            while (rb := api.next_batch(h)) is not None:
+                got += rb.to_pylist()
+            api.finalize_native(h)
+        want = sorted(zip(fact["fk"], fact["amount"]))
+        assert sorted((r["fk"], r["amount"]) for r in got) == want
+    finally:
+        api.remove_resource("wd_fact")
+        api.remove_resource("wd_blocks")
+
+
+def test_wide_decimal_join_keys(wide_data):
+    """joins ON a wide decimal key route and match by exact value."""
+    amounts = [pydec.Decimal("123456789012345678901234.5678"),
+               pydec.Decimal("-99999999999999999999.0001"),
+               pydec.Decimal("0.0001")]
+    left = Batch.from_pydict(
+        {"a": amounts * 2, "x": list(range(6))},
+        schema=T.Schema.of(T.Field("a", T.decimal(38, 4)), T.Field("x", T.INT64)),
+    )
+    right = Batch.from_pydict(
+        {"a2": amounts[:2], "tag": [10, 20]},
+        schema=T.Schema.of(T.Field("a2", T.decimal(38, 4)), T.Field("tag", T.INT64)),
+    )
+    j = B.hash_join(
+        B.memory_scan(left.schema, "wd_l"), B.memory_scan(right.schema, "wd_r"),
+        [col(0)], [col(0)], "inner", build_side="right",
+    )
+    op = plan_from_proto(j)
+    ctx = ExecutionContext(resources={"wd_l": [[left]], "wd_r": [[right]]})
+    got = op.collect(ctx=ctx).to_arrow().to_pylist()
+    assert len(got) == 4  # amounts[0], amounts[1] each matched twice
+    for r in got:
+        assert r["a"] == r["a2"]
+        assert r["tag"] == (10 if r["a"] == amounts[0] else 20)
+
+
+def test_wide_decimal_sort(wide_data):
+    """ORDER BY a wide decimal column sorts numerically (not by code)."""
+    vals = [pydec.Decimal("1e20"), pydec.Decimal("-3e25"),
+            pydec.Decimal("7.5"), None, pydec.Decimal("-0.5")]
+    b = Batch.from_pydict(
+        {"a": vals}, schema=T.Schema.of(T.Field("a", T.decimal(38, 4)))
+    )
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    s = B.sort(B.memory_scan(b.schema, "wd_s"), [(col(0), SortSpec())])
+    op = plan_from_proto(s)
+    ctx = ExecutionContext(resources={"wd_s": [[b]]})
+    got = [r["a"] for r in op.collect(ctx=ctx).to_arrow().to_pylist()]
+    assert got == [None, pydec.Decimal("-3e25"), pydec.Decimal("-0.5"),
+                   pydec.Decimal("7.5"), pydec.Decimal("1e20")]
+
+
+def test_wide_decimal_sum_overflow_goes_null():
+    """a sum whose exact total exceeds 38 digits emits NULL, never a
+    wrapped value (Spark non-ANSI overflow semantics)."""
+    from auron_tpu.exec.agg_exec import FINAL, PARTIAL, AggExpr, HashAggExec
+    from auron_tpu.exec.basic import MemoryScanExec
+
+    vals = [pydec.Decimal(10) ** 33] * 200_000  # exact sum 2e38 > p38
+    b = Batch.from_pydict(
+        {"k": [1] * len(vals), "v": vals},
+        schema=T.Schema.of(T.Field("k", T.INT32), T.Field("v", T.decimal(38, 0))),
+    )
+    scan = MemoryScanExec.single([b])
+    partial = HashAggExec(scan, [(col(0), "k")], [(AggExpr("sum", col(1)), "s")], PARTIAL)
+    mid = MemoryScanExec.single(
+        list(partial.execute(0, ExecutionContext())) or []
+    )
+    final = HashAggExec(mid, [(col(0), "k")], [(AggExpr("sum", col(1)), "s")], FINAL)
+    got = final.collect().to_arrow().to_pylist()
+    assert len(got) == 1 and got[0]["s"] is None
+
+
+def test_wide_decimal_filter_against_literal():
+    """WHERE amount > <literal> compares exact VALUES, not codes."""
+    from auron_tpu.exprs.ir import BinaryOp, lit
+
+    vals = [pydec.Decimal("1e25"), pydec.Decimal("-5e20"),
+            pydec.Decimal("100.49"), pydec.Decimal("100.51"), None]
+    b = Batch.from_pydict(
+        {"a": vals}, schema=T.Schema.of(T.Field("a", T.decimal(38, 4)))
+    )
+    plan = B.filter_(
+        B.memory_scan(b.schema, "wf"),
+        [BinaryOp("gt", col(0), lit(pydec.Decimal("100.5"), T.decimal(5, 1)))],
+    )
+    op = plan_from_proto(plan)
+    got = [r["a"] for r in op.collect(
+        ctx=ExecutionContext(resources={"wf": [[b]]})
+    ).to_arrow().to_pylist()]
+    assert got == [pydec.Decimal("1e25"), pydec.Decimal("100.51")]
+
+
+def test_wide_decimal_outer_join_null_side():
+    """outer-join null extension builds decimal-typed sentinel dicts."""
+    left = Batch.from_pydict(
+        {"k": [1, 2, 3]}, schema=T.Schema.of(T.Field("k", T.INT64))
+    )
+    right = Batch.from_pydict(
+        {"k2": [1], "amt": [pydec.Decimal("1e20")]},
+        schema=T.Schema.of(T.Field("k2", T.INT64), T.Field("amt", T.decimal(38, 2))),
+    )
+    j = B.hash_join(B.memory_scan(left.schema, "ol"),
+                    B.memory_scan(right.schema, "orr"),
+                    [col(0)], [col(0)], "left", build_side="right")
+    op = plan_from_proto(j)
+    got = op.collect(ctx=ExecutionContext(
+        resources={"ol": [[left]], "orr": [[right]]}
+    )).to_arrow().to_pylist()
+    got = sorted(got, key=lambda r: r["k"])
+    assert got[0]["amt"] == pydec.Decimal("1e20")
+    assert got[1]["amt"] is None and got[2]["amt"] is None
+
+
+def test_wide_decimal_scalar_fn_fails_loudly():
+    from auron_tpu.exprs.ir import ScalarFunc
+
+    b = Batch.from_pydict(
+        {"a": [pydec.Decimal("1e20")]},
+        schema=T.Schema.of(T.Field("a", T.decimal(38, 2))),
+    )
+    plan = B.project(B.memory_scan(b.schema, "wfn"),
+                     [(ScalarFunc("abs", (col(0),)), "r")])
+    op = plan_from_proto(plan)
+    with pytest.raises(NotImplementedError, match="decimal"):
+        op.collect(ctx=ExecutionContext(resources={"wfn": [[b]]}))
