@@ -64,6 +64,10 @@ def call_native(task_bytes: bytes) -> int:
     with _lock:
         resources = dict(_resources)
     rt = TaskRuntime(task_bytes, resources=resources)
+    # conf-gated observability service (auron/src/http analog)
+    from auron_tpu.utils.httpsvc import maybe_start_from_conf
+
+    maybe_start_from_conf(rt.ctx.conf)
     h = next(_next_handle)
     with _lock:
         _runtimes[h] = rt

@@ -591,13 +591,17 @@ class HashAggExec(ExecOperator):
             emit_t = avg_type(in_t)
             cnt = np.asarray(jax.device_get(cols[k].values))
             ok = valid & (cnt > 0)
-            shift = 10 ** (emit_t.scale - st.scale)
+            diff = emit_t.scale - st.scale
+            num_shift = 10 ** max(diff, 0)  # pure-int shifts: a float
+            den_shift = 10 ** max(-diff, 0)  # 10**negative would corrupt
             q = pydec.Decimal(1)
             unscaled = np.zeros(len(valid), dtype=object)
             for i in np.nonzero(ok)[0]:
                 unscaled[i] = int(
-                    (pydec.Decimal(int(total[i]) * shift) / pydec.Decimal(int(cnt[i])))
-                    .quantize(q, rounding=pydec.ROUND_HALF_UP)
+                    (
+                        pydec.Decimal(int(total[i]) * num_shift)
+                        / pydec.Decimal(int(cnt[i]) * den_shift)
+                    ).quantize(q, rounding=pydec.ROUND_HALF_UP)
                 )
         if emit_t.is_wide_decimal:
             # dict-encoded exact emission (identity codes); totals beyond

@@ -17,27 +17,125 @@ from auron_tpu.exec.base import ExecOperator, ExecutionContext
 from auron_tpu.exec.shuffle.format import encode_block
 
 
-class ParquetSinkExec(ExecOperator):
-    """Writes the partition stream as part-<partition>.parquet under
-    output_path; yields nothing (the host engine commits the files)."""
+def _hive_escape(v) -> str:
+    """Hive partition-path encoding of a partition value."""
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    s = str(v)
+    out = []
+    for ch in s:
+        # the character set Hive escapes in partition directory names
+        if ch in '"#%\'*/:=?\\{}[]^' or ord(ch) < 0x20:
+            out.append(f"%{ord(ch):02X}")
+        else:
+            out.append(ch)
+    return "".join(out)
 
-    def __init__(self, child: ExecOperator, output_path: str, props: dict | None = None):
+
+class ParquetSinkExec(ExecOperator):
+    """Writes the partition stream under output_path; yields nothing (the
+    host engine commits the files). With ``partition_by`` columns the
+    output is Hive-style: <path>/col1=v1/col2=v2/part-N.parquet with the
+    partition columns dropped from the files (reference:
+    parquet_sink_exec.rs + NativeParquetSinkUtils.java dynamic
+    partitioning)."""
+
+    def __init__(self, child: ExecOperator, output_path: str,
+                 props: dict | None = None,
+                 partition_by: list[str] | None = None):
         super().__init__([child], child.schema)
         self.output_path = output_path
         self.props = props or {}
+        self.partition_by = list(partition_by or [])
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         import os
 
-        os.makedirs(self.output_path, exist_ok=True)
-        path = os.path.join(self.output_path, f"part-{partition:05d}.parquet")
         compression = self.props.get("compression", "zstd")
-        writer = None
+        if not self.partition_by:
+            os.makedirs(self.output_path, exist_ok=True)
+            path = os.path.join(self.output_path, f"part-{partition:05d}.parquet")
+            self._write_stream(
+                (b.to_arrow() for b in self.child_stream(0, partition, ctx)),
+                path, self.schema.to_arrow(), compression, ctx,
+            )
+            return
+            yield  # pragma: no cover
+
+        # dynamic (hive-style) partitioned write: split every batch by the
+        # partition-key tuple, one open writer per seen partition directory
+        part_idx = [self.schema.names.index(c) for c in self.partition_by]
+        data_idx = [i for i in range(len(self.schema)) if i not in part_idx]
+        out_schema = pa.schema(
+            [self.schema.to_arrow().field(i) for i in data_idx]
+        )
+        writers: dict[tuple, pq.ParquetWriter] = {}
         rows = 0
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
                 rb = b.to_arrow()
+                if rb.num_rows == 0:
+                    continue
+                tbl = pa.Table.from_batches([rb])
+                # vectorized split: per-column dictionary codes combined to
+                # one group id (NaN floats unify through Arrow's dictionary
+                # semantics, avoiding nan != nan duplicate writers)
+                import numpy as np
+                import pyarrow.compute as pc
+
+                code_cols, dicts = [], []
+                for i in part_idx:
+                    enc = pc.dictionary_encode(tbl.column(i).combine_chunks())
+                    codes = enc.indices.fill_null(-1).to_numpy(
+                        zero_copy_only=False
+                    ).astype(np.int64)
+                    code_cols.append(codes)
+                    dicts.append(enc.dictionary.to_pylist())
+                combo = code_cols[0].copy()
+                for codes, d in zip(code_cols[1:], dicts[1:]):
+                    combo = combo * (len(d) + 1) + (codes + 1)
+                for gid in np.unique(combo):
+                    mask_np = combo == gid
+                    first = int(np.nonzero(mask_np)[0][0])
+                    key = tuple(
+                        (d[codes[first]] if codes[first] >= 0 else None)
+                        for codes, d in zip(code_cols, dicts)
+                    )
+                    sub = tbl.filter(pa.array(mask_np)).select(data_idx)
+                    w = writers.get(key)
+                    if w is None:
+                        d = os.path.join(
+                            self.output_path,
+                            *(
+                                f"{c}={_hive_escape(v)}"
+                                for c, v in zip(self.partition_by, key)
+                            ),
+                        )
+                        os.makedirs(d, exist_ok=True)
+                        with ctx.metrics.timer("io_time"):
+                            w = pq.ParquetWriter(
+                                os.path.join(d, f"part-{partition:05d}.parquet"),
+                                out_schema, compression=compression,
+                            )
+                        writers[key] = w
+                    with ctx.metrics.timer("io_time"):
+                        w.write_table(sub)
+                    rows += sub.num_rows
+        finally:
+            for w in writers.values():
+                w.close()
+        ctx.metrics.add("rows_written", rows)
+        ctx.metrics.add("partitions_written", len(writers))
+        return
+        yield  # pragma: no cover
+
+    def _write_stream(self, rbs, path, schema, compression, ctx):
+        writer = None
+        rows = 0
+        try:
+            for rb in rbs:
+                ctx.check_cancelled()
                 if rb.num_rows == 0:
                     continue
                 if writer is None:
@@ -51,12 +149,10 @@ class ParquetSinkExec(ExecOperator):
                 writer.close()
         if writer is None:  # write an empty file with the right schema
             pq.write_table(
-                pa.Table.from_batches([], schema=self.schema.to_arrow()),
-                path, compression=compression,
+                pa.Table.from_batches([], schema=schema), path,
+                compression=compression,
             )
         ctx.metrics.add("rows_written", rows)
-        return
-        yield  # pragma: no cover
 
 
 class OrcSinkExec(ExecOperator):

@@ -334,12 +334,16 @@ class Evaluator:
             return [jnp.asarray(tabs[j])[idx] for j in range(W)]
         # narrow side: scaled int64 at its own scale, shifted up by
         # k = s - ns digits. word j = floor(v * 10^(k-13j)) mod 1e13,
-        # computed without overflow via exact div/mod identities
-        dv = cv if cv.dtype.kind == T.TypeKind.DECIMAL else self._cast(
-            cv, ir._as_decimal(cv.dtype)
-        )
-        v = dv.values.astype(jnp.int64)
-        k = s - dv.dtype.scale
+        # computed without overflow via exact div/mod identities.
+        # Integers enter directly at scale 0 (never cast: _as_decimal of
+        # INT64 is decimal(20,0), itself wide)
+        if cv.dtype.kind == T.TypeKind.DECIMAL:
+            v = cv.values.astype(jnp.int64)
+            ns = cv.dtype.scale
+        else:
+            v = cv.values.astype(jnp.int64)
+            ns = 0
+        k = s - ns
         words = []
         sign_lo = jnp.where(v < 0, jnp.int64(BASE - 1), jnp.int64(0))
         sign_top = jnp.where(v < 0, jnp.int64(-1), jnp.int64(0))
@@ -554,7 +558,18 @@ def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
     """Make CASE/COALESCE branch values physically mergeable (same dtype, and
     for strings, the same dictionary)."""
     if any(v.dtype.is_dict_encoded for v in vals):
-        assert all(v.dtype.is_dict_encoded for v in vals), "mixed string/non-string branches"
+        assert all(
+            v.dtype.is_dict_encoded for v in vals
+        ), "mixed dict-encoded / plain branches"
+        first = vals[0].dtype
+        if first.kind == T.TypeKind.DECIMAL:
+            import decimal as pydec
+
+            value_type, filler = first.to_arrow(), [pydec.Decimal(0)]
+        elif first.kind == T.TypeKind.BINARY:
+            value_type, filler = pa.binary(), [b""]
+        else:
+            value_type, filler = pa.string(), [""]
         vocab: dict = {}
         remaps = []
         for v in vals:
@@ -563,7 +578,7 @@ def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
             for i, s in enumerate(pl):
                 r[i] = vocab.setdefault(s, len(vocab))
             remaps.append(r)
-        unified = pa.array(list(vocab.keys()) or [""], type=pa.string())
+        unified = pa.array(list(vocab.keys()) or filler, type=value_type)
         out = []
         for v, r in zip(vals, remaps):
             codes = jnp.asarray(r)[jnp.clip(v.values, 0, len(r) - 1)]

@@ -61,6 +61,8 @@ from auron_tpu.plan.planner import (
 )
 from auron_tpu.proto import plan_pb2 as pb
 from auron_tpu.utils.config import (
+    EXCHANGE_COALESCE_ENABLE,
+    EXCHANGE_COALESCE_TARGET_BYTES,
     EXCHANGE_MESH_MAX_BYTES,
     EXCHANGE_MODE,
     Configuration,
@@ -75,9 +77,25 @@ class ExchangeStats:
     mode: str  # "mesh" | "file"
     rows: np.ndarray  # [P_src, P_dst] routed row counts
     est_bytes_per_shard: int  # payload of the hottest receiving shard
+    coalesced_groups: list | None = None  # AQE partition grouping, if applied
 
     def partition_sizes(self) -> np.ndarray:
         return self.rows.sum(axis=0)
+
+
+class CoalescedBlockProvider:
+    """AQE post-shuffle coalescing consumer: reduce task p reads every
+    original partition of its group (Spark CoalesceShufflePartitions —
+    grouping whole hash partitions preserves group-by/join co-partitioning).
+    """
+
+    def __init__(self, inner, groups: list[list[int]]):
+        self.inner = inner
+        self.groups = groups
+
+    def __call__(self, partition: int):
+        for orig in self.groups[partition]:
+            yield from self.inner(orig)
 
 
 class MeshQueryDriver:
@@ -92,6 +110,8 @@ class MeshQueryDriver:
         self.stats: list[ExchangeStats] = []
         self._exchange_seq = 0
         self._tmp_dirs: list[str] = []
+        self._reduce_parts: int | None = None  # AQE-coalesced stage width
+        self._coalesce_candidate = None
 
     # ------------------------------------------------------------------
 
@@ -102,9 +122,22 @@ class MeshQueryDriver:
         try:
             from auron_tpu.plan.optimizer import prune_columns
 
+            # per-run state (drivers are reusable across queries)
+            self.stats = []
+            self._exchange_seq = 0
+            self._reduce_parts = None
+            self._coalesce_candidate = None
+
             resolved = self._rewrite(prune_columns(plan), resources)
+            if self._coalesce_candidate is not None and len(self.stats) == 1:
+                # the AQE re-plan: one exchange feeding the residual stage
+                ex_id, provider, groups = self._coalesce_candidate
+                resources[ex_id] = CoalescedBlockProvider(provider, groups)
+                self.stats[0].coalesced_groups = groups
+                self._reduce_parts = len(groups)
             outs: list[list[Batch]] = []
-            for p in range(self.n_parts):
+            n_reduce = self._reduce_parts or self.n_parts
+            for p in range(n_reduce):
                 op = plan_from_proto(resolved)
                 ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
                                        resources=resources)
@@ -315,7 +348,24 @@ class MeshQueryDriver:
                 pairs.append((data_f, index_f))
         finally:
             resources.pop(src_id, None)
-        resources[ex_id] = MultiMapBlockProvider(pairs)
+        provider = MultiMapBlockProvider(pairs)
+        # ---- AQE: statistics-driven post-shuffle coalescing candidate.
+        # Applied AFTER the whole rewrite, and only when this exchange is
+        # the plan's only one — shrinking the residual stage width is only
+        # sound when every stage input agrees on it.
+        if self.conf.get(EXCHANGE_COALESCE_ENABLE):
+            from auron_tpu.parallel.broadcast import (
+                map_output_stats,
+                plan_coalesced_partitions,
+            )
+
+            sizes = map_output_stats([i for _, i in pairs])
+            groups = plan_coalesced_partitions(
+                sizes, self.conf.get(EXCHANGE_COALESCE_TARGET_BYTES)
+            )
+            if len(groups) < self.n_parts:
+                self._coalesce_candidate = (ex_id, provider, groups)
+        resources[ex_id] = provider
         return pb.PhysicalPlanNode(
             ipc_reader=pb.IpcReaderNode(
                 schema=schema_to_proto(schema), resource_id=ex_id

@@ -313,9 +313,11 @@ def generate(child, generator: str, gen_expr: ir.Expr, required_cols: list[int],
     return _wrap(generate=n)
 
 
-def parquet_sink(child, output_path: str, props: dict | None = None) -> pb.PhysicalPlanNode:
+def parquet_sink(child, output_path: str, props: dict | None = None,
+                 partition_by: list[str] | None = None) -> pb.PhysicalPlanNode:
     return _wrap(parquet_sink=pb.ParquetSinkNode(
-        child=child, output_path=output_path, props=props or {}))
+        child=child, output_path=output_path, props=props or {},
+        partition_by=list(partition_by or [])))
 
 
 def ipc_writer(child, resource_id: str) -> pb.PhysicalPlanNode:

@@ -445,6 +445,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             plan_from_proto(p.parquet_sink.child),
             p.parquet_sink.output_path,
             dict(p.parquet_sink.props),
+            partition_by=list(p.parquet_sink.partition_by) or None,
         )
     if which == "ipc_writer":
         from auron_tpu.exec.sink import IpcWriterExec

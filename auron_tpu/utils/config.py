@@ -186,6 +186,15 @@ EXCHANGE_MODE = str_conf(
     "file (durable compacted shuffle files) | auto (mesh when the payload "
     "fits exchange.mesh.max.bytes per shard)",
 )
+EXCHANGE_COALESCE_ENABLE = bool_conf(
+    "exchange.coalesce.enable", True, "shuffle",
+    "AQE post-shuffle coalescing: group small reduce partitions from "
+    "map-output statistics (CoalesceShufflePartitions analog)",
+)
+EXCHANGE_COALESCE_TARGET_BYTES = int_conf(
+    "exchange.coalesce.target.bytes", 64 << 20, "shuffle",
+    "target bytes per coalesced reduce partition",
+)
 EXCHANGE_MESH_MAX_BYTES = int_conf(
     "exchange.mesh.max.bytes", 2 << 30, "shuffle",
     "auto-mode ceiling for device-resident exchange payload per shard; "

@@ -1,0 +1,149 @@
+"""In-process observability HTTP service.
+
+Analog of the reference's feature-gated HTTP service exposing CPU pprof
+and heap profiles (auron/src/http/mod.rs:10-95, http/pprof.rs,
+http/memory_profiling.rs). The TPU engine's equivalents:
+
+- /metrics   — JSON metric trees of every live task runtime plus the
+               memory manager's budget/consumer state
+- /stacks    — all-thread python stack dump (the flamegraph source: feed
+               repeated samples to any folded-stack tool)
+- /conf      — the resolved configuration registry
+- /healthz   — liveness
+
+Gated by ``http.service.enable`` (off by default, like the reference's
+feature flag); the bridge starts it lazily on the first task when
+enabled.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from auron_tpu.utils.config import bool_conf, int_conf
+
+HTTP_SERVICE_ENABLE = bool_conf(
+    "http.service.enable", False, "observability",
+    "serve /metrics /stacks /conf /healthz from an in-process HTTP "
+    "service (auron/src/http feature analog)",
+)
+HTTP_SERVICE_PORT = int_conf(
+    "http.service.port", 0, "observability",
+    "port for the observability service (0 = ephemeral)",
+)
+
+_lock = threading.Lock()
+_server: ThreadingHTTPServer | None = None
+_port: int | None = None
+
+
+def _metrics_payload() -> dict:
+    from auron_tpu.bridge import api
+    from auron_tpu.memory.memmgr import MemManager
+
+    with api._lock:
+        runtimes = dict(api._runtimes)
+    tasks = {}
+    for h, rt in runtimes.items():
+        tasks[str(h)] = {
+            "stage": rt.ctx.stage_id,
+            "partition": rt.ctx.partition_id,
+            "metrics": rt.ctx.metrics.snapshot(),
+        }
+    mm = MemManager.get()
+    with mm._lock:
+        consumers = [
+            {"name": c.name, "mem_used": c.mem_used()} for c in mm._consumers
+        ]
+    return {
+        "tasks": tasks,
+        "memory": {
+            "budget_bytes": mm.budget,
+            "num_spills": mm.num_spills,
+            "consumers": consumers,
+        },
+    }
+
+
+def _stacks_payload() -> str:
+    import sys
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ({names.get(tid, '?')}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        try:
+            if self.path == "/healthz":
+                self._send(b"ok\n", "text/plain")
+            elif self.path == "/metrics":
+                self._send(
+                    json.dumps(_metrics_payload(), indent=2).encode(),
+                    "application/json",
+                )
+            elif self.path == "/stacks":
+                self._send(_stacks_payload().encode(), "text/plain")
+            elif self.path == "/conf":
+                from auron_tpu.utils.config import _REGISTRY, active_conf
+
+                conf = active_conf()
+                payload = {
+                    k: repr(conf.get(o)) for k, o in sorted(_REGISTRY.items())
+                }
+                self._send(
+                    json.dumps(payload, indent=2).encode(), "application/json"
+                )
+            else:
+                self._send(b"not found\n", "text/plain", 404)
+        except Exception as e:  # noqa: BLE001 — observability must not crash tasks
+            self._send(f"error: {e}\n".encode(), "text/plain", 500)
+
+
+def start(port: int = 0) -> int:
+    """Start (or return) the service; returns the bound port."""
+    global _server, _port
+    with _lock:
+        if _server is not None:
+            return _port
+        _server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        _port = _server.server_address[1]
+        t = threading.Thread(
+            target=_server.serve_forever, daemon=True, name="auron-http-svc"
+        )
+        t.start()
+        return _port
+
+
+def stop() -> None:
+    global _server, _port
+    with _lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+            _port = None
+
+
+def maybe_start_from_conf(conf) -> int | None:
+    """Lazy conf-gated start (called by the bridge on task entry)."""
+    if not conf.get(HTTP_SERVICE_ENABLE):
+        return None
+    return start(conf.get(HTTP_SERVICE_PORT))

@@ -1,0 +1,75 @@
+"""Observability HTTP service (auron/src/http/mod.rs analog)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.bridge import api
+from auron_tpu.columnar import Batch
+from auron_tpu.exprs.ir import col
+from auron_tpu.plan import builders as B
+from auron_tpu.utils import httpsvc
+
+
+@pytest.fixture()
+def svc():
+    port = httpsvc.start(0)
+    yield port
+    httpsvc.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_healthz_and_conf(svc):
+    code, body = _get(svc, "/healthz")
+    assert code == 200 and body == "ok\n"
+    code, body = _get(svc, "/conf")
+    conf = json.loads(body)
+    assert "exchange.mode" in conf and "batch.size" in conf
+
+
+def test_metrics_expose_live_tasks(svc):
+    b = Batch.from_pydict({"v": list(range(100))},
+                          schema=T.Schema.of(T.Field("v", T.INT64)))
+    api.put_resource("http_rows", [[b]])
+    try:
+        plan = B.hash_agg(B.memory_scan(b.schema, "http_rows"), [],
+                          [("sum", col(0), "s")], "partial")
+        h = api.call_native(B.task(plan).SerializeToString())
+        # while the runtime is live, /metrics sees it
+        code, body = _get(svc, "/metrics")
+        payload = json.loads(body)
+        assert code == 200
+        assert str(h) in payload["tasks"]
+        assert "budget_bytes" in payload["memory"]
+        while api.next_batch(h) is not None:
+            pass
+        api.finalize_native(h)
+    finally:
+        api.remove_resource("http_rows")
+
+
+def test_stacks_dump(svc):
+    code, body = _get(svc, "/stacks")
+    assert code == 200
+    assert "--- thread" in body and "MainThread" in body
+
+
+def test_conf_gated_autostart():
+    from auron_tpu.utils.config import Configuration
+
+    assert httpsvc.maybe_start_from_conf(Configuration()) is None  # off by default
+    port = httpsvc.maybe_start_from_conf(
+        Configuration().set(httpsvc.HTTP_SERVICE_ENABLE, True)
+    )
+    try:
+        assert port is not None
+        code, _ = _get(port, "/healthz")
+        assert code == 200
+    finally:
+        httpsvc.stop()

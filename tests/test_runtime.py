@@ -201,3 +201,51 @@ def test_metric_render():
 def _row_metrics_on(enable_row_metrics):
     # these suites assert per-operator output_rows metrics
     pass
+
+
+def test_hive_partitioned_parquet_sink(tmp_path):
+    """parquet sink with partition_by writes hive-style directories with
+    partition columns dropped from the files (parquet_sink_exec.rs +
+    NativeParquetSinkUtils dynamic partitioning analog)."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    from auron_tpu import types as T
+    from auron_tpu.bridge import api
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.plan import builders as B
+
+    b = Batch.from_pydict(
+        {"year": [2023, 2023, 2024, 2024, 2024],
+         "cat": ["a", "b", "a", "a", None],
+         "v": [1, 2, 3, 4, 5]},
+        schema=T.Schema.of(T.Field("year", T.INT32), T.Field("cat", T.STRING),
+                           T.Field("v", T.INT64)),
+    )
+    api.put_resource("sink_rows", [[b]])
+    try:
+        out = str(tmp_path / "table")
+        plan = B.parquet_sink(B.memory_scan(b.schema, "sink_rows"), out,
+                              partition_by=["year", "cat"])
+        h = api.call_native(B.task(plan).SerializeToString())
+        while api.next_batch(h) is not None:
+            pass
+        m = api.finalize_native(h)
+        dirs = sorted(
+            os.path.relpath(os.path.join(r, f), out)
+            for r, _, fs in os.walk(out) for f in fs
+        )
+        assert "year=2023/cat=a/part-00000.parquet" in dirs
+        assert "year=2024/cat=__HIVE_DEFAULT_PARTITION__/part-00000.parquet" in dirs
+        tbl = pq.read_table(os.path.join(out, "year=2024", "cat=a"))
+        assert tbl.column_names == ["v"]  # partition cols dropped
+        assert sorted(tbl.column("v").to_pylist()) == [3, 4]
+        # hive-read round trip reconstructs the partition columns
+        import pyarrow.dataset as ds
+
+        full = ds.dataset(out, partitioning="hive").to_table()
+        assert full.num_rows == 5
+    finally:
+        api.remove_resource("sink_rows")

@@ -266,3 +266,40 @@ def test_wide_decimal_scalar_fn_fails_loudly():
     op = plan_from_proto(plan)
     with pytest.raises(NotImplementedError, match="decimal"):
         op.collect(ctx=ExecutionContext(resources={"wfn": [[b]]}))
+
+
+def test_wide_decimal_vs_int_compare():
+    from auron_tpu.exprs.ir import BinaryOp
+
+    b = Batch.from_pydict(
+        {"a": [pydec.Decimal("5"), pydec.Decimal("1e20"), pydec.Decimal("-3")],
+         "n": [5, 7, -3]},
+        schema=T.Schema.of(T.Field("a", T.decimal(38, 0)), T.Field("n", T.INT64)),
+    )
+    plan = B.project(B.memory_scan(b.schema, "wi"),
+                     [(BinaryOp("eq", col(0), col(1)), "e"),
+                      (BinaryOp("gt", col(0), col(1)), "g")])
+    op = plan_from_proto(plan)
+    got = op.collect(ctx=ExecutionContext(resources={"wi": [[b]]})).to_pydict()
+    assert got["e"] == [True, False, True]
+    assert got["g"] == [False, True, False]
+
+
+def test_wide_decimal_least_greatest_and_coalesce():
+    from auron_tpu.exprs.ir import Coalesce, ScalarFunc
+
+    a = [pydec.Decimal("1e25"), None, pydec.Decimal("-5")]
+    c = [pydec.Decimal("3"), pydec.Decimal("2e30"), pydec.Decimal("-1e21")]
+    b = Batch.from_pydict(
+        {"a": a, "c": c},
+        schema=T.Schema.of(T.Field("a", T.decimal(38, 2)), T.Field("c", T.decimal(38, 2))),
+    )
+    plan = B.project(B.memory_scan(b.schema, "wl"),
+                     [(ScalarFunc("least", (col(0), col(1))), "l"),
+                      (ScalarFunc("greatest", (col(0), col(1))), "g"),
+                      (Coalesce((col(0), col(1))), "co")])
+    op = plan_from_proto(plan)
+    got = op.collect(ctx=ExecutionContext(resources={"wl": [[b]]})).to_pydict()
+    assert got["l"] == [pydec.Decimal("3"), pydec.Decimal("2e30"), pydec.Decimal("-1e21")]
+    assert got["g"] == [pydec.Decimal("1e25"), pydec.Decimal("2e30"), pydec.Decimal("-5")]
+    assert got["co"] == [pydec.Decimal("1e25"), pydec.Decimal("2e30"), pydec.Decimal("-5")]
