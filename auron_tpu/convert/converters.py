@@ -136,6 +136,11 @@ class _Converter:
     def to_proto(self, node: HostNode, children: list[pb.PhysicalPlanNode]):
         fn = getattr(self, "_c_" + node.op, None)
         if fn is None:
+            from auron_tpu.convert.providers import find_provider
+
+            provider = find_provider(node, self.conf)
+            if provider is not None:
+                return provider.convert(node, children, self.conf)
             raise ValueError(f"{node.op} has no converter")
         return fn(node, children)
 
@@ -310,8 +315,12 @@ class _Converter:
 
     def _c_DataWritingCommandExec(self, n, ch):
         fmt = n.args.get("format", "parquet")
+        partition_by = n.args.get("partition_by") or []
         if fmt == "parquet":
-            return B.parquet_sink(ch[0], n.args["path"], n.args.get("props"))
+            return B.parquet_sink(ch[0], n.args["path"], n.args.get("props"),
+                                  partition_by=partition_by)
+        if partition_by:
+            raise ValueError("dynamic partitioning is parquet-only for now")
         from auron_tpu.plan.builders import _wrap
 
         return _wrap(orc_sink=pb.OrcSinkNode(

@@ -1,0 +1,57 @@
+"""Pluggable conversion providers (AuronConvertProvider SPI analog).
+
+The reference extends its conversion layer through a ServiceLoader SPI
+(spark-extension/.../AuronConvertProvider.scala: isEnabled / isSupported /
+convert) — the mechanism behind the Iceberg/Hudi/Paimon table-format
+plugins (thirdparty/auron-{iceberg,hudi,paimon}). Here providers register
+with the conversion layer and are consulted for host operators the
+built-in converter table doesn't know.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from auron_tpu.convert.hostplan import HostNode
+from auron_tpu.proto import plan_pb2 as pb
+from auron_tpu.utils.config import Configuration, bool_conf
+
+TABLE_FORMATS_ENABLE = bool_conf(
+    "convert.enable.table_formats", True, "convert",
+    "convert table-format scans (iceberg/hudi/paimon descriptors) to "
+    "native file scans",
+)
+
+
+class ConvertProvider(Protocol):
+    def is_enabled(self, node: HostNode, conf: Configuration) -> bool: ...
+
+    def is_supported(self, node: HostNode) -> bool: ...
+
+    def convert(
+        self, node: HostNode, children: list[pb.PhysicalPlanNode],
+        conf: Configuration,
+    ) -> pb.PhysicalPlanNode: ...
+
+
+_PROVIDERS: list[ConvertProvider] = []
+
+
+def register_provider(p: ConvertProvider) -> None:
+    _PROVIDERS.append(p)
+
+
+def find_provider(node: HostNode, conf: Configuration) -> ConvertProvider | None:
+    for p in _PROVIDERS:
+        if p.is_supported(node) and p.is_enabled(node, conf):
+            return p
+    return None
+
+
+def _install_builtin_providers() -> None:
+    from auron_tpu.convert.table_formats import TableFormatScanProvider
+
+    register_provider(TableFormatScanProvider())
+
+
+_install_builtin_providers()

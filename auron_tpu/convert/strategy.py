@@ -92,10 +92,21 @@ def tag_plan(root: HostNode, conf: Configuration, try_convert) -> ConvertTags:
 
     ``try_convert(node, tags)`` must raise with a reason when the node (with
     its children assumed converted where tagged) cannot convert."""
+    from auron_tpu.convert.providers import find_provider
+
     tags = ConvertTags()
     for node in root.walk_up():
         flag_key = OP_FLAG.get(node.op)
         if flag_key is None:
+            # extension point: table-format / third-party providers
+            # (AuronConvertProvider SPI analog)
+            if find_provider(node, conf) is not None:
+                try:
+                    try_convert(node, tags)
+                    tags.convertible[id(node)] = True
+                except Exception as e:  # noqa: BLE001
+                    tags.never(node, f"{node.op}: {e}")
+                continue
             tags.never(node, f"{node.op} is not supported yet.")
             continue
         if not conf.get(ENABLE_FLAGS[flag_key]):

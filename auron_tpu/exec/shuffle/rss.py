@@ -1,0 +1,112 @@
+"""Remote-shuffle-service client analog (Celeborn/Uniffle plugins).
+
+The reference ships RSS integrations under thirdparty/auron-celeborn-* and
+auron-uniffle: a shuffle manager that pushes natively-written partition
+blocks to the service (AuronRssShuffleWriterBase.scala:40-62 handing a
+``RssPartitionWriter`` into the engine) and a reader that fetches them
+back per reduce partition, with the service handling replication.
+
+``LocalRssService`` is the in-process service those clients talk to —
+a faithful single-node stand-in with the same semantics the engine
+depends on: per-(shuffle, map) push streams, commit-on-complete (only
+COMMITTED map outputs are visible to readers — task retries overwrite
+uncommitted pushes), replica fan-out, and per-partition fetch.
+``RssPartitionWriterClient`` plugs into RssShuffleWriterExec through the
+resource map; ``RssBlockProvider`` plugs into IpcReaderExec.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Iterator
+
+import pyarrow as pa
+
+from auron_tpu.exec.shuffle.format import decode_blocks
+
+
+class LocalRssService:
+    """In-process RSS daemon analog (replication degree is cosmetic on one
+    node, but the write path exercises the real fan-out)."""
+
+    def __init__(self, num_replicas: int = 2):
+        self.num_replicas = max(1, num_replicas)
+        self._lock = threading.Lock()
+        # in-flight (uncommitted) pushes: (shuffle, map) -> partition -> blocks
+        self._staging: dict = defaultdict(lambda: defaultdict(list))
+        # committed, immutable outputs: replica -> shuffle -> map -> part -> blocks
+        self._replicas = [
+            defaultdict(lambda: defaultdict(lambda: defaultdict(list)))
+            for _ in range(self.num_replicas)
+        ]
+        self._committed: set[tuple[str, int]] = set()
+
+    # -- write path (client pushes) --
+
+    def push(self, shuffle_id: str, map_id: int, partition: int, block: bytes) -> None:
+        with self._lock:
+            self._staging[(shuffle_id, map_id)][partition].append(block)
+
+    def restart_map(self, shuffle_id: str, map_id: int) -> None:
+        """A (re)started map attempt drops its UNCOMMITTED staging only —
+        committed output is immutable (a speculative duplicate attempt
+        must never destroy the published result)."""
+        with self._lock:
+            self._staging.pop((shuffle_id, map_id), None)
+
+    def commit(self, shuffle_id: str, map_id: int) -> None:
+        """First commit wins: later (speculative) attempts are discarded."""
+        with self._lock:
+            staged = self._staging.pop((shuffle_id, map_id), None)
+            if (shuffle_id, map_id) in self._committed or staged is None:
+                return
+            for rep in self._replicas:
+                for part, blocks in staged.items():
+                    rep[shuffle_id][map_id][part].extend(blocks)
+            self._committed.add((shuffle_id, map_id))
+
+    # -- read path --
+
+    def fetch(self, shuffle_id: str, partition: int,
+              replica: int = 0) -> list[bytes]:
+        """Blocks of every COMMITTED map output for one reduce partition."""
+        with self._lock:
+            rep = self._replicas[replica % self.num_replicas]
+            out: list[bytes] = []
+            for map_id in sorted(rep[shuffle_id]):
+                if (shuffle_id, map_id) in self._committed:
+                    out.extend(rep[shuffle_id][map_id][partition])
+            return out
+
+
+class RssPartitionWriterClient:
+    """The ``RssPartitionWriter`` handed to RssShuffleWriterExec via the
+    resource map (AuronRssShuffleWriterBase analog): write per-partition
+    blocks, commit on flush."""
+
+    def __init__(self, service: LocalRssService, shuffle_id: str, map_id: int):
+        self.service = service
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        service.restart_map(shuffle_id, map_id)  # retry-clean semantics
+
+    def write(self, partition: int, block: bytes) -> None:
+        self.service.push(self.shuffle_id, self.map_id, partition, block)
+
+    def flush(self) -> None:
+        self.service.commit(self.shuffle_id, self.map_id)
+
+
+class RssBlockProvider:
+    """Reduce-side block provider for IpcReaderExec resources."""
+
+    def __init__(self, service: LocalRssService, shuffle_id: str,
+                 replica: int = 0):
+        self.service = service
+        self.shuffle_id = shuffle_id
+        self.replica = replica
+
+    def __call__(self, partition: int) -> Iterator[pa.RecordBatch]:
+        for block in self.service.fetch(self.shuffle_id, partition, self.replica):
+            yield from decode_blocks(block)

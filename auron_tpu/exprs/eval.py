@@ -565,6 +565,13 @@ def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
         if first.kind == T.TypeKind.DECIMAL:
             import decimal as pydec
 
+            # branches may carry different scales: unify at the max scale
+            # (precision 38) so every entry is representable exactly
+            s_common = max(
+                v.dtype.scale for v in vals
+                if v.dtype.kind == T.TypeKind.DECIMAL
+            )
+            first = T.decimal(38, s_common)
             value_type, filler = first.to_arrow(), [pydec.Decimal(0)]
         elif first.kind == T.TypeKind.BINARY:
             value_type, filler = pa.binary(), [b""]
@@ -582,7 +589,7 @@ def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
         out = []
         for v, r in zip(vals, remaps):
             codes = jnp.asarray(r)[jnp.clip(v.values, 0, len(r) - 1)]
-            out.append(ColumnVal(codes, v.validity, vals[0].dtype, unified))
+            out.append(ColumnVal(codes, v.validity, first, unified))
         return out
     target = vals[0].dtype
     for v in vals[1:]:

@@ -130,11 +130,14 @@ class MeshQueryDriver:
 
             resolved = self._rewrite(prune_columns(plan), resources)
             if self._coalesce_candidate is not None and len(self.stats) == 1:
-                # the AQE re-plan: one exchange feeding the residual stage
                 ex_id, provider, groups = self._coalesce_candidate
-                resources[ex_id] = CoalescedBlockProvider(provider, groups)
-                self.stats[0].coalesced_groups = groups
-                self._reduce_parts = len(groups)
+                # shrinking the residual stage width is only sound when the
+                # exchange is its ONLY per-partition input — any other
+                # source would be misaligned or partially dropped
+                if self._only_source_is(resolved, ex_id):
+                    resources[ex_id] = CoalescedBlockProvider(provider, groups)
+                    self.stats[0].coalesced_groups = groups
+                    self._reduce_parts = len(groups)
             outs: list[list[Batch]] = []
             n_reduce = self._reduce_parts or self.n_parts
             for p in range(n_reduce):
@@ -145,6 +148,35 @@ class MeshQueryDriver:
             return outs
         finally:
             self._cleanup_tmp()
+
+    @staticmethod
+    def _only_source_is(plan: pb.PhysicalPlanNode, ex_id: str) -> bool:
+        """True iff the plan's only source/leaf node is the exchange's
+        spliced ipc_reader."""
+        sources: list[tuple[str, str]] = []
+
+        def rec(node):
+            which = node.WhichOneof("plan")
+            inner = getattr(node, which)
+            if which == "union":
+                for c in inner.children:
+                    rec(c)
+                return
+            has_child = False
+            for f in ("child", "left", "right"):
+                try:
+                    present = inner.HasField(f)
+                except ValueError:
+                    continue
+                if present:
+                    has_child = True
+                    rec(getattr(inner, f))
+            if not has_child:
+                rid = getattr(inner, "resource_id", "")
+                sources.append((which, rid))
+
+        rec(plan)
+        return sources == [("ipc_reader", ex_id)]
 
     def _cleanup_tmp(self) -> None:
         import shutil

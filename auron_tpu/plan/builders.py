@@ -276,6 +276,13 @@ def mesh_exchange(child, partitioning: pb.Partitioning,
         child=child, partitioning=partitioning, exchange_id=exchange_id))
 
 
+def rss_shuffle_writer(child, partitioning: pb.Partitioning,
+                       rss_resource_id: str) -> pb.PhysicalPlanNode:
+    return _wrap(rss_shuffle_writer=pb.RssShuffleWriterNode(
+        child=child, partitioning=partitioning,
+        rss_resource_id=rss_resource_id))
+
+
 def ipc_reader(schema: T.Schema, resource_id: str) -> pb.PhysicalPlanNode:
     return _wrap(ipc_reader=pb.IpcReaderNode(
         schema=schema_to_proto(schema), resource_id=resource_id))

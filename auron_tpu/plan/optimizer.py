@@ -94,6 +94,13 @@ def _out_width(node: pb.PhysicalPlanNode) -> int:
         return _out_width(inner.left) + _out_width(inner.right)
     if which == "union":
         return _out_width(inner.children[0])
+    if which == "hash_agg" and inner.mode == pb.AGG_FINAL:
+        return len(inner.groupings) + len(inner.aggs)
+    if which == "kafka_scan":
+        return len(inner.schema.fields)
+    # fallback instantiates the exec subtree; never valid across a
+    # mesh_exchange (driver-resolved), so width-opaque nodes above one
+    # must be covered structurally above
     from auron_tpu.plan.planner import plan_from_proto
 
     return len(plan_from_proto(node).schema)

@@ -234,3 +234,67 @@ def test_ffi_boundary_executes():
     op = plan_from_proto(root.plan)
     got = op.collect(ctx=ctx).to_pydict()
     assert got["doubled"] == [20, 40, 60]
+
+
+def test_table_format_provider_prunes_files(tmp_path):
+    """Iceberg/Hudi/Paimon analog (AuronConvertProvider SPI): a table-scan
+    descriptor lowers to a parquet scan over only the partition-matching
+    data files, and executes correctly."""
+    import pyarrow.parquet as pa_pq
+
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.plan.planner import plan_from_proto
+
+    files = []
+    for year in (2022, 2023, 2024):
+        path = str(tmp_path / f"y{year}.parquet")
+        pa_pq.write_table(
+            pa.table({"year": pa.array([year] * 10, pa.int32()),
+                      "v": pa.array(range(10), pa.int64())}),
+            path,
+        )
+        files.append({"path": path, "partition": {"year": year},
+                      "record_count": 10})
+
+    plan = {
+        "op": "IcebergScanExec",
+        "schema": [["year", "int", True], ["v", "long", True]],
+        "args": {"files": files,
+                 "filters": [_call("greaterthanorequal", _attr(0), _lit(2023, "int"))]},
+        "children": [],
+    }
+    res = convert_plan(plan)
+    assert isinstance(res.root, NativeSegment)
+    scan = res.root.plan.parquet_scan
+    assert len(scan.file_paths) == 2  # 2022 file pruned by partition value
+    assert all("2022" not in p for p in scan.file_paths)
+
+    op = plan_from_proto(res.root.plan)
+    rows = op.collect(ctx=ExecutionContext()).to_arrow().to_pylist()
+    assert len(rows) == 20 and {r["year"] for r in rows} == {2023, 2024}
+
+    # the per-op conf gate turns the provider off
+    conf = Configuration().set("convert.enable.table_formats", False)
+    res2 = convert_plan(plan, conf=conf)
+    assert isinstance(res2.root, HostOp)
+
+
+def test_table_format_provider_composes_with_pipeline():
+    """A table-format scan participates in a larger convertible subtree."""
+    plan = {
+        "op": "HashAggregateExec",
+        "schema": [["year", "int", True], ["c#count", "long", False]],
+        "args": {"mode": "partial",
+                 "groupings": [{"expr": _attr(0), "name": "year"}],
+                 "aggs": [{"fn": "count_star", "expr": None, "name": "c"}]},
+        "children": [{
+            "op": "PaimonScanExec",
+            "schema": [["year", "int", True], ["v", "long", True]],
+            "args": {"files": [], "filters": []},
+            "children": [],
+        }],
+    }
+    res = convert_plan(plan)
+    assert isinstance(res.root, NativeSegment)
+    assert res.root.plan.WhichOneof("plan") == "hash_agg"
+    assert res.root.plan.hash_agg.child.WhichOneof("plan") == "parquet_scan"

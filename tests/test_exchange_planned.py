@@ -180,3 +180,36 @@ def test_aqe_coalesces_small_reduce_partitions(mesh):
     got2, d2 = _run(mesh, df, "file", **{"exchange.coalesce.enable": False})
     assert d2.stats[0].coalesced_groups is None
     pd.testing.assert_frame_equal(got, got2)
+
+
+def test_aqe_skipped_when_other_sources_feed_reduce_stage(mesh):
+    """coalescing must not shrink a stage with additional per-partition
+    inputs (their partitions would be dropped/misaligned)."""
+    df = _fact(n=400, seed=13)
+    schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(df.iloc[:1], preserve_index=False).schema
+    )
+    dim = pd.DataFrame({"k2": np.arange(97, dtype=np.int64),
+                        "tag": np.arange(97, dtype=np.int64) * 10})
+    dim_schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(dim.iloc[:1], preserve_index=False).schema
+    )
+    scan = B.memory_scan(schema, "fact")
+    partial = B.hash_agg(scan, [(col(0), "k")], [("sum", col(2), "s")], "partial")
+    ex = B.mesh_exchange(partial, B.hash_partitioning([col(0)], N_DEV), "exj")
+    final = B.hash_agg(ex, [(col(0), "k")], [("sum", col(1), "s")], "final")
+    j = B.hash_join(final, B.memory_scan(dim_schema, "dim"),
+                    [col(0)], [col(0)], "inner", build_side="right")
+    conf = Configuration().set(EXCHANGE_MODE, "file").set(
+        "exchange.coalesce.target.bytes", 1 << 20)
+    driver = MeshQueryDriver(mesh, conf=conf)
+    dim_b = Batch.from_arrow(pa.RecordBatch.from_pandas(dim, preserve_index=False))
+    out = driver.collect(j, {"fact": _partitioned(df, N_DEV),
+                             "dim": [[dim_b]] * N_DEV})
+    # the join stage has a second input -> no coalescing applied
+    assert driver.stats[0].coalesced_groups is None
+    want = (df.groupby("k").agg(s=("v", "sum")).reset_index()
+            .merge(dim, left_on="k", right_on="k2"))
+    out = out.sort_values("k").reset_index(drop=True)
+    assert out["s"].astype(np.int64).tolist() == want["s"].tolist()
+    assert out["tag"].astype(np.int64).tolist() == want["tag"].tolist()
