@@ -124,7 +124,11 @@ class TableFormatScanProvider:
             node.schema, kept, filters,
             node.args.get("fs_resource_id", ""),
         )
-        # surfaced for explain/tests (the reference reports planFiles stats)
-        self.last_pruned_files = pruned
-        self.last_kept_files = len(kept)
+        if pruned:
+            from auron_tpu.utils.logging import get_logger
+
+            get_logger().info(
+                "%s: pruned %d/%d data files by partition values",
+                node.op, pruned, pruned + len(kept),
+            )
         return scan

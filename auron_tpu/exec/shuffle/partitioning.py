@@ -44,7 +44,27 @@ class HashPartitioning(Partitioning):
     def partition_ids(self, batch: Batch, ctx) -> jnp.ndarray:
         ev = Evaluator(batch.schema)
         vals = ev.evaluate(batch, self.exprs)
-        # hash_batch works on column indices; express via a key-projected batch
+        # hot single-int64-key case: the hand-tiled pallas kernel on TPU
+        # (identical spark-exact bits; jnp path everywhere else). NULL keys
+        # leave the running hash at the seed, so their pid is the constant
+        # pmod(seed) — blended on device, no host sync, no fallback
+        if (
+            len(vals) == 1
+            and vals[0].dict is None
+            and str(vals[0].values.dtype) == "int64"
+        ):
+            from auron_tpu.ops.pallas_kernels import (
+                partition_ids_pallas,
+                use_pallas,
+            )
+
+            if use_pallas():
+                pids = partition_ids_pallas(vals[0].values, self.num_partitions)
+                null_pid = pmod(
+                    jnp.full(batch.capacity, jnp.uint32(42)).view(jnp.int32),
+                    self.num_partitions,
+                )
+                return jnp.where(vals[0].validity, pids, null_pid)
         from auron_tpu.exec.basic import batch_from_columns
 
         kb = batch_from_columns(vals, [f"k{i}" for i in range(len(vals))], batch.device.sel)

@@ -7,10 +7,10 @@ blocks to the service (AuronRssShuffleWriterBase.scala:40-62 handing a
 back per reduce partition, with the service handling replication.
 
 ``LocalRssService`` is the in-process service those clients talk to —
-a faithful single-node stand-in with the same semantics the engine
-depends on: per-(shuffle, map) push streams, commit-on-complete (only
-COMMITTED map outputs are visible to readers — task retries overwrite
-uncommitted pushes), replica fan-out, and per-partition fetch.
+a faithful single-node stand-in with the semantics the engine depends
+on: per-ATTEMPT push streams (speculative duplicates are isolated),
+first-complete-attempt-wins commit, committed output immutability,
+replica fan-out, and per-partition fetch.
 ``RssPartitionWriterClient`` plugs into RssShuffleWriterExec through the
 resource map; ``RssBlockProvider`` plugs into IpcReaderExec.
 """
@@ -33,8 +33,11 @@ class LocalRssService:
     def __init__(self, num_replicas: int = 2):
         self.num_replicas = max(1, num_replicas)
         self._lock = threading.Lock()
-        # in-flight (uncommitted) pushes: (shuffle, map) -> partition -> blocks
+        # in-flight (uncommitted) pushes, isolated PER ATTEMPT so a
+        # speculative duplicate can never clobber the running attempt:
+        # (shuffle, map, attempt) -> partition -> blocks
         self._staging: dict = defaultdict(lambda: defaultdict(list))
+        self._next_attempt = 0
         # committed, immutable outputs: replica -> shuffle -> map -> part -> blocks
         self._replicas = [
             defaultdict(lambda: defaultdict(lambda: defaultdict(list)))
@@ -44,21 +47,25 @@ class LocalRssService:
 
     # -- write path (client pushes) --
 
-    def push(self, shuffle_id: str, map_id: int, partition: int, block: bytes) -> None:
+    def new_attempt(self, shuffle_id: str, map_id: int) -> int:
         with self._lock:
-            self._staging[(shuffle_id, map_id)][partition].append(block)
+            self._next_attempt += 1
+            return self._next_attempt
 
-    def restart_map(self, shuffle_id: str, map_id: int) -> None:
-        """A (re)started map attempt drops its UNCOMMITTED staging only —
-        committed output is immutable (a speculative duplicate attempt
-        must never destroy the published result)."""
+    def push(self, shuffle_id: str, map_id: int, attempt: int,
+             partition: int, block: bytes) -> None:
         with self._lock:
-            self._staging.pop((shuffle_id, map_id), None)
+            self._staging[(shuffle_id, map_id, attempt)][partition].append(block)
 
-    def commit(self, shuffle_id: str, map_id: int) -> None:
-        """First commit wins: later (speculative) attempts are discarded."""
+    def abort_attempt(self, shuffle_id: str, map_id: int, attempt: int) -> None:
         with self._lock:
-            staged = self._staging.pop((shuffle_id, map_id), None)
+            self._staging.pop((shuffle_id, map_id, attempt), None)
+
+    def commit(self, shuffle_id: str, map_id: int, attempt: int) -> None:
+        """First complete attempt wins; later/other attempts are discarded
+        and committed output is immutable."""
+        with self._lock:
+            staged = self._staging.pop((shuffle_id, map_id, attempt), None)
             if (shuffle_id, map_id) in self._committed or staged is None:
                 return
             for rep in self._replicas:
@@ -89,13 +96,17 @@ class RssPartitionWriterClient:
         self.service = service
         self.shuffle_id = shuffle_id
         self.map_id = map_id
-        service.restart_map(shuffle_id, map_id)  # retry-clean semantics
+        self.attempt = service.new_attempt(shuffle_id, map_id)
 
     def write(self, partition: int, block: bytes) -> None:
-        self.service.push(self.shuffle_id, self.map_id, partition, block)
+        self.service.push(self.shuffle_id, self.map_id, self.attempt,
+                          partition, block)
 
     def flush(self) -> None:
-        self.service.commit(self.shuffle_id, self.map_id)
+        self.service.commit(self.shuffle_id, self.map_id, self.attempt)
+
+    def abort(self) -> None:
+        self.service.abort_attempt(self.shuffle_id, self.map_id, self.attempt)
 
 
 class RssBlockProvider:

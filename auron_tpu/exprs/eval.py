@@ -565,24 +565,32 @@ def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
         if first.kind == T.TypeKind.DECIMAL:
             import decimal as pydec
 
-            # branches may carry different scales: unify at the max scale
-            # (precision 38) so every entry is representable exactly
-            s_common = max(
-                v.dtype.scale for v in vals
-                if v.dtype.kind == T.TypeKind.DECIMAL
-            )
-            first = T.decimal(38, s_common)
+            # Spark branch-type widening: max integer digits + max scale,
+            # bounded at p38 with scale give-back (adjustPrecisionScale)
+            s_max = max(v.dtype.scale for v in vals)
+            i_max = max(v.dtype.precision - v.dtype.scale for v in vals)
+            first = ir._bounded(i_max + s_max, s_max)
+            _q = pydec.Decimal(1).scaleb(-first.scale)
             value_type, filler = first.to_arrow(), [pydec.Decimal(0)]
         elif first.kind == T.TypeKind.BINARY:
             value_type, filler = pa.binary(), [b""]
         else:
             value_type, filler = pa.string(), [""]
+        is_dec = first.kind == T.TypeKind.DECIMAL
         vocab: dict = {}
         remaps = []
         for v in vals:
             pl = v.dict.to_pylist()
             r = np.empty(len(pl), dtype=np.int32)
             for i, s in enumerate(pl):
+                if is_dec and s is not None:
+                    import decimal as pydec
+
+                    # cast-to-branch-type semantics: quantize HALF_UP at
+                    # the widened target scale (exact when scale grew)
+                    with pydec.localcontext() as _hp:
+                        _hp.prec = 100
+                        s = s.quantize(_q, rounding=pydec.ROUND_HALF_UP)
                 r[i] = vocab.setdefault(s, len(vocab))
             remaps.append(r)
         unified = pa.array(list(vocab.keys()) or filler, type=value_type)

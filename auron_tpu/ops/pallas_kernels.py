@@ -75,6 +75,36 @@ def partition_ids_pallas(
     return out.reshape(-1)[:n]
 
 
+def _histogram_kernel(pid_ref, out_ref, *, n_parts: int):
+    """Per-partition row counts: VPU one-hot compare-accumulate (the
+    shuffle-sizing histogram; buffered_data.rs routing-count analog).
+    One vectorized store (scalar stores lower poorly on Mosaic)."""
+    pids = pid_ref[:]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n_parts, 1, 1), 0)
+    onehot = (pids[None, :, :] == iota).astype(jnp.int32)
+    out_ref[:] = jnp.sum(onehot, axis=(1, 2))
+
+
+@partial(jax.jit, static_argnames=("n_parts", "interpret"))
+def partition_histogram_pallas(
+    pids: jnp.ndarray, n_parts: int, interpret: bool = False
+) -> jnp.ndarray:
+    """Rows per partition from an int32 pid vector (invalid ids < 0 or
+    >= n_parts fall out of every bucket)."""
+    from jax.experimental import pallas as pl
+
+    n = pids.shape[0]
+    rows = max((n + _LANES - 1) // _LANES, 8)
+    padded = rows * _LANES
+    p2 = jnp.full(padded, jnp.int32(-1)).at[:n].set(pids.astype(jnp.int32))
+    out = pl.pallas_call(
+        partial(_histogram_kernel, n_parts=n_parts),
+        out_shape=jax.ShapeDtypeStruct((n_parts,), jnp.int32),
+        interpret=interpret,
+    )(p2.reshape(rows, _LANES))
+    return out
+
+
 def use_pallas() -> bool:
     try:
         return jax.devices()[0].platform in ("tpu", "axon")

@@ -264,9 +264,27 @@ class MeshQueryDriver:
         return self._mesh_exchange(schema, shard_batches, pids, counts, ex_id, resources)
 
     def _routing_counts(self, batches: list[Batch], pids: list[jnp.ndarray]) -> np.ndarray:
-        """Exact [P_src, P_dst] live-row routing matrix (one host sync)."""
+        """Exact [P_src, P_dst] live-row routing matrix (one host sync).
+
+        On TPU the histogram runs as a pallas kernel and only n_parts ints
+        cross to the host per shard; elsewhere the pid vector transfers
+        and numpy bincounts."""
+        from auron_tpu.ops.pallas_kernels import (
+            partition_histogram_pallas,
+            use_pallas,
+        )
+
         counts = np.zeros((self.n_parts, self.n_parts), dtype=np.int64)
+        on_tpu = use_pallas()
         for src, (b, pid) in enumerate(zip(batches, pids)):
+            if on_tpu:
+                live_pid = jnp.where(b.device.sel, pid.astype(jnp.int32), -1)
+                counts[src] = np.asarray(
+                    jax.device_get(
+                        partition_histogram_pallas(live_pid, self.n_parts)
+                    )
+                )
+                continue
             sel = np.asarray(jax.device_get(b.device.sel))
             pid_h = np.asarray(jax.device_get(pid))[sel]
             if pid_h.size:

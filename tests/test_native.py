@@ -233,3 +233,50 @@ def test_c_abi_error_relay(tmp_path):
     )
     assert r.returncode != 0
     assert "failed" in r.stderr
+
+
+def test_pallas_partition_histogram_interpret():
+    import jax.numpy as jnp
+
+    from auron_tpu.ops.pallas_kernels import partition_histogram_pallas
+
+    rng = np.random.default_rng(9)
+    pids = rng.integers(0, 7, 5000).astype(np.int32)
+    try:
+        got = np.asarray(partition_histogram_pallas(jnp.asarray(pids), 7, interpret=True))
+    except NotImplementedError as e:
+        pytest.skip(f"pallas unavailable: {e}")
+    want = np.bincount(pids, minlength=7)
+    assert (got == want).all()
+
+
+def test_pallas_pid_path_matches_generic(monkeypatch):
+    """Force the gated pallas pid path (interpret mode) through the real
+    HashPartitioning entry and compare with the generic jnp path."""
+    import jax.numpy as jnp
+
+    import auron_tpu.exec.shuffle.partitioning as P
+    import auron_tpu.ops.pallas_kernels as PK
+    from auron_tpu import types as T
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exprs.ir import col
+
+    rng = np.random.default_rng(10)
+    b = Batch.from_pydict(
+        {"k": rng.integers(-(2**60), 2**60, 2000).tolist()},
+        schema=T.Schema.of(T.Field("k", T.INT64)),
+    )
+    hp = P.HashPartitioning([col(0)], 16)
+    want = np.asarray(hp.partition_ids(b, None))
+
+    monkeypatch.setattr(PK, "use_pallas", lambda: True)
+    orig = PK.partition_ids_pallas
+    monkeypatch.setattr(
+        PK, "partition_ids_pallas",
+        lambda v, n, seed=42: orig(v, n, seed=seed, interpret=True),
+    )
+    try:
+        got = np.asarray(hp.partition_ids(b, None))
+    except NotImplementedError as e:
+        pytest.skip(f"pallas unavailable: {e}")
+    assert (got == want).all()
