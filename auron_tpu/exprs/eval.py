@@ -302,6 +302,93 @@ class Evaluator:
         }[op]
         return ColumnVal(res, valid, T.BOOL)
 
+    def _wide_literal_arith(
+        self, op: str, l: ColumnVal, r: ColumnVal
+    ) -> ColumnVal | None:
+        """Exact wide-decimal arithmetic when one operand is a broadcast
+        constant (a one-entry dictionary or a scalar-valued narrow side):
+        the op evaluates once per DICTIONARY ENTRY with python Decimals —
+        the dictionary-transform pattern string functions use. Returns
+        None when neither side is constant (column-pair arithmetic)."""
+        import decimal as pydec
+
+        def const_of(cv: ColumnVal):
+            if cv.dtype.is_wide_decimal:
+                if cv.dict is not None and len(cv.dict) == 1:
+                    return cv.dict.to_pylist()[0]
+                return None
+            if cv.dtype.kind not in (
+                T.TypeKind.DECIMAL, T.TypeKind.INT8, T.TypeKind.INT16,
+                T.TypeKind.INT32, T.TypeKind.INT64,
+            ):
+                return None
+            import jax
+
+            host = np.asarray(jax.device_get(cv.values))
+            if host.size == 0 or not (host == host.flat[0]).all():
+                return None
+            v = int(host.flat[0])
+            if cv.dtype.kind == T.TypeKind.DECIMAL:
+                return T.decimal_from_unscaled(v, cv.dtype.scale)
+            return pydec.Decimal(v)
+
+        wide, other, wide_is_left = (
+            (l, r, True) if l.dtype.is_wide_decimal else (r, l, False)
+        )
+        const = const_of(other)
+        if const is None or wide.dict is None:
+            return None
+        out_t = ir.arith_result_type(op, l.dtype, r.dtype)
+        assert out_t.kind == T.TypeKind.DECIMAL
+        q = pydec.Decimal(1).scaleb(-out_t.scale)
+        bound = pydec.Decimal(10) ** (out_t.precision - out_t.scale)
+        new_entries: list = []
+        ok_tab = np.zeros(max(len(wide.dict), 1), dtype=bool)
+        with pydec.localcontext() as hp:
+            hp.prec = 100
+            for i, e in enumerate(wide.dict.to_pylist()):
+                if e is None:
+                    new_entries.append(pydec.Decimal(0))
+                    continue
+                a, b = (e, const) if wide_is_left else (const, e)
+                try:
+                    if op == "add":
+                        v = a + b
+                    elif op == "sub":
+                        v = a - b
+                    elif op == "mul":
+                        v = a * b
+                    elif op == "div":
+                        if b == 0:
+                            raise ZeroDivisionError
+                        v = a / b
+                    elif op == "mod":
+                        if b == 0:
+                            raise ZeroDivisionError
+                        v = a % b  # Decimal %: sign of the dividend (Spark)
+                    else:
+                        return None
+                    v = v.quantize(q, rounding=pydec.ROUND_HALF_UP)
+                except (pydec.InvalidOperation, ZeroDivisionError):
+                    new_entries.append(pydec.Decimal(0))
+                    continue
+                if abs(v) >= bound:  # Spark non-ANSI overflow -> NULL
+                    new_entries.append(pydec.Decimal(0))
+                    continue
+                new_entries.append(v)
+                ok_tab[i] = True
+        valid = l.validity & r.validity
+        idx = jnp.clip(wide.values, 0, len(ok_tab) - 1)
+        valid = valid & jnp.asarray(ok_tab)[idx]
+        d = pa.array(new_entries, type=out_t.to_arrow())
+        if out_t.is_wide_decimal:
+            return ColumnVal(wide.values, valid, out_t, d)
+        # narrow result: gather the scaled int64 values by code
+        tab = np.zeros(len(new_entries), dtype=np.int64)
+        for i, v in enumerate(new_entries):
+            tab[i] = T.unscaled_int(v, out_t.scale)
+        return ColumnVal(jnp.asarray(tab)[idx], valid, out_t)
+
     def _wide_as_float(self, cv: ColumnVal) -> jnp.ndarray:
         if not cv.dtype.is_wide_decimal:
             if cv.dtype.kind == T.TypeKind.DECIMAL:
@@ -383,10 +470,13 @@ class Evaluator:
 
     def _arith(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
         if l.dtype.is_wide_decimal or r.dtype.is_wide_decimal:
+            out = self._wide_literal_arith(op, l, r)
+            if out is not None:
+                return out
             raise NotImplementedError(
-                "arithmetic over decimal(p>18) operands is not device-"
-                "representable yet (values are dictionary codes); cast to "
-                "decimal(18,s) or aggregate instead"
+                "arithmetic over decimal(p>18) COLUMN pairs is not device-"
+                "representable yet (values are dictionary codes); literal "
+                "operands compute exactly as dictionary transforms"
             )
         out = ir.arith_result_type(op, l.dtype, r.dtype)
         valid = l.validity & r.validity

@@ -303,3 +303,53 @@ def test_wide_decimal_least_greatest_and_coalesce():
     assert got["l"] == [pydec.Decimal("3"), pydec.Decimal("2e30"), pydec.Decimal("-1e21")]
     assert got["g"] == [pydec.Decimal("1e25"), pydec.Decimal("2e30"), pydec.Decimal("-5")]
     assert got["co"] == [pydec.Decimal("1e25"), pydec.Decimal("2e30"), pydec.Decimal("-5")]
+
+
+def test_wide_decimal_literal_arithmetic_exact():
+    """wide-decimal column (+|-|*|/) literal computes exactly as a
+    dictionary transform (the q6 'price > 1.2 * avg' shape)."""
+    from auron_tpu.exprs.ir import BinaryOp, lit
+
+    vals = [pydec.Decimal("1e24"), pydec.Decimal("-250.5"), None,
+            pydec.Decimal("0.0001")]
+    b = Batch.from_pydict(
+        {"a": vals}, schema=T.Schema.of(T.Field("a", T.decimal(38, 4)))
+    )
+    plan = B.project(B.memory_scan(b.schema, "wa"), [
+        (BinaryOp("mul", col(0), lit(pydec.Decimal("1.2"), T.decimal(2, 1))), "m"),
+        (BinaryOp("add", col(0), lit(pydec.Decimal("100"), T.decimal(3, 0))), "p"),
+        (BinaryOp("div", col(0), lit(pydec.Decimal("4"), T.decimal(1, 0))), "d"),
+    ])
+    op = plan_from_proto(plan)
+    got = op.collect(ctx=ExecutionContext(resources={"wa": [[b]]})).to_arrow().to_pylist()
+    rows = {i: r for i, r in enumerate(got)}
+    assert rows[0]["m"] == pydec.Decimal("1.2e24")
+    assert rows[1]["m"] == pydec.Decimal("-300.6")
+    assert rows[2]["m"] is None
+    assert rows[0]["p"] == pydec.Decimal("1e24") + 100
+    assert rows[1]["p"] == pydec.Decimal("-150.5")
+    assert rows[3]["d"] == pydec.Decimal("0.0001") / 4  # HALF_UP at div scale
+    # column-pair wide arithmetic still fails loudly
+    plan2 = B.project(B.memory_scan(b.schema, "wa"),
+                      [(BinaryOp("add", col(0), col(0)), "x")])
+    op2 = plan_from_proto(plan2)
+    with pytest.raises(RuntimeError):
+        list(op2.execute(0, ExecutionContext(resources={"wa": [[b]]})))
+
+
+def test_wide_decimal_filter_with_literal_arith():
+    """WHERE amount > 1.2 * <wide threshold>: arithmetic + comparison."""
+    from auron_tpu.exprs.ir import BinaryOp, lit
+
+    vals = [pydec.Decimal("100"), pydec.Decimal("130"), pydec.Decimal("1e22")]
+    b = Batch.from_pydict(
+        {"a": vals}, schema=T.Schema.of(T.Field("a", T.decimal(38, 2)))
+    )
+    pred = BinaryOp("gt", col(0),
+                    BinaryOp("mul", lit(pydec.Decimal("1.2"), T.decimal(2, 1)),
+                             lit(pydec.Decimal("100"), T.decimal(38, 2))))
+    plan = B.filter_(B.memory_scan(b.schema, "wf2"), [pred])
+    op = plan_from_proto(plan)
+    got = [r["a"] for r in op.collect(
+        ctx=ExecutionContext(resources={"wf2": [[b]]})).to_arrow().to_pylist()]
+    assert got == [pydec.Decimal("130"), pydec.Decimal("1e22")]
