@@ -290,18 +290,13 @@ class WindowExec(ExecOperator):
 
         valid = cv.validity & sel
         if wf.agg in ("sum", "avg", "count"):
-            in_sum_t = sum_type(cv.dtype) if wf.agg != "count" else None
-            if cv.dtype.is_wide_decimal:
-                raise NotImplementedError(
-                    "window sum/avg over decimal(p>18) inputs is not "
-                    "supported yet (group aggregation handles them exactly)"
-                )
-            if in_sum_t is not None and in_sum_t.is_wide_decimal:
-                # window sums compute in the decimal64 domain: clamp the
-                # nominal wide sum type, overflow -> NULL via precision_ok
-                from auron_tpu import types as _T
+            from auron_tpu.exec.agg_exec import is_wide_sum
 
-                in_sum_t = _T.decimal(18, min(in_sum_t.scale, 18))
+            if wf.agg != "count" and is_wide_sum(cv.dtype):
+                return self._agg_wide(
+                    wf, cv, sel, valid, seg_ids, seg_start, peer_end, cap
+                )
+            in_sum_t = sum_type(cv.dtype) if wf.agg != "count" else None
             if wf.agg != "count":
                 ev = Evaluator(T.Schema())
                 cvs = ev._cast(cv, in_sum_t)
@@ -346,6 +341,109 @@ class WindowExec(ExecOperator):
 
         # min/max: segmented scan (running) or segment reduce (whole)
         assert wf.agg in ("min", "max")
+        return self._agg_minmax(wf, cv, sel, valid, iota, seg_ids, seg_start,
+                                peer_end, cap)
+
+    def _agg_wide(self, wf, cv, sel, valid, seg_ids, seg_start, peer_end, cap):
+        """Exact windowed sum/avg over wide decimal sums: the same base-1e9
+        limb machinery the group aggregate uses, with per-row host
+        reconstruction (windows emit one value per row)."""
+        import decimal as pydec
+
+        import numpy as np
+
+        from auron_tpu import types as T_
+        from auron_tpu.exec.agg_exec import (
+            _LIMB_BASE,
+            _decimal_limb_tables,
+            _n_limbs,
+            avg_type,
+            sum_type,
+        )
+
+        st = sum_type(cv.dtype)
+        k = _n_limbs(st.precision)
+        in_scale = cv.dtype.scale
+        if cv.dtype.is_wide_decimal:
+            tabs = _decimal_limb_tables(cv.dict, in_scale, k)
+            idx = jnp.clip(cv.values, 0, tabs[0].shape[0] - 1)
+            limb_rows = [jnp.asarray(t)[idx] for t in tabs]
+        else:
+            cur = jnp.where(valid, cv.values.astype(jnp.int64), jnp.int64(0))
+            limb_rows = []
+            for _ in range(k - 1):
+                limb_rows.append(jnp.mod(cur, _LIMB_BASE))
+                cur = jnp.floor_divide(cur, _LIMB_BASE)
+            limb_rows.append(cur)
+
+        def windowed(arr):
+            a = jnp.where(valid, arr, jnp.zeros_like(arr))
+            if wf.frame_whole:
+                tot = jax.ops.segment_sum(a, seg_ids, num_segments=cap + 1)[:cap]
+                return tot[jnp.clip(seg_ids, 0, cap - 1)]
+            cum = jnp.cumsum(a)
+            base = jnp.where(
+                seg_start[jnp.clip(seg_ids, 0, cap - 1)] > 0,
+                cum[jnp.clip(seg_start[jnp.clip(seg_ids, 0, cap - 1)] - 1, 0, cap - 1)],
+                jnp.zeros_like(a[:1])[0],
+            )
+            return cum[jnp.clip(peer_end - 1, 0, cap - 1)] - base
+
+        limb_sums = jax.device_get(tuple(windowed(lr) for lr in limb_rows))
+        cnt = np.asarray(jax.device_get(windowed(valid.astype(jnp.int64))))
+        sel_h = np.asarray(jax.device_get(sel))
+
+        total = np.zeros(cap, dtype=object)
+        base = 1
+        for limb in limb_sums:
+            total = total + np.asarray(limb).astype(object) * base
+            base *= _LIMB_BASE
+        ok = (cnt > 0) & sel_h
+        if wf.agg == "sum":
+            emit_t = st
+            unscaled = total
+        else:
+            emit_t = avg_type(cv.dtype)
+            diff = emit_t.scale - in_scale
+            num_shift = 10 ** max(diff, 0)
+            den_shift = 10 ** max(-diff, 0)
+            q = pydec.Decimal(1)
+            unscaled = np.zeros(cap, dtype=object)
+            for i in np.nonzero(ok)[0]:
+                unscaled[i] = int(
+                    (
+                        pydec.Decimal(int(total[i]) * num_shift)
+                        / pydec.Decimal(int(cnt[i]) * den_shift)
+                    ).quantize(q, rounding=pydec.ROUND_HALF_UP)
+                )
+        bound = 10 ** emit_t.precision
+        if emit_t.is_wide_decimal:
+            import pyarrow as pa
+
+            decs = [
+                T_.decimal_from_unscaled(int(u), emit_t.scale)
+                if o and -bound < int(u) < bound else None
+                for u, o in zip(unscaled, ok)
+            ]
+            d = pa.array(
+                [x if x is not None else pydec.Decimal(0) for x in decs],
+                type=pa.decimal128(emit_t.precision, emit_t.scale),
+            )
+            codes = jnp.arange(cap, dtype=jnp.int32)
+            ok_dev = jnp.asarray(np.array([x is not None for x in decs]))
+            return ColumnVal(codes, ok_dev & sel, emit_t, d)
+        bound = 10 ** min(emit_t.precision, 18)
+        out_vals = np.zeros(cap, dtype=np.int64)
+        out_ok = np.zeros(cap, dtype=bool)
+        for i in np.nonzero(ok)[0]:
+            u = int(unscaled[i])
+            if -bound < u < bound and -(2**63) <= u < 2**63:
+                out_vals[i] = u
+                out_ok[i] = True
+        return ColumnVal(jnp.asarray(out_vals), jnp.asarray(out_ok) & sel, emit_t)
+
+    def _agg_minmax(self, wf, cv, sel, valid, iota, seg_ids, seg_start,
+                    peer_end, cap):
         work = cv.values
         inv_arr = None
         if cv.dict is not None and len(cv.dict) > 0:

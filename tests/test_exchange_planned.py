@@ -213,3 +213,46 @@ def test_aqe_skipped_when_other_sources_feed_reduce_stage(mesh):
     out = out.sort_values("k").reset_index(drop=True)
     assert out["s"].astype(np.int64).tolist() == want["s"].tolist()
     assert out["tag"].astype(np.int64).tolist() == want["tag"].tolist()
+
+
+def test_range_partitioned_exchange_orders_partitions(mesh):
+    """RANGE partitioning through the planned exchange: reduce partition i
+    holds keys strictly below partition i+1's (Spark RangePartitioner)."""
+    from auron_tpu.exec.shuffle.partitioning import make_range_bounds
+    from auron_tpu.ops.sortkeys import SortSpec
+    from auron_tpu.plan.builders import sort_field
+    from auron_tpu.proto import plan_pb2 as pb
+
+    df = _fact(n=2000, seed=21)
+    schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(df.iloc[:1], preserve_index=False).schema
+    )
+    sample = Batch.from_arrow(
+        pa.RecordBatch.from_pandas(df.sample(256, random_state=0),
+                                   preserve_index=False)
+    )
+    bounds = make_range_bounds(sample, [col(0)], [SortSpec()], N_DEV)
+    part = pb.Partitioning(kind=pb.Partitioning.RANGE, num_partitions=N_DEV,
+                           range_words_per_bound=bounds.shape[1])
+    part.range_fields.add().CopyFrom(sort_field(col(0), SortSpec()))
+    part.range_bound_words.extend(int(x) for x in bounds.reshape(-1))
+
+    scan = B.memory_scan(schema, "fact")
+    ex = B.mesh_exchange(scan, part, "ex_range")
+    driver = MeshQueryDriver(mesh, conf=Configuration().set(EXCHANGE_MODE, "mesh"))
+    outs = driver.run(B.filter_(ex, []), {"fact": _partitioned(df, N_DEV)})
+    per_part_keys = []
+    for p, batches in enumerate(outs):
+        ks = []
+        for b in batches:
+            ks += b.to_arrow().to_pydict()["k"]
+        per_part_keys.append(ks)
+    assert sum(len(k) for k in per_part_keys) == len(df)
+    # ranges are ordered: max(part i) <= min(part i+1)
+    prev_max = None
+    for ks in per_part_keys:
+        if not ks:
+            continue
+        if prev_max is not None:
+            assert prev_max <= min(ks)
+        prev_max = max(ks)

@@ -353,3 +353,40 @@ def test_wide_decimal_filter_with_literal_arith():
     got = [r["a"] for r in op.collect(
         ctx=ExecutionContext(resources={"wf2": [[b]]})).to_arrow().to_pylist()]
     assert got == [pydec.Decimal("130"), pydec.Decimal("1e22")]
+
+
+def test_window_wide_decimal_running_sum_and_avg():
+    """windowed sum/avg over decimal(38,x): exact limb-based running and
+    whole-frame aggregates (previously a loud NotImplementedError)."""
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.exec.window_exec import WindowExec, WindowFunc
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    vals = [pydec.Decimal("1e22"), pydec.Decimal("2.5"), pydec.Decimal("-1e22"),
+            pydec.Decimal("7"), None]
+    b = Batch.from_pydict(
+        {"g": [1, 1, 1, 2, 2], "o": [0, 1, 2, 0, 1], "a": vals},
+        schema=T.Schema.of(T.Field("g", T.INT64), T.Field("o", T.INT64),
+                           T.Field("a", T.decimal(38, 2))),
+    )
+    w = WindowExec(
+        MemoryScanExec.single([b]), [col(0)], [(col(1), SortSpec())],
+        [(WindowFunc("agg", agg="sum", expr=col(2)), "run"),
+         (WindowFunc("agg", agg="sum", expr=col(2), frame_whole=True), "tot"),
+         (WindowFunc("agg", agg="avg", expr=col(2), frame_whole=True), "av")],
+    )
+    got = w.collect().to_arrow().to_pylist()
+    got = sorted(got, key=lambda r: (r["g"], r["o"]))
+    assert got[0]["run"] == pydec.Decimal("1e22")
+    assert got[1]["run"] == pydec.Decimal("1e22") + pydec.Decimal("2.5")
+    assert got[2]["run"] == pydec.Decimal("2.5")
+    assert all(got[i]["tot"] == pydec.Decimal("2.5") for i in range(3))
+    assert got[3]["tot"] == pydec.Decimal("7") and got[4]["tot"] == pydec.Decimal("7")
+    # avg over group 2: 7 / 1 (null skipped), group 1: 2.5/3
+    with pydec.localcontext() as hp:
+        hp.prec = 100
+        want_av = (pydec.Decimal("2.5") / 3).quantize(
+            pydec.Decimal(1).scaleb(-6), rounding=pydec.ROUND_HALF_UP
+        )
+    assert got[0]["av"] == want_av
+    assert got[3]["av"] == pydec.Decimal("7")
