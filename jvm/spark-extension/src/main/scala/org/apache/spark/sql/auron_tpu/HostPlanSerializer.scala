@@ -1,0 +1,158 @@
+/*
+ * SparkPlan -> neutral host-plan JSON (the format consumed by
+ * auron_tpu/convert/hostplan.py). The AuronConverters analog, collapsed
+ * to serialization: convertibility decisions, per-op flags, fallback
+ * wrapping and provider dispatch all run ENGINE-side, so this file stays
+ * Spark-version-stable (no @sparkver macro forest).
+ */
+package org.apache.spark.sql.auron_tpu
+
+import org.apache.spark.sql.catalyst.expressions._
+import org.apache.spark.sql.catalyst.expressions.aggregate._
+import org.apache.spark.sql.execution._
+import org.apache.spark.sql.execution.aggregate.HashAggregateExec
+import org.apache.spark.sql.execution.exchange.ShuffleExchangeExec
+import org.apache.spark.sql.execution.joins.{BroadcastHashJoinExec, ShuffledHashJoinExec, SortMergeJoinExec}
+import org.apache.spark.sql.types._
+import org.json4s.JsonDSL._
+import org.json4s._
+import org.json4s.jackson.JsonMethods._
+
+object HostPlanSerializer {
+
+  def serialize(plan: SparkPlan): String = compact(render(node(plan)))
+
+  private def node(p: SparkPlan): JObject = {
+    val base: JObject =
+      ("op" -> p.getClass.getSimpleName) ~
+      ("schema" -> p.output.map(a =>
+        JArray(List(JString(a.name), JString(typeName(a.dataType)),
+          JBool(a.nullable))))) ~
+      ("children" -> p.children.map(node))
+    base ~ ("args" -> args(p))
+  }
+
+  private def args(p: SparkPlan): JObject = p match {
+    case e: ProjectExec =>
+      "projections" -> e.projectList.map(x => expr(x, e.child.output))
+    case e: FilterExec =>
+      "predicates" -> List(expr(e.condition, e.child.output))
+    case e: SortExec =>
+      "order" -> e.sortOrder.map(o =>
+        ("expr" -> expr(o.child, e.child.output)) ~
+        ("asc" -> (o.direction == Ascending)) ~
+        ("nulls_first" -> (o.nullOrdering == NullsFirst)))
+    case e: HashAggregateExec =>
+      val in = e.child.output
+      ("mode" -> aggMode(e)) ~
+      ("groupings" -> e.groupingExpressions.map(g =>
+        ("expr" -> expr(g, in)) ~ ("name" -> g.name))) ~
+      ("aggs" -> e.aggregateExpressions.map(a =>
+        ("fn" -> aggName(a.aggregateFunction)) ~
+        ("expr" -> a.aggregateFunction.children.headOption.map(expr(_, in))) ~
+        ("name" -> a.resultAttribute.name)))
+    case e: SortMergeJoinExec =>
+      joinArgs(e.leftKeys, e.rightKeys, e.joinType.toString.toLowerCase,
+        e.condition, e.left.output, e.right.output)
+    case e: BroadcastHashJoinExec =>
+      joinArgs(e.leftKeys, e.rightKeys, e.joinType.toString.toLowerCase,
+        e.condition, e.left.output, e.right.output) ~
+      ("build_side" -> e.buildSide.toString.toLowerCase.replace("build", ""))
+    case e: ShuffledHashJoinExec =>
+      joinArgs(e.leftKeys, e.rightKeys, e.joinType.toString.toLowerCase,
+        e.condition, e.left.output, e.right.output) ~
+      ("build_side" -> e.buildSide.toString.toLowerCase.replace("build", ""))
+    case e: ShuffleExchangeExec =>
+      "partitioning" -> (e.outputPartitioning match {
+        case org.apache.spark.sql.catalyst.plans.physical.HashPartitioning(k, n) =>
+          ("kind" -> "hash") ~ ("num_partitions" -> n) ~
+          ("exprs" -> k.map(expr(_, e.child.output)))
+        case p0 =>
+          ("kind" -> "round_robin") ~ ("num_partitions" -> p0.numPartitions)
+      })
+    case e: FileSourceScanExec =>
+      ("format" -> "parquet") ~
+      ("files" -> e.relation.location.inputFiles.toList)
+    case e: LocalLimitExec => "limit" -> e.limit
+    case e: GlobalLimitExec => "limit" -> e.limit
+    case _ => JObject()
+  }
+
+  private def joinArgs(lk: Seq[Expression], rk: Seq[Expression], jt: String,
+                       cond: Option[Expression],
+                       lout: Seq[Attribute], rout: Seq[Attribute]): JObject = {
+    val combined = lout ++ rout
+    ("left_keys" -> lk.map(expr(_, lout))) ~
+    ("right_keys" -> rk.map(expr(_, rout))) ~
+    ("join_type" -> (jt match {
+      case "leftsemi" => "left_semi"
+      case "leftanti" => "left_anti"
+      case "fullouter" => "full"
+      case "leftouter" => "left"
+      case "rightouter" => "right"
+      case other => other
+    })) ~
+    ("condition" -> cond.map(expr(_, combined)))
+  }
+
+  /** Catalyst expression -> engine expression dict (bound references). */
+  private def expr(e: Expression, input: Seq[Attribute]): JObject = e match {
+    case a: AttributeReference =>
+      ("kind" -> "attr") ~ ("index" -> input.indexWhere(_.exprId == a.exprId)) ~
+      ("name" -> a.name)
+    case Alias(child, _) => expr(child, input)
+    case l: Literal =>
+      ("kind" -> "lit") ~ ("value" -> JString(String.valueOf(l.value))) ~
+      ("type" -> typeName(l.dataType))
+    case c: Cast =>
+      ("kind" -> "call") ~ ("name" -> "cast") ~
+      ("children" -> List(expr(c.child, input))) ~
+      ("to" -> typeName(c.dataType))
+    case b: BinaryExpression =>
+      ("kind" -> "call") ~ ("name" -> b.getClass.getSimpleName.toLowerCase) ~
+      ("children" -> List(expr(b.left, input), expr(b.right, input)))
+    case u: UnaryExpression =>
+      ("kind" -> "call") ~ ("name" -> u.getClass.getSimpleName.toLowerCase) ~
+      ("children" -> List(expr(u.child, input)))
+    case other =>
+      // anything else ships by name; the engine decides convert vs
+      // HostUDF fallback vs whole-node fallback
+      ("kind" -> "call") ~ ("name" -> other.getClass.getSimpleName.toLowerCase) ~
+      ("children" -> other.children.map(expr(_, input)))
+  }
+
+  private def aggMode(e: HashAggregateExec): String =
+    e.aggregateExpressions.headOption.map(_.mode) match {
+      case Some(Partial) => "partial"
+      case Some(PartialMerge) => "partial_merge"
+      case _ => "final"
+    }
+
+  private def aggName(f: AggregateFunction): String = f match {
+    case _: Sum => "sum"
+    case _: Average => "avg"
+    case _: Min => "min"
+    case _: Max => "max"
+    case c: Count if c.children.isEmpty => "count_star"
+    case _: Count => "count"
+    case _: First => "first"
+    case other => other.prettyName
+  }
+
+  private def typeName(t: DataType): String = t match {
+    case BooleanType => "boolean"
+    case ByteType => "byte"
+    case ShortType => "short"
+    case IntegerType => "int"
+    case LongType => "long"
+    case FloatType => "float"
+    case DoubleType => "double"
+    case StringType => "string"
+    case BinaryType => "binary"
+    case DateType => "date"
+    case TimestampType => "timestamp"
+    case d: DecimalType => s"decimal(${d.precision},${d.scale})"
+    case ArrayType(el, _) => s"array<${typeName(el)}>"
+    case other => other.simpleString
+  }
+}

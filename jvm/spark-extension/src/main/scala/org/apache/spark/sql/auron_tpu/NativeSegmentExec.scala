@@ -1,0 +1,79 @@
+/*
+ * The Spark physical operator executing one native segment
+ * (NativeSupports/NativeRDD analog): per partition it registers FFI
+ * inputs (child iterators exported as Arrow IPC), starts the task through
+ * the C ABI, and decodes the engine's Arrow IPC output stream into
+ * InternalRows.
+ */
+package org.apache.spark.sql.auron_tpu
+
+import java.io.ByteArrayInputStream
+
+import org.apache.arrow.memory.RootAllocator
+import org.apache.arrow.vector.ipc.ArrowStreamReader
+import org.apache.spark.rdd.RDD
+import org.apache.spark.sql.catalyst.InternalRow
+import org.apache.spark.sql.catalyst.expressions.{Attribute, UnsafeProjection}
+import org.apache.spark.sql.execution.SparkPlan
+import org.apache.spark.sql.util.ArrowUtils
+
+/**
+ * @param taskProtoPerPartition serialized TaskDefinition bytes (the
+ *   engine conversion layer emits one template; the partition id is
+ *   patched per task, exactly like NativeRDD's per-partition closure)
+ * @param ffiInputs (resourceId, child index) pairs: unconvertible child
+ *   plans whose rows stream to the engine as Arrow IPC resources
+ */
+case class NativeSegmentExec(
+    output: Seq[Attribute],
+    taskProtoPerPartition: Int => Array[Byte],
+    ffiInputs: Seq[(String, Int)],
+    children: Seq[SparkPlan])
+  extends SparkPlan {
+
+  override protected def doExecute(): RDD[InternalRow] = {
+    val childRdds = children.map(_.execute())
+    val out = output
+    val nParts = childRdds.headOption.map(_.getNumPartitions).getOrElse(1)
+    sparkContext
+      .parallelize(0 until nParts, nParts)
+      .mapPartitionsWithIndex { (pid, _) =>
+        // 1. export unconvertible children as Arrow IPC resources
+        ffiInputs.foreach { case (rid, childIdx) =>
+          val ipc = ArrowIpcExport.collectPartition(childRdds(childIdx), pid)
+          NativeBridge.putResource(s"$rid.$pid", ipc)
+        }
+        // 2. run the task, decoding IPC output into rows
+        val handle = NativeBridge.callNative(taskProtoPerPartition(pid))
+        new Iterator[InternalRow] {
+          private val allocator = new RootAllocator(Long.MaxValue)
+          private val proj = UnsafeProjection.create(out.map(_.dataType).toArray)
+          private var current: Iterator[InternalRow] = Iterator.empty
+          private var done = false
+
+          override def hasNext: Boolean = {
+            while (!current.hasNext && !done) {
+              val ipc = NativeBridge.nextBatch(handle)
+              if (ipc == null) {
+                done = true
+                NativeBridge.finalizeNative(handle)
+              } else {
+                val reader = new ArrowStreamReader(
+                  new ByteArrayInputStream(ipc), allocator)
+                reader.loadNextBatch()
+                current = ArrowUtils
+                  .fromArrowRecordBatch(reader.getVectorSchemaRoot)
+                  .map(proj)
+              }
+            }
+            current.hasNext
+          }
+
+          override def next(): InternalRow = current.next()
+        }
+      }
+  }
+
+  override def withNewChildrenInternal(newChildren: IndexedSeq[SparkPlan]): SparkPlan =
+    copy(children = newChildren)
+}
