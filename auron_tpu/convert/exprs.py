@@ -44,10 +44,26 @@ _FN_RENAME = {
 
 def convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None) -> ir.Expr:
     """Convert one host expression dict; raises UnsupportedExpr on failure
-    (the caller decides whole-node fallback vs HostUDF wrapping)."""
+    (the caller decides whole-node fallback vs HostUDF wrapping).
+    Malformed payloads (missing keys) degrade to UnsupportedExpr so the
+    owning operator falls back instead of crashing conversion."""
+    try:
+        return _convert_expr(e, conf, udf_registry)
+    except UnsupportedExpr:
+        raise
+    except (KeyError, TypeError, ValueError) as err:
+        raise UnsupportedExpr(f"malformed host expression {e!r}: {err}") from err
+
+
+def _convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None) -> ir.Expr:
     kind = e.get("kind")
     if kind == "attr":
-        return ir.Column(int(e["index"]), e.get("name", ""))
+        idx = int(e["index"])
+        if idx < 0:
+            raise UnsupportedExpr(
+                "unbound attribute (host serializer could not resolve it)"
+            )
+        return ir.Column(idx, e.get("name", ""))
     if kind == "lit":
         dt = parse_type(e.get("type", "null"))
         return ir.Literal(e.get("value"), dt)
@@ -76,16 +92,20 @@ def convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None)
     if name == "if":
         return ir.If(sub(0), sub(1), sub(2))
     if name == "casewhen":
+        # "branches" is REQUIRED: a generic name+children serialization of
+        # CaseWhen would otherwise become a silent all-NULL expression
         branches = tuple(
             (convert_expr(w, conf, udf_registry), convert_expr(t, conf, udf_registry))
-            for w, t in e.get("branches", [])
+            for w, t in e["branches"]
         )
         orelse = (
             convert_expr(e["else"], conf, udf_registry) if e.get("else") else None
         )
         return ir.Case(branches, orelse)
     if name == "in":
-        return ir.In(sub(0), tuple(e.get("values", [])), bool(e.get("negated")))
+        # "values" is REQUIRED (a missing key would silently become an
+        # empty IN list matching nothing)
+        return ir.In(sub(0), tuple(e["values"]), bool(e.get("negated")))
     if name == "coalesce":
         return ir.Coalesce(tuple(subs()))
     if name == "like":

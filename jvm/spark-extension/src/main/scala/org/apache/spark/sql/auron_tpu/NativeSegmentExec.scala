@@ -1,9 +1,10 @@
 /*
  * The Spark physical operator executing one native segment
- * (NativeSupports/NativeRDD analog): per partition it registers FFI
- * inputs (child iterators exported as Arrow IPC), starts the task through
- * the C ABI, and decodes the engine's Arrow IPC output stream into
- * InternalRows.
+ * (NativeSupports/NativeRDD analog): per partition it exports FFI inputs
+ * (unconvertible child output as Arrow IPC), starts the task through the
+ * C ABI, and decodes the engine's Arrow IPC output stream into
+ * InternalRows. Task/resource lifecycle rides Spark's task-completion
+ * listener so early termination (LIMIT) still finalizes the native task.
  */
 package org.apache.spark.sql.auron_tpu
 
@@ -11,6 +12,7 @@ import java.io.ByteArrayInputStream
 
 import org.apache.arrow.memory.RootAllocator
 import org.apache.arrow.vector.ipc.ArrowStreamReader
+import org.apache.spark.TaskContext
 import org.apache.spark.rdd.RDD
 import org.apache.spark.sql.catalyst.InternalRow
 import org.apache.spark.sql.catalyst.expressions.{Attribute, UnsafeProjection}
@@ -21,59 +23,120 @@ import org.apache.spark.sql.util.ArrowUtils
  * @param taskProtoPerPartition serialized TaskDefinition bytes (the
  *   engine conversion layer emits one template; the partition id is
  *   patched per task, exactly like NativeRDD's per-partition closure)
- * @param ffiInputs (resourceId, child index) pairs: unconvertible child
- *   plans whose rows stream to the engine as Arrow IPC resources
+ * @param ffiInput optional (resourceId) of ONE unconvertible child whose
+ *   rows stream to the engine as Arrow IPC (multi-input segments are
+ *   planned engine-side as separate stages joined through exchanges)
  */
 case class NativeSegmentExec(
     output: Seq[Attribute],
     taskProtoPerPartition: Int => Array[Byte],
-    ffiInputs: Seq[(String, Int)],
-    children: Seq[SparkPlan])
+    ffiInput: Option[String],
+    child: Option[SparkPlan])
   extends SparkPlan {
 
+  override def children: Seq[SparkPlan] = child.toSeq
+
   override protected def doExecute(): RDD[InternalRow] = {
-    val childRdds = children.map(_.execute())
     val out = output
-    val nParts = childRdds.headOption.map(_.getNumPartitions).getOrElse(1)
-    sparkContext
-      .parallelize(0 until nParts, nParts)
-      .mapPartitionsWithIndex { (pid, _) =>
-        // 1. export unconvertible children as Arrow IPC resources
-        ffiInputs.foreach { case (rid, childIdx) =>
-          val ipc = ArrowIpcExport.collectPartition(childRdds(childIdx), pid)
-          NativeBridge.putResource(s"$rid.$pid", ipc)
+    val ffi = ffiInput
+    val protoOf = taskProtoPerPartition
+    child match {
+      case Some(c) =>
+        // drive the child iterator ON the executor (no RDD capture —
+        // SPARK-5063) and hand its Arrow IPC to the engine before start
+        c.execute().mapPartitionsWithIndex { (pid, rows) =>
+          val rid = s"${ffi.get}.$pid"
+          NativeBridge.putResource(rid, ArrowIpcExport.encode(rows, c.schema))
+          segmentIterator(protoOf(pid), out, Some(rid))
         }
-        // 2. run the task, decoding IPC output into rows
-        val handle = NativeBridge.callNative(taskProtoPerPartition(pid))
-        new Iterator[InternalRow] {
-          private val allocator = new RootAllocator(Long.MaxValue)
-          private val proj = UnsafeProjection.create(out.map(_.dataType).toArray)
-          private var current: Iterator[InternalRow] = Iterator.empty
-          private var done = false
-
-          override def hasNext: Boolean = {
-            while (!current.hasNext && !done) {
-              val ipc = NativeBridge.nextBatch(handle)
-              if (ipc == null) {
-                done = true
-                NativeBridge.finalizeNative(handle)
-              } else {
-                val reader = new ArrowStreamReader(
-                  new ByteArrayInputStream(ipc), allocator)
-                reader.loadNextBatch()
-                current = ArrowUtils
-                  .fromArrowRecordBatch(reader.getVectorSchemaRoot)
-                  .map(proj)
-              }
-            }
-            current.hasNext
-          }
-
-          override def next(): InternalRow = current.next()
+      case None =>
+        val nParts = 1.max(conf.numShufflePartitions)
+        sparkContext.parallelize(0 until nParts, nParts).mapPartitionsWithIndex {
+          (pid, _) => segmentIterator(protoOf(pid), out, None)
         }
+    }
+  }
+
+  private def segmentIterator(
+      taskProto: Array[Byte],
+      out: Seq[Attribute],
+      resource: Option[String]): Iterator[InternalRow] = {
+    val handle = NativeBridge.callNative(taskProto)
+    val allocator = new RootAllocator(Long.MaxValue)
+    var finalized = false
+
+    def cleanup(): Unit = if (!finalized) {
+      finalized = true
+      try NativeBridge.finalizeNative(handle) finally {
+        resource.foreach(NativeBridge.removeResource)
+        allocator.close()
       }
+    }
+    Option(TaskContext.get()).foreach(_.addTaskCompletionListener[Unit](_ => cleanup()))
+
+    new Iterator[InternalRow] {
+      private val proj = UnsafeProjection.create(out.map(_.dataType).toArray)
+      private var current: Iterator[InternalRow] = Iterator.empty
+      private var done = false
+
+      override def hasNext: Boolean = {
+        while (!current.hasNext && !done) {
+          val ipc = NativeBridge.nextBatch(handle)
+          if (ipc == null) {
+            done = true
+            cleanup()
+          } else {
+            val reader = new ArrowStreamReader(
+              new ByteArrayInputStream(ipc), allocator)
+            try {
+              val builder = Seq.newBuilder[InternalRow]
+              while (reader.loadNextBatch()) { // ALL batches in the stream
+                builder ++= ArrowUtils
+                  .fromArrowRecordBatch(reader.getVectorSchemaRoot)
+                  .map(r => proj(r).copy())
+              }
+              current = builder.result().iterator
+            } finally reader.close()
+          }
+        }
+        current.hasNext
+      }
+
+      override def next(): InternalRow = current.next()
+    }
   }
 
   override def withNewChildrenInternal(newChildren: IndexedSeq[SparkPlan]): SparkPlan =
-    copy(children = newChildren)
+    copy(child = newChildren.headOption)
+}
+
+/** Arrow IPC stream encoding of a row iterator (ConvertToNative analog). */
+object ArrowIpcExport {
+  import org.apache.arrow.vector.VectorSchemaRoot
+  import org.apache.arrow.vector.ipc.ArrowStreamWriter
+  import org.apache.spark.sql.types.StructType
+
+  def encode(rows: Iterator[InternalRow], schema: StructType): Array[Byte] = {
+    val allocator = new RootAllocator(Long.MaxValue)
+    val arrowSchema = ArrowUtils.toArrowSchema(schema, null, true, false)
+    val root = VectorSchemaRoot.create(arrowSchema, allocator)
+    val bytes = new java.io.ByteArrayOutputStream()
+    val writer = new ArrowStreamWriter(root, null, bytes)
+    try {
+      val arrowWriter = org.apache.spark.sql.execution.arrow.ArrowWriter.create(root)
+      writer.start()
+      var n = 0
+      rows.foreach { r =>
+        arrowWriter.write(r)
+        n += 1
+        if (n % 8192 == 0) { // batch boundaries
+          arrowWriter.finish(); writer.writeBatch(); arrowWriter.reset()
+        }
+      }
+      arrowWriter.finish(); writer.writeBatch(); writer.end()
+      bytes.toByteArray
+    } finally {
+      writer.close(); root.close(); allocator.close()
+    }
+  }
 }

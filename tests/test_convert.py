@@ -298,3 +298,21 @@ def test_table_format_provider_composes_with_pipeline():
     assert isinstance(res.root, NativeSegment)
     assert res.root.plan.WhichOneof("plan") == "hash_agg"
     assert res.root.plan.hash_agg.child.WhichOneof("plan") == "parquet_scan"
+
+
+def test_malformed_host_exprs_fall_back_not_crash():
+    """missing keys / unbound attrs degrade to unconvertible-with-reason."""
+    for bad_expr in (
+        _call("in", _attr(0)),                # no "values"
+        _call("like", _attr(0)),              # no "pattern"
+        {"kind": "attr", "index": -1},        # unbound reference
+        _call("scalarsubquery"),              # no resource_id
+    ):
+        plan = {
+            "op": "FilterExec", "schema": SCHEMA,
+            "args": {"predicates": [bad_expr]},
+            "children": [_scan(SCHEMA)],
+        }
+        res = convert_plan(plan)
+        assert isinstance(res.root, HostOp), bad_expr
+        assert res.tags.why(res.root.node), bad_expr
