@@ -223,6 +223,23 @@ def _pred_false_for_stats(e: ir.Expr, schema: T.Schema, stats: dict) -> bool:
     return False
 
 
+def adapt_table(tbl: pa.Table, want: "pa.Schema") -> pa.Table:
+    """Schema adaption (AuronSchemaAdapterFactory analog): project the
+    physical table onto the requested schema — columns missing from the
+    file become NULL, compatible physical types widen via cast (int32
+    files read as int64 columns, etc.). Incompatible columns raise."""
+    arrays = []
+    for f in want:
+        if f.name in tbl.column_names:
+            col = tbl.column(f.name)
+            if col.type != f.type:
+                col = col.cast(f.type)  # widening / safe casts only
+            arrays.append(col)
+        else:
+            arrays.append(pa.nulls(tbl.num_rows, type=f.type))
+    return pa.Table.from_arrays(arrays, schema=want)
+
+
 def _pred_columns(preds: list[ir.Expr]) -> set[int]:
     out: set[int] = set()
 
@@ -270,6 +287,7 @@ class ParquetScanExec(ExecOperator):
         late_enabled = ctx.conf.get(PARQUET_LATE_MATERIALIZATION) and filt is not None
         pred_cols = sorted(_pred_columns(preds)) if late_enabled else []
         pred_names = [self.schema[i].name for i in pred_cols]
+        want_arrow = self.schema.to_arrow()
 
         for path in self.file_paths:
             ctx.check_cancelled()
@@ -311,7 +329,14 @@ class ParquetScanExec(ExecOperator):
                 #    (dictionary/page-check analog at row-group granularity)
                 if late_enabled and pred_names:
                     with ctx.metrics.timer("pruning_time"):
-                        ptbl = pf.read_row_group(rg, columns=pred_names)
+                        present = [
+                            n for n in pred_names
+                            if n in pf.schema_arrow.names
+                        ]
+                        ptbl = adapt_table(
+                            pf.read_row_group(rg, columns=present),
+                            pa.schema([want_arrow.field(i) for i in pred_cols]),
+                        )
                         if ptbl.filter(filt).num_rows == 0:
                             # count the probe only when it's all we read:
                             # surviving groups count the full decode below
@@ -319,7 +344,10 @@ class ParquetScanExec(ExecOperator):
                             ctx.metrics.add("row_groups_pruned_late", 1)
                             continue
                 with ctx.metrics.timer("io_time"):
-                    tbl = pf.read_row_group(rg, columns=cols)
+                    present = [n for n in cols if n in pf.schema_arrow.names]
+                    tbl = adapt_table(
+                        pf.read_row_group(rg, columns=present), want_arrow
+                    )
                 ctx.metrics.add("bytes_scanned", tbl.nbytes)
                 if filt is not None:
                     with ctx.metrics.timer("pruning_time"):
