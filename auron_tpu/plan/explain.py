@@ -91,6 +91,47 @@ def explain(op: ExecOperator, indent: int = 0) -> str:
     return "\n".join(lines)
 
 
+def explain_proto(node, indent: int = 0) -> str:
+    """Render a protobuf plan tree (works for driver-resolved nodes like
+    mesh_exchange / kafka_scan that never become exec operators)."""
+    from auron_tpu.proto import plan_pb2 as pb
+
+    which = node.WhichOneof("plan")
+    inner = getattr(node, which)
+    details = []
+    for attr in ("resource_id", "topic", "format", "startup_mode", "on_error",
+                 "output_path", "exchange_id", "generator", "limit"):
+        v = getattr(inner, attr, None)
+        if v:
+            details.append(f"{attr}={v}")
+    if getattr(inner, "file_paths", None):
+        details.append(f"files={len(inner.file_paths)}")
+    part = getattr(inner, "partitioning", None)
+    if part is not None and part.ByteSize() >= 0 and (
+        part.num_partitions or part.kind
+    ):
+        kind = pb.Partitioning.Kind.Name(part.kind).lower()
+        details.append(f"partitioning={kind}({part.num_partitions})")
+    if getattr(inner, "has_projection", False):
+        details.append(f"projection={list(inner.projection)}")
+    if getattr(inner, "mode", None) is not None and which == "hash_agg":
+        details.append(f"mode={pb.AggMode.Name(inner.mode).lower()}")
+    line = "  " * indent + which + (" " + " ".join(details) if details else "")
+    lines = [line]
+    if which == "union":
+        for c in inner.children:
+            lines.append(explain_proto(c, indent + 1))
+    else:
+        for f in ("child", "left", "right"):
+            try:
+                present = inner.HasField(f)
+            except ValueError:
+                continue
+            if present:
+                lines.append(explain_proto(getattr(inner, f), indent + 1))
+    return "\n".join(lines)
+
+
 def normalize(plan_text: str) -> str:
     """Strip run-specific detail (paths, resource ids) for golden diffs."""
     t = re.sub(r"/[^\s]*\.(data|index|parquet|orc)", "<path>", plan_text)

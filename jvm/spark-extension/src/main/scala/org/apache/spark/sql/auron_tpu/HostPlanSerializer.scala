@@ -169,7 +169,11 @@ object HostPlanSerializer {
     e.aggregateExpressions.headOption.map(_.mode) match {
       case Some(Partial) => "partial"
       case Some(PartialMerge) => "partial_merge"
-      case _ => "final"
+      case Some(Final) => "final"
+      // Complete (single-stage over raw input) is not the engine's
+      // final-over-intermediates: name it truthfully so the engine tags
+      // the node unconvertible instead of merging wrong
+      case other => other.map(_.toString.toLowerCase).getOrElse("final")
     }
 
   private def aggName(f: AggregateFunction): String = f match {

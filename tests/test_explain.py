@@ -49,3 +49,27 @@ def test_plan_stability_detects_change(tmp_path):
     other = FilterExec(MemoryScanExec.single([b1]), [BinaryOp("lt", col(0), lit(9))])
     with pytest.raises(AssertionError, match="plan changed"):
         check_stability(other, golden)
+
+
+def test_explain_proto_renders_driver_nodes():
+    """proto-level explain covers nodes that never become exec operators
+    (mesh_exchange, kafka_scan)."""
+    from auron_tpu import types as T
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.plan import builders as B
+    from auron_tpu.plan.explain import explain_proto
+
+    schema = T.Schema.of(T.Field("k", T.INT64), T.Field("v", T.INT64))
+    plan = B.hash_agg(
+        B.mesh_exchange(
+            B.hash_agg(B.kafka_scan(schema, "orders", "src",
+                                    data_format="protobuf"),
+                       [(col(0), "k")], [("sum", col(1), "s")], "partial"),
+            B.hash_partitioning([col(0)], 8), "e1"),
+        [(col(0), "k")], [("sum", col(1), "s")], "final")
+    text = explain_proto(plan)
+    assert "mesh_exchange" in text and "exchange_id=e1" in text
+    assert "kafka_scan" in text and "topic=orders" in text
+    assert "partitioning=hash(8)" in text
+    assert "mode=agg_partial" in text and "mode=agg_final" in text
+    assert text.count("\n") == 3  # nested 4-level tree
