@@ -383,23 +383,56 @@ class OrcScanExec(ExecOperator):
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         import pyarrow.orc as orc
 
+        from auron_tpu.utils.config import PARQUET_LATE_MATERIALIZATION
+
         cols = self.schema.names
+        preds = self.pruning_predicates
         filt = None
-        for p in self.pruning_predicates:
+        for p in preds:
             f = pruning_to_arrow_filter(p, self.schema)
             if f is not None:
                 filt = f if filt is None else (filt & f)
         bs = ctx.batch_size()
+        late_enabled = ctx.conf.get(PARQUET_LATE_MATERIALIZATION) and filt is not None
+        pred_cols = sorted(_pred_columns(preds)) if late_enabled else []
+        want_arrow = self.schema.to_arrow()
         opener = ctx.resources.get(self.fs_resource_id) if self.fs_resource_id else None
         for path in self.file_paths:
             ctx.check_cancelled()
             src = opener(path) if opener is not None else path
             with ctx.metrics.timer("io_time"):
                 of = orc.ORCFile(src)
+            file_names = set(of.schema.names)
+            present_cols = [n for n in cols if n in file_names]
+            pred_names = [
+                self.schema[i].name for i in pred_cols
+                if self.schema[i].name in file_names
+            ]
             for stripe_i in range(of.nstripes):
                 ctx.check_cancelled()
+                # late materialization: probe the predicate columns first,
+                # skip the wide stripe decode on zero matches (ORC has no
+                # exposed stripe statistics in pyarrow, so this is the
+                # pruning tier — orc_exec.rs analog)
+                if late_enabled and pred_names:
+                    with ctx.metrics.timer("pruning_time"):
+                        ptbl = adapt_table(
+                            pa.Table.from_batches([
+                                of.read_stripe(stripe_i, columns=pred_names)
+                            ]),
+                            pa.schema([want_arrow.field(i) for i in pred_cols]),
+                        )
+                        if ptbl.filter(filt).num_rows == 0:
+                            ctx.metrics.add("stripes_pruned_late", 1)
+                            ctx.metrics.add("bytes_scanned", ptbl.nbytes)
+                            continue
                 with ctx.metrics.timer("io_time"):
-                    tbl = pa.Table.from_batches([of.read_stripe(stripe_i, columns=cols)])
+                    tbl = adapt_table(
+                        pa.Table.from_batches([
+                            of.read_stripe(stripe_i, columns=present_cols)
+                        ]),
+                        want_arrow,
+                    )
                 if filt is not None:
                     tbl = tbl.filter(filt)
                 ctx.metrics.add("bytes_scanned", tbl.nbytes)

@@ -189,3 +189,35 @@ def test_schema_adaption_with_predicates(tmp_path):
     assert sorted(r["k"] for r in rows) == list(range(15, 20))
     m = ctx.metrics.snapshot()["values"]
     assert m.get("row_groups_pruned_late", 0) >= 1  # file a probe: 0 matches
+
+
+def test_orc_late_materialization_and_adaption(tmp_path):
+    import pyarrow.orc as orc
+
+    from auron_tpu.exec.scan import OrcScanExec
+    from auron_tpu.exprs.ir import BinaryOp
+
+    path = str(tmp_path / "t.orc")
+    n = 3000
+    tbl = pa.table({"k": pa.array(range(n), pa.int64()),
+                    "v": pa.array([i % 50 for i in range(n)], pa.int64())})
+    orc.write_table(tbl, path, stripe_size=8192)  # several stripes
+
+    schema = T.Schema.of(T.Field("k", T.INT64), T.Field("v", T.INT64),
+                         T.Field("missing", T.STRING))
+    op = OrcScanExec(schema, [path], [BinaryOp("eq", col(1), lit(777))])
+    ctx = ExecutionContext()
+    rows = []
+    for b in op.execute(0, ctx):
+        rows.extend(b.to_arrow().to_pylist())
+    assert rows == []  # v==777 never occurs
+    m = ctx.metrics.snapshot()["values"]
+    assert m.get("stripes_pruned_late", 0) >= 1  # probe skipped wide decodes
+
+    op2 = OrcScanExec(schema, [path], [BinaryOp("lt", col(0), lit(3))])
+    ctx2 = ExecutionContext()
+    rows2 = []
+    for b in op2.execute(0, ctx2):
+        rows2.extend(b.to_arrow().to_pylist())
+    assert [r["k"] for r in rows2] == [0, 1, 2]
+    assert all(r["missing"] is None for r in rows2)  # schema adaption
