@@ -361,6 +361,35 @@ def device_concat(batches: Sequence[Batch]) -> Batch:
     return Batch(schema, DeviceBatch(sel, tuple(values), tuple(validity)), tuple(new_dicts))
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("out_cap",))
+def _compact_dev(dev: DeviceBatch, out_cap: int) -> DeviceBatch:
+    """Scatter live rows into a dense prefix of a smaller buffer (O(n), no
+    sort). Used when selectivity collapses a batch (post-filter/join) so
+    blocking ops (sort-segmentation, exchange pulls) pay for live rows only."""
+    pos = jnp.cumsum(dev.sel.astype(jnp.int32)) - 1
+    slot = jnp.where(dev.sel, pos, out_cap)  # dead rows -> dropped
+    n_live = jnp.sum(dev.sel.astype(jnp.int32))
+    sel_out = jnp.arange(out_cap, dtype=jnp.int32) < n_live
+    values = tuple(
+        jnp.zeros(out_cap, v.dtype).at[slot].set(v, mode="drop") for v in dev.values
+    )
+    validity = tuple(
+        jnp.zeros(out_cap, bool).at[slot].set(m, mode="drop") for m in dev.validity
+    )
+    return DeviceBatch(sel_out, values, validity)
+
+
+def compact_batch(batch: Batch, out_capacity: int) -> Batch:
+    """Compact live rows into a batch of ``out_capacity`` slots (must be
+    >= the live count — callers size it from a synced row count)."""
+    if out_capacity >= batch.capacity:
+        return batch
+    return Batch(batch.schema, _compact_dev(batch.device, out_capacity), batch.dicts)
+
+
 def prefix_slice(batch: Batch, new_capacity: int) -> Batch:
     """Keep only the first new_capacity slots (used to shrink prefix-packed
     group states back to a small capacity bucket)."""

@@ -244,17 +244,33 @@ class HashAggExec(ExecOperator):
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
-                with ctx.metrics.timer("elapsed_compute"):
-                    inter = self._to_intermediate(b, ctx)
-                # one combined transfer for both counters
-                n, g = (
-                    int(x)
-                    for x in jax.device_get(
-                        (b.device.num_rows(), inter.device.num_rows())
+                if self.mode == PARTIAL:
+                    # sync the live count FIRST: sparse batches (post-filter/
+                    # join output still at input capacity) are compacted
+                    # before the O(cap log cap) sort-segmentation — grouping
+                    # cost follows live rows, not the capacity bucket
+                    n = int(jax.device_get(b.device.num_rows()))
+                    if n == 0:
+                        continue
+                    if 4 * n <= b.capacity:
+                        from auron_tpu.columnar.batch import compact_batch
+
+                        b = compact_batch(b, bucket_capacity(n))
+                    with ctx.metrics.timer("elapsed_compute"):
+                        inter = self._to_intermediate(b, ctx)
+                    g = int(jax.device_get(inter.device.num_rows()))
+                else:
+                    # merge modes never compact: one combined transfer
+                    with ctx.metrics.timer("elapsed_compute"):
+                        inter = self._to_intermediate(b, ctx)
+                    n, g = (
+                        int(x)
+                        for x in jax.device_get(
+                            (b.device.num_rows(), inter.device.num_rows())
+                        )
                     )
-                )
-                if n == 0:
-                    continue
+                    if n == 0:
+                        continue
                 seen_rows += n
                 seen_groups += g
                 if skipping:

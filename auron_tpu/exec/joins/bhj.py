@@ -48,11 +48,12 @@ class BroadcastHashJoinExec(ExecOperator):
         if key is not None and key in ctx.resources:
             cached: PreparedBuild = ctx.resources[key]
             # fresh matched-flags per task; the map itself is shared
+            import dataclasses
+
             import jax.numpy as jnp
 
-            return PreparedBuild(
-                cached.batch, cached.words, cached.n_live,
-                jnp.zeros(cached.batch.capacity, bool),
+            return dataclasses.replace(
+                cached, matched=jnp.zeros(cached.batch.capacity, bool)
             )
         with ctx.metrics.timer("build_hash_map_time"):
             batches = list(self.child_stream(build_child, partition, ctx))

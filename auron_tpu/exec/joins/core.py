@@ -72,6 +72,17 @@ class PreparedBuild:
     words: list[jnp.ndarray]  # canonical key words, sorted order
     n_live: int  # live row count (host)
     matched: jnp.ndarray  # bool per build row, updated across probe batches
+    # -- unique-key fast path (PK-like build sides) --
+    # When every live build key is distinct, each probe row has at most one
+    # match, so the join degenerates to one gather: no ragged expansion, no
+    # per-batch host sync. Dimension-table joins (the common BHJ shape) are
+    # almost always in this regime.
+    unique: bool = False
+    # dense direct-address table: lut[word - lut_base] = sorted row index
+    # (or -1). Built when the single key is integer-like with a small value
+    # range (surrogate-key dims); turns the probe into a single O(1) gather.
+    lut: jnp.ndarray | None = None
+    lut_base: int = 0  # uint64 word base (int value of words.min())
 
 
 def _key_columns(batch: Batch, key_exprs: list[ir.Expr]) -> list[ColumnVal]:
@@ -147,13 +158,102 @@ def prepare_build(batches: list[Batch], key_exprs: list[ir.Expr], schema: T.Sche
         big.dicts,
     )
     sorted_words = [w for w in sorted_ops[1:-1]]
-    n_live = int(jax.device_get(jnp.sum(sel)))
+    # uniqueness + key-range stats ride the same transfer as the live count
+    live_sorted = jnp.arange(cap) < jnp.sum(sel)  # live rows are a prefix
+    dup = jnp.ones(cap, bool).at[0].set(False)
+    for w in sorted_words:
+        dup = dup & jnp.concatenate([jnp.zeros(1, bool), w[1:] == w[:-1]])
+    # adjacent ALL-columns-equal, both rows live, marks a duplicate key
+    has_dup = jnp.any(dup & live_sorted & jnp.concatenate([jnp.zeros(1, bool), live_sorted[:-1]]))
+    w0 = sorted_words[0]
+    kmin = w0[0]
+    n_live_dev = jnp.sum(sel)
+    kmax = w0[jnp.clip(n_live_dev - 1, 0, cap - 1)]
+    n_live, has_dup_h, kmin_h, kmax_h = (
+        int(x) for x in jax.device_get((n_live_dev, has_dup, kmin, kmax))
+    )
+    unique = n_live > 0 and not has_dup_h
+    lut = None
+    lut_base = 0
+    if (
+        unique
+        and len(sorted_words) == 1
+        and vals[0].dtype.kind
+        in (T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32, T.TypeKind.INT64,
+            T.TypeKind.DATE32, T.TypeKind.TIMESTAMP)
+        and not vals[0].dtype.is_dict_encoded
+        and 0 <= kmax_h - kmin_h < max(4 * cap, 1 << 16)
+        and kmax_h - kmin_h < (1 << 22)
+        and kmax_h < (1 << 63)  # negative int64 keys view as huge uint64s
+    ):
+        size = int(kmax_h - kmin_h) + 1
+        idx = (w0[:cap].astype(jnp.int64) - jnp.int64(kmin_h)).astype(jnp.int32)
+        slot = jnp.where(live_sorted, idx, size)  # dead rows dropped
+        lut = (
+            jnp.full(size, -1, jnp.int32)
+            .at[slot]
+            .set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+        )
+        lut_base = kmin_h
     return PreparedBuild(
         batch=clustered,
         words=sorted_words,
         n_live=n_live,
         matched=jnp.zeros(cap, bool),
+        unique=unique,
+        lut=lut,
+        lut_base=lut_base,
     )
+
+
+def _probe_unique_ops(
+    probe_words, ok_base, lut, lut_base, bwords, n_live, bcap: int
+):
+    """Traceable core of the unique-build probe (called inside jit)."""
+    if lut is not None:
+        w = probe_words[0]
+        size = lut.shape[0]
+        idx = w.astype(jnp.int64) - lut_base
+        in_range = (idx >= 0) & (idx < size)
+        bi = lut[jnp.clip(idx, 0, size - 1).astype(jnp.int32)]
+        ok = ok_base & in_range & (bi >= 0)
+        return jnp.clip(bi, 0, bcap - 1), ok
+    lo = binsearch._search(bwords, probe_words, n_live, binsearch._lex_less)
+    bi = jnp.clip(lo, 0, bcap - 1)
+    eq = lo < n_live
+    for bw, pw in zip(bwords, probe_words):
+        eq = eq & (bw[bi] == pw)
+    return bi, ok_base & eq
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("bcap", "use_lut", "probe_outer"))
+def _unique_join_emit_jit(
+    probe_words,
+    pvalid,
+    psel,
+    lut,
+    lut_base,
+    bwords,
+    n_live,
+    build_vals,
+    build_masks,
+    bcap: int,
+    use_lut: bool,
+    probe_outer: bool,
+):
+    """One fused program: unique probe + projected build-column gathers +
+    output selection. Probe-side columns never move (views)."""
+    ok_base = psel & (pvalid if pvalid is not None else jnp.ones_like(psel))
+    bi, ok = _probe_unique_ops(
+        probe_words, ok_base, lut if use_lut else None, lut_base, bwords, n_live, bcap
+    )
+    out_vals = tuple(v[bi] for v in build_vals)
+    out_masks = tuple(m[bi] & ok for m in build_masks)
+    sel_out = psel if probe_outer else (psel & ok)
+    return bi, ok, out_vals, out_masks, sel_out
 
 
 def probe_ranges(build: PreparedBuild, probe_words, probe_valid, probe_sel):

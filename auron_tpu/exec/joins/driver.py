@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch
 from auron_tpu.exec.basic import batch_from_columns
-from auron_tpu.exprs import ir
+from auron_tpu.exprs import Evaluator, ir
 from auron_tpu.exprs.eval import ColumnVal
 from auron_tpu.exec.joins import core
 from auron_tpu.exec.joins.core import (
@@ -91,6 +91,7 @@ class EquiJoinDriver:
         probe_keys = self.left_keys if self.probe_is_left else self.right_keys
         pvals = _key_columns(pb, probe_keys)
         has_dict_keys = any(v.dtype.is_dict_encoded for v in pvals)
+        orig_build = build  # matched-flag updates must land on the caller's object
         if has_dict_keys:
             # only dict keys need the build side re-keyed (joint vocabulary);
             # for fixed-width keys build.words from prepare_build are final
@@ -98,12 +99,22 @@ class EquiJoinDriver:
             bvals = _key_columns(build.batch, build_keys)
             bvals, pvals = unify_key_dicts(bvals, pvals)
             bwords, _ = _canon_words(bvals)
+            # re-keying preserves equality but the fast path also needs the
+            # sorted order / LUT built from the ORIGINAL words, which only
+            # survives when the build remap was the identity — conservatively
+            # drop to the general path for dict keys
             build = PreparedBuild(build.batch, bwords, build.n_live, build.matched)
             # note: build rows are already clustered by their own codes; a
             # joint vocabulary preserves equality but NOT order, so remap
             # must keep the original sort order valid -> it does, because
             # unify_key_dicts maps build codes first (identity order).
         pwords, pvalid = _canon_words(pvals)
+
+        if build.unique:
+            yield from self._probe_batch_unique(build, pb, pwords, pvalid)
+            if orig_build is not build:
+                orig_build.matched = build.matched
+            return
 
         lo, counts = probe_ranges(build, pwords, pvalid, pb.device.sel)
 
@@ -122,6 +133,8 @@ class EquiJoinDriver:
             probe_matched = (counts > 0) & pb.device.sel
             build_delta = self._mark_build_matched(build, lo, counts)
         build.matched = build.matched | build_delta
+        if orig_build is not build:
+            orig_build.matched = build.matched
 
         if self.wants_pairs:
             for li, ri, ok in chunks:
@@ -136,6 +149,92 @@ class EquiJoinDriver:
                 yield self._emit_probe_only(pb, pb.device.sel & ~probe_matched)
             else:  # existence
                 yield self._emit_probe_exists(pb, probe_matched)
+
+    def _probe_batch_unique(
+        self, build: PreparedBuild, pb: Batch, pwords, pvalid
+    ) -> Iterator[Batch]:
+        """Unique-build probe: each probe row has <=1 match, so one batch at
+        probe capacity covers every join type — probe columns stay as views
+        (zero gather), only projected build columns are gathered at ``bi``.
+        No ragged expansion and no host sync on the match count."""
+        bb = build.batch
+        needs_all_pairs = self.condition is not None
+        nl = len(self.left_schema)
+        full_n = nl + len(self.right_schema)
+        proj = (
+            list(range(full_n))
+            if (self.projection is None or not self.wants_pairs or needs_all_pairs)
+            else self.projection
+        )
+        # build-side columns the fused program must gather
+        if self.wants_pairs or needs_all_pairs:
+            bcol_ids = [
+                (oi if oi < nl else oi - nl)
+                for oi in proj
+                if (oi < nl) != self.probe_is_left
+            ]
+        else:
+            bcol_ids = []
+        import jax.numpy as _jnp
+
+        bi, ok, bvals, bmasks, sel_out = core._unique_join_emit_jit(
+            pwords,
+            pvalid,
+            pb.device.sel,
+            build.lut,
+            _jnp.int64(build.lut_base) if build.lut is not None else None,
+            build.words,
+            _jnp.int32(build.n_live),
+            tuple(bb.col_values(c) for c in bcol_ids),
+            tuple(bb.col_validity(c) for c in bcol_ids),
+            bcap=bb.capacity,
+            use_lut=build.lut is not None,
+            probe_outer=self.probe_outer,
+        )
+        b_at = {c: k for k, c in enumerate(bcol_ids)}
+
+        def build_col(ci: int) -> ColumnVal:
+            k = b_at[ci]
+            return ColumnVal(bvals[k], bmasks[k], bb.schema[ci].dtype, bb.dicts[ci])
+
+        def probe_col(ci: int) -> ColumnVal:
+            return ColumnVal(
+                pb.col_values(ci), pb.col_validity(ci),
+                pb.schema[ci].dtype, pb.dicts[ci],
+            )
+
+        if self.condition is not None:
+            pcols = [probe_col(i) for i in range(len(pb.schema))]
+            bcols = [build_col(i) for i in range(len(bb.schema))]
+            lcols, rcols = (pcols, bcols) if self.probe_is_left else (bcols, pcols)
+            comb = core.join_output_schema(self.left_schema, self.right_schema, INNER)
+            pair = batch_from_columns(lcols + rcols, comb.names, ok)
+            cv = Evaluator(comb).evaluate(Batch(comb, pair.device, pair.dicts), [self.condition])[0]
+            ok = ok & cv.validity & cv.values.astype(bool)
+            # condition may veto matches: rebuild outputs that depend on ok
+            bmasks = tuple(m & ok for m in bmasks)
+            sel_out = pb.device.sel if self.probe_outer else (pb.device.sel & ok)
+
+        if self.build_mark or self.build_outer:
+            build.matched = build.matched.at[bi].max(ok, mode="drop")
+
+        if self.wants_pairs:
+            out_cols = []
+            for oi in (self.projection if self.projection is not None else range(full_n)):
+                on_left = oi < nl
+                ci = oi if on_left else oi - nl
+                out_cols.append(
+                    probe_col(ci) if on_left == self.probe_is_left else build_col(ci)
+                )
+            out = batch_from_columns(out_cols, self.out_schema.names, sel_out)
+            yield Batch(self.out_schema, out.device, out.dicts)
+        elif self.probe_mark:
+            if self.join_type == LEFT_SEMI:
+                yield self._emit_probe_only(pb, pb.device.sel & ok)
+            elif self.join_type == LEFT_ANTI:
+                yield self._emit_probe_only(pb, pb.device.sel & ~ok)
+            else:  # existence
+                yield self._emit_probe_exists(pb, ok & pb.device.sel)
 
     def finish(self, build: PreparedBuild) -> Iterator[Batch]:
         bb = build.batch

@@ -167,11 +167,17 @@ def partition_batch(
     """Cluster a batch by partition id on device; return per-partition arrow
     slices (host). Dead rows are excluded. The device portion (pid sort +
     counts + gather) is one jitted program per batch shape."""
+    from auron_tpu.columnar.batch import bucket_capacity, prefix_slice
+
     pids = partitioning.partition_ids(b, ctx)
     n_out = partitioning.num_partitions
     clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
     clustered = Batch(b.schema, clustered_dev, b.dicts)
     counts_np = np.asarray(jax.device_get(counts))[:n_out]
+    total_live = int(counts_np.sum())
+    # live rows sort to the front (dead rows got pid=n_out): pull only the
+    # live prefix — sparse batches don't pay device->host bytes for padding
+    clustered = prefix_slice(clustered, bucket_capacity(max(total_live, 1)))
     rb = clustered.to_arrow(compact=False)  # one transfer; rows already clustered
     out = []
     start = 0
