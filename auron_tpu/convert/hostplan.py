@@ -24,7 +24,8 @@ from auron_tpu import types as T
 
 
 def parse_type(s: str) -> T.DataType:
-    s = s.strip().lower()
+    raw = s.strip()  # struct field names are case-sensitive
+    s = raw.lower()
     simple = {
         "boolean": T.BOOL,
         "byte": T.INT8,
@@ -52,7 +53,38 @@ def parse_type(s: str) -> T.DataType:
         return T.decimal(10, 0)
     if s.startswith("array<") and s.endswith(">"):
         return T.DataType(T.TypeKind.LIST, inner=(parse_type(s[6:-1]),))
+    if s.startswith("map<") and s.endswith(">"):
+        parts = _split_top(raw[4:-1])
+        if len(parts) != 2:
+            raise ValueError(f"unsupported host type {s!r}")
+        k, v = parts
+        return T.DataType(T.TypeKind.MAP, inner=(parse_type(k), parse_type(v)))
+    if s.startswith("struct<") and s.endswith(">"):
+        names, inners = [], []
+        for part in _split_top(raw[7:-1]):
+            name, _, t = part.partition(":")
+            names.append(name.strip())
+            inners.append(parse_type(t))
+        return T.DataType(
+            T.TypeKind.STRUCT, inner=tuple(inners), struct_names=tuple(names)
+        )
     raise ValueError(f"unsupported host type {s!r}")
+
+
+def _split_top(s: str) -> list[str]:
+    """Split on commas at bracket/paren depth 0
+    (struct<a:decimal(10,2),b:map<int,int>>)."""
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "<(":
+            depth += 1
+        elif ch in ">)":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(s[start:i])
+            start = i + 1
+    out.append(s[start:])
+    return [p.strip() for p in out]
 
 
 @dataclass
@@ -63,20 +95,35 @@ class HostNode:
     schema: T.Schema  # output schema
     args: dict = field(default_factory=dict)
     children: list["HostNode"] = field(default_factory=list)
+    # non-None when this node's declared schema contains a type the engine
+    # can't represent: the node itself becomes NeverConvert (the reference
+    # tags only the owning operator, AuronConvertStrategy.scala), while
+    # sibling subtrees stay convertible
+    schema_error: str | None = None
 
     @staticmethod
     def from_json(data: dict | str) -> "HostNode":
         if isinstance(data, str):
             data = json.loads(data)
-        fields = tuple(
-            T.Field(name, parse_type(t), bool(nullable))
-            for name, t, nullable in data.get("schema", [])
-        )
+        fields = []
+        schema_error = None
+        for name, t, nullable in data.get("schema", []):
+            try:
+                dtype = parse_type(t)
+            except ValueError as e:
+                # UNSUPPORTED placeholder: the owning node degrades, and any
+                # parent binding this column fails its own trial conversion
+                # (physical_dtype / proto lowering raise on this kind)
+                dtype = T.DataType(T.TypeKind.UNSUPPORTED)
+                if schema_error is None:
+                    schema_error = str(e)
+            fields.append(T.Field(name, dtype, bool(nullable)))
         return HostNode(
             op=data["op"],
-            schema=T.Schema(fields),
+            schema=T.Schema(tuple(fields)),
             args=data.get("args", {}),
             children=[HostNode.from_json(c) for c in data.get("children", [])],
+            schema_error=schema_error,
         )
 
     def walk_up(self):

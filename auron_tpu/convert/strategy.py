@@ -96,6 +96,10 @@ def tag_plan(root: HostNode, conf: Configuration, try_convert) -> ConvertTags:
 
     tags = ConvertTags()
     for node in root.walk_up():
+        if node.schema_error is not None:
+            # unsupported column type: only the owning node degrades
+            tags.never(node, f"{node.op}: {node.schema_error}")
+            continue
         flag_key = OP_FLAG.get(node.op)
         if flag_key is None:
             # extension point: table-format / third-party providers

@@ -241,6 +241,12 @@ class HashAggExec(ExecOperator):
         skipping = False
         merge_threshold = max(ctx.batch_size() * 4, 1 << 15)
 
+        # device scalar: group count of the PREVIOUS batch — synced together
+        # with the next batch's row count (one transfer per batch); the skip
+        # heuristic tolerates the one-batch lag
+        pending_g = None
+        pending_proxy = 0
+
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
@@ -248,8 +254,25 @@ class HashAggExec(ExecOperator):
                     # sync the live count FIRST: sparse batches (post-filter/
                     # join output still at input capacity) are compacted
                     # before the O(cap log cap) sort-segmentation — grouping
-                    # cost follows live rows, not the capacity bucket
-                    n = int(jax.device_get(b.device.num_rows()))
+                    # cost follows live rows, not the capacity bucket.
+                    # The previous batch's group count rides the same
+                    # transfer (its reduce has completed by now), so steady
+                    # state pays ONE host round-trip per batch.
+                    if pending_g is None:
+                        n = int(jax.device_get(b.device.num_rows()))
+                    else:
+                        n, gp = (
+                            int(x)
+                            for x in jax.device_get(
+                                (b.device.num_rows(), pending_g)
+                            )
+                        )
+                        seen_groups += gp
+                        # replace the previous batch's staged-rows proxy with
+                        # its exact group count, so low-cardinality aggs don't
+                        # cross the merge threshold on inflated estimates
+                        table.adjust_staged(gp - pending_proxy)
+                        pending_g = None
                     if n == 0:
                         continue
                     if 4 * n <= b.capacity:
@@ -258,7 +281,9 @@ class HashAggExec(ExecOperator):
                         b = compact_batch(b, bucket_capacity(n))
                     with ctx.metrics.timer("elapsed_compute"):
                         inter = self._to_intermediate(b, ctx)
-                    g = int(jax.device_get(inter.device.num_rows()))
+                    pending_g = inter.device.num_rows()
+                    g = pending_proxy = min(n, inter.capacity)  # proxy; the
+                    # exact count settles one batch later via pending_g
                 else:
                     # merge modes never compact: one combined transfer
                     with ctx.metrics.timer("elapsed_compute"):
@@ -272,7 +297,8 @@ class HashAggExec(ExecOperator):
                     if n == 0:
                         continue
                 seen_rows += n
-                seen_groups += g
+                if self.mode != PARTIAL:
+                    seen_groups += g
                 if skipping:
                     yield inter
                     continue
@@ -702,6 +728,12 @@ class _AggTableConsumer:
         with self._lock:
             self.staged.append(inter)
             self.staged_rows += groups
+
+    def adjust_staged(self, delta: int) -> None:
+        """Correct the staged-rows estimate once an exact group count settles
+        (clamped: a concurrent compact() may already have reset it)."""
+        with self._lock:
+            self.staged_rows = max(0, self.staged_rows + delta)
 
     def compact(self) -> None:
         with self._lock:

@@ -51,6 +51,8 @@ _KIND_TO_T = {
     pb.DataType.STRING: T.TypeKind.STRING,
     pb.DataType.BINARY: T.TypeKind.BINARY,
     pb.DataType.LIST: T.TypeKind.LIST,
+    pb.DataType.MAP: T.TypeKind.MAP,
+    pb.DataType.STRUCT: T.TypeKind.STRUCT,
 }
 _T_TO_KIND = {v: k for k, v in _KIND_TO_T.items()}
 
@@ -59,6 +61,12 @@ def dtype_from_proto(p: pb.DataType) -> T.DataType:
     kind = _KIND_TO_T[p.kind]
     if kind == T.TypeKind.LIST:
         return T.DataType(kind, inner=(dtype_from_proto(p.inner),))
+    if kind in (T.TypeKind.MAP, T.TypeKind.STRUCT):
+        return T.DataType(
+            kind,
+            inner=tuple(dtype_from_proto(i) for i in p.inners),
+            struct_names=tuple(p.struct_names),
+        )
     return T.DataType(kind, p.precision, p.scale)
 
 
@@ -66,6 +74,10 @@ def dtype_to_proto(t: T.DataType) -> pb.DataType:
     p = pb.DataType(kind=_T_TO_KIND[t.kind], precision=t.precision, scale=t.scale)
     if t.kind == T.TypeKind.LIST:
         p.inner.CopyFrom(dtype_to_proto(t.inner[0]))
+    elif t.kind in (T.TypeKind.MAP, T.TypeKind.STRUCT):
+        p.inners.extend(dtype_to_proto(i) for i in t.inner)
+        if t.struct_names:
+            p.struct_names.extend(t.struct_names)
     return p
 
 

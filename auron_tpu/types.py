@@ -47,6 +47,11 @@ class TypeKind(enum.Enum):
     LIST = "list"  # dict-encoded on device (codes); dictionary holds lists
     MAP = "map"  # dict-encoded on device (codes); dictionary holds maps
     STRUCT = "struct"  # dict-encoded; inner = (field DataTypes); names in struct_names
+    # placeholder for a host type the engine cannot represent: any attempt to
+    # evaluate / lower / ship a column of this kind raises, so conversion of
+    # the owning node (and of any parent binding the column) degrades to the
+    # host engine instead of silently mistyping data
+    UNSUPPORTED = "unsupported"
 
 
 _INT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)

@@ -316,3 +316,42 @@ def test_malformed_host_exprs_fall_back_not_crash():
         res = convert_plan(plan)
         assert isinstance(res.root, HostOp), bad_expr
         assert res.tags.why(res.root.node), bad_expr
+
+
+def test_unsupported_column_type_degrades_only_owner():
+    """ADVICE r2 (medium): an unsupported column type anywhere in the host
+    plan must tag only the OWNING node NeverConvert — sibling subtrees keep
+    converting (the reference tags per-node, never aborts the whole query)."""
+    bad_schema = [["m", "interval day to second", True]]
+    plan = {
+        "op": "UnionExec",
+        "schema": SCHEMA,
+        "args": {},
+        "children": [
+            _scan(SCHEMA, rid="a"),
+            {"op": "ProjectExec", "schema": bad_schema,
+             "args": {"projections": [_attr(0)]},
+             "children": [_scan(bad_schema, rid="b")]},
+        ],
+    }
+    res = convert_plan(plan)  # must not raise
+    # the union binds the bad-typed child column, so it degrades as well
+    # (native union over mistyped FFI data would corrupt); the GOOD sibling
+    # subtree still converts — the failure never aborts the whole plan
+    root = res.root
+    assert isinstance(root, HostOp) and root.node.op == "UnionExec"
+    good, bad = root.children
+    assert isinstance(good, NativeSegment)
+    assert isinstance(bad, HostOp) and bad.node.op == "ProjectExec"
+    assert "unsupported host type" in (res.tags.why(bad.node) or "")
+
+
+def test_map_struct_types_parse():
+    """map<>/struct<> host types lower to engine MAP/STRUCT columns."""
+    schema = [["m", "map<string,int>", True],
+              ["st", "struct<a:int,b:array<long>>", True]]
+    plan = {"op": "ProjectExec", "schema": schema,
+            "args": {"projections": [_attr(0), _attr(1)]},
+            "children": [_scan(schema, rid="ms")]}
+    res = convert_plan(plan)
+    assert isinstance(res.root, NativeSegment)
