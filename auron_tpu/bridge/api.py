@@ -46,6 +46,16 @@ def put_resource_ipc(key: str, payload: bytes) -> None:
     put_resource(key, batches)
 
 
+def put_resource_shuffle(key: str, manifest: bytes) -> None:
+    """C-ABI shuffle-fetch entry: the payload is a ShuffleManager JSON
+    manifest ([{data,index},...]); it registers as a reduce-side block
+    provider (the host shuffle fetch handing blocks to IpcReaderExec,
+    AuronBlockStoreShuffleReaderBase analog)."""
+    from auron_tpu.convert.stages import provider_from_manifest
+
+    put_resource(key, provider_from_manifest(manifest))
+
+
 def get_resource(key: str) -> Any:
     with _lock:
         return _resources.get(key)

@@ -52,7 +52,7 @@ def parse_type(s: str) -> T.DataType:
             return T.decimal(int(p), int(sc))
         return T.decimal(10, 0)
     if s.startswith("array<") and s.endswith(">"):
-        return T.DataType(T.TypeKind.LIST, inner=(parse_type(s[6:-1]),))
+        return T.DataType(T.TypeKind.LIST, inner=(parse_type(raw[6:-1]),))
     if s.startswith("map<") and s.endswith(">"):
         parts = _split_top(raw[4:-1])
         if len(parts) != 2:

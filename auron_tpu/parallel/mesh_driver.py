@@ -199,25 +199,13 @@ class MeshQueryDriver:
     # ------------------------------------------------------------------
 
     def _rewrite(self, node: pb.PhysicalPlanNode, resources: dict) -> pb.PhysicalPlanNode:
+        from auron_tpu.plan.protowalk import rewrite_children
+
         which = node.WhichOneof("plan")
         if which == "mesh_exchange":
             child = self._rewrite(node.mesh_exchange.child, resources)
             return self._execute_exchange(node.mesh_exchange, child, resources)
-        new = pb.PhysicalPlanNode()
-        new.CopyFrom(node)
-        inner = getattr(new, which)
-        if which == "union":
-            for c in inner.children:
-                c.CopyFrom(self._rewrite(c, resources))
-            return new
-        for f in ("child", "left", "right"):
-            try:
-                present = inner.HasField(f)
-            except ValueError:
-                continue
-            if present:
-                getattr(inner, f).CopyFrom(self._rewrite(getattr(inner, f), resources))
-        return new
+        return rewrite_children(node, lambda c: self._rewrite(c, resources))
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,50 @@
+"""Shared traversal over PhysicalPlanNode child links.
+
+Every plan operator reaches its inputs through one of: ``child``,
+``left``/``right``, or the repeated ``children`` of union. Walkers across
+the codebase (optimizer, explain, mesh driver, stage split) must agree on
+this shape — this module is the single definition.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+from auron_tpu.proto import plan_pb2 as pb
+
+
+def child_nodes(node: pb.PhysicalPlanNode) -> Iterator[pb.PhysicalPlanNode]:
+    """Yield the direct child plan nodes (mutable references)."""
+    inner = getattr(node, node.WhichOneof("plan"))
+    if hasattr(inner, "children"):
+        yield from inner.children
+        return
+    for f in ("child", "left", "right"):
+        try:
+            present = inner.HasField(f)
+        except ValueError:
+            continue
+        if present:
+            yield getattr(inner, f)
+
+
+def rewrite_children(
+    node: pb.PhysicalPlanNode,
+    fn: Callable[[pb.PhysicalPlanNode], pb.PhysicalPlanNode],
+) -> pb.PhysicalPlanNode:
+    """Copy ``node`` with every direct child replaced by ``fn(child)``."""
+    new = pb.PhysicalPlanNode()
+    new.CopyFrom(node)
+    inner = getattr(new, new.WhichOneof("plan"))
+    if hasattr(inner, "children"):
+        for c in inner.children:
+            c.CopyFrom(fn(c))
+        return new
+    for f in ("child", "left", "right"):
+        try:
+            present = inner.HasField(f)
+        except ValueError:
+            continue
+        if present:
+            getattr(inner, f).CopyFrom(fn(getattr(inner, f)))
+    return new

@@ -245,6 +245,24 @@ int auron_put_resource_bytes(const char* key, const uint8_t* value,
   return rc;
 }
 
+int auron_put_resource_shuffle(const char* key, const uint8_t* manifest,
+                               size_t len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyObject_CallMethod(
+      g_api, "put_resource_shuffle", "sy#", key,
+      reinterpret_cast<const char*>(manifest), static_cast<Py_ssize_t>(len));
+  if (res != nullptr) {
+    rc = 0;
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
 int auron_remove_resource(const char* key) {
   if (!ensure_init()) return -1;
   PyGILState_STATE st = PyGILState_Ensure();

@@ -50,6 +50,12 @@ void auron_on_exit(void);
 int auron_put_resource(const char* key, const uint8_t* value, size_t len);
 int auron_put_resource_bytes(const char* key, const uint8_t* value,
                              size_t len);
+/* Shuffle fetch registration: the payload is a JSON manifest of committed
+ * map outputs ([{"data": path, "index": path}, ...]) — the MapStatus/
+ * shuffle-fetch contract for host-scheduled stages. The reduce task's
+ * ipc_reader with this key then reads exactly those blocks. */
+int auron_put_resource_shuffle(const char* key, const uint8_t* manifest,
+                               size_t len);
 int auron_remove_resource(const char* key);
 
 /* Last error message for the calling thread (UTF-8, engine-owned). */

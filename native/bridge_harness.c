@@ -7,13 +7,17 @@
  *
  *   bridge_harness <taskdef.bin> <out.bin> [<key> <resource.bin>]...
  *
- * out.bin: sequence of [u64 little-endian length][arrow IPC stream bytes]
- * per pulled batch. The finalize metrics JSON goes to stdout.
+ * Resource keys are registered as Arrow IPC batch payloads; a key of the
+ * form "shuffle:<id>" registers its file as a shuffle-fetch JSON manifest
+ * under <id> instead (host-scheduled reduce stage input). out.bin:
+ * sequence of [u64 little-endian length][arrow IPC stream bytes] per
+ * pulled batch. The finalize metrics JSON goes to stdout.
  */
 
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include "auron_bridge.h"
 
@@ -46,7 +50,13 @@ int main(int argc, char** argv) {
   for (int i = 3; i + 1 < argc; i += 2) {
     size_t len = 0;
     uint8_t* payload = read_file(argv[i + 1], &len);
-    if (auron_put_resource(argv[i], payload, len) != 0) {
+    int rc;
+    if (strncmp(argv[i], "shuffle:", 8) == 0) {
+      rc = auron_put_resource_shuffle(argv[i] + 8, payload, len);
+    } else {
+      rc = auron_put_resource(argv[i], payload, len);
+    }
+    if (rc != 0) {
       fprintf(stderr, "put_resource(%s) failed: %s\n", argv[i],
               auron_last_error());
       return 3;

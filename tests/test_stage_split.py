@@ -1,0 +1,351 @@
+"""Host-schedulable stage execution (VERDICT r2 item 3).
+
+The converter's mesh_exchange plans assume the engine owns cross-stage
+execution (MeshQueryDriver). A real Spark schedules stages itself — the
+reference integrates via its shuffle manager: map tasks end in a native
+shuffle writer whose output is committed as MapStatus, reduce tasks start
+at a reader fed by the shuffle fetch (AuronShuffleManager.scala:14-37,
+NativeShuffleExchangeBase.scala:124-296, Shims.scala:249).
+
+These tests prove the same decomposition WITHOUT Spark:
+
+- ``split_stages`` turns a two-stage q3-class plan into per-stage task
+  plans (stage 0 ends in shuffle_writer, stage 1 starts at ipc_reader);
+- the stages run as SEPARATE task invocations against the ShuffleManager
+  contract, in-process first, then through the C ABI harness as separate
+  OS processes (the stand-in JVM executor);
+- results are identical to MeshQueryDriver resolving the same plan.
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.bridge import api
+from auron_tpu.columnar import Batch
+from auron_tpu.convert.stages import (
+    ShuffleManager,
+    split_stages,
+    stage_task,
+)
+from auron_tpu.exprs.ir import BinaryOp, col, lit
+from auron_tpu.plan import builders as B
+
+N_MAP = 2
+N_REDUCE = 2
+
+
+def _tables(seed=3, n=6000):
+    rng = np.random.default_rng(seed)
+    fact = pd.DataFrame(
+        {
+            "date_sk": rng.integers(0, 365, n).astype(np.int64),
+            "item_sk": rng.integers(0, 300, n).astype(np.int64),
+            "price": np.round(rng.gamma(2.0, 20.0, n), 2),
+        }
+    )
+    dates = pd.DataFrame(
+        {
+            "d_date_sk": np.arange(365, dtype=np.int64),
+            "d_moy": (np.arange(365) // 31 + 1).astype(np.int64),
+            "d_year": (2000 + np.arange(365) % 3).astype(np.int64),
+        }
+    )
+    items = pd.DataFrame(
+        {
+            "i_item_sk": np.arange(300, dtype=np.int64),
+            "i_brand": rng.integers(0, 40, 300).astype(np.int64),
+        }
+    )
+    return fact, dates, items
+
+
+def _oracle(fact, dates, items):
+    m = fact.merge(dates[dates.d_moy == 5], left_on="date_sk", right_on="d_date_sk")
+    m = m.merge(items, left_on="item_sk", right_on="i_item_sk")
+    return (
+        m.groupby(["d_year", "i_brand"])
+        .agg(s=("price", "sum"))
+        .reset_index()
+        .sort_values(["d_year", "i_brand"])
+        .reset_index(drop=True)
+    )
+
+
+def _q3_plan(fact_schema, dd_schema, it_schema):
+    """scan -> bhj(date, moy=5) -> bhj(item) -> project -> partial agg ->
+    mesh_exchange(hash[d_year, i_brand]) -> final agg."""
+    scan = B.ffi_reader(fact_schema, "fact")
+    dscan = B.filter_(B.ffi_reader(dd_schema, "dd"), [BinaryOp("eq", col(1), lit(5))])
+    iscan = B.ffi_reader(it_schema, "it")
+    j1 = B.hash_join(scan, dscan, [col(0)], [col(0)], "inner", build_side="right")
+    # fact(3) + date(3): d_year at 5; item join on item_sk (1)
+    j2 = B.hash_join(j1, iscan, [col(1)], [col(0)], "inner", build_side="right")
+    # + item(2): i_brand at 7
+    proj = B.project(j2, [(col(5), "d_year"), (col(7), "i_brand"), (col(2), "price")])
+    partial = B.hash_agg(
+        proj, [(col(0), "d_year"), (col(1), "i_brand")], [("sum", col(2), "s")],
+        "partial",
+    )
+    part = B.hash_partitioning([col(0), col(1)], N_REDUCE)
+    ex = B.mesh_exchange(partial, part, "q3ex")
+    return B.hash_agg(
+        ex, [(col(0), "d_year"), (col(1), "i_brand")], [("sum", col(2), "s")],
+        "final",
+    )
+
+
+def _schemas(fact, dates, items):
+    def sch(df):
+        return T.Schema.from_arrow(
+            pa.RecordBatch.from_pandas(df.iloc[:1], preserve_index=False).schema
+        )
+
+    return sch(fact), sch(dates), sch(items)
+
+
+def _fact_chunks(fact):
+    per = (len(fact) + N_MAP - 1) // N_MAP
+    return [
+        pa.RecordBatch.from_pandas(fact.iloc[p * per : (p + 1) * per],
+                                   preserve_index=False)
+        for p in range(N_MAP)
+    ]
+
+
+def test_split_stages_shapes():
+    fact, dates, items = _tables()
+    plan = _q3_plan(*_schemas(fact, dates, items))
+    stages = split_stages(plan)
+    assert len(stages) == 2
+    s0, s1 = stages
+    assert s0.plan.WhichOneof("plan") == "shuffle_writer"
+    assert s0.exchange_id == "q3ex"
+    assert s0.num_output_partitions == N_REDUCE
+    assert s1.is_final and s1.input_exchange_ids == ["q3ex"]
+    assert s1.plan.hash_agg.child.WhichOneof("plan") == "ipc_reader"
+    assert s1.plan.hash_agg.child.ipc_reader.resource_id == "q3ex"
+    # task instantiation fills per-partition shuffle paths
+    t = stage_task(s0, 1, "/tmp/work")
+    assert t.plan.shuffle_writer.output_data_file == "/tmp/work/q3ex_map1.data"
+    assert t.stage_id == 0 and t.partition_id == 1
+
+
+def test_nested_exchanges_order():
+    """exchange-over-exchange splits into producers-before-consumers."""
+    schema = T.Schema.of(T.Field("k", T.INT64), T.Field("v", T.INT64))
+    inner = B.mesh_exchange(
+        B.ffi_reader(schema, "in"), B.hash_partitioning([col(0)], 2), "exA"
+    )
+    agg = B.hash_agg(inner, [(col(0), "k")], [("sum", col(1), "s")], "partial")
+    outer = B.mesh_exchange(agg, B.hash_partitioning([col(0)], 2), "exB")
+    final = B.hash_agg(outer, [(col(0), "k")], [("sum", col(1), "s")], "final")
+    stages = split_stages(final)
+    assert [s.exchange_id for s in stages] == ["exA", "exB", None]
+    assert stages[1].input_exchange_ids == ["exA"]
+    assert stages[2].input_exchange_ids == ["exB"]
+
+
+def _run_stage_inprocess(task_bytes: bytes) -> list[pa.RecordBatch]:
+    h = api.call_native(task_bytes)
+    out = []
+    while (rb := api.next_batch(h)) is not None:
+        out.append(rb)
+    api.finalize_native(h)
+    return out
+
+
+def test_stage_split_matches_mesh_driver(tmp_path):
+    """Drive the two stages as separate task invocations (in-process bridge)
+    with the ShuffleManager contract; results match MeshQueryDriver running
+    the SAME unsplit plan."""
+    from auron_tpu.parallel.mesh import make_mesh
+    from auron_tpu.parallel.mesh_driver import MeshQueryDriver
+
+    fact, dates, items = _tables()
+    plan = _q3_plan(*_schemas(fact, dates, items))
+    chunks = _fact_chunks(fact)
+    dd_rb = pa.RecordBatch.from_pandas(dates, preserve_index=False)
+    it_rb = pa.RecordBatch.from_pandas(items, preserve_index=False)
+
+    # ---- host-scheduled path
+    stages = split_stages(plan)
+    mgr = ShuffleManager()
+    s0, s1 = stages
+    for p in range(N_MAP):
+        api.put_resource("fact", [chunks[p]])
+        api.put_resource("dd", [dd_rb])
+        api.put_resource("it", [it_rb])
+        t = stage_task(s0, p, str(tmp_path))
+        assert _run_stage_inprocess(t.SerializeToString()) == []
+        mgr.register_map_output(
+            s0.exchange_id, p,
+            t.plan.shuffle_writer.output_data_file,
+            t.plan.shuffle_writer.output_index_file,
+        )
+    frames = []
+    api.put_resource(s0.exchange_id, mgr.block_provider(s0.exchange_id))
+    for p in range(N_REDUCE):
+        t = stage_task(s1, p, str(tmp_path))
+        for rb in _run_stage_inprocess(t.SerializeToString()):
+            frames.append(rb.to_pandas())
+    for k in ("fact", "dd", "it", s0.exchange_id):
+        api.remove_resource(k)
+    got = (
+        pd.concat(frames)
+        .sort_values(["d_year", "i_brand"])
+        .reset_index(drop=True)
+    )
+
+    # ---- engine-scheduled oracle (MeshQueryDriver on the same plan)
+    mesh = make_mesh(N_REDUCE)
+    driver = MeshQueryDriver(mesh, work_dir=str(tmp_path / "drv"))
+    resources = {
+        "fact": lambda p: [chunks[p]] if p < N_MAP else [],
+        "dd": [dd_rb],
+        "it": [it_rb],
+    }
+    want = (
+        driver.collect(plan, resources)
+        .sort_values(["d_year", "i_brand"])
+        .reset_index(drop=True)
+    )
+
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    # and both match the pandas oracle
+    oracle = _oracle(fact, dates, items)
+    assert got["s"].sum() == pytest.approx(oracle["s"].sum(), rel=1e-9)
+    assert len(got) == len(oracle)
+
+
+# ---------------------------------------------------------------------------
+# C ABI proof: the same two stages as separate OS processes (VERDICT r2 #3
+# done-criterion: per-stage task invocations through the C harness)
+# ---------------------------------------------------------------------------
+
+
+def _build_bridge():
+    import shutil
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(root, "native")
+    if shutil.which("make") is None:
+        pytest.skip("no make in this environment")
+    r = subprocess.run(
+        ["make", "-C", native, "libauron_bridge.so", "bridge_harness"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, f"bridge build failed: {r.stderr[-800:]}"
+    return os.path.join(native, "bridge_harness")
+
+
+def _harness_env():
+    import sysconfig
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = sysconfig.get_paths()["purelib"]
+    env["JAX_PLATFORMS"] = "cpu"
+    env["AURON_TPU_ROOT"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return env
+
+
+def _ipc_bytes(rb: pa.RecordBatch) -> bytes:
+    import io
+
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def _decode_framed(path) -> list[dict]:
+    import io
+    import struct
+
+    data = open(path, "rb").read()
+    pos, rows = 0, []
+    while pos < len(data):
+        (n,) = struct.unpack_from("<Q", data, pos)
+        pos += 8
+        with pa.ipc.open_stream(io.BytesIO(data[pos : pos + n])) as r:
+            for rb in r:
+                rows += rb.to_pylist()
+        pos += n
+    return rows
+
+
+def test_c_abi_two_stage_execution(tmp_path):
+    harness = _build_bridge()
+    fact, dates, items = _tables(n=2500)
+    plan = _q3_plan(*_schemas(fact, dates, items))
+    chunks = _fact_chunks(fact)
+    dd_rb = pa.RecordBatch.from_pandas(dates, preserve_index=False)
+    it_rb = pa.RecordBatch.from_pandas(items, preserve_index=False)
+
+    stages = split_stages(plan)
+    s0, s1 = stages
+    work = tmp_path / "shuffle"
+    work.mkdir()
+    (tmp_path / "dd.bin").write_bytes(_ipc_bytes(dd_rb))
+    (tmp_path / "it.bin").write_bytes(_ipc_bytes(it_rb))
+
+    mgr = ShuffleManager()
+    # ---- stage 0: one OS process per map task
+    for p in range(N_MAP):
+        t = stage_task(s0, p, str(work))
+        task_f = tmp_path / f"map{p}.task"
+        task_f.write_bytes(t.SerializeToString())
+        fact_f = tmp_path / f"fact{p}.bin"
+        fact_f.write_bytes(_ipc_bytes(chunks[p]))
+        out_f = tmp_path / f"map{p}.out"
+        r = subprocess.run(
+            [harness, str(task_f), str(out_f),
+             "fact", str(fact_f), "dd", str(tmp_path / "dd.bin"),
+             "it", str(tmp_path / "it.bin")],
+            env=_harness_env(), capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert _decode_framed(out_f) == []  # writers emit no rows
+        w = t.plan.shuffle_writer
+        assert os.path.exists(w.output_data_file)
+        mgr.register_map_output(
+            s0.exchange_id, p, w.output_data_file, w.output_index_file
+        )
+
+    # ---- stage 1: one OS process per reduce task, shuffle fetch via the
+    # JSON manifest crossing the C ABI (auron_put_resource_shuffle)
+    manifest_f = tmp_path / "manifest.json"
+    manifest_f.write_bytes(mgr.manifest(s0.exchange_id))
+    rows = []
+    for p in range(N_REDUCE):
+        t = stage_task(s1, p, str(work))
+        task_f = tmp_path / f"red{p}.task"
+        task_f.write_bytes(t.SerializeToString())
+        out_f = tmp_path / f"red{p}.out"
+        r = subprocess.run(
+            [harness, str(task_f), str(out_f),
+             f"shuffle:{s0.exchange_id}", str(manifest_f)],
+            env=_harness_env(), capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-1500:]
+        rows += _decode_framed(out_f)
+        metrics = json.loads(r.stdout)
+        assert metrics["name"] == "HashAggExec"
+
+    got = (
+        pd.DataFrame(rows)
+        .sort_values(["d_year", "i_brand"])
+        .reset_index(drop=True)
+    )
+    oracle = _oracle(fact, dates, items)
+    assert len(got) == len(oracle)
+    assert got["d_year"].tolist() == oracle["d_year"].tolist()
+    assert got["i_brand"].tolist() == oracle["i_brand"].tolist()
+    for g, w in zip(got["s"], oracle["s"]):
+        assert g == pytest.approx(w, rel=1e-9)
