@@ -117,6 +117,14 @@ def finalize_native_json(handle: int) -> bytes:
     return json.dumps(finalize_native(handle)).encode("utf-8")
 
 
+def convert_plan_json(payload: bytes) -> bytes:
+    """Conversion service entry (C ABI auron_convert_plan): host-plan JSON
+    in, segmentation response JSON out (convert/service.py)."""
+    from auron_tpu.convert.service import convert_host_plan_json
+
+    return convert_host_plan_json(payload)
+
+
 def on_exit() -> None:
     with _lock:
         handles = list(_runtimes)

@@ -32,6 +32,7 @@ class NativeSegment:
     plan: pb.PhysicalPlanNode
     schema: T.Schema
     inputs: list[tuple[str, "ConvertedNode"]] = field(default_factory=list)
+    host: HostNode | None = None  # the subtree root this segment covers
 
     @property
     def is_native(self) -> bool:
@@ -102,7 +103,7 @@ def convert_plan(
         if tags.ok(node):
             inputs: list[tuple[str, ConvertedNode]] = []
             proto = lower(node, inputs)
-            return NativeSegment(proto, node.schema, inputs)
+            return NativeSegment(proto, node.schema, inputs, host=node)
         return HostOp(node, [build(c) for c in node.children])
 
     def lower(node: HostNode, inputs) -> pb.PhysicalPlanNode:
@@ -118,6 +119,61 @@ def convert_plan(
         return conv.to_proto(node, child_protos)
 
     return ConversionResult(build(root), tags, root)
+
+
+def _range_partitioning_proto(fields, num: int, bound_rows: list) -> pb.Partitioning:
+    """RANGE partitioning proto from host-sampled bound rows.
+
+    ``bound_rows``: one row per boundary, each a list of typed literal dicts
+    ({"value": v, "type": t}) for the sort keys. Dict-encoded key types
+    (strings) are rejected — their orderable words are per-dictionary ranks,
+    not comparable against data batches — so the owning exchange degrades to
+    host execution instead of mis-routing."""
+    import numpy as np
+
+    from auron_tpu.columnar.batch import Batch
+    from auron_tpu.convert.hostplan import parse_type
+    from auron_tpu.exprs.eval import ColumnVal
+    from auron_tpu.ops.sortkeys import sort_operands
+    from auron_tpu.plan.builders import sort_field
+
+    specs = [s for _, s in fields]
+    part = pb.Partitioning(kind=pb.Partitioning.RANGE, num_partitions=num)
+    for e, s in fields:
+        part.range_fields.add().CopyFrom(sort_field(e, s))
+    if not bound_rows:
+        if num > 1:
+            # without host-sampled bounds every row routes to partition 0 —
+            # degrade to host execution instead of silently mis-scattering
+            raise ValueError("range partitioning requires host-sampled bounds")
+        part.range_words_per_bound = 2 * len(fields)
+        return part
+    n_keys = len(bound_rows[0])
+    import pyarrow as pa
+
+    cols = []
+    for k in range(n_keys):
+        dt = parse_type(bound_rows[0][k]["type"])
+        if dt.is_dict_encoded:
+            raise ValueError("range bounds over dictionary-encoded keys")
+        arr = pa.array([r[k]["value"] for r in bound_rows], type=dt.to_arrow())
+        cols.append((arr, dt))
+    rb = pa.record_batch([a for a, _ in cols],
+                         names=[f"b{k}" for k in range(n_keys)])
+    sample = Batch.from_arrow(rb)
+    keys = [
+        ColumnVal(sample.col_values(k), sample.col_validity(k), dt, sample.dicts[k])
+        for k, (_, dt) in enumerate(cols)
+    ]
+    import jax
+
+    words = [np.asarray(jax.device_get(w)) for w in sort_operands(keys, specs)]
+    sel = np.asarray(jax.device_get(sample.device.sel))
+    live = np.nonzero(sel)[0]
+    mat = np.stack([w[live] for w in words], axis=1).astype(np.uint64)
+    part.range_words_per_bound = mat.shape[1]
+    part.range_bound_words.extend(int(x) for x in mat.reshape(-1))
+    return part
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +208,10 @@ class _Converter:
     def _c_FileSourceScanExec(self, n, ch):
         fmt = n.args.get("format", "parquet")
         pruning = [self.expr(e) for e in n.args.get("filters", [])]
+        # host-decided task placement: "partitions" (per-task file groups)
+        # beats the flat "files" list — a real Spark scan must not read the
+        # whole table in every task (ADVICE r2)
+        partitions = n.args.get("partitions")
         if fmt == "orc":
             from auron_tpu.plan.builders import _wrap
 
@@ -162,11 +222,16 @@ class _Converter:
             )
             for p in pruning:
                 node.pruning_predicates.add().CopyFrom(B.expr_to_proto(p))
+            for group in partitions or []:
+                node.partitions.add().paths.extend(group)
             return _wrap(orc_scan=node)
-        return B.parquet_scan(
+        node = B.parquet_scan(
             n.schema, n.args["files"], pruning,
             n.args.get("fs_resource_id", ""),
         )
+        for group in partitions or []:
+            node.parquet_scan.partitions.add().paths.extend(group)
+        return node
 
     _c_OrcScanExec = _c_FileSourceScanExec
 
@@ -303,6 +368,12 @@ class _Converter:
             part = pb.Partitioning(
                 kind=pb.Partitioning.ROUND_ROBIN, num_partitions=num
             )
+        elif kind == "range":
+            # bounds are sampled host-side (the reference samples on the JVM,
+            # NativeShuffleExchangeBase.scala:312) and ship as typed literal
+            # rows; the engine turns them into orderable words
+            fields = convert_sort_fields(p["order"], self.conf, self.udfs)
+            part = _range_partitioning_proto(fields, num, p.get("bounds", []))
         else:
             raise ValueError(f"unsupported partitioning {kind}")
         return B.mesh_exchange(ch[0], part, n.args.get("exchange_id", ""))

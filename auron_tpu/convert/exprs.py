@@ -39,6 +39,9 @@ _FN_RENAME = {
     "dateadd": "date_add",
     "datesub": "date_sub",
     "dayofmonth": "day",
+    "createarray": "make_array",
+    "makearray": "make_array",
+    "createnamedstruct": "named_struct",
 }
 
 
@@ -104,8 +107,18 @@ def _convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None
         return ir.Case(branches, orelse)
     if name == "in":
         # "values" is REQUIRED (a missing key would silently become an
-        # empty IN list matching nothing)
-        return ir.In(sub(0), tuple(e["values"]), bool(e.get("negated")))
+        # empty IN list matching nothing). "value_type" (the serializer's
+        # literal type tag) coerces items to typed scalars — string-encoded
+        # decimals/dates become exact values instead of raw strings
+        # (ADVICE r2: intCol IN (1,2,3) must not compare as strings).
+        items = tuple(e["values"])
+        vt = e.get("value_type")
+        if vt:
+            items = tuple(
+                None if v is None else _coerce_literal(v, parse_type(vt))
+                for v in items
+            )
+        return ir.In(sub(0), items, bool(e.get("negated")))
     if name == "coalesce":
         return ir.Coalesce(tuple(subs()))
     if name == "like":
@@ -127,6 +140,32 @@ def _convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None
         out_t = parse_type(e.get("type", "string"))
         return ir.HostUDF(name, tuple(subs()), out_t)
     raise UnsupportedExpr(f"expression {e['name']!r} is not supported")
+
+
+def _coerce_literal(v, dt):
+    """Coerce a JSON-decoded IN-list item to the serializer's declared
+    literal type. String-like types stay plain python strings (the string
+    IN path compares dictionary entries); numeric/temporal/decimal items
+    become typed ir.Literals so comparisons run in value space."""
+    from auron_tpu import types as T
+
+    k = dt.kind
+    if k == T.TypeKind.STRING:
+        return v
+    if k == T.TypeKind.BINARY:
+        # binary dictionaries hold bytes; JSON ships str
+        return v.encode("utf-8") if isinstance(v, str) else v
+    if k == T.TypeKind.BOOL:
+        return ir.Literal(bool(v), dt)
+    if k == T.TypeKind.DECIMAL:
+        import decimal as pydec
+
+        return ir.Literal(pydec.Decimal(str(v)), dt)
+    if dt.is_integer or k in (T.TypeKind.DATE32, T.TypeKind.TIMESTAMP):
+        return ir.Literal(int(v), dt)
+    if k in (T.TypeKind.FLOAT32, T.TypeKind.FLOAT64):
+        return ir.Literal(float(v), dt)
+    return v
 
 
 def convert_sort_fields(fields: list[dict], conf, udf_registry=None):

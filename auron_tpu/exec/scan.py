@@ -261,11 +261,24 @@ class ParquetScanExec(ExecOperator):
         file_paths: list[str],
         pruning_predicates: list[ir.Expr] | None = None,
         fs_resource_id: str | None = None,
+        partitions: list[list[str]] | None = None,
     ):
         super().__init__([], schema)
         self.file_paths = file_paths
         self.pruning_predicates = pruning_predicates or []
         self.fs_resource_id = fs_resource_id
+        # host-decided per-task placement: task p reads partitions[p]
+        self.partitions = partitions or None
+
+    def _task_files(self, partition: int) -> list[str]:
+        if self.partitions is not None:
+            # over-provisioned hosts (more tasks than file groups) read
+            # nothing in the extra tasks; UNDER-provisioning is data loss
+            # the engine cannot see from inside one task — the conversion
+            # response pins the required task count (task_partitions) and
+            # the host must honor it
+            return self.partitions[partition] if partition < len(self.partitions) else []
+        return self.file_paths
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         cols = self.schema.names
@@ -289,7 +302,7 @@ class ParquetScanExec(ExecOperator):
         pred_names = [self.schema[i].name for i in pred_cols]
         want_arrow = self.schema.to_arrow()
 
-        for path in self.file_paths:
+        for path in self._task_files(partition):
             ctx.check_cancelled()
             try:
                 if opener is not None:
@@ -374,11 +387,15 @@ class OrcScanExec(ExecOperator):
         file_paths: list[str],
         pruning_predicates: list[ir.Expr] | None = None,
         fs_resource_id: str | None = None,
+        partitions: list[list[str]] | None = None,
     ):
         super().__init__([], schema)
         self.file_paths = file_paths
         self.pruning_predicates = pruning_predicates or []
         self.fs_resource_id = fs_resource_id
+        self.partitions = partitions or None
+
+    _task_files = ParquetScanExec._task_files
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         import pyarrow.orc as orc
@@ -397,7 +414,7 @@ class OrcScanExec(ExecOperator):
         pred_cols = sorted(_pred_columns(preds)) if late_enabled else []
         want_arrow = self.schema.to_arrow()
         opener = ctx.resources.get(self.fs_resource_id) if self.fs_resource_id else None
-        for path in self.file_paths:
+        for path in self._task_files(partition):
             ctx.check_cancelled()
             src = opener(path) if opener is not None else path
             with ctx.metrics.timer("io_time"):
@@ -451,7 +468,12 @@ class FFIReaderExec(ExecOperator):
         self.resource_id = resource_id
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
-        exporter = ctx.resources[self.resource_id]
+        # per-partition form first ("rid.pid" — what a host executor
+        # registers when several tasks of one stage share the process),
+        # then the shared key
+        exporter = ctx.resources.get(f"{self.resource_id}.{partition}")
+        if exporter is None:
+            exporter = ctx.resources[self.resource_id]
         stream = exporter(partition) if callable(exporter) else exporter
         for rb in stream:
             ctx.check_cancelled()

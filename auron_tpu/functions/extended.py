@@ -576,6 +576,34 @@ _host_rowwise(
 # ---------------------------------------------------------------------------
 
 
+@registry.register("make_array")
+def _make_array(args, cap):
+    """make_array(c1, c2, ...) — Spark CreateArray (reference:
+    spark_make_array.rs). NULL elements stay inside the list; the result is
+    never NULL. Host-assembled into the LIST dictionary representation."""
+    from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+
+    if not args:
+        # Spark's array() — zero elements, element type NULL
+        out_dt = T.DataType(T.TypeKind.LIST, inner=(T.NULL,))
+        from auron_tpu.columnar.batch import _arrow_to_device
+
+        arr = pa.array([[]] * cap, type=out_dt.to_arrow())
+        v, m, d = _arrow_to_device(arr, out_dt, cap)
+        return _cv(v, jnp.ones(cap, bool), out_dt, d)
+    el_t = args[0].dtype
+    out_dt = T.DataType(T.TypeKind.LIST, inner=(el_t,))
+    host_cols = []
+    for cv in args:
+        v = np.asarray(jax.device_get(cv.values))
+        m = np.asarray(jax.device_get(cv.validity))
+        host_cols.append(_device_to_arrow(v, m, cv.dtype, cv.dict).to_pylist())
+    rows = [list(vals) for vals in zip(*host_cols)]
+    arr = pa.array(rows, type=out_dt.to_arrow())
+    v, m, d = _arrow_to_device(arr, out_dt, cap)
+    return _cv(v, jnp.ones(cap, bool), out_dt, d)
+
+
 @registry.register("named_struct")
 def _named_struct(args, cap):
     """named_struct(name1, col1, name2, col2, ...) — names are literals."""

@@ -294,6 +294,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             list(p.parquet_scan.file_paths),
             [expr_from_proto(e) for e in p.parquet_scan.pruning_predicates],
             p.parquet_scan.fs_resource_id or None,
+            partitions=[list(fp.paths) for fp in p.parquet_scan.partitions] or None,
         )
     if which == "project":
         return basic.ProjectExec(
@@ -441,6 +442,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
             list(p.orc_scan.file_paths),
             [expr_from_proto(e) for e in p.orc_scan.pruning_predicates],
             p.orc_scan.fs_resource_id or None,
+            partitions=[list(fp.paths) for fp in p.orc_scan.partitions] or None,
         )
     if which == "orc_sink":
         from auron_tpu.exec.sink import OrcSinkExec

@@ -40,9 +40,16 @@ public final class NativeBridge {
         handle("auron_put_resource_bytes",
             FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
                 ValueLayout.ADDRESS, ValueLayout.JAVA_LONG));
+    private static final MethodHandle PUT_RESOURCE_SHUFFLE =
+        handle("auron_put_resource_shuffle",
+            FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG));
     private static final MethodHandle REMOVE_RESOURCE =
         handle("auron_remove_resource",
             FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS));
+    private static final MethodHandle CONVERT_PLAN = handle("auron_convert_plan",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG, ValueLayout.ADDRESS, ValueLayout.ADDRESS));
     private static final MethodHandle LAST_ERROR = handle("auron_last_error",
         FunctionDescriptor.of(ValueLayout.ADDRESS));
 
@@ -119,6 +126,36 @@ public final class NativeBridge {
                 payload.length);
             int rc = (int) target.invokeExact(k, buf, (long) payload.length);
             if (rc != 0) throw new RuntimeException(lastError());
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
+    }
+
+    /** Shuffle-fetch registration: JSON manifest of committed map outputs
+     * ([{"data": path, "index": path}, ...]) under the exchange id. */
+    public static void putResourceShuffle(String key, byte[] manifestJson) {
+        putResource(key, manifestJson, PUT_RESOURCE_SHUFFLE);
+    }
+
+    /** Engine-side plan conversion: host-plan JSON in, segmentation
+     * response JSON out (auron_tpu/convert/service.py schema). */
+    public static String convertPlan(String hostPlanJson) {
+        byte[] payload =
+            hostPlanJson.getBytes(java.nio.charset.StandardCharsets.UTF_8);
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment buf = arena.allocate(payload.length);
+            MemorySegment.copy(payload, 0, buf, ValueLayout.JAVA_BYTE, 0,
+                payload.length);
+            MemorySegment respPtr = arena.allocate(ValueLayout.ADDRESS);
+            MemorySegment lenPtr = arena.allocate(ValueLayout.JAVA_LONG);
+            int rc = (int) CONVERT_PLAN.invokeExact(buf, (long) payload.length,
+                respPtr, lenPtr);
+            if (rc != 0) throw new RuntimeException(lastError());
+            long len = lenPtr.get(ValueLayout.JAVA_LONG, 0);
+            MemorySegment data = respPtr.get(ValueLayout.ADDRESS, 0)
+                .reinterpret(len);
+            return new String(data.toArray(ValueLayout.JAVA_BYTE),
+                java.nio.charset.StandardCharsets.UTF_8);
         } catch (Throwable t) {
             throw wrap(t);
         }

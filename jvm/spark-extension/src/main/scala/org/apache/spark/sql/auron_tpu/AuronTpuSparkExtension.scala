@@ -39,32 +39,153 @@ object ConvertToNativeRule extends Rule[SparkPlan] {
       return plan
     }
     val hostJson = HostPlanSerializer.serialize(plan)
-    // engine-side conversion (auron_tpu/convert/converters.py
-    // ::convert_plan) returns the segmentation: per-segment
-    // TaskDefinition templates + host boundary paths. Splicing
-    // NativeSegmentExec nodes at those paths is mechanical tree surgery
-    // over `plan` (requires the target Spark version on the classpath to
-    // finish; boundaries carry ffi resource ids for the host children).
-    val segments = EngineClient.convert(hostJson)
-    segments.fold(plan)(s => NativeSegmentSplicer.splice(plan, s))
+    // engine-side conversion (auron_tpu/convert/service.py): tagging,
+    // segmentation and stage splitting all run in the engine; the response
+    // carries per-segment TaskDefinition-ready plans + tree paths, so
+    // splicing here is mechanical tree surgery.
+    EngineClient.convert(hostJson) match {
+      case Some(resp) => NativeSegmentSplicer.splice(plan, resp)
+      case None => plan
+    }
   }
 }
 
-/** Engine conversion round trip over the C ABI: ship host JSON, read the
- * segmentation JSON back (a dedicated conversion TaskDefinition whose
- * single output block carries the result). */
+/** Engine conversion round trip over the C ABI (auron_convert_plan). */
 object EngineClient {
   def convert(hostPlanJson: String): Option[String] =
-    try {
-      NativeBridge.putResourceBytes("__convert_request__",
-        hostPlanJson.getBytes(java.nio.charset.StandardCharsets.UTF_8))
-      // reserved conversion task id 0: the engine bridge interprets an
-      // empty TaskDefinition with the request resource present as a
-      // conversion call and emits one JSON block
-      None // wiring completed alongside the splicer
-    } catch { case _: Throwable => None }
+    try Some(NativeBridge.convertPlan(hostPlanJson))
+    catch { case _: Throwable => None }
 }
 
+/**
+ * Splices NativeSegmentExec nodes at the segment roots named by the
+ * conversion response. Response paths are RELATIVE to the parent response
+ * node (service.py contract), so splicing composes: every call receives
+ * the Spark subtree standing at the response node's own position.
+ */
 object NativeSegmentSplicer {
-  def splice(plan: SparkPlan, segmentationJson: String): SparkPlan = plan
+  import org.json4s._
+  import org.json4s.jackson.JsonMethods._
+
+  def splice(plan: SparkPlan, responseJson: String): SparkPlan = {
+    val resp = parse(responseJson)
+    (resp \ "converted") match {
+      case JBool(true) => spliceNode(plan, resp \ "root")
+      case _ => plan
+    }
+  }
+
+  /** plan: the Spark subtree AT this response node's position. */
+  private def spliceNode(plan: SparkPlan, node: JValue): SparkPlan =
+    node \ "kind" match {
+      case JString("segment") => segmentExec(plan, node)
+      case JString("host") =>
+        val kids = (node \ "children") match {
+          case JArray(cs) => cs
+          case _ => Nil
+        }
+        kids.foldLeft(plan) { (acc, c) =>
+          val p = pathOf(c)
+          val sub = navigate(acc, p)
+          val spliced = spliceNode(sub, c)
+          if (spliced eq sub) acc else replaceAt(acc, p, spliced)
+        }
+      case _ => plan
+    }
+
+  /** plan: the Spark subtree this segment covers (segRoot itself). */
+  private def segmentExec(plan: SparkPlan, seg: JValue): SparkPlan = {
+    val planB64 = (seg \ "plan_b64") match {
+      case JString(s) => s
+      case _ => return plan
+    }
+    val stages = (seg \ "stages") match {
+      case JArray(ss) => ss
+      case _ => Nil
+    }
+    // multi-stage segments (mesh_exchange inside) need the host's stage
+    // scheduler wired through the ShuffleManager contract; splicing them
+    // as one task would fail at plan_from_proto. Until the Spark shuffle
+    // integration lands, leave those subtrees on the host.
+    if (stages.length > 1) return plan
+    val template = java.util.Base64.getDecoder.decode(planB64)
+    val inputs = (seg \ "inputs") match {
+      case JArray(is) => is
+      case _ => Nil
+    }
+    // one FFI boundary is supported operator-side (NativeSegmentExec);
+    // multi-input segments fall back to the host plan for now
+    if (inputs.length > 1) return plan
+    val ffi = inputs.headOption.map { i =>
+      val JString(rid) = (i \ "resource_id"): @unchecked
+      // the boundary child keeps running on Spark (recursively spliced);
+      // its path is relative to THIS segment's root
+      val childJson = i \ "child"
+      val childPlan = navigate(plan, pathOf(childJson))
+      (rid, spliceNode(childPlan, childJson))
+    }
+    // scan file placement pins the task count (service task_partitions);
+    // ignoring it would silently drop file groups
+    val pinnedParts = (seg \ "task_partitions") match {
+      case JInt(n) => Some(n.toInt)
+      case _ => None
+    }
+    // the engine's FFIReaderExec prefers the per-partition resource form
+    // "rid.pid" (what NativeSegmentExec registers), so the template needs
+    // only the partition id stamped per task
+    val taskOf: Int => Array[Byte] =
+      pid => TaskDefs.withPartition(template, pid)
+    NativeSegmentExec(
+      plan.output,
+      taskOf,
+      ffi.map(_._1),
+      ffi.map(_._2),
+      pinnedParts)
+  }
+
+  private def pathOf(node: JValue): List[Int] = (node \ "path") match {
+    case JArray(xs) => xs.collect { case JInt(i) => i.toInt }
+    case _ => Nil
+  }
+
+  private def navigate(plan: SparkPlan, path: List[Int]): SparkPlan =
+    path.foldLeft(plan)((p, i) => p.children(i))
+
+  private def replaceAt(plan: SparkPlan, path: List[Int],
+                        sub: SparkPlan): SparkPlan = path match {
+    case Nil => sub
+    case i :: rest =>
+      val newChildren = plan.children.zipWithIndex.map {
+        case (c, j) if j == i => replaceAt(c, rest, sub)
+        case (c, _) => c
+      }
+      plan.withNewChildren(newChildren)
+  }
+}
+
+/** TaskDefinition assembly: wrap the engine's plan-proto template with the
+ * per-task partition id. The protobuf surgery uses the lightweight
+ * wire-format (field 1 = plan message, field 3 = partition_id varint) to
+ * avoid a generated-proto dependency. */
+object TaskDefs {
+  def withPartition(planProto: Array[Byte], partitionId: Int): Array[Byte] = {
+    val out = new java.io.ByteArrayOutputStream()
+    // field 1 (plan), wire type 2 (length-delimited)
+    writeVarint(out, (1 << 3) | 2)
+    writeVarint(out, planProto.length)
+    out.write(planProto)
+    // field 3 (partition_id), wire type 0
+    writeVarint(out, (3 << 3) | 0)
+    writeVarint(out, partitionId)
+    out.toByteArray
+  }
+
+  private def writeVarint(out: java.io.ByteArrayOutputStream, v0: Int): Unit = {
+    var v = v0
+    while ((v & ~0x7f) != 0) {
+      out.write((v & 0x7f) | 0x80)
+      v >>>= 7
+    }
+    out.write(v)
+  }
 }

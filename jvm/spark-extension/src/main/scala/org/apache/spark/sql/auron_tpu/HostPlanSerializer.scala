@@ -10,9 +10,12 @@ package org.apache.spark.sql.auron_tpu
 import org.apache.spark.sql.catalyst.expressions._
 import org.apache.spark.sql.catalyst.expressions.aggregate._
 import org.apache.spark.sql.execution._
-import org.apache.spark.sql.execution.aggregate.HashAggregateExec
+import org.apache.spark.sql.execution.aggregate
+import org.apache.spark.sql.execution.command.DataWritingCommandExec
+import org.apache.spark.sql.execution.datasources.InsertIntoHadoopFsRelationCommand
 import org.apache.spark.sql.execution.exchange.ShuffleExchangeExec
 import org.apache.spark.sql.execution.joins.{BroadcastHashJoinExec, ShuffledHashJoinExec, SortMergeJoinExec}
+import org.apache.spark.sql.execution.window.WindowExec
 import org.apache.spark.sql.types._
 import org.json4s.JsonDSL._
 import org.json4s._
@@ -42,9 +45,11 @@ object HostPlanSerializer {
         ("expr" -> expr(o.child, e.child.output)) ~
         ("asc" -> (o.direction == Ascending)) ~
         ("nulls_first" -> (o.nullOrdering == NullsFirst)))
-    case e: HashAggregateExec =>
+    case e: aggregate.BaseAggregateExec =>
+      // HashAggregateExec / ObjectHashAggregateExec / SortAggregateExec all
+      // serialize identically — the engine's sort-segmented agg covers them
       val in = e.child.output
-      ("mode" -> aggMode(e)) ~
+      ("mode" -> aggMode(e.aggregateExpressions)) ~
       ("groupings" -> e.groupingExpressions.map(g =>
         ("expr" -> expr(g, in)) ~ ("name" -> g.name))) ~
       ("aggs" -> e.aggregateExpressions.map(a =>
@@ -72,21 +77,140 @@ object HostPlanSerializer {
           ("kind" -> "single") ~ ("num_partitions" -> 1)
         case RoundRobinPartitioning(n) =>
           ("kind" -> "round_robin") ~ ("num_partitions" -> n)
+        case RangePartitioning(ordering, n) =>
+          // bounds are sampled here (the host owns sampling, like the
+          // reference's NativeShuffleExchangeBase.scala:312); when the
+          // sample is unavailable at serialization time the engine
+          // degrades this exchange to host execution rather than
+          // mis-scattering (bounds required for num_partitions > 1)
+          ("kind" -> "range") ~ ("num_partitions" -> n) ~
+          ("order" -> ordering.map(o =>
+            ("expr" -> expr(o.child, e.child.output)) ~
+            ("asc" -> (o.direction == Ascending)) ~
+            ("nulls_first" -> (o.nullOrdering == NullsFirst)))) ~
+          ("bounds" -> RangeBoundsSampler.sample(e, ordering, n))
         case p0 =>
-          // range & friends: name the kind truthfully so the engine tags
-          // the node unconvertible instead of silently mis-scattering
+          // unknown partitionings: name the kind truthfully so the engine
+          // tags the node unconvertible instead of silently mis-scattering
           ("kind" -> p0.getClass.getSimpleName.toLowerCase) ~
           ("num_partitions" -> p0.numPartitions)
       })
     case e: FileSourceScanExec =>
       // the REAL format, so the engine never parquet-decodes ORC bytes;
-      // unknown formats make the node unconvertible engine-side
+      // unknown formats make the node unconvertible engine-side.
+      // "partitions" carries Spark's OWN task file placement so each native
+      // task reads only its split — never the whole-table inputFiles list.
+      val parts = e.relation.location
+        .listFiles(e.partitionFilters, e.dataFilters)
+        .map(_.files.map(_.getPath.toString).toList)
       ("format" -> e.relation.fileFormat.getClass.getSimpleName
         .toLowerCase.stripSuffix("fileformat")) ~
-      ("files" -> e.relation.location.inputFiles.toList)
+      ("files" -> parts.flatten.toList) ~
+      ("partitions" -> parts.toList)
     case e: LocalLimitExec => "limit" -> e.limit
     case e: GlobalLimitExec => "limit" -> e.limit
+    case e: UnionExec => JObject()
+    case e: TakeOrderedAndProjectExec =>
+      ("limit" -> e.limit) ~
+      ("order" -> e.sortOrder.map(o =>
+        ("expr" -> expr(o.child, e.child.output)) ~
+        ("asc" -> (o.direction == Ascending)) ~
+        ("nulls_first" -> (o.nullOrdering == NullsFirst)))) ~
+      ("projections" -> e.projectList.map(x => expr(x, e.child.output)))
+    case e: ExpandExec =>
+      "projections" -> e.projections.map(_.map(expr(_, e.child.output)))
+    case e: WindowExec =>
+      val in = e.child.output
+      ("partition_by" -> e.partitionSpec.map(expr(_, in))) ~
+      ("order" -> e.orderSpec.map(o =>
+        ("expr" -> expr(o.child, in)) ~
+        ("asc" -> (o.direction == Ascending)) ~
+        ("nulls_first" -> (o.nullOrdering == NullsFirst)))) ~
+      ("funcs" -> e.windowExpression.flatMap { we =>
+        we.collectFirst { case wex: WindowExpression =>
+          windowFunc(wex, we.asInstanceOf[NamedExpression].name, in)
+        }
+      })
+    case e: GenerateExec =>
+      val (gen, genExpr) = e.generator match {
+        case Explode(child0) => ("explode", expr(child0, e.child.output))
+        case PosExplode(child0) => ("pos_explode", expr(child0, e.child.output))
+        case g @ JsonTuple(children0) =>
+          ("json_tuple", expr(children0.head, e.child.output))
+        case other =>
+          (other.getClass.getSimpleName.toLowerCase,
+            expr(other.children.head, e.child.output))
+      }
+      ("generator" -> gen) ~
+      ("gen_expr" -> genExpr) ~
+      ("outer" -> e.outer) ~
+      ("required_cols" -> e.requiredChildOutput.map(a =>
+        e.child.output.indexWhere(_.exprId == a.exprId))) ~
+      ("json_fields" -> (e.generator match {
+        case JsonTuple(children0) => children0.tail.collect {
+          case Literal(f, _) => String.valueOf(f)
+        }
+        case _ => Nil
+      }))
+    case e: DataWritingCommandExec =>
+      e.cmd match {
+        case c: InsertIntoHadoopFsRelationCommand =>
+          ("format" -> c.fileFormat.getClass.getSimpleName
+            .toLowerCase.stripSuffix("fileformat")) ~
+          ("path" -> c.outputPath.toString) ~
+          ("partition_by" -> c.partitionColumns.map(_.name)) ~
+          ("props" -> c.options)
+        case other => "command" -> other.getClass.getSimpleName
+      }
     case _ => JObject()
+  }
+
+  private def windowFunc(we: WindowExpression, name: String,
+                         in: Seq[Attribute]): JObject = {
+    val frameWhole = we.windowSpec.frameSpecification match {
+      case SpecifiedWindowFrame(RowFrame, UnboundedPreceding, UnboundedFollowing) => true
+      case _: UnspecifiedFrame.type => false
+      case SpecifiedWindowFrame(RangeFrame, UnboundedPreceding, UnboundedFollowing) => true
+      case _ => false
+    }
+    we.windowFunction match {
+      case _: RowNumber => ("kind" -> "row_number") ~ ("name" -> name)
+      case _: Rank => ("kind" -> "rank") ~ ("name" -> name)
+      case _: DenseRank => ("kind" -> "dense_rank") ~ ("name" -> name)
+      case _: PercentRank => ("kind" -> "percent_rank") ~ ("name" -> name)
+      case _: CumeDist => ("kind" -> "cume_dist") ~ ("name" -> name)
+      case nt: NTile =>
+        ("kind" -> "ntile") ~ ("name" -> name) ~
+        ("offset" -> (nt.buckets match {
+          case Literal(v, _) => v.toString.toInt
+          case _ => 1
+        }))
+      case l: Lead =>
+        ("kind" -> "lead") ~ ("name" -> name) ~
+        ("expr" -> expr(l.input, in)) ~
+        ("offset" -> (l.offset match {
+          case Literal(v, _) => v.toString.toInt; case _ => 1
+        }))
+      case l: Lag =>
+        ("kind" -> "lag") ~ ("name" -> name) ~
+        ("expr" -> expr(l.input, in)) ~
+        ("offset" -> (l.offset match {
+          case Literal(v, _) => v.toString.toInt; case _ => 1
+        }))
+      case nth: NthValue =>
+        ("kind" -> "nth_value") ~ ("name" -> name) ~
+        ("expr" -> expr(nth.input, in)) ~
+        ("offset" -> (nth.offset match {
+          case Literal(v, _) => v.toString.toInt; case _ => 1
+        }))
+      case agg: AggregateExpression =>
+        ("kind" -> "agg") ~ ("name" -> name) ~
+        ("agg" -> aggName(agg.aggregateFunction)) ~
+        ("expr" -> agg.aggregateFunction.children.headOption.map(expr(_, in))) ~
+        ("frame_whole" -> frameWhole)
+      case other =>
+        ("kind" -> other.getClass.getSimpleName.toLowerCase) ~ ("name" -> name)
+    }
   }
 
   private def joinArgs(lk: Seq[Expression], rk: Seq[Expression], jt: String,
@@ -115,10 +239,13 @@ object HostPlanSerializer {
       ("kind" -> "attr") ~ ("index" -> input.indexWhere(_.exprId == a.exprId)) ~
       ("name" -> a.name)
     case In(child, list) if list.forall(_.isInstanceOf[Literal]) =>
+      // typed scalars, same encoding as Literal (ADVICE r2: string-typed
+      // IN values over an int column convert fine but fail at runtime)
       ("kind" -> "call") ~ ("name" -> "in") ~
       ("children" -> List(expr(child, input))) ~
-      ("values" -> list.map { case Literal(v, _) =>
-        if (v == null) JNull else JString(String.valueOf(v))
+      ("values" -> list.map { case l: Literal => literalValue(l) }) ~
+      ("value_type" -> list.headOption.map {
+        case l: Literal => typeName(l.dataType)
       })
     case CaseWhen(branches, elseValue) =>
       ("kind" -> "call") ~ ("name" -> "casewhen") ~
@@ -132,22 +259,8 @@ object HostPlanSerializer {
       ("pattern" -> String.valueOf(pat)) ~ ("escape" -> esc.toString)
     case Alias(child, _) => expr(child, input)
     case l: Literal =>
-      // typed scalars, matching ir.Literal's expectations (numbers as
-      // numbers, null as null; decimals as exact display strings the
-      // engine parses with python Decimal)
-      val jval: JValue = l.value match {
-        case null => JNull
-        case b: java.lang.Boolean => JBool(b)
-        case n @ (_: java.lang.Byte | _: java.lang.Short |
-                  _: java.lang.Integer | _: java.lang.Long) =>
-          JLong(n.asInstanceOf[Number].longValue)
-        case f @ (_: java.lang.Float | _: java.lang.Double) =>
-          JDouble(f.asInstanceOf[Number].doubleValue)
-        case d: org.apache.spark.sql.types.Decimal => JString(d.toString)
-        case s0: org.apache.spark.unsafe.types.UTF8String => JString(s0.toString)
-        case other => JString(String.valueOf(other))
-      }
-      ("kind" -> "lit") ~ ("value" -> jval) ~ ("type" -> typeName(l.dataType))
+      ("kind" -> "lit") ~ ("value" -> literalValue(l)) ~
+      ("type" -> typeName(l.dataType))
     case c: Cast =>
       ("kind" -> "call") ~ ("name" -> "cast") ~
       ("children" -> List(expr(c.child, input))) ~
@@ -165,8 +278,24 @@ object HostPlanSerializer {
       ("children" -> other.children.map(expr(_, input)))
   }
 
-  private def aggMode(e: HashAggregateExec): String =
-    e.aggregateExpressions.headOption.map(_.mode) match {
+  /** Typed scalar encoding shared by Literal exprs and IN-value lists:
+   * numbers as numbers, null as null, decimals as exact display strings
+   * the engine parses with python Decimal. */
+  private def literalValue(l: Literal): JValue = l.value match {
+    case null => JNull
+    case b: java.lang.Boolean => JBool(b)
+    case n @ (_: java.lang.Byte | _: java.lang.Short |
+              _: java.lang.Integer | _: java.lang.Long) =>
+      JLong(n.asInstanceOf[Number].longValue)
+    case f @ (_: java.lang.Float | _: java.lang.Double) =>
+      JDouble(f.asInstanceOf[Number].doubleValue)
+    case d: org.apache.spark.sql.types.Decimal => JString(d.toString)
+    case s0: org.apache.spark.unsafe.types.UTF8String => JString(s0.toString)
+    case other => JString(String.valueOf(other))
+  }
+
+  private def aggMode(aggs: Seq[AggregateExpression]): String =
+    aggs.headOption.map(_.mode) match {
       case Some(Partial) => "partial"
       case Some(PartialMerge) => "partial_merge"
       case Some(Final) => "final"
@@ -187,6 +316,10 @@ object HostPlanSerializer {
     case other => other.prettyName
   }
 
+  /* shared with RangeBoundsSampler */
+  private[auron_tpu] def literalValueJson(l: Literal): JValue = literalValue(l)
+  private[auron_tpu] def typeNameOf(t: DataType): String = typeName(t)
+
   private def typeName(t: DataType): String = t match {
     case BooleanType => "boolean"
     case ByteType => "byte"
@@ -201,6 +334,49 @@ object HostPlanSerializer {
     case TimestampType => "timestamp"
     case d: DecimalType => s"decimal(${d.precision},${d.scale})"
     case ArrayType(el, _) => s"array<${typeName(el)}>"
+    case MapType(k, v, _) => s"map<${typeName(k)},${typeName(v)}>"
+    case s: StructType =>
+      "struct<" + s.fields.map(f => s"${f.name}:${typeName(f.dataType)}")
+        .mkString(",") + ">"
     case other => other.simpleString
   }
+}
+
+/**
+ * JVM-side range-bound sampling (NativeShuffleExchangeBase.scala:312
+ * analog): take a bounded sample of the exchange child, sort it by the
+ * range ordering, and emit n-1 quantile boundary rows as typed literal
+ * dicts. The engine turns these into orderable bound words; when sampling
+ * is disabled or fails, the empty list makes the engine degrade the
+ * exchange to host execution (never mis-scatter).
+ */
+object RangeBoundsSampler {
+  import org.apache.spark.sql.catalyst.expressions.codegen.LazilyGeneratedOrdering
+  import org.json4s.JsonDSL._
+
+  def sample(e: ShuffleExchangeExec, ordering: Seq[SortOrder],
+             n: Int): List[JValue] = try {
+    if (n <= 1) return Nil
+    // OPT-IN: executeTake launches a planning-time job over the child and
+    // samples a non-random prefix — acceptable for cheap/unsorted inputs,
+    // skewed for inputs clustered on the sort key. Default off: range
+    // exchanges then degrade to host execution (correct, never skewed).
+    if (!e.conf.getConfString("spark.auron_tpu.range.sample", "false").toBoolean) {
+      return Nil
+    }
+    val rows = e.child.executeTake(math.max(100, n * 20))
+    if (rows.length < 2) return Nil
+    val ord = new LazilyGeneratedOrdering(ordering, e.child.output)
+    val sorted = rows.sorted(ord)
+    val keys = ordering.map(o =>
+      BindReferences.bindReference(o.child, e.child.output))
+    (1 until n).toList.map { i =>
+      val row = sorted(math.min(sorted.length - 1, i * sorted.length / n))
+      JArray(keys.map { k =>
+        val l = Literal(k.eval(row), k.dataType)
+        (("value" -> HostPlanSerializer.literalValueJson(l)) ~
+         ("type" -> HostPlanSerializer.typeNameOf(k.dataType))): JValue
+      }.toList)
+    }
+  } catch { case _: Throwable => Nil }
 }

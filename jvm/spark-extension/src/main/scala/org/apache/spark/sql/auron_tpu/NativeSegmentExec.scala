@@ -31,7 +31,8 @@ case class NativeSegmentExec(
     output: Seq[Attribute],
     taskProtoPerPartition: Int => Array[Byte],
     ffiInput: Option[String],
-    child: Option[SparkPlan])
+    child: Option[SparkPlan],
+    pinnedPartitions: Option[Int] = None)
   extends SparkPlan {
 
   override def children: Seq[SparkPlan] = child.toSeq
@@ -50,7 +51,9 @@ case class NativeSegmentExec(
           segmentIterator(protoOf(pid), out, Some(rid))
         }
       case None =>
-        val nParts = 1.max(conf.numShufflePartitions)
+        // scan file placement pins the task count; fewer tasks than file
+        // groups would silently drop data (conversion service contract)
+        val nParts = pinnedPartitions.getOrElse(1.max(conf.numShufflePartitions))
         sparkContext.parallelize(0 until nParts, nParts).mapPartitionsWithIndex {
           (pid, _) => segmentIterator(protoOf(pid), out, None)
         }

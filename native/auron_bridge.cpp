@@ -278,6 +278,39 @@ int auron_remove_resource(const char* key) {
   return rc;
 }
 
+/* conversion-response buffer: thread-local (like tl_error) so concurrent
+ * conversions on different host threads never clobber each other; the
+ * pointer stays valid until this thread's next auron_convert_plan call */
+static thread_local std::string tl_convert_buf;
+
+int auron_convert_plan(const uint8_t* host_plan_json, size_t len,
+                       const uint8_t** response_json, size_t* response_len) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyObject_CallMethod(
+      g_api, "convert_plan_json", "y#",
+      reinterpret_cast<const char*>(host_plan_json),
+      static_cast<Py_ssize_t>(len));
+  if (res != nullptr) {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(res, &buf, &n) == 0) {
+      tl_convert_buf.assign(buf, static_cast<size_t>(n));
+      *response_json = reinterpret_cast<const uint8_t*>(tl_convert_buf.data());
+      *response_len = tl_convert_buf.size();
+      rc = 0;
+    } else {
+      capture_python_error();
+    }
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
 const char* auron_last_error(void) { return tl_error.c_str(); }
 
 } /* extern "C" */

@@ -58,6 +58,14 @@ int auron_put_resource_shuffle(const char* key, const uint8_t* manifest,
                                size_t len);
 int auron_remove_resource(const char* key);
 
+/* Conversion service: host-plan JSON in, segmentation-response JSON out
+ * (the engine-side AuronConverters; see auron_tpu/convert/service.py for
+ * the response schema). The response buffer is engine-owned, per-thread,
+ * and valid until the CALLING thread's next auron_convert_plan call.
+ * Returns 0 on success, negative on error. */
+int auron_convert_plan(const uint8_t* host_plan_json, size_t len,
+                       const uint8_t** response_json, size_t* response_len);
+
 /* Last error message for the calling thread (UTF-8, engine-owned). */
 const char* auron_last_error(void);
 

@@ -41,9 +41,32 @@ static uint8_t* read_file(const char* path, size_t* out_len) {
 }
 
 int main(int argc, char** argv) {
+  if (argc == 4 && strcmp(argv[1], "--convert") == 0) {
+    /* conversion-service mode: host-plan JSON -> segmentation JSON */
+    size_t len = 0;
+    uint8_t* payload = read_file(argv[2], &len);
+    const uint8_t* resp = NULL;
+    size_t resp_len = 0;
+    if (auron_convert_plan(payload, len, &resp, &resp_len) != 0) {
+      fprintf(stderr, "convert_plan failed: %s\n", auron_last_error());
+      return 7;
+    }
+    free(payload);
+    FILE* cf = fopen(argv[3], "wb");
+    if (cf == NULL) {
+      fprintf(stderr, "cannot open %s\n", argv[3]);
+      return 2;
+    }
+    fwrite(resp, 1, resp_len, cf);
+    fclose(cf);
+    auron_on_exit();
+    return 0;
+  }
   if (argc < 3 || (argc - 3) % 2 != 0) {
-    fprintf(stderr, "usage: %s <taskdef.bin> <out.bin> [<key> <file>]...\n",
-            argv[0]);
+    fprintf(stderr,
+            "usage: %s <taskdef.bin> <out.bin> [<key> <file>]...\n"
+            "       %s --convert <hostplan.json> <response.json>\n",
+            argv[0], argv[0]);
     return 2;
   }
 

@@ -355,3 +355,175 @@ def test_map_struct_types_parse():
             "children": [_scan(schema, rid="ms")]}
     res = convert_plan(plan)
     assert isinstance(res.root, NativeSegment)
+
+
+# ---------------------------------------------------------------------------
+# serializer-shaped coverage: JSON in the exact shape HostPlanSerializer
+# emits, for every operator class the engine converts (VERDICT r2 item 4)
+# ---------------------------------------------------------------------------
+
+
+def _sort_field(e, asc=True, nf=True):
+    return {"expr": e, "asc": asc, "nulls_first": nf}
+
+
+def test_serializer_shaped_full_operator_coverage():
+    from auron_tpu.convert import convert_plan as cp
+
+    scan = _scan(SCHEMA, rid="t")
+    win = {
+        "op": "WindowExec",
+        "schema": SCHEMA + [["rn", "long", True]],
+        "args": {
+            "partition_by": [_attr(0)],
+            "order": [_sort_field(_attr(1))],
+            "funcs": [{"kind": "row_number", "name": "rn"},
+                      {"kind": "agg", "agg": "sum", "expr": _attr(1),
+                       "frame_whole": True, "name": "s"}],
+        },
+        "children": [scan],
+    }
+    expand = {
+        "op": "ExpandExec",
+        "schema": [["k", "long", True], ["v", "long", True]],
+        "args": {"projections": [[_attr(0), _attr(1)],
+                                 [_attr(0), _lit(None, "long")]]},
+        "children": [scan],
+    }
+    union = {"op": "UnionExec",
+             "schema": [["k", "long", True], ["v", "long", True]],
+             "args": {}, "children": [expand, expand]}
+    topk = {
+        "op": "TakeOrderedAndProjectExec",
+        "schema": [["k", "long", True]],
+        "args": {"limit": 5, "order": [_sort_field(_attr(1), asc=False)],
+                 "projections": [_attr(0)]},
+        "children": [union],
+    }
+    res = cp(topk)
+    assert isinstance(res.root, NativeSegment), res.explain()
+
+    gen = {
+        "op": "GenerateExec",
+        "schema": [["k", "long", True], ["x", "long", True]],
+        "args": {"generator": "explode",
+                 "gen_expr": _call("makearray", _attr(0), _attr(1)),
+                 "required_cols": [0], "outer": False, "json_fields": []},
+        "children": [scan],
+    }
+    res = cp(gen)
+    assert isinstance(res.root, NativeSegment), res.explain()
+
+    write = {
+        "op": "DataWritingCommandExec",
+        "schema": [],
+        "args": {"format": "parquet", "path": "/tmp/out_w",
+                 "partition_by": [], "props": {}},
+        "children": [scan],
+    }
+    res = cp(write)
+    assert isinstance(res.root, NativeSegment), res.explain()
+
+
+def test_serializer_shaped_range_exchange_with_bounds():
+    from auron_tpu.convert import convert_plan as cp
+
+    plan = {
+        "op": "ShuffleExchangeExec",
+        "schema": SCHEMA,
+        "args": {"partitioning": {
+            "kind": "range", "num_partitions": 4,
+            "order": [_sort_field(_attr(0))],
+            "bounds": [[{"value": 10, "type": "long"}],
+                       [{"value": 20, "type": "long"}],
+                       [{"value": 30, "type": "long"}]],
+        }},
+        "children": [_scan(SCHEMA)],
+    }
+    res = cp(plan)
+    assert isinstance(res.root, NativeSegment), res.explain()
+    ex = res.root.plan.mesh_exchange
+    from auron_tpu.proto import plan_pb2 as pb
+
+    assert ex.partitioning.kind == pb.Partitioning.RANGE
+    assert ex.partitioning.num_partitions == 4
+    assert len(ex.partitioning.range_bound_words) == 3 * 2  # 2 words per key
+
+    # without bounds, a multi-partition range exchange DEGRADES (never
+    # mis-scatters)
+    plan["args"]["partitioning"]["bounds"] = []
+    res = cp(plan)
+    assert isinstance(res.root, HostOp)
+    assert "bounds" in (res.tags.why(res.root.node) or "")
+
+
+def test_serializer_shaped_in_list_typed_values():
+    """ADVICE r2: intCol IN (1,2,3) must compare as ints even when values
+    ride as JSON with a type tag (decimal strings become exact decimals)."""
+    import pandas as pd
+
+    from auron_tpu.bridge import api
+    from auron_tpu.convert import convert_plan as cp
+
+    plan = {
+        "op": "FilterExec", "schema": [["k", "long", True]],
+        "args": {"predicates": [
+            {"kind": "call", "name": "in", "children": [_attr(0)],
+             "values": [1, 3, 5], "value_type": "long"}]},
+        "children": [_scan([["k", "long", True]], rid="inlist")],
+    }
+    res = cp(plan)
+    assert isinstance(res.root, NativeSegment)
+    from auron_tpu.columnar import Batch
+
+    api.put_resource("inlist", [[Batch.from_pydict({"k": [1, 2, 3, 4, 5, 6]})]])
+    try:
+        from auron_tpu.plan import builders as B
+
+        h = api.call_native(B.task(res.root.plan).SerializeToString())
+        rows = []
+        while (rb := api.next_batch(h)) is not None:
+            rows += rb.to_pylist()
+        api.finalize_native(h)
+        assert sorted(r["k"] for r in rows) == [1, 3, 5]
+    finally:
+        api.remove_resource("inlist")
+
+
+def test_conversion_service_response_shape():
+    from auron_tpu.convert.service import convert_host_plan_json
+    import base64
+    import json as _json
+
+    plan = {
+        "op": "ProjectExec", "schema": [["k", "long", True]],
+        "args": {"projections": [_attr(0)]},
+        "children": [{
+            "op": "PythonMapExec", "schema": SCHEMA, "args": {},
+            "children": [_scan(SCHEMA)],
+        }],
+    }
+    resp = _json.loads(convert_host_plan_json(_json.dumps(plan)))
+    assert resp["converted"] is True
+    root = resp["root"]
+    assert root["kind"] == "segment" and root["path"] == []
+    assert root["schema"] == [["k", "long", True]]
+    assert len(root["stages"]) == 1 and root["stages"][0]["exchange_id"] is None
+    # the boundary input: python op at path [0], its scan child a segment
+    (inp,) = root["inputs"]
+    child = inp["child"]
+    assert child["kind"] == "host" and child["op"] == "PythonMapExec"
+    assert child["path"] == [0]  # relative to the segment root
+    assert child["children"][0]["kind"] == "segment"
+    assert child["children"][0]["path"] == [0]  # relative to the python op
+    assert root["task_partitions"] is None
+    # plan proto decodes
+    from auron_tpu.proto import plan_pb2 as pb
+
+    node = pb.PhysicalPlanNode()
+    node.ParseFromString(base64.b64decode(root["plan_b64"]))
+    assert node.WhichOneof("plan") == "project"
+    # tags are (op, ok, reason) rows in walk order
+    assert [t[0] for t in resp["tags"]] == [
+        "ProjectExec", "PythonMapExec", "LocalTableScanExec"
+    ]

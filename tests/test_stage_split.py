@@ -349,3 +349,35 @@ def test_c_abi_two_stage_execution(tmp_path):
     assert got["i_brand"].tolist() == oracle["i_brand"].tolist()
     for g, w in zip(got["s"], oracle["s"]):
         assert g == pytest.approx(w, rel=1e-9)
+
+
+def test_c_abi_conversion_service(tmp_path):
+    """The conversion service through the C ABI: host-plan JSON ->
+    segmentation response, as the JVM shim calls it (auron_convert_plan)."""
+    harness = _build_bridge()
+    plan = {
+        "op": "ProjectExec", "schema": [["k", "long", True]],
+        "args": {"projections": [{"kind": "attr", "index": 0, "name": "k"}]},
+        "children": [{"op": "LocalTableScanExec",
+                      "schema": [["k", "long", True]],
+                      "args": {"resource_id": "t"}, "children": []}],
+    }
+    req = tmp_path / "hostplan.json"
+    req.write_text(json.dumps(plan))
+    out = tmp_path / "resp.json"
+    r = subprocess.run(
+        [harness, "--convert", str(req), str(out)],
+        env=_harness_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    resp = json.loads(out.read_text())
+    assert resp["converted"] is True
+    assert resp["root"]["kind"] == "segment"
+    assert resp["root"]["stages"][0]["exchange_id"] is None
+    import base64
+
+    from auron_tpu.proto import plan_pb2 as pb
+
+    node = pb.PhysicalPlanNode()
+    node.ParseFromString(base64.b64decode(resp["root"]["plan_b64"]))
+    assert node.WhichOneof("plan") == "project"
