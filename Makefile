@@ -21,7 +21,6 @@ SPARK_JARS = $(wildcard $(SPARK_HOME)/jars/*.jar)
 EMPTY :=
 SPACE := $(EMPTY) $(EMPTY)
 JVM_CLASSPATH = $(subst $(SPACE),:,$(strip $(SPARK_JARS)))
-JVM_SRC = $(shell find jvm -name '*.scala' -o -name '*.java')
 
 jvm-compile:
 	@if [ -z "$(SPARK_HOME)" ] || ! command -v scalac >/dev/null; then \

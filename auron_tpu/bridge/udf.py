@@ -36,26 +36,63 @@ def udf_names() -> list[str]:
 
 
 # ---------------------------------------------------------------------------
-# UDAFs (aggregate fallback)
+# UDAFs (aggregate fallback — incremental accumulator protocol)
 # ---------------------------------------------------------------------------
 
-_UDAFS: dict[str, tuple[Callable, "object"]] = {}
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class UdafSpec:
+    """Incremental accumulator protocol, the SparkUDAFWrapperContext analog
+    (spark-extension .../SparkUDAFWrapperContext.scala:59-235: initialize /
+    update / merge / eval over FFI state batches):
+
+    - ``init() -> state``                    fresh per-group state
+    - ``update(state, value) -> state``      fold one input value
+    - ``merge(state, other) -> state``       combine partial states
+    - ``finish(state) -> scalar``            final value
+
+    States are opaque python objects, pickled into the BINARY intermediate
+    column between stages — memory per group is bounded by the state size,
+    never by the group's input count, and the state batches spill through
+    the MemManager like any other aggregation state."""
+
+    init: Callable
+    update: Callable
+    merge: Callable
+    finish: Callable
+    out_dtype: "object"
+
+
+_UDAFS: dict[str, UdafSpec] = {}
+
+
+def register_udaf_accumulator(
+    name: str, *, init: Callable, update: Callable, merge: Callable,
+    finish: Callable, out_dtype,
+) -> None:
+    """Register an incremental (bounded-state) host UDAF."""
+    _UDAFS[name] = UdafSpec(init, update, merge, finish, out_dtype)
 
 
 def register_udaf(name: str, fn: Callable, out_dtype) -> None:
     """fn(values: list) -> python scalar, evaluated per group at final.
 
-    The aggregate fallback analog of the reference's
-    SparkUDAFWrapperContext (spark-extension .../SparkUDAFWrapperContext.scala:59-235):
-    the engine accumulates the group's inputs (LIST-dictionary state, same
-    machinery as collect_list) and the host callback computes the final
-    value. Heavier than native aggregation by design — it exists so *any*
-    host-engine UDAF keeps the plan on the accelerator path.
+    Convenience wrapper over the accumulator protocol with LIST state —
+    the group's raw inputs accumulate (unbounded, like the pre-accumulator
+    behavior). Prefer ``register_udaf_accumulator`` for bounded memory.
     """
-    _UDAFS[name] = (fn, out_dtype)
+    _UDAFS[name] = UdafSpec(
+        init=list,
+        update=lambda st, v: (st.append(v) or st),
+        merge=lambda a, b: (a.extend(b) or a),
+        finish=fn,
+        out_dtype=out_dtype,
+    )
 
 
-def lookup_udaf(name: str) -> tuple[Callable, "object"]:
+def lookup_udaf(name: str) -> UdafSpec:
     if name not in _UDAFS:
         raise KeyError(f"host UDAF '{name}' is not registered with the bridge")
     return _UDAFS[name]

@@ -69,7 +69,12 @@ def _convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None
         return ir.Column(idx, e.get("name", ""))
     if kind == "lit":
         dt = parse_type(e.get("type", "null"))
-        return ir.Literal(e.get("value"), dt)
+        v = e.get("value")
+        if dt.kind == T.TypeKind.BINARY and isinstance(v, str):
+            import base64
+
+            v = base64.b64decode(v)  # serializer ships bytes as base64
+        return ir.Literal(v, dt)
     if kind != "call":
         raise UnsupportedExpr(f"unknown expression kind {kind!r}")
 
@@ -153,8 +158,10 @@ def _coerce_literal(v, dt):
     if k == T.TypeKind.STRING:
         return v
     if k == T.TypeKind.BINARY:
-        # binary dictionaries hold bytes; JSON ships str
-        return v.encode("utf-8") if isinstance(v, str) else v
+        # serializer ships binary values as base64 strings
+        import base64
+
+        return base64.b64decode(v) if isinstance(v, str) else v
     if k == T.TypeKind.BOOL:
         return ir.Literal(bool(v), dt)
     if k == T.TypeKind.DECIMAL:

@@ -95,7 +95,7 @@ def final_type(a: AggExpr, in_t: T.DataType | None) -> T.DataType:
     if a.func == "host_udaf":
         from auron_tpu.bridge.udf import lookup_udaf
 
-        return lookup_udaf(a.udaf)[1]
+        return lookup_udaf(a.udaf).out_dtype
     return in_t  # min/max/first
 
 
@@ -149,7 +149,7 @@ def intermediate_fields(a: AggExpr, in_t: T.DataType | None, prefix: str) -> lis
             T.Field(f"{prefix}#value", in_t, True),
             T.Field(f"{prefix}#seen", T.BOOL, False),
         ]
-    if a.func in ("collect_list", "collect_set", "host_udaf"):
+    if a.func in ("collect_list", "collect_set"):
         return [
             T.Field(
                 f"{prefix}#items",
@@ -157,6 +157,10 @@ def intermediate_fields(a: AggExpr, in_t: T.DataType | None, prefix: str) -> lis
                 True,
             )
         ]
+    if a.func == "host_udaf":
+        # pickled accumulator state per group (bounded by state size, not
+        # input count — SparkUDAFWrapperContext's state-batch FFI analog)
+        return [T.Field(f"{prefix}#state", T.BINARY, True)]
     raise ValueError(a.func)
 
 
@@ -465,11 +469,71 @@ class HashAggExec(ExecOperator):
         raw: bool,
     ) -> Batch:
         out_vals, group_valid = _reduce_columns(
-            sel, keys, agg_cols, raw, self._reduce_cfg, collect_cb=self._reduce_collect
+            sel, keys, agg_cols, raw, self._reduce_cfg, collect_cb=self._host_agg_cb
         )
         out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
         return Batch(self.inter_schema, out.device, out.dicts)
 
+
+    def _host_agg_cb(self, a, in_t, cols, order, seg, cap, raw, group_valid):
+        """Dispatch host-side aggregates: collect_* vs accumulator UDAFs."""
+        if a.func == "host_udaf":
+            return self._reduce_udaf_state(
+                a, in_t, cols, order, seg, cap, raw, group_valid
+            )
+        return self._reduce_collect(a, in_t, cols, order, seg, cap, raw, group_valid)
+
+    def _reduce_udaf_state(
+        self, a: AggExpr, in_t, cols, order, seg, cap, raw, group_valid
+    ) -> list[ColumnVal]:
+        """Incremental host-UDAF accumulation (SparkUDAFWrapperContext's
+        initialize/update/merge state batches, .scala:59-235): fold this
+        batch's inputs into per-group states (raw) or merge partial states
+        (merge/final input). One device->host pull per reduce; memory per
+        group is the accumulator state, never the input count."""
+        import pickle
+
+        import jax
+
+        from auron_tpu.bridge.udf import lookup_udaf
+        from auron_tpu.columnar.batch import _device_to_arrow
+
+        spec = lookup_udaf(a.udaf)
+        cv = cols[0]
+        sv = cv.values[order]
+        sm = cv.validity[order] & seg.sel_sorted
+        ids_np = np.asarray(jax.device_get(seg.seg_ids))
+        sv_np = np.asarray(jax.device_get(sv))
+        sm_np = np.asarray(jax.device_get(sm))
+        n_groups = int(jax.device_get(seg.num_groups))
+        n_slots = max(n_groups, 1)
+        states: list = [None] * n_slots
+        if raw:
+            decoded = _device_to_arrow(sv_np, sm_np, in_t, cv.dict).to_pylist()
+            for gid, val, ok in zip(ids_np, decoded, sm_np):
+                if 0 <= gid < n_groups and ok:
+                    st = states[gid] if states[gid] is not None else spec.init()
+                    states[gid] = spec.update(st, val)
+        else:
+            entries = cv.dict.to_pylist()
+            for gid, code, ok in zip(ids_np, sv_np, sm_np):
+                if not (0 <= gid < n_groups and ok):
+                    continue
+                blob = entries[code] if 0 <= code < len(entries) else None
+                if not blob:
+                    continue
+                other = pickle.loads(blob)
+                states[gid] = (
+                    other if states[gid] is None
+                    else spec.merge(states[gid], other)
+                )
+        blobs = [
+            pickle.dumps(st if st is not None else spec.init())
+            for st in states
+        ]
+        d = pa.array(blobs, type=pa.binary())
+        codes = jnp.arange(cap, dtype=jnp.int32) % n_slots
+        return [ColumnVal(codes, group_valid, T.BINARY, d)]
 
     def _reduce_collect(
         self, a: AggExpr, in_t, cols, order, seg, cap, raw, group_valid
@@ -519,27 +583,34 @@ class HashAggExec(ExecOperator):
         return [ColumnVal(codes, group_valid, list_t, d)]
 
     def _final_udaf(self, a: AggExpr, in_t, state_cv: ColumnVal) -> ColumnVal:
-        """Evaluate the host UDAF callback over each group's collected
-        inputs (bridge.udf.register_udaf)."""
+        """finish() each group's accumulator state (the evaluate leg of the
+        SparkUDAFWrapperContext protocol)."""
+        import pickle
+
         import jax
 
         from auron_tpu.bridge.udf import lookup_udaf
         from auron_tpu.columnar.batch import _arrow_to_device
 
-        fn, out_dtype = lookup_udaf(a.udaf)
+        spec = lookup_udaf(a.udaf)
         cap = int(state_cv.values.shape[0])
         codes = np.asarray(jax.device_get(state_cv.values))
         valid = np.asarray(jax.device_get(state_cv.validity))
         entries = state_cv.dict.to_pylist()
         out_rows = []
         for i in range(cap):
-            if valid[i] and 0 <= codes[i] < len(entries):
-                out_rows.append(fn(entries[codes[i]] or []))
+            blob = (
+                entries[codes[i]]
+                if valid[i] and 0 <= codes[i] < len(entries)
+                else None
+            )
+            if blob:
+                out_rows.append(spec.finish(pickle.loads(blob)))
             else:
                 out_rows.append(None)
-        arr = pa.array(out_rows, type=out_dtype.to_arrow())
-        v, m, d = _arrow_to_device(arr, out_dtype, cap)
-        return ColumnVal(v, m & state_cv.validity, out_dtype, d)
+        arr = pa.array(out_rows, type=spec.out_dtype.to_arrow())
+        v, m, d = _arrow_to_device(arr, spec.out_dtype, cap)
+        return ColumnVal(v, m & state_cv.validity, spec.out_dtype, d)
 
     # ------------------------------------------------------------------
 
@@ -809,7 +880,9 @@ def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataTyp
     t = first_field.dtype
     if a.func in ("count", "count_star"):
         return None
-    if a.func in ("collect_list", "collect_set", "host_udaf"):
+    if a.func == "host_udaf":
+        return None  # state column carries no input type
+    if a.func in ("collect_list", "collect_set"):
         return t.inner[0]
     if a.func == "sum" or a.func == "avg":
         if "#sum0p" in first_field.name:

@@ -130,6 +130,10 @@ object NativeSegmentSplicer {
       case JInt(n) => Some(n.toInt)
       case _ => None
     }
+    // a pinned scan AND an FFI child cannot both dictate the partition
+    // count — leave such segments on the host rather than risk dropping
+    // file groups or mis-aligning the boundary stream
+    if (pinnedParts.nonEmpty && ffi.nonEmpty) return plan
     // the engine's FFIReaderExec prefers the per-partition resource form
     // "rid.pid" (what NativeSegmentExec registers), so the template needs
     // only the partition id stamped per task

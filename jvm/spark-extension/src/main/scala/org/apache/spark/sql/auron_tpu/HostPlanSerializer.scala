@@ -98,15 +98,28 @@ object HostPlanSerializer {
     case e: FileSourceScanExec =>
       // the REAL format, so the engine never parquet-decodes ORC bytes;
       // unknown formats make the node unconvertible engine-side.
-      // "partitions" carries Spark's OWN task file placement so each native
-      // task reads only its split — never the whole-table inputFiles list.
-      val parts = e.relation.location
-        .listFiles(e.partitionFilters, e.dataFilters)
-        .map(_.files.map(_.getPath.toString).toList)
+      // "partitions" carries task file SPLITS (size-binned like Spark's
+      // FilePartition.getFilePartitions — listFiles alone yields Hive
+      // directories, which would pin 1 task for unpartitioned tables and
+      // thousands for heavily partitioned ones).
+      val dirs = e.relation.location.listFiles(e.partitionFilters, e.dataFilters)
+      val sized = dirs.flatMap(_.files.map(f =>
+        (f.getPath.toString, f.getLen)))
+      val maxBytes = e.conf.filesMaxPartitionBytes
+      val groups = scala.collection.mutable.ListBuffer[List[String]]()
+      var cur = scala.collection.mutable.ListBuffer[String]()
+      var curBytes = 0L
+      sized.foreach { case (path, len) =>
+        if (cur.nonEmpty && curBytes + len > maxBytes) {
+          groups += cur.toList; cur = scala.collection.mutable.ListBuffer(); curBytes = 0L
+        }
+        cur += path; curBytes += len
+      }
+      if (cur.nonEmpty) groups += cur.toList
       ("format" -> e.relation.fileFormat.getClass.getSimpleName
         .toLowerCase.stripSuffix("fileformat")) ~
-      ("files" -> parts.flatten.toList) ~
-      ("partitions" -> parts.toList)
+      ("files" -> sized.map(_._1).toList) ~
+      ("partitions" -> groups.toList)
     case e: LocalLimitExec => "limit" -> e.limit
     case e: GlobalLimitExec => "limit" -> e.limit
     case e: UnionExec => JObject()
@@ -181,28 +194,19 @@ object HostPlanSerializer {
       case _: CumeDist => ("kind" -> "cume_dist") ~ ("name" -> name)
       case nt: NTile =>
         ("kind" -> "ntile") ~ ("name" -> name) ~
-        ("offset" -> (nt.buckets match {
-          case Literal(v, _) => v.toString.toInt
-          case _ => 1
-        }))
+        ("offset" -> staticOffset(nt.buckets))
       case l: Lead =>
         ("kind" -> "lead") ~ ("name" -> name) ~
         ("expr" -> expr(l.input, in)) ~
-        ("offset" -> (l.offset match {
-          case Literal(v, _) => v.toString.toInt; case _ => 1
-        }))
+        ("offset" -> staticOffset(l.offset))
       case l: Lag =>
         ("kind" -> "lag") ~ ("name" -> name) ~
         ("expr" -> expr(l.input, in)) ~
-        ("offset" -> (l.offset match {
-          case Literal(v, _) => v.toString.toInt; case _ => 1
-        }))
+        ("offset" -> staticOffset(l.offset).map(math.abs))
       case nth: NthValue =>
         ("kind" -> "nth_value") ~ ("name" -> name) ~
         ("expr" -> expr(nth.input, in)) ~
-        ("offset" -> (nth.offset match {
-          case Literal(v, _) => v.toString.toInt; case _ => 1
-        }))
+        ("offset" -> staticOffset(nth.offset))
       case agg: AggregateExpression =>
         ("kind" -> "agg") ~ ("name" -> name) ~
         ("agg" -> aggName(agg.aggregateFunction)) ~
@@ -278,6 +282,16 @@ object HostPlanSerializer {
       ("children" -> other.children.map(expr(_, input)))
   }
 
+  /** Static window frame offset: Literal (possibly negated — Spark wraps
+   * Lag offsets in UnaryMinus). Non-static offsets serialize as null; the
+   * engine's int(None) then fails the trial conversion and the node
+   * degrades to host execution instead of silently computing offset 1. */
+  private def staticOffset(e: Expression): Option[Int] = e match {
+    case Literal(v, _) => Some(v.toString.toInt)
+    case UnaryMinus(Literal(v, _), _) => Some(-v.toString.toInt)
+    case _ => None
+  }
+
   /** Typed scalar encoding shared by Literal exprs and IN-value lists:
    * numbers as numbers, null as null, decimals as exact display strings
    * the engine parses with python Decimal. */
@@ -291,6 +305,10 @@ object HostPlanSerializer {
       JDouble(f.asInstanceOf[Number].doubleValue)
     case d: org.apache.spark.sql.types.Decimal => JString(d.toString)
     case s0: org.apache.spark.unsafe.types.UTF8String => JString(s0.toString)
+    case b: Array[Byte] =>
+      // binary literals ride as base64 (JSON can't carry bytes; the
+      // engine's lit/IN coercion decodes when the declared type is binary)
+      JString(java.util.Base64.getEncoder.encodeToString(b))
     case other => JString(String.valueOf(other))
   }
 

@@ -321,3 +321,143 @@ def test_min_max_over_strings_lexicographic():
     got = _sorted(got, ["k"])
     assert list(got["mn"]) == ["apple", "pear"]
     assert list(got["mx"]) == ["zebra", "pear"]
+
+
+def test_udaf_accumulator_across_shuffle_bounded_state():
+    """VERDICT r2 item 5: incremental accumulator UDAF with partial/merge/
+    final states across a real exchange, matching a pandas oracle, with the
+    serialized per-group state bounded regardless of input size."""
+    import pickle
+
+    import pandas as pd
+
+    from auron_tpu.bridge.udf import register_udaf_accumulator
+    from auron_tpu.parallel.mesh import make_mesh
+    from auron_tpu.parallel.mesh_driver import MeshQueryDriver
+    from auron_tpu.plan import builders as B
+
+    # Welford-style mean accumulator: state = (count, total) — constant size
+    register_udaf_accumulator(
+        "acc_mean",
+        init=lambda: (0, 0.0),
+        update=lambda st, v: (st[0] + 1, st[1] + v) if v is not None else st,
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        finish=lambda st: (st[1] / st[0]) if st[0] else None,
+        out_dtype=T.FLOAT64,
+    )
+
+    rng = np.random.default_rng(11)
+    n = 40_000
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 37, n).astype(np.int64),
+            "v": rng.normal(10.0, 3.0, n),
+        }
+    )
+    n_dev = 8
+    schema = T.Schema.of(T.Field("k", T.INT64), T.Field("v", T.FLOAT64))
+    per = (n + n_dev - 1) // n_dev
+    parts = [
+        [Batch.from_arrow(pa.RecordBatch.from_pandas(
+            df.iloc[p * per : (p + 1) * per], preserve_index=False))]
+        for p in range(n_dev)
+    ]
+    scan = B.memory_scan(schema, "udaf_fact")
+    partial = B.hash_agg(
+        scan, [(col(0), "k")],
+        [("host_udaf", col(1), "m", "acc_mean"), ("count_star", None, "c")],
+        "partial",
+    )
+    ex = B.mesh_exchange(partial, B.hash_partitioning([col(0)], n_dev), "udaf_ex")
+    final = B.hash_agg(
+        ex, [(col(0), "k")],
+        [("host_udaf", col(1), "m", "acc_mean"), ("count_star", None, "c")],
+        "final",
+    )
+    driver = MeshQueryDriver(make_mesh(n_dev))
+    got = driver.collect(final, {"udaf_fact": parts}).sort_values("k").reset_index(drop=True)
+
+    want = (
+        df.groupby("k").agg(m=("v", "mean"), c=("v", "size")).reset_index()
+        .sort_values("k").reset_index(drop=True)
+    )
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["c"].tolist() == want["c"].tolist()
+    for g, w in zip(got["m"], want["m"]):
+        assert g == pytest.approx(w, rel=1e-9)
+
+    # memory bound: inspect the ENGINE's actual partial-stage state column —
+    # every serialized per-group state must be O(1) bytes even though each
+    # group folded ~1000 inputs (a collect-based fallback would hold the
+    # raw values and grow with the input count)
+    scan2 = B.memory_scan(schema, "udaf_fact")
+    partial2 = B.hash_agg(
+        scan2, [(col(0), "k")],
+        [("host_udaf", col(1), "m", "acc_mean")], "partial",
+    )
+    from auron_tpu.bridge import api as _api
+
+    _api.put_resource("udaf_fact", parts)
+    try:
+        h = _api.call_native(B.task(partial2, partition_id=0).SerializeToString())
+        state_sizes = []
+        while (rb := _api.next_batch(h)) is not None:
+            for blob in rb.column(1).to_pylist():
+                if blob:
+                    state_sizes.append(len(blob))
+        _api.finalize_native(h)
+    finally:
+        _api.remove_resource("udaf_fact")
+    assert state_sizes, "partial stage produced no states"
+    assert max(state_sizes) < 100, max(state_sizes)
+
+
+def test_udaf_accumulator_state_spills(tmp_path):
+    """Accumulator state batches ride the normal spill machinery."""
+    from auron_tpu.bridge.udf import register_udaf_accumulator
+    from auron_tpu.memory.memmgr import MemManager
+
+    register_udaf_accumulator(
+        "acc_sum",
+        init=lambda: 0.0,
+        update=lambda st, v: st + (v or 0.0),
+        merge=lambda a, b: a + b,
+        finish=lambda st: st,
+        out_dtype=T.FLOAT64,
+    )
+    rng = np.random.default_rng(5)
+    n = 20_000
+    ks = rng.integers(0, 50, n).astype(np.int64)
+    vs = rng.normal(size=n)
+    # many small batches so states accumulate under a tiny budget
+    chunk = 512
+    batches = [
+        Batch.from_pydict({"k": ks[i : i + chunk].tolist(),
+                           "v": vs[i : i + chunk].tolist()})
+        for i in range(0, n, chunk)
+    ]
+    MemManager.init(budget_bytes=8192)
+    try:
+        partial = HashAggExec(
+            MemoryScanExec.single(batches),
+            [(col(0), "k")],
+            [(AggExpr("host_udaf", col(1), udaf="acc_sum"), "s")],
+            "partial",
+        )
+        final = HashAggExec(
+            partial, [(col(0), "k")],
+            [(AggExpr("host_udaf", col(1), udaf="acc_sum"), "s")],
+            "final",
+        )
+        out = final.collect().to_pandas().sort_values("k").reset_index(drop=True)
+        import pandas as pd
+
+        want = (
+            pd.DataFrame({"k": ks, "v": vs}).groupby("k")["v"].sum()
+            .reset_index().sort_values("k").reset_index(drop=True)
+        )
+        assert out["k"].tolist() == want["k"].tolist()
+        for g, w in zip(out["s"], want["v"]):
+            assert g == pytest.approx(w, rel=1e-9)
+    finally:
+        MemManager.init()
