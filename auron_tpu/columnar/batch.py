@@ -364,21 +364,30 @@ def device_concat(batches: Sequence[Batch]) -> Batch:
 from functools import partial as _partial
 
 
+def compaction_index(sel: jnp.ndarray, out_cap: int):
+    """(idx[out_cap], sel_out[out_cap]): positions of the live rows, via
+    cumsum + branchless binary search. Gather-based on purpose — XLA:CPU
+    lowers scatters to serial loops (the platform even advertises
+    prefer-no-scatter), while the log2(cap) searchsorted passes vectorize."""
+    cap = sel.shape[0]
+    pos = jnp.cumsum(sel.astype(jnp.int32))
+    idx = jnp.searchsorted(
+        pos, jnp.arange(1, out_cap + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, cap - 1)
+    sel_out = jnp.arange(out_cap, dtype=jnp.int32) < pos[-1]
+    return idx, sel_out
+
+
 @_partial(jax.jit, static_argnames=("out_cap",))
 def _compact_dev(dev: DeviceBatch, out_cap: int) -> DeviceBatch:
-    """Scatter live rows into a dense prefix of a smaller buffer (O(n), no
-    sort). Used when selectivity collapses a batch (post-filter/join) so
-    blocking ops (sort-segmentation, exchange pulls) pay for live rows only."""
-    pos = jnp.cumsum(dev.sel.astype(jnp.int32)) - 1
-    slot = jnp.where(dev.sel, pos, out_cap)  # dead rows -> dropped
-    n_live = jnp.sum(dev.sel.astype(jnp.int32))
-    sel_out = jnp.arange(out_cap, dtype=jnp.int32) < n_live
-    values = tuple(
-        jnp.zeros(out_cap, v.dtype).at[slot].set(v, mode="drop") for v in dev.values
-    )
-    validity = tuple(
-        jnp.zeros(out_cap, bool).at[slot].set(m, mode="drop") for m in dev.validity
-    )
+    """Gather live rows into a dense prefix of a smaller buffer (O(n) +
+    O(out log n), no sort). Used when selectivity collapses a batch
+    (post-filter/join) so blocking ops (sort-segmentation, exchange pulls)
+    pay for live rows only."""
+    idx, sel_out = compaction_index(dev.sel, out_cap)
+    values = tuple(v[idx] for v in dev.values)
+    validity = tuple(m[idx] & sel_out for m in dev.validity)
     return DeviceBatch(sel_out, values, validity)
 
 

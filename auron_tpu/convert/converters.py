@@ -329,8 +329,15 @@ class _Converter:
         funcs = []
         for f in n.args["funcs"]:
             e = self.expr(f["expr"]) if f.get("expr") is not None else None
+            if f["kind"] in ("lead", "lag", "nth_value", "ntile"):
+                # offset REQUIRED and static: a missing/null offset (non-
+                # literal in the host plan) must fail the trial conversion,
+                # never silently default (int(None) raises)
+                offset = int(f["offset"])
+            else:
+                offset = int(f.get("offset", 1))
             funcs.append(
-                (f["kind"], f.get("agg"), e, int(f.get("offset", 1)),
+                (f["kind"], f.get("agg"), e, offset,
                  bool(f.get("frame_whole", False)), f["name"])
             )
         return B.window(

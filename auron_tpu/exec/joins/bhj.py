@@ -63,6 +63,12 @@ class BroadcastHashJoinExec(ExecOperator):
         return built
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        from auron_tpu.exec.joins.chain import try_fused_chain
+
+        fused = try_fused_chain(self, partition, ctx)
+        if fused is not None:
+            yield from fused
+            return
         build = self._build(partition, ctx)
         probe_child = 1 if self.build_side == "left" else 0
         for pb in self.child_stream(probe_child, partition, ctx):

@@ -229,10 +229,89 @@ def _probe_unique_ops(
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("bcap", "use_lut", "probe_outer"))
+def _canon_words_traced(key_vals, key_masks, key_kinds):
+    """Canonical equality words from raw key arrays (traceable: the kind
+    tags ride as static args so the whole canon+probe chain fuses into one
+    program instead of per-op full-capacity passes)."""
+    words = []
+    valid = None
+    for v, m, kind in zip(key_vals, key_masks, key_kinds):
+        if kind == "bool":
+            w = v.astype(jnp.uint64)
+        elif kind == "f32":
+            f = v.astype(jnp.float32)
+            f = jnp.where(f == 0, jnp.float32(0), f)
+            f = jnp.where(jnp.isnan(f), jnp.float32(jnp.nan), f)
+            w = f.view(jnp.uint32).astype(jnp.uint64)
+        elif kind == "f64":
+            f = v.astype(jnp.float64)
+            f = jnp.where(f == 0, jnp.float64(0), f)
+            f = jnp.where(jnp.isnan(f), jnp.float64(jnp.nan), f)
+            w = f.view(jnp.uint64)
+        else:  # ints / date / timestamp / decimal64 / dict codes
+            w = v.astype(jnp.int64).view(jnp.uint64)
+        words.append(jnp.where(m, w, jnp.uint64(0)))
+        valid = m if valid is None else (valid & m)
+    return words, valid
+
+
+def key_kind(dtype) -> str:
+    if dtype.kind == T.TypeKind.BOOL:
+        return "bool"
+    if dtype.is_dict_encoded:
+        return "int"
+    if dtype.kind == T.TypeKind.FLOAT32:
+        return "f32"
+    if dtype.kind == T.TypeKind.FLOAT64:
+        return "f64"
+    return "int"
+
+
+@partial(jax.jit, static_argnames=("bcap", "use_lut", "probe_outer", "key_kinds"))
+def _unique_probe_jit(
+    key_vals, key_masks, psel, lut, lut_base, bwords, n_live,
+    bcap: int, use_lut: bool, probe_outer: bool, key_kinds: tuple,
+):
+    """Canon + probe in ONE program (no gathers): (bi, ok, sel_out, live)."""
+    probe_words, pvalid = _canon_words_traced(key_vals, key_masks, key_kinds)
+    ok_base = psel & (pvalid if pvalid is not None else jnp.ones_like(psel))
+    bi, ok = _probe_unique_ops(
+        probe_words, ok_base, lut if use_lut else None, lut_base, bwords, n_live, bcap
+    )
+    sel_out = psel if probe_outer else (psel & ok)
+    return bi, ok, sel_out, jnp.sum(sel_out.astype(jnp.int32))
+
+
+@jax.jit
+def _unique_compact_take_jit(
+    probe_vals, probe_masks, bi, ok, build_vals, build_masks, idx, n_live
+):
+    """Compaction with a HOST-computed row index (np.flatnonzero of the
+    selection — on CPU hosts that's a memcpy + linear scan, far cheaper
+    than a device cumsum+searchsorted chain)."""
+    new_sel = jnp.arange(idx.shape[0], dtype=jnp.int32) < n_live
+    c_pvals = tuple(v[idx] for v in probe_vals)
+    c_pmasks = tuple(m[idx] & new_sel for m in probe_masks)
+    c_bi = bi[idx]
+    c_ok = ok[idx] & new_sel
+    out_bvals = tuple(v[c_bi] for v in build_vals)
+    out_bmasks = tuple(m[c_bi] & c_ok for m in build_masks)
+    return c_pvals, c_pmasks, out_bvals, out_bmasks, new_sel
+
+
+@jax.jit
+def _gather_build_jit(build_vals, build_masks, bi, ok):
+    """Build-column gathers at probe capacity (dense-output fallback)."""
+    return (
+        tuple(v[bi] for v in build_vals),
+        tuple(m[bi] & ok for m in build_masks),
+    )
+
+
+@partial(jax.jit, static_argnames=("bcap", "use_lut", "probe_outer", "key_kinds"))
 def _unique_join_emit_jit(
-    probe_words,
-    pvalid,
+    key_vals,
+    key_masks,
     psel,
     lut,
     lut_base,
@@ -243,9 +322,11 @@ def _unique_join_emit_jit(
     bcap: int,
     use_lut: bool,
     probe_outer: bool,
+    key_kinds: tuple = (),
 ):
-    """One fused program: unique probe + projected build-column gathers +
-    output selection. Probe-side columns never move (views)."""
+    """One fused program: key canon + unique probe + projected build-column
+    gathers + output selection. Probe-side columns never move (views)."""
+    probe_words, pvalid = _canon_words_traced(key_vals, key_masks, key_kinds)
     ok_base = psel & (pvalid if pvalid is not None else jnp.ones_like(psel))
     bi, ok = _probe_unique_ops(
         probe_words, ok_base, lut if use_lut else None, lut_base, bwords, n_live, bcap

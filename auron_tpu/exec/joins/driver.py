@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Callable, Iterator
 
 import jax.numpy as jnp
+import numpy as np
 
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch
@@ -23,6 +24,22 @@ from auron_tpu.exec.joins.core import (
     PreparedBuild, expand_pairs, gather_columns, null_columns, probe_ranges,
     unify_key_dicts, _canon_words, _key_columns,
 )
+
+
+def _compact_join_output_enabled() -> bool:
+    from auron_tpu.exec.base import current_context
+    from auron_tpu.utils.config import JOIN_COMPACT_OUTPUT, active_conf
+
+    ctx = current_context()
+    conf = ctx.conf if ctx is not None else active_conf()
+    mode = conf.get(JOIN_COMPACT_OUTPUT)
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    from auron_tpu.jaxenv import is_tpu
+
+    return not is_tpu()  # auto: syncs are cheap on CPU, costly on the link
 
 
 class EquiJoinDriver:
@@ -108,14 +125,13 @@ class EquiJoinDriver:
             # joint vocabulary preserves equality but NOT order, so remap
             # must keep the original sort order valid -> it does, because
             # unify_key_dicts maps build codes first (identity order).
-        pwords, pvalid = _canon_words(pvals)
-
         if build.unique:
-            yield from self._probe_batch_unique(build, pb, pwords, pvalid)
+            yield from self._probe_batch_unique(build, pb, pvals)
             if orig_build is not build:
                 orig_build.matched = build.matched
             return
 
+        pwords, pvalid = _canon_words(pvals)
         lo, counts = probe_ranges(build, pwords, pvalid, pb.device.sel)
 
         condition = None
@@ -151,7 +167,7 @@ class EquiJoinDriver:
                 yield self._emit_probe_exists(pb, probe_matched)
 
     def _probe_batch_unique(
-        self, build: PreparedBuild, pb: Batch, pwords, pvalid
+        self, build: PreparedBuild, pb: Batch, pvals
     ) -> Iterator[Batch]:
         """Unique-build probe: each probe row has <=1 match, so one batch at
         probe capacity covers every join type — probe columns stay as views
@@ -177,9 +193,21 @@ class EquiJoinDriver:
             bcol_ids = []
         import jax.numpy as _jnp
 
+        # sparse-output compaction: densify BEFORE gathering build columns
+        # (one host sync per batch — worth it on CPU hosts, off on
+        # accelerators where the round-trip dominates)
+        compact_ok = (
+            self.wants_pairs
+            and self.condition is None
+            and _compact_join_output_enabled()
+        )
+        if compact_ok:
+            yield from self._emit_unique_compacted(build, pb, pvals, bcol_ids, proj)
+            return
+
         bi, ok, bvals, bmasks, sel_out = core._unique_join_emit_jit(
-            pwords,
-            pvalid,
+            tuple(cv.values for cv in pvals),
+            tuple(cv.validity for cv in pvals),
             pb.device.sel,
             build.lut,
             _jnp.int64(build.lut_base) if build.lut is not None else None,
@@ -190,6 +218,7 @@ class EquiJoinDriver:
             bcap=bb.capacity,
             use_lut=build.lut is not None,
             probe_outer=self.probe_outer,
+            key_kinds=tuple(core.key_kind(cv.dtype) for cv in pvals),
         )
         b_at = {c: k for k, c in enumerate(bcol_ids)}
 
@@ -235,6 +264,89 @@ class EquiJoinDriver:
                 yield self._emit_probe_only(pb, pb.device.sel & ~ok)
             else:  # existence
                 yield self._emit_probe_exists(pb, ok & pb.device.sel)
+
+    def _emit_unique_compacted(
+        self, build: PreparedBuild, pb: Batch, pvals, bcol_ids, proj
+    ) -> Iterator[Batch]:
+        import jax
+
+        from auron_tpu.columnar.batch import bucket_capacity
+
+        bb = build.batch
+        nl = len(self.left_schema)
+        bi, ok, sel_out, n_live_dev = core._unique_probe_jit(
+            tuple(cv.values for cv in pvals),
+            tuple(cv.validity for cv in pvals),
+            pb.device.sel,
+            build.lut,
+            jnp.int64(build.lut_base) if build.lut is not None else None,
+            build.words, jnp.int32(build.n_live),
+            bcap=bb.capacity,
+            use_lut=build.lut is not None,
+            probe_outer=self.probe_outer,
+            key_kinds=tuple(core.key_kind(cv.dtype) for cv in pvals),
+        )
+        if self.build_mark or self.build_outer:
+            build.matched = build.matched.at[bi].max(ok, mode="drop")
+        # ONE transfer: the selection mask itself (it was going to sync for
+        # the live count anyway; the mask is 1 byte/row and yields the
+        # compaction index host-side via flatnonzero)
+        sel_np = np.asarray(jax.device_get(sel_out))
+        idx_np = np.flatnonzero(sel_np)
+        n_live = int(idx_np.size)
+        out_cap = bucket_capacity(max(n_live, 1))
+        pcol_ids = [
+            (oi if oi < nl else oi - nl)
+            for oi in proj
+            if (oi < nl) == self.probe_is_left
+        ]
+        if out_cap * 4 > pb.capacity:
+            # dense output: compaction wouldn't pay — plain gathers
+            bvals, bmasks = core._gather_build_jit(
+                tuple(bb.col_values(c) for c in bcol_ids),
+                tuple(bb.col_validity(c) for c in bcol_ids),
+                bi, ok,
+            )
+            p_at = None
+            c_pvals = c_pmasks = None
+            new_sel = sel_out
+        else:
+            idx_pad = np.zeros(out_cap, dtype=np.int32)
+            idx_pad[:n_live] = idx_np
+            c_pvals, c_pmasks, bvals, bmasks, new_sel = core._unique_compact_take_jit(
+                tuple(pb.col_values(c) for c in pcol_ids),
+                tuple(pb.col_validity(c) for c in pcol_ids),
+                bi, ok,
+                tuple(bb.col_values(c) for c in bcol_ids),
+                tuple(bb.col_validity(c) for c in bcol_ids),
+                jnp.asarray(idx_pad), jnp.int32(n_live),
+            )
+            p_at = {c: k for k, c in enumerate(pcol_ids)}
+        b_at = {c: k for k, c in enumerate(bcol_ids)}
+        out_cols = []
+        for oi in proj:
+            on_left = oi < nl
+            ci = oi if on_left else oi - nl
+            if on_left == self.probe_is_left:
+                if p_at is None:
+                    out_cols.append(
+                        ColumnVal(pb.col_values(ci), pb.col_validity(ci),
+                                  pb.schema[ci].dtype, pb.dicts[ci])
+                    )
+                else:
+                    k = p_at[ci]
+                    out_cols.append(
+                        ColumnVal(c_pvals[k], c_pmasks[k],
+                                  pb.schema[ci].dtype, pb.dicts[ci])
+                    )
+            else:
+                k = b_at[ci]
+                out_cols.append(
+                    ColumnVal(bvals[k], bmasks[k],
+                              bb.schema[ci].dtype, bb.dicts[ci])
+                )
+        out = batch_from_columns(out_cols, self.out_schema.names, new_sel)
+        yield Batch(self.out_schema, out.device, out.dicts)
 
     def finish(self, build: PreparedBuild) -> Iterator[Batch]:
         bb = build.batch

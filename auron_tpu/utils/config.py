@@ -153,6 +153,12 @@ BATCH_SIZE_BUCKETS = str_conf(
     "batch.capacity.buckets", "auto", "exec",
     "capacity bucketing policy for static shapes: auto = next_pow2",
 )
+JOIN_COMPACT_OUTPUT = str_conf(
+    "join.compact.output", "auto", "join",
+    "compact sparse unique-join outputs before gathering build columns "
+    "(costs one host sync per probe batch): auto = on for CPU hosts, off "
+    "on accelerators where the sync round-trip outweighs the saved gather",
+)
 SMJ_FALLBACK_ENABLE = bool_conf(
     "smj.fallback.enable", True, "join",
     "fall back from hash join to sort-merge when the build side exceeds budget (SMJ_FALLBACK_* in conf.rs:53-55)",

@@ -194,19 +194,22 @@ object HostPlanSerializer {
       case _: CumeDist => ("kind" -> "cume_dist") ~ ("name" -> name)
       case nt: NTile =>
         ("kind" -> "ntile") ~ ("name" -> name) ~
-        ("offset" -> staticOffset(nt.buckets))
+        ("offset" -> offsetJson(staticOffset(nt.buckets)))
       case l: Lead =>
         ("kind" -> "lead") ~ ("name" -> name) ~
         ("expr" -> expr(l.input, in)) ~
-        ("offset" -> staticOffset(l.offset))
+        ("offset" -> offsetJson(staticOffset(l.offset)))
       case l: Lag =>
+        // Spark stores lag(x, k) with offset -k; the engine's lag takes
+        // the positive look-back count, so NEGATE (abs would flip the
+        // direction of lag(x, -k) == lead(x, k))
         ("kind" -> "lag") ~ ("name" -> name) ~
         ("expr" -> expr(l.input, in)) ~
-        ("offset" -> staticOffset(l.offset).map(math.abs))
+        ("offset" -> offsetJson(staticOffset(l.offset).map(o => -o)))
       case nth: NthValue =>
         ("kind" -> "nth_value") ~ ("name" -> name) ~
         ("expr" -> expr(nth.input, in)) ~
-        ("offset" -> staticOffset(nth.offset))
+        ("offset" -> offsetJson(staticOffset(nth.offset)))
       case agg: AggregateExpression =>
         ("kind" -> "agg") ~ ("name" -> name) ~
         ("agg" -> aggName(agg.aggregateFunction)) ~
@@ -291,6 +294,11 @@ object HostPlanSerializer {
     case UnaryMinus(Literal(v, _), _) => Some(-v.toString.toInt)
     case _ => None
   }
+
+  /** None must reach the engine as an EXPLICIT null (json4s drops JNothing
+   * fields entirely, and a missing key would default engine-side). */
+  private def offsetJson(o: Option[Int]): JValue =
+    o.map(JInt(_): JValue).getOrElse(JNull)
 
   /** Typed scalar encoding shared by Literal exprs and IN-value lists:
    * numbers as numbers, null as null, decimals as exact display strings
