@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from auron_tpu import types as T
 from auron_tpu.convert.hostplan import parse_type
+from auron_tpu.exprs import cast as cast_kernels
 from auron_tpu.exprs import ir
 from auron_tpu.functions import registry  # loads the full function registry
 from auron_tpu.utils.config import UDF_FALLBACK_ENABLE, Configuration
@@ -96,7 +97,14 @@ def _convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None
     if name == "isnotnull":
         return ir.IsNotNull(sub(0))
     if name == "cast":
-        return ir.Cast(sub(0), parse_type(e["to"]), bool(e.get("try", False)))
+        child = sub(0)
+        to = parse_type(e["to"])
+        # the serializer ships the source type ("from"); without it the only
+        # statically-known source is a literal child
+        src = parse_type(e["from"]) if "from" in e else getattr(child, "dtype", None)
+        if src is not None and not cast_kernels.can_cast(src, to):
+            raise UnsupportedExpr(f"cast {src} -> {to} is not castable")
+        return ir.Cast(child, to, bool(e.get("try", False)))
     if name == "if":
         return ir.If(sub(0), sub(1), sub(2))
     if name == "casewhen":

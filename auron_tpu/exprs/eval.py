@@ -198,6 +198,8 @@ class Evaluator:
     def _cast(self, c: ColumnVal, to: T.DataType) -> ColumnVal:
         if c.dtype == to:
             return c
+        if c.dtype.is_dict_encoded and to.is_dict_encoded:
+            return self._cast_dict_to_dict(c, to)
         if c.dtype.is_dict_encoded and not to.is_dict_encoded:
             if to.is_string_like:
                 return ColumnVal(c.values, c.validity, to, c.dict)
@@ -207,14 +209,50 @@ class Evaluator:
             ok = jnp.asarray(dok)[codes]
             return ColumnVal(vals, c.validity & ok, to)
         if to.is_dict_encoded:
-            if c.dtype.is_dict_encoded:
-                return ColumnVal(c.values, c.validity, to, c.dict)
-            raise NotImplementedError(
-                "numeric -> string cast requires the host-fallback projection "
-                "(dictionary construction from data); planner wraps it"
-            )
+            return self._cast_plain_to_dict(c, to)
         v, m = C.cast_values(c.values, c.validity, c.dtype, to)
         return ColumnVal(v, m, to)
+
+    def _cast_dict_to_dict(self, c: ColumnVal, to: T.DataType) -> ColumnVal:
+        """dict-encoded -> dict-encoded: transform the dictionary host-side
+        (it is small), keep the device codes."""
+        if c.dtype.is_string_like and to.is_string_like:
+            return ColumnVal(c.values, c.validity, to, c.dict)
+        entries = c.dict.to_pylist()
+        out, ok = [], np.ones(len(entries), dtype=bool)
+        for i, v in enumerate(entries):
+            r = C.cast_scalar(v, c.dtype, to) if v is not None else None
+            if v is not None and r is None:
+                ok[i] = False  # invalid entry -> NULL rows (non-ANSI)
+            out.append(r)
+        new_dict = pa.array(out, type=to.to_arrow())
+        codes = jnp.clip(c.values, 0, max(len(entries) - 1, 0))
+        okv = jnp.asarray(ok)[codes] if len(entries) else jnp.zeros_like(c.validity)
+        return ColumnVal(c.values, c.validity & okv, to, new_dict)
+
+    def _cast_plain_to_dict(self, c: ColumnVal, to: T.DataType) -> ColumnVal:
+        """fixed-width -> string/binary/wide-decimal: the one cast that must
+        BUILD a dictionary from data. One host sync; unique-codes the values
+        so the dictionary stays |distinct|-sized."""
+        vals = np.asarray(c.values)
+        valid = np.asarray(c.validity)
+        if vals.dtype.kind == "f":
+            # dedup on the BIT pattern: np.unique would collapse -0.0 == 0.0
+            # (they display differently) and merge NaN payloads
+            bits = vals.view(np.int32 if vals.dtype == np.float32 else np.int64)
+            uniq_bits, inv = np.unique(bits, return_inverse=True)
+            uniq = uniq_bits.view(vals.dtype)
+        else:
+            uniq, inv = np.unique(vals, return_inverse=True)
+        ents = [C.cast_scalar(u.item(), c.dtype, to) for u in uniq]
+        new_dict = pa.array(ents, type=to.to_arrow())
+        ok = np.array([e is not None for e in ents], dtype=bool)[inv]
+        return ColumnVal(
+            jnp.asarray(inv.astype(np.int32)),
+            c.validity & jnp.asarray(ok & valid),
+            to,
+            new_dict,
+        )
 
     # ---- binary ops ----
 

@@ -271,7 +271,8 @@ object HostPlanSerializer {
     case c: Cast =>
       ("kind" -> "call") ~ ("name" -> "cast") ~
       ("children" -> List(expr(c.child, input))) ~
-      ("to" -> typeName(c.dataType))
+      ("to" -> typeName(c.dataType)) ~
+      ("from" -> typeName(c.child.dataType))
     case b: BinaryExpression =>
       ("kind" -> "call") ~ ("name" -> b.getClass.getSimpleName.toLowerCase) ~
       ("children" -> List(expr(b.left, input), expr(b.right, input)))

@@ -1,0 +1,399 @@
+"""Cast long tail (cast.rs parity): lenient string->datetime, X->string
+Java formatting, nested list/map/struct casts.
+
+Spark oracle values in comments were produced by spark-shell 3.5:
+  spark.sql("select cast(X as Y)").
+"""
+
+import datetime as dt
+import decimal as pydec
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exprs import cast as C
+from auron_tpu.exprs import eval_exprs
+from auron_tpu.exprs.ir import Cast, col
+
+
+def _run(data, exprs, schema=None):
+    b = Batch.from_pydict(data, schema=schema)
+    outs = eval_exprs(b, exprs)
+    n = b.num_rows()
+    res = []
+    for o in outs:
+        vals = np.asarray(o.values)[:n]
+        mask = np.asarray(o.validity)[:n]
+        if o.dtype.is_dict_encoded:
+            d = o.dict.to_pylist()
+            res.append([d[v] if m else None for v, m in zip(vals, mask)])
+        else:
+            res.append([v if m else None for v, m in zip(vals.tolist(), mask)])
+    return res
+
+
+# ---------------------------------------------------------------------------
+# lenient string -> date
+# ---------------------------------------------------------------------------
+
+
+def _days(y, m, d):
+    return (dt.date(y, m, d) - dt.date(1970, 1, 1)).days
+
+
+@pytest.mark.parametrize(
+    "s,expect",
+    [
+        ("2021-03-05", _days(2021, 3, 5)),
+        ("2021-3-5", _days(2021, 3, 5)),  # 1-digit segments
+        ("2021-03", _days(2021, 3, 1)),  # day defaults to 1
+        ("2021", _days(2021, 1, 1)),
+        (" 2021-01-01 ", _days(2021, 1, 1)),  # trimmed
+        ("2021-01-01T12:33:00", _days(2021, 1, 1)),  # time ignored
+        ("2021-01-01 whatever", _days(2021, 1, 1)),  # junk after sep ignored
+        ("02021-01-01", _days(2021, 1, 1)),  # 5-digit year ok (<=7)
+        ("21-01-01", None),  # 2-digit year invalid
+        ("2021-13-01", None),
+        ("2021-02-30", None),
+        ("2021/01/01", None),
+        ("", None),
+        ("abc", None),
+    ],
+)
+def test_string_to_date_lenient(s, expect):
+    assert C.spark_string_to_date(s) == expect
+
+
+# ---------------------------------------------------------------------------
+# lenient string -> timestamp
+# ---------------------------------------------------------------------------
+
+
+def _us(y, mo, d, h=0, mi=0, s=0, us=0):
+    base = dt.datetime(y, mo, d, h, mi, s, tzinfo=dt.timezone.utc)
+    return int(base.timestamp()) * 1_000_000 + us
+
+
+@pytest.mark.parametrize(
+    "s,expect",
+    [
+        ("2019-10-06 10:11:12", _us(2019, 10, 6, 10, 11, 12)),
+        ("2019-10-06T10:11:12", _us(2019, 10, 6, 10, 11, 12)),
+        ("2019-10-06 10:11", _us(2019, 10, 6, 10, 11)),
+        ("2019-10-06 10", _us(2019, 10, 6, 10)),  # hour-only time
+        ("2019-10-06", _us(2019, 10, 6)),
+        ("2019-10", _us(2019, 10, 1)),
+        ("2019", _us(2019, 1, 1)),
+        ("2019-10-06 10:11:12.345678", _us(2019, 10, 6, 10, 11, 12, 345678)),
+        # 9 fraction digits truncate to micros
+        ("2019-10-06 10:11:12.123456789", _us(2019, 10, 6, 10, 11, 12, 123456)),
+        ("2019-10-06 10:11:12.5", _us(2019, 10, 6, 10, 11, 12, 500000)),
+        # zones
+        ("2019-10-06 10:11:12Z", _us(2019, 10, 6, 10, 11, 12)),
+        ("2019-10-06 10:11:12 UTC", _us(2019, 10, 6, 10, 11, 12)),
+        ("2019-10-06 10:11:12+08:00", _us(2019, 10, 6, 2, 11, 12)),
+        ("2019-10-06 10:11:12-0130", _us(2019, 10, 6, 11, 41, 12)),
+        ("2019-10-06 10:11:12+8", _us(2019, 10, 6, 2, 11, 12)),
+        ("2019-10-06 10:11:12GMT+01:00", _us(2019, 10, 6, 9, 11, 12)),
+        # invalids
+        ("2019-10-06 25:00:00", None),
+        ("2019-10-06 10:61:00", None),
+        ("2019-10-06 10:11:12.1234567890", None),  # >9 fraction digits
+        ("2019-10-06 10:11:12 NOTAZONE", None),
+        ("1", None),  # 1-digit year
+        ("", None),
+    ],
+)
+def test_string_to_timestamp_lenient(s, expect):
+    assert C.spark_string_to_timestamp(s) == expect
+
+
+def test_string_to_timestamp_fraction_requires_seconds():
+    assert C.spark_string_to_timestamp("2019-10-06 10:11.5") is None
+
+
+def test_bare_time_uses_default_date():
+    got = C.spark_string_to_timestamp("12:30:45", default_date=dt.date(2020, 5, 4))
+    assert got == _us(2020, 5, 4, 12, 30, 45)
+
+
+def test_region_zone_if_zoneinfo_available():
+    got = C.spark_string_to_timestamp("2019-01-15 12:00:00 America/New_York")
+    if got is not None:  # zoneinfo db present
+        assert got == _us(2019, 1, 15, 17, 0, 0)  # EST = UTC-5 in January
+
+
+# ---------------------------------------------------------------------------
+# Java Float/Double.toString
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "x,expect",
+    [
+        (1.0, "1.0"),
+        (-1.5, "-1.5"),
+        (0.0, "0.0"),
+        (10000000.0, "1.0E7"),  # >= 1e7 goes scientific
+        (9999999.5, "9999999.5"),
+        (0.001, "0.001"),
+        (0.0001, "1.0E-4"),  # < 1e-3 goes scientific
+        (123456.789, "123456.789"),
+        (1e100, "1.0E100"),
+        (-2.5e-9, "-2.5E-9"),
+        (float("nan"), "NaN"),
+        (float("inf"), "Infinity"),
+        (float("-inf"), "-Infinity"),
+    ],
+)
+def test_java_double_str(x, expect):
+    assert C._java_fp_str(x, single=False) == expect
+
+
+def test_java_float_str_shortest_for_float32():
+    # 0.1f prints as 0.1 (shortest for float precision), not 0.100000001...
+    assert C._java_fp_str(0.1, single=True) == "0.1"
+    assert C._java_fp_str(float(np.float32(1.0) / 3), single=True) == "0.33333334"
+
+
+def test_negative_zero():
+    assert C._java_fp_str(-0.0, single=False) == "-0.0"
+
+
+# ---------------------------------------------------------------------------
+# Java BigDecimal.toString
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "unscaled,scale,expect",
+    [
+        (12345, 2, "123.45"),
+        (-12345, 2, "-123.45"),
+        (12345, 0, "12345"),
+        (5, 7, "5E-7"),  # adjusted exponent < -6 -> scientific
+        (50, 7, "0.0000050"),  # adjusted exponent == -6 -> plain
+        (123, 7, "0.0000123"),  # adjusted exponent -5 >= -6 -> plain
+        (12, 9, "1.2E-8"),
+        (0, 2, "0.00"),
+        (7, 3, "0.007"),
+    ],
+)
+def test_java_bigdecimal_str(unscaled, scale, expect):
+    assert C._java_bigdecimal_str(unscaled, scale) == expect
+
+
+# ---------------------------------------------------------------------------
+# timestamp/date -> string
+# ---------------------------------------------------------------------------
+
+
+def test_timestamp_to_string_trims_fraction():
+    us = _us(2019, 10, 6, 10, 11, 12)
+    assert C._timestamp_str(us) == "2019-10-06 10:11:12"
+    assert C._timestamp_str(us + 500000) == "2019-10-06 10:11:12.5"
+    assert C._timestamp_str(us + 123450) == "2019-10-06 10:11:12.12345"
+
+
+# ---------------------------------------------------------------------------
+# column casts through the evaluator
+# ---------------------------------------------------------------------------
+
+
+def test_int_to_string_column():
+    data = {"a": pa.array([1, None, -42, 1, 7], type=pa.int64())}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["1", None, "-42", "1", "7"]
+
+
+def test_double_to_string_column():
+    data = {"a": pa.array([1.5, 1e8, None], type=pa.float64())}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["1.5", "1.0E8", None]
+
+
+def test_bool_and_date_to_string():
+    data = {
+        "b": pa.array([True, False, None]),
+        "d": pa.array([dt.date(2021, 3, 5), dt.date(1969, 12, 31), None]),
+    }
+    bs, ds = _run(data, [Cast(col(0), T.STRING), Cast(col(1), T.STRING)])
+    assert bs == ["true", "false", None]
+    assert ds == ["2021-03-05", "1969-12-31", None]
+
+
+def test_decimal_to_string_column():
+    data = {"a": pa.array([pydec.Decimal("123.45"), pydec.Decimal("-0.07"), None],
+                          type=pa.decimal128(10, 2))}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["123.45", "-0.07", None]
+
+
+def test_string_to_timestamp_column_lenient():
+    data = {"s": pa.array(["2019-10-06 10", "2019-10-06 10:11:12+08:00", "nope", None])}
+    (out,) = _run(data, [Cast(col(0), T.TIMESTAMP)])
+    assert out == [
+        _us(2019, 10, 6, 10),
+        _us(2019, 10, 6, 2, 11, 12),
+        None,
+        None,
+    ]
+
+
+def test_list_int_to_list_string():
+    t = pa.list_(pa.int64())
+    data = {"a": pa.array([[1, 2], [], None, [3, None]], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.DataType(T.TypeKind.LIST, inner=(T.STRING,)))])
+    assert out == [["1", "2"], [], None, ["3", None]]
+
+
+def test_list_string_to_list_int_invalid_elements_null():
+    t = pa.list_(pa.string())
+    data = {"a": pa.array([["1", "x", "3"]], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.DataType(T.TypeKind.LIST, inner=(T.INT64,)))])
+    assert out == [[1, None, 3]]
+
+
+def test_struct_cast_fields():
+    t = pa.struct([("x", pa.int64()), ("y", pa.string())])
+    data = {"a": pa.array([{"x": 1, "y": "2.5"}, {"x": None, "y": "bad"}], type=t)}
+    dst = T.DataType(
+        T.TypeKind.STRUCT, inner=(T.STRING, T.FLOAT64), struct_names=("x", "y")
+    )
+    (out,) = _run(data, [Cast(col(0), dst)])
+    assert out == [{"x": "1", "y": 2.5}, {"x": None, "y": None}]
+
+
+def test_map_cast_values():
+    t = pa.map_(pa.string(), pa.int64())
+    data = {"a": pa.array([[("k", 5)], []], type=t)}
+    dst = T.DataType(T.TypeKind.MAP, inner=(T.STRING, T.STRING))
+    (out,) = _run(data, [Cast(col(0), dst)])
+    assert out == [[("k", "5")], []]
+
+
+def test_list_to_string_display_format():
+    t = pa.list_(pa.int64())
+    data = {"a": pa.array([[1, 2, None]], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["[1, 2, null]"]
+
+
+def test_struct_to_string_display_format():
+    t = pa.struct([("x", pa.int64()), ("y", pa.string())])
+    data = {"a": pa.array([{"x": 1, "y": "a"}], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["{1, a}"]
+
+
+def test_map_to_string_display_format():
+    t = pa.map_(pa.string(), pa.int64())
+    data = {"a": pa.array([[("k", 1), ("j", None)]], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["{k -> 1, j -> null}"]
+
+
+def test_wide_decimal_to_string():
+    big = pydec.Decimal("12345678901234567890.12")
+    data = {"a": pa.array([big, None], type=pa.decimal128(25, 2))}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["12345678901234567890.12", None]
+
+
+def test_string_to_wide_decimal_roundtrip():
+    data = {"s": pa.array(["12345678901234567890.12", "oops"])}
+    (out,) = _run(data, [Cast(col(0), T.decimal(25, 2))])
+    assert out == [pydec.Decimal("12345678901234567890.12"), None]
+
+
+def test_list_timestamp_to_string():
+    # nested temporals arrive as datetime objects from the dictionary
+    t = pa.list_(pa.timestamp("us"))
+    data = {"a": pa.array([[dt.datetime(2019, 10, 6, 10, 11, 12)]], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["[2019-10-06 10:11:12]"]
+
+
+def test_list_date_cast_to_list_string():
+    t = pa.list_(pa.date32())
+    data = {"a": pa.array([[dt.date(2021, 3, 5), None]], type=t)}
+    (out,) = _run(data, [Cast(col(0), T.DataType(T.TypeKind.LIST, inner=(T.STRING,)))])
+    assert out == [["2021-03-05", None]]
+
+
+def test_list_string_to_list_decimal_objects():
+    t = pa.list_(pa.string())
+    dst = T.DataType(T.TypeKind.LIST, inner=(T.decimal(10, 2),))
+    data = {"a": pa.array([["1.25", "bad"]], type=t)}
+    (out,) = _run(data, [Cast(col(0), dst)])
+    assert out == [[pydec.Decimal("1.25"), None]]
+
+
+def test_seven_digit_year_date():
+    # python datetime caps at year 9999; Spark's LocalDate does not
+    assert C.spark_string_to_date("123456-01-01") == C._days_from_civil(123456, 1, 1)
+    assert C.spark_string_to_timestamp("123456-01-01 00:00:01") == (
+        C._days_from_civil(123456, 1, 1) * 86400 + 1
+    ) * 1_000_000
+
+
+def test_days_from_civil_matches_datetime_in_range():
+    for y, m, d in [(1970, 1, 1), (2000, 2, 29), (1969, 12, 31), (9999, 12, 31), (1, 1, 1)]:
+        assert C._days_from_civil(y, m, d) == (dt.date(y, m, d) - dt.date(1970, 1, 1)).days
+
+
+def test_int_to_binary_big_endian():
+    data = {"a": pa.array([1, -1, None], type=pa.int32())}
+    (out,) = _run(data, [Cast(col(0), T.BINARY)])
+    assert out == [b"\x00\x00\x00\x01", b"\xff\xff\xff\xff", None]
+
+
+def test_double_to_binary_not_castable():
+    assert not C.can_cast(T.FLOAT64, T.BINARY)
+    assert C.can_cast(T.INT64, T.BINARY)
+    assert C.can_cast(T.STRING, T.BINARY)
+
+
+def test_negative_zero_and_zero_distinct_in_string_cast():
+    data = {"a": pa.array([0.0, -0.0, 0.0], type=pa.float64())}
+    (out,) = _run(data, [Cast(col(0), T.STRING)])
+    assert out == ["0.0", "-0.0", "0.0"]
+
+
+def test_double_to_wide_decimal_exact():
+    # regression: the scalar path must treat 2.5 as the VALUE, not unscaled
+    data = {"a": pa.array([2.5, 1e20, None], type=pa.float64())}
+    (out,) = _run(data, [Cast(col(0), T.decimal(38, 2))])
+    assert out == [pydec.Decimal("2.50"), pydec.Decimal("1E+20").quantize(pydec.Decimal("0.01")), None]
+
+
+def test_big_int_to_wide_decimal_no_spurious_null():
+    v = 5_000_000_000_000_000_000  # > decimal(18) capacity, fits decimal(38)
+    data = {"a": pa.array([v], type=pa.int64())}
+    (out,) = _run(data, [Cast(col(0), T.decimal(38, 0))])
+    assert out == [pydec.Decimal(v)]
+
+
+def test_far_future_date_roundtrip_no_crash():
+    # parser accepts 6-digit years; formatting must not hit datetime's cap
+    days = C.spark_string_to_date("123456-01-02")
+    assert C._date_str(days) == "123456-01-02"
+    assert C._civil_from_days(C._days_from_civil(-44, 3, 15)) == (-44, 3, 15)
+
+
+def test_lowercase_t_separator_rejected():
+    assert C.spark_string_to_timestamp("2021-01-01t10:00:00") is None
+    assert C.spark_string_to_timestamp("2021-01-01T10:00:00") is not None
+
+
+def test_can_cast_lattice():
+    lst_i = T.DataType(T.TypeKind.LIST, inner=(T.INT64,))
+    lst_s = T.DataType(T.TypeKind.LIST, inner=(T.STRING,))
+    assert C.can_cast(lst_i, lst_s)
+    assert C.can_cast(lst_i, T.STRING)
+    assert not C.can_cast(lst_i, T.INT64)
+    assert not C.can_cast(T.INT64, lst_i)
+    assert C.can_cast(T.STRING, T.TIMESTAMP)
