@@ -44,6 +44,9 @@ class BroadcastHashJoinExec(ExecOperator):
 
     def _build(self, partition: int, ctx: ExecutionContext) -> PreparedBuild:
         build_child = 0 if self.build_side == "left" else 1
+        memo = ctx.resources.pop(("fusion_build_memo", id(self), partition), None)
+        if memo is not None:
+            return memo  # prepared during a fused-chain attempt that fell back
         key = self.cached_build_id
         if key is not None and key in ctx.resources:
             cached: PreparedBuild = ctx.resources[key]

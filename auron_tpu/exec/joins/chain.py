@@ -32,7 +32,7 @@ import numpy as np
 
 from auron_tpu.columnar.batch import Batch, bucket_capacity
 from auron_tpu.exec.basic import batch_from_columns
-from auron_tpu.exprs import Evaluator, ir
+from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import ColumnVal
 from auron_tpu.exec.joins import core
 from auron_tpu.exec.joins.driver import _compact_join_output_enabled
@@ -146,13 +146,19 @@ def try_fused_chain(top, partition: int, ctx) -> Iterator[Batch] | None:
         out_map.append(r)
 
     # all structural checks passed — NOW prepare the builds (building
-    # before the checks would re-run build child streams on fallback)
+    # before the checks would re-run build child streams on fallback).
+    # Uniqueness is only knowable after building; when a non-unique build
+    # forces fallback, stash everything built so far in the task resource
+    # map so the per-operator path (and inner sub-chain re-attempts) pop
+    # the prepared maps instead of re-streaming build children.
     builds = []
     for ex, _ in links:
         b = ex._build(partition, ctx)
-        if not b.unique:
-            return None
         builds.append(b)
+        if not b.unique:
+            for (ex2, _), b2 in zip(links, builds):
+                ctx.resources[("fusion_build_memo", id(ex2), partition)] = b2
+            return None
 
     return _run_chain(
         top_ex, bottom, links, builds, key_cols_per_level, out_map,
@@ -167,18 +173,39 @@ def _run_chain(
     out_schema = d_top.out_schema
     probe_child_stream = bottom.execute(partition, ctx)
 
+    # loop invariants (column maps, key kinds, build column tuples) — the
+    # probe loop runs per batch and must not rebuild these
+    bottom_schema = bottom.schema
+    kinds_per_level = [
+        tuple(core.key_kind(bottom_schema[c].dtype) for c in key_cols)
+        for key_cols in key_cols_per_level
+    ]
+    probe_cols = sorted({c for s, c in out_map if s == -1})
+    bcols_per_level = [
+        sorted({c for s, c in out_map if s == lv}) for lv in range(len(links))
+    ]
+    p_at = {c: k for k, c in enumerate(probe_cols)}
+    b_at = [{c: k for k, c in enumerate(cs)} for cs in bcols_per_level]
+    bvals_all = tuple(
+        tuple(b.batch.col_values(c) for c in cs)
+        for b, cs in zip(builds, bcols_per_level)
+    )
+    bmasks_all = tuple(
+        tuple(b.batch.col_validity(c) for c in cs)
+        for b, cs in zip(builds, bcols_per_level)
+    )
+
     for pb in probe_child_stream:
         ctx.check_cancelled()
         with ctx.metrics.timer("probe_time"):
             # one probe program per level — no gathers, no intermediates
             oks = []
             bis = []
-            for build, key_cols in zip(builds, key_cols_per_level):
+            for build, key_cols, kinds in zip(
+                builds, key_cols_per_level, kinds_per_level
+            ):
                 kvals = tuple(pb.col_values(c) for c in key_cols)
                 kmasks = tuple(pb.col_validity(c) for c in key_cols)
-                kinds = tuple(
-                    core.key_kind(pb.schema[c].dtype) for c in key_cols
-                )
                 bi, ok, _, _ = core._unique_probe_jit(
                     kvals, kmasks, pb.device.sel,
                     build.lut,
@@ -196,34 +223,38 @@ def _run_chain(
             idx_np = np.flatnonzero(sel_np)
             n_live = int(idx_np.size)
             out_cap = bucket_capacity(max(n_live, 1))
-            idx_pad = np.zeros(out_cap, dtype=np.int32)
-            idx_pad[:n_live] = idx_np
 
-            probe_cols = sorted({c for s, c in out_map if s == -1})
-            bcols_per_level = [
-                sorted({c for s, c in out_map if s == lv})
-                for lv in range(len(links))
-            ]
-            c_p, c_pm, c_b, c_bm, new_sel = _chain_take_jit(
-                tuple(pb.col_values(c) for c in probe_cols),
-                tuple(pb.col_validity(c) for c in probe_cols),
-                tuple(tuple(b.batch.col_values(c) for c in cs)
-                      for b, cs in zip(builds, bcols_per_level)),
-                tuple(tuple(b.batch.col_validity(c) for c in cs)
-                      for b, cs in zip(builds, bcols_per_level)),
-                tuple(bis),
-                jnp.asarray(idx_pad), jnp.int32(n_live),
-            )
-            p_at = {c: k for k, c in enumerate(probe_cols)}
-            b_at = [
-                {c: k for k, c in enumerate(cs)} for cs in bcols_per_level
-            ]
+            if out_cap * 4 > pb.capacity:
+                # dense output: compaction wouldn't pay (same threshold as
+                # driver._emit_unique_compacted) — gather build columns at
+                # full width, keep probe columns as zero-copy views
+                c_b, c_bm = _chain_take_dense_jit(
+                    bvals_all, bmasks_all, tuple(bis), sel_out
+                )
+                c_p = c_pm = None
+                new_sel = sel_out
+            else:
+                idx_pad = np.zeros(out_cap, dtype=np.int32)
+                idx_pad[:n_live] = idx_np
+                c_p, c_pm, c_b, c_bm, new_sel = _chain_take_jit(
+                    tuple(pb.col_values(c) for c in probe_cols),
+                    tuple(pb.col_validity(c) for c in probe_cols),
+                    bvals_all, bmasks_all,
+                    tuple(bis),
+                    jnp.asarray(idx_pad), jnp.int32(n_live),
+                )
             out_cols = []
             for (src, ci), f in zip(out_map, out_schema):
                 if src == -1:
-                    out_cols.append(ColumnVal(
-                        c_p[p_at[ci]], c_pm[p_at[ci]], f.dtype, pb.dicts[ci]
-                    ))
+                    if c_p is None:
+                        out_cols.append(ColumnVal(
+                            pb.col_values(ci), pb.col_validity(ci),
+                            f.dtype, pb.dicts[ci],
+                        ))
+                    else:
+                        out_cols.append(ColumnVal(
+                            c_p[p_at[ci]], c_pm[p_at[ci]], f.dtype, pb.dicts[ci]
+                        ))
                 else:
                     bb = builds[src].batch
                     out_cols.append(ColumnVal(
@@ -232,6 +263,18 @@ def _run_chain(
                     ))
             out = batch_from_columns(out_cols, out_schema.names, new_sel)
             yield Batch(out_schema, out.device, out.dicts)
+
+
+@jax.jit
+def _chain_take_dense_jit(build_vals, build_masks, bis, sel):
+    """Dense-output variant: gather each level's build columns at the probe
+    width (no compaction index, no probe-column copies)."""
+    c_b = []
+    c_bm = []
+    for lv_vals, lv_masks, bi in zip(build_vals, build_masks, bis):
+        c_b.append(tuple(v[bi] for v in lv_vals))
+        c_bm.append(tuple(m[bi] & sel for m in lv_masks))
+    return tuple(c_b), tuple(c_bm)
 
 
 @jax.jit

@@ -313,11 +313,11 @@ def spark_string_to_date(s: str) -> int | None:
     t = s.strip()
     if not t:
         return None
-    # chop a trailing time part introduced by ' ' or 'T'
-    for sep in ("T", " "):
-        idx = t.find(sep)
-        if idx > 0:
-            t = t[:idx]
+    # chop at the FIRST ' ' or 'T' separator (searching 'T' globally would
+    # trip on zone names like UTC/EST after a space-separated time)
+    for i, ch in enumerate(t):
+        if ch in "T " and i > 0:
+            t = t[:i]
             break
     ymd = _parse_date_segments(t)
     if ymd is None:
@@ -631,7 +631,7 @@ def format_scalar(v, dtype: T.DataType) -> str | None:
 def cast_scalar(v, src: T.DataType, dst: T.DataType):
     """Spark-cast one python scalar; returns the converted value or None
     (invalid -> NULL, matching the non-ANSI device kernels)."""
-    if v is None:
+    if v is None or src.kind == T.TypeKind.NULL:
         return None
     if src == dst:
         return v

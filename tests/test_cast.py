@@ -389,6 +389,20 @@ def test_lowercase_t_separator_rejected():
     assert C.spark_string_to_timestamp("2021-01-01T10:00:00") is not None
 
 
+def test_date_chop_ignores_zone_names_with_T():
+    # 'T' inside a trailing zone name must not become the separator
+    assert C.spark_string_to_date("2021-01-01 10:11:12 UTC") == _days(2021, 1, 1)
+    assert C.spark_string_to_date("2021-01-01 10:11:12 EST") == _days(2021, 1, 1)
+
+
+def test_cast_null_literal_to_string():
+    from auron_tpu.exprs.ir import Literal
+
+    data = {"a": pa.array([1, 2], type=pa.int64())}
+    (out,) = _run(data, [Cast(Literal(None, T.NULL), T.STRING)])
+    assert out == [None, None]
+
+
 def test_can_cast_lattice():
     lst_i = T.DataType(T.TypeKind.LIST, inner=(T.INT64,))
     lst_s = T.DataType(T.TypeKind.LIST, inner=(T.STRING,))

@@ -1,0 +1,160 @@
+"""Fused star-schema join chains (exec/joins/chain.py): fused vs
+per-operator fallback differential, build reuse on fallback, dense guard.
+
+Oracle: pandas merges over the same frames.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.basic import MemoryScanExec
+from auron_tpu.exec.joins import BroadcastHashJoinExec
+from auron_tpu.exec.joins import chain as chain_mod
+from auron_tpu.exec.joins.driver import EquiJoinDriver
+from auron_tpu.exprs.ir import col
+
+
+def _mk(df, chunk=None):
+    if chunk is None:
+        return MemoryScanExec.single(
+            [Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))]
+        )
+    bs = [
+        Batch.from_arrow(
+            pa.RecordBatch.from_pandas(df.iloc[i : i + chunk], preserve_index=False)
+        )
+        for i in range(0, len(df), chunk)
+    ]
+    return MemoryScanExec.single(bs)
+
+
+def _star(fact, dims, dim_keys, unique=True):
+    """fact JOIN dim0 ON fact.k0 = dim0.id JOIN dim1 ON fact.k1 = dim1.id ..."""
+    node = _mk(fact, chunk=37)
+    nleft = len(fact.columns)
+    for i, (dim, fk) in enumerate(zip(dims, dim_keys)):
+        node = BroadcastHashJoinExec(
+            node, _mk(dim), [col(fk)], [col(0)], "inner", build_side="right"
+        )
+        nleft += len(dim.columns)
+    return node
+
+
+def _oracle(fact, dims, dim_key_names):
+    out = fact
+    for dim, k in zip(dims, dim_key_names):
+        out = out.merge(dim, left_on=k, right_on=dim.columns[0], how="inner")
+    return out
+
+
+def _collect_sorted(op):
+    got = op.collect_pydict()
+    df = pd.DataFrame(got)
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def _fact_dims(n=500, nd1=40, nd2=25, seed=0):
+    rng = np.random.default_rng(seed)
+    fact = pd.DataFrame({
+        "k0": rng.integers(0, nd1 + 5, n),  # some keys miss (5 dangling ids)
+        "k1": rng.integers(0, nd2 + 5, n),
+        "amt": rng.normal(size=n).round(3),
+    })
+    d1 = pd.DataFrame({"id1": np.arange(nd1), "d1v": np.arange(nd1) * 10})
+    d2 = pd.DataFrame({"id2": np.arange(nd2), "d2v": np.arange(nd2) * 7})
+    return fact, d1, d2
+
+
+def test_fused_two_level_chain_matches_oracle():
+    fact, d1, d2 = _fact_dims()
+    top = _star(fact, [d1, d2], [0, 1])
+    calls = {"fused": 0}
+    orig = chain_mod._run_chain
+
+    def spy(*a, **k):
+        calls["fused"] += 1
+        return orig(*a, **k)
+
+    chain_mod._run_chain, saved = spy, orig
+    try:
+        got = _collect_sorted(top)
+    finally:
+        chain_mod._run_chain = saved
+    assert calls["fused"] == 1, "fused path must engage for a unique star chain"
+    exp = _oracle(fact, [d1, d2], ["k0", "k1"])
+    exp.columns = got.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_non_unique_build_falls_back_without_rebuilding():
+    fact, d1, d2 = _fact_dims(n=300)
+    # duplicate a dim row: build no longer unique -> fusion must fall back
+    d2_dup = pd.concat([d2, d2.iloc[[3]]], ignore_index=True)
+    top = _star(fact, [d1, d2_dup], [0, 1])
+
+    prepares = {"n": 0}
+    orig_prepare = EquiJoinDriver.prepare
+
+    def counting_prepare(self, batches):
+        prepares["n"] += 1
+        return orig_prepare(self, batches)
+
+    EquiJoinDriver.prepare = counting_prepare
+    try:
+        got = _collect_sorted(top)
+    finally:
+        EquiJoinDriver.prepare = orig_prepare
+    # 2 joins -> exactly 2 builds even though fusion was attempted and
+    # abandoned (the memo hands the prepared maps to the fallback path)
+    assert prepares["n"] == 2, f"builds ran {prepares['n']} times, expected 2"
+    exp = _oracle(fact, [d1, d2_dup], ["k0", "k1"])
+    exp.columns = got.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_dense_survival_chain_matches_oracle():
+    # every fact row matches every dim -> n_live == capacity -> dense path
+    rng = np.random.default_rng(1)
+    n = 256
+    fact = pd.DataFrame({
+        "k0": rng.integers(0, 8, n),
+        "k1": rng.integers(0, 4, n),
+        "amt": np.arange(n),
+    })
+    d1 = pd.DataFrame({"id1": np.arange(8), "d1v": np.arange(8) * 10})
+    d2 = pd.DataFrame({"id2": np.arange(4), "d2v": np.arange(4) * 7})
+    top = _star(fact, [d1, d2], [0, 1])
+    got = _collect_sorted(top)
+    exp = _oracle(fact, [d1, d2], ["k0", "k1"])
+    exp.columns = got.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_three_level_chain_with_nulls():
+    rng = np.random.default_rng(2)
+    n = 400
+    fact = pd.DataFrame({
+        "k0": pd.array(
+            [None if i % 11 == 0 else int(rng.integers(0, 20)) for i in range(n)],
+            dtype="Int64",
+        ),
+        "k1": rng.integers(0, 15, n),
+        "k2": rng.integers(0, 10, n),
+        "amt": rng.normal(size=n).round(3),
+    })
+    d1 = pd.DataFrame({"id1": np.arange(20), "d1v": np.arange(20) * 10})
+    d2 = pd.DataFrame({"id2": np.arange(15), "d2v": np.arange(15) * 7})
+    d3 = pd.DataFrame({"id3": np.arange(10), "d3v": np.arange(10) * 3})
+    top = _star(fact, [d1, d2, d3], [0, 1, 2])
+    got = _collect_sorted(top)
+    exp = fact.dropna(subset=["k0"]).astype({"k0": "int64"})
+    exp = _oracle(exp, [d1, d2, d3], ["k0", "k1", "k2"])
+    exp.columns = got.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
