@@ -73,7 +73,7 @@ def call_native(task_bytes: bytes) -> int:
     """Start a task from a serialized TaskDefinition; returns a handle."""
     with _lock:
         resources = dict(_resources)
-    rt = TaskRuntime(task_bytes, resources=resources)
+    rt = TaskRuntime(task_bytes, resources=resources, shared=_resources)
     # conf-gated observability service (auron/src/http analog)
     from auron_tpu.utils.httpsvc import maybe_start_from_conf
 

@@ -48,6 +48,7 @@ from auron_tpu.exec.basic import batch_from_columns
 from auron_tpu.exprs import Evaluator, ir
 from auron_tpu.exprs import decimal_math as D
 from auron_tpu.exprs.eval import ColumnVal
+from auron_tpu.ops import hostsort
 from auron_tpu.ops import segments as S
 from auron_tpu.utils.config import (
     PARTIAL_AGG_SKIPPING_ENABLE,
@@ -437,7 +438,7 @@ class HashAggExec(ExecOperator):
             )
             out_v, out_m, group_valid = _reduce_arrays_jit(
                 sel, key_v, key_m, agg_v, agg_m, agg_aux,
-                cfg=self._reduce_cfg, raw=raw,
+                cfg=self._reduce_cfg + (hostsort.use_host_sort(),), raw=raw,
             )
             out_vals = []
             dict_map = self._output_dicts(keys, agg_cols)
@@ -469,7 +470,9 @@ class HashAggExec(ExecOperator):
         raw: bool,
     ) -> Batch:
         out_vals, group_valid = _reduce_columns(
-            sel, keys, agg_cols, raw, self._reduce_cfg, collect_cb=self._host_agg_cb
+            sel, keys, agg_cols, raw,
+            self._reduce_cfg + (hostsort.use_host_sort(),),
+            collect_cb=self._host_agg_cb
         )
         out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
         return Batch(self.inter_schema, out.device, out.dicts)
@@ -937,10 +940,11 @@ def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
 def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None):
     """Segment + reduce already-evaluated columns.
 
-    cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...)) — pure values, so the
-    jitted wrapper's compile cache is shared by every operator instance with
-    the same aggregate signature."""
-    n_keys, key_dtypes, agg_specs = cfg
+    cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...), host_sort) — pure
+    values, so the jitted wrapper's compile cache is shared by every operator
+    instance with the same aggregate signature; host_sort rides in cfg so a
+    config change retraces instead of hitting a stale compiled choice."""
+    n_keys, key_dtypes, agg_specs, host_sort = cfg
     cap = int(sel.shape[0])
     if n_keys == 0:
         # global aggregation: single segment containing all live rows
@@ -954,7 +958,7 @@ def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None
         )
     else:
         words = S.key_words(keys)
-        seg = S.segment_by_keys(words, sel)
+        seg = S.segment_by_keys(words, sel, host_sort=host_sort)
     order = seg.order
 
     out_vals: list[ColumnVal] = []
@@ -1155,7 +1159,7 @@ def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid, aux=None):
 
 
 def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, cfg, raw):
-    n_keys, key_dtypes, agg_specs = cfg
+    n_keys, key_dtypes, agg_specs, _host_sort = cfg
     keys = [
         ColumnVal(v, m, dt, None) for (v, m, dt) in zip(key_v, key_m, key_dtypes)
     ]

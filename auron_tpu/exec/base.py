@@ -52,6 +52,10 @@ class ExecutionContext:
     conf: Configuration = field(default_factory=lambda: active_conf().copy())
     metrics: MetricNode = field(default_factory=lambda: MetricNode("root"))
     resources: dict = field(default_factory=dict)
+    #: executor-shared store (the bridge's live resource map, NOT the
+    #: per-task copy): cached broadcast builds land here so concurrent
+    #: tasks reuse one build instead of each building their own
+    shared: dict | None = None
     _cancelled: threading.Event = field(default_factory=threading.Event)
 
     def cancel(self) -> None:

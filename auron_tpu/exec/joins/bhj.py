@@ -10,6 +10,7 @@ way). PartitionMode BuildLeft/BuildRight decides which child builds.
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator
 
 from auron_tpu.columnar.batch import Batch
@@ -17,6 +18,18 @@ from auron_tpu.exec.base import ExecOperator, ExecutionContext
 from auron_tpu.exec.joins.core import PreparedBuild
 from auron_tpu.exec.joins.driver import EquiJoinDriver
 from auron_tpu.exprs import ir
+
+
+_key_locks: dict[str, threading.Lock] = {}
+_key_locks_guard = threading.Lock()
+
+
+def _build_key_lock(key: str) -> threading.Lock:
+    with _key_locks_guard:
+        lk = _key_locks.get(key)
+        if lk is None:
+            lk = _key_locks[key] = threading.Lock()
+        return lk
 
 
 class BroadcastHashJoinExec(ExecOperator):
@@ -48,21 +61,43 @@ class BroadcastHashJoinExec(ExecOperator):
         if memo is not None:
             return memo  # prepared during a fused-chain attempt that fell back
         key = self.cached_build_id
-        if key is not None and key in ctx.resources:
-            cached: PreparedBuild = ctx.resources[key]
-            # fresh matched-flags per task; the map itself is shared
+        if key is not None:
+            # Executor-shared when the bridge hands us the live resource map
+            # (ctx.shared): concurrent tasks probing the same broadcast wait
+            # on one build instead of each building their own — the same
+            # executor-wide broadcast-build cache the reference keeps.
+            # CONTRACT: cached_build_id must uniquely identify the build
+            # DATA (the host side mints a fresh id per broadcast instance,
+            # like a Spark broadcast variable id) and the host removes the
+            # resource when the broadcast is destroyed.
+            store = ctx.shared if ctx.shared is not None else ctx.resources
             import dataclasses
 
             import jax.numpy as jnp
 
+            lk = _build_key_lock(key)
+            # bounded wait: plans whose cached joins nest in opposite key
+            # orders could otherwise ABBA-deadlock; on timeout just build
+            # locally (duplicate work, never a wrong result)
+            acquired = lk.acquire(timeout=30.0)
+            try:
+                cached = store.get(key)
+                if cached is None:
+                    with ctx.metrics.timer("build_hash_map_time"):
+                        batches = list(self.child_stream(build_child, partition, ctx))
+                        cached = self.driver.prepare(batches)
+                    if acquired:
+                        store[key] = cached
+            finally:
+                if acquired:
+                    lk.release()
+            # fresh matched-flags per task; the map itself is shared
             return dataclasses.replace(
                 cached, matched=jnp.zeros(cached.batch.capacity, bool)
             )
         with ctx.metrics.timer("build_hash_map_time"):
             batches = list(self.child_stream(build_child, partition, ctx))
             built = self.driver.prepare(batches)
-        if key is not None:
-            ctx.resources[key] = built
         return built
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:

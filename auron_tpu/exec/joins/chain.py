@@ -45,8 +45,9 @@ def try_fused_chain(top, partition: int, ctx) -> Iterator[Batch] | None:
     caller then runs the ordinary per-operator path)."""
     from auron_tpu.exec.joins.bhj import BroadcastHashJoinExec
 
-    if not _compact_join_output_enabled():
-        return None
+    # on accelerators (compact off) the chain still fuses — it just emits
+    # dense outputs with NO host sync; on CPU hosts it compacts per batch
+    compact_mode = _compact_join_output_enabled()
 
     # collect the stack of fusable links, top-down
     links = []  # (exec, probe_child_index)
@@ -162,12 +163,13 @@ def try_fused_chain(top, partition: int, ctx) -> Iterator[Batch] | None:
 
     return _run_chain(
         top_ex, bottom, links, builds, key_cols_per_level, out_map,
-        partition, ctx,
+        partition, ctx, compact_mode,
     )
 
 
 def _run_chain(
-    top_ex, bottom, links, builds, key_cols_per_level, out_map, partition, ctx
+    top_ex, bottom, links, builds, key_cols_per_level, out_map, partition, ctx,
+    compact_mode: bool = True,
 ) -> Iterator[Batch]:
     d_top = top_ex.driver
     out_schema = d_top.out_schema
@@ -195,74 +197,130 @@ def _run_chain(
         for b, cs in zip(builds, bcols_per_level)
     )
 
-    for pb in probe_child_stream:
-        ctx.check_cancelled()
-        with ctx.metrics.timer("probe_time"):
-            # one probe program per level — no gathers, no intermediates
-            oks = []
-            bis = []
-            for build, key_cols, kinds in zip(
-                builds, key_cols_per_level, kinds_per_level
-            ):
-                kvals = tuple(pb.col_values(c) for c in key_cols)
-                kmasks = tuple(pb.col_validity(c) for c in key_cols)
-                bi, ok, _, _ = core._unique_probe_jit(
-                    kvals, kmasks, pb.device.sel,
-                    build.lut,
-                    jnp.int64(build.lut_base) if build.lut is not None else None,
-                    build.words, jnp.int32(build.n_live),
-                    bcap=build.batch.capacity,
-                    use_lut=build.lut is not None,
-                    probe_outer=False,
-                    key_kinds=kinds,
-                )
-                oks.append(ok)
-                bis.append(bi)
-            sel_out = _and_all(pb.device.sel, oks)
+    level_cfgs = tuple(
+        (b.batch.capacity, b.lut is not None, kinds)
+        for b, kinds in zip(builds, kinds_per_level)
+    )
+    luts = tuple(b.lut for b in builds)
+    lut_bases = tuple(
+        jnp.int64(b.lut_base) if b.lut is not None else None for b in builds
+    )
+    bwords_all = tuple(b.words for b in builds)
+    n_lives = tuple(jnp.int32(b.n_live) for b in builds)
+
+    def dispatch(pb):
+        """Async half: ALL levels' canon + probe + selection AND as ONE
+        program (single pass over the probe keys). No host sync here —
+        finish() syncs one batch later, so the mask transfer of batch i
+        overlaps batch i+1's device compute (and, on remote accelerators,
+        hides link latency)."""
+        kv_all = tuple(
+            tuple(pb.col_values(c) for c in key_cols)
+            for key_cols in key_cols_per_level
+        )
+        km_all = tuple(
+            tuple(pb.col_validity(c) for c in key_cols)
+            for key_cols in key_cols_per_level
+        )
+        sel_out, bis = _chain_probe_all_jit(
+            kv_all, km_all, pb.device.sel,
+            luts, lut_bases, bwords_all, n_lives,
+            cfgs=level_cfgs,
+        )
+        return pb, sel_out, list(bis)
+
+    def finish(state) -> Batch:
+        pb, sel_out, bis = state
+        if compact_mode:
             sel_np = np.asarray(jax.device_get(sel_out))
             idx_np = np.flatnonzero(sel_np)
             n_live = int(idx_np.size)
             out_cap = bucket_capacity(max(n_live, 1))
+        else:
+            # accelerator mode: dense output, ZERO host syncs in the chain
+            out_cap = pb.capacity
 
-            if out_cap * 4 > pb.capacity:
-                # dense output: compaction wouldn't pay (same threshold as
-                # driver._emit_unique_compacted) — gather build columns at
-                # full width, keep probe columns as zero-copy views
-                c_b, c_bm = _chain_take_dense_jit(
-                    bvals_all, bmasks_all, tuple(bis), sel_out
-                )
-                c_p = c_pm = None
-                new_sel = sel_out
-            else:
-                idx_pad = np.zeros(out_cap, dtype=np.int32)
-                idx_pad[:n_live] = idx_np
-                c_p, c_pm, c_b, c_bm, new_sel = _chain_take_jit(
-                    tuple(pb.col_values(c) for c in probe_cols),
-                    tuple(pb.col_validity(c) for c in probe_cols),
-                    bvals_all, bmasks_all,
-                    tuple(bis),
-                    jnp.asarray(idx_pad), jnp.int32(n_live),
-                )
-            out_cols = []
-            for (src, ci), f in zip(out_map, out_schema):
-                if src == -1:
-                    if c_p is None:
-                        out_cols.append(ColumnVal(
-                            pb.col_values(ci), pb.col_validity(ci),
-                            f.dtype, pb.dicts[ci],
-                        ))
-                    else:
-                        out_cols.append(ColumnVal(
-                            c_p[p_at[ci]], c_pm[p_at[ci]], f.dtype, pb.dicts[ci]
-                        ))
-                else:
-                    bb = builds[src].batch
+        if out_cap * 4 > pb.capacity:
+            # dense output: compaction wouldn't pay (same threshold as
+            # driver._emit_unique_compacted) — gather build columns at
+            # full width, keep probe columns as zero-copy views
+            c_b, c_bm = _chain_take_dense_jit(
+                bvals_all, bmasks_all, tuple(bis), sel_out
+            )
+            c_p = c_pm = None
+            new_sel = sel_out
+        else:
+            idx_pad = np.zeros(out_cap, dtype=np.int32)
+            idx_pad[:n_live] = idx_np
+            c_p, c_pm, c_b, c_bm, new_sel = _chain_take_jit(
+                tuple(pb.col_values(c) for c in probe_cols),
+                tuple(pb.col_validity(c) for c in probe_cols),
+                bvals_all, bmasks_all,
+                tuple(bis),
+                jnp.asarray(idx_pad), jnp.int32(n_live),
+            )
+        out_cols = []
+        for (src, ci), f in zip(out_map, out_schema):
+            if src == -1:
+                if c_p is None:
                     out_cols.append(ColumnVal(
-                        c_b[src][b_at[src][ci]], c_bm[src][b_at[src][ci]],
-                        f.dtype, bb.dicts[ci],
+                        pb.col_values(ci), pb.col_validity(ci),
+                        f.dtype, pb.dicts[ci],
                     ))
-            out = batch_from_columns(out_cols, out_schema.names, new_sel)
-            yield Batch(out_schema, out.device, out.dicts)
+                else:
+                    out_cols.append(ColumnVal(
+                        c_p[p_at[ci]], c_pm[p_at[ci]], f.dtype, pb.dicts[ci]
+                    ))
+            else:
+                bb = builds[src].batch
+                out_cols.append(ColumnVal(
+                    c_b[src][b_at[src][ci]], c_bm[src][b_at[src][ci]],
+                    f.dtype, bb.dicts[ci],
+                ))
+        out = batch_from_columns(out_cols, out_schema.names, new_sel)
+        return Batch(out_schema, out.device, out.dicts)
+
+    # one-deep software pipeline: dispatch batch i+1 before syncing batch i
+    pending = None
+    for pb in probe_child_stream:
+        ctx.check_cancelled()
+        with ctx.metrics.timer("probe_time"):
+            cur = dispatch(pb)
+            if pending is not None:
+                ready = finish(pending)
+            else:
+                ready = None
+            pending = cur
+        if ready is not None:
+            yield ready
+    if pending is not None:
+        with ctx.metrics.timer("probe_time"):
+            ready = finish(pending)
+        yield ready
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("cfgs",))
+def _chain_probe_all_jit(kv_all, km_all, psel, luts, lut_bases, bwords_all, n_lives, cfgs):
+    """Every level's key canonicalization + unique probe + the combined
+    selection AND in ONE program: XLA fuses the per-level LUT gathers into a
+    single pass over the probe stream, and no per-level ok/live-count
+    intermediates are materialized."""
+    sel = psel
+    bis = []
+    for kv, km, lut, lb, bw, nl, (bcap, use_lut, kinds) in zip(
+        kv_all, km_all, luts, lut_bases, bwords_all, n_lives, cfgs
+    ):
+        words, pvalid = core._canon_words_traced(kv, km, kinds)
+        ok_base = psel & (pvalid if pvalid is not None else jnp.ones_like(psel))
+        bi, ok = core._probe_unique_ops(
+            words, ok_base, lut if use_lut else None, lb, bw, nl, bcap
+        )
+        bis.append(bi)
+        sel = sel & ok
+    return sel, tuple(bis)
 
 
 @jax.jit

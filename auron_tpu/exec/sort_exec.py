@@ -115,11 +115,17 @@ class SortExec(ExecOperator):
         cap = big.capacity
         live = jnp.where(big.device.sel, jnp.uint64(0), jnp.uint64(1))
         iota = jnp.arange(cap, dtype=jnp.int32)
+        from auron_tpu.ops import hostsort
+
         with ctx.metrics.timer("sort_time"):
-            sorted_ops = lax.sort(
-                tuple([live, *ops, iota]), num_keys=len(ops) + 1
-            )
-        order = sorted_ops[-1]
+            if hostsort.use_host_sort():
+                order = hostsort.order_by_words((live, *ops))
+                sorted_ops = (None, *(o[order] for o in ops), order)
+            else:
+                sorted_ops = lax.sort(
+                    tuple([live, *ops, iota]), num_keys=len(ops) + 1
+                )
+                order = sorted_ops[-1]
         dev = big.device
         n = big.num_rows()
         new_cap = bucket_capacity(max(n, 1))

@@ -29,6 +29,12 @@ def setup_jax() -> None:
     )
     if cache_dir:
         try:
+            # key the cache by a host fingerprint: XLA:CPU AOT results encode
+            # the COMPILE machine's ISA features, and loading them on a
+            # different host both spams warnings and runs code scheduled for
+            # the wrong machine (e.g. prefer-no-gather avoids gather
+            # instructions this host has)
+            cache_dir = os.path.join(cache_dir, _host_fingerprint())
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
@@ -36,6 +42,22 @@ def setup_jax() -> None:
         except Exception:
             pass
     _SETUP_DONE = True
+
+
+def _host_fingerprint() -> str:
+    """Short stable id of this host's CPU feature set."""
+    import hashlib
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return "host-" + hashlib.sha256(flags.encode()).hexdigest()[:12]
 
 
 def force_cpu_backend(num_devices: int = 8) -> None:

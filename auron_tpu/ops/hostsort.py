@@ -1,0 +1,51 @@
+"""Backend-adaptive order-permutation primitive.
+
+Every grouping/ordering kernel here reduces to "stable ascending sort of a
+tuple of uint64 key words" (ops/segments.py segment_by_keys, ops/sortkeys.py
+sort operands, exec/sort_exec.py runs). On accelerators that is one
+multi-operand ``lax.sort`` over HBM-resident data — the right call. XLA:CPU
+however lowers ``lax.sort`` to a generic comparator sort, measured ~50-100x
+slower than a lexicographic host sort for these word tuples; on the CPU
+backend the permutation is therefore computed by a ``pure_callback``
+``np.lexsort`` (stable, identical tie semantics to the stable ``lax.sort``),
+and the surrounding program stays jitted — only the argsort leaves the
+device, the gathers it feeds remain fused XLA.
+
+The reference hits the same fork: its CPU engine sorts with a hand-written
+radix sort (datafusion-ext-commons rdx_sort), not a comparison sort.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.utils.config import HOST_SORT_MODE, active_conf
+
+
+def use_host_sort() -> bool:
+    """Trace-time decision: host lexsort or device lax.sort."""
+    mode = active_conf().get(HOST_SORT_MODE)
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return jax.default_backend() == "cpu"
+
+
+def _lexsort_cb(*words):
+    # primary key first in our convention; np.lexsort wants primary LAST
+    return np.lexsort(tuple(reversed(words))).astype(np.int32)
+
+
+def order_by_words(operands: tuple) -> jnp.ndarray:
+    """Stable ascending order permutation (int32) of the operand tuple;
+    operands[0] is the primary key. Host path — call only under
+    use_host_sort()."""
+    cap = operands[0].shape[0]
+    return jax.pure_callback(
+        _lexsort_cb,
+        jax.ShapeDtypeStruct((cap,), jnp.int32),
+        *operands,
+    )

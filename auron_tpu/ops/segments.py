@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax
+from functools import partial
 import jax.numpy as jnp
 from jax import lax
 
@@ -84,16 +85,28 @@ class Segmentation(NamedTuple):
     sel_sorted: jnp.ndarray  # liveness in sorted order
 
 
-@jax.jit
-def segment_by_keys(words: list[jnp.ndarray], sel: jnp.ndarray) -> Segmentation:
+@partial(jax.jit, static_argnames=("host_sort",))
+def segment_by_keys(
+    words: list[jnp.ndarray], sel: jnp.ndarray, host_sort: bool | None = None
+) -> Segmentation:
+    """host_sort must be threaded in as a STATIC value by jitted callers
+    (jit caches are keyed by shapes, not config — deciding inside the trace
+    would bake a stale choice into already-compiled programs)."""
+    from auron_tpu.ops import hostsort
+
     cap = sel.shape[0]
     dead_first_key = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
     iota = jnp.arange(cap, dtype=jnp.int32)
-    operands = [dead_first_key, *words, iota]
-    sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1)
-    sel_sorted = sorted_ops[0] == 0
-    sorted_words = sorted_ops[1:-1]
-    order = sorted_ops[-1]
+    if hostsort.use_host_sort() if host_sort is None else host_sort:
+        order = hostsort.order_by_words((dead_first_key, *words))
+        sel_sorted = sel[order]
+        sorted_words = tuple(w[order] for w in words)
+    else:
+        operands = [dead_first_key, *words, iota]
+        sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1)
+        sel_sorted = sorted_ops[0] == 0
+        sorted_words = sorted_ops[1:-1]
+        order = sorted_ops[-1]
 
     diff = jnp.zeros(cap, dtype=bool).at[0].set(True)
     for w in sorted_words:

@@ -111,7 +111,8 @@ class MeshQueryDriver:
         self._exchange_seq = 0
         self._tmp_dirs: list[str] = []
         self._reduce_parts: int | None = None  # AQE-coalesced stage width
-        self._coalesce_candidate = None
+        #: pending per-exchange AQE candidates: ex_id -> (provider, sizes)
+        self._coalesce_candidates: dict[str, tuple] = {}
 
     # ------------------------------------------------------------------
 
@@ -126,21 +127,13 @@ class MeshQueryDriver:
             self.stats = []
             self._exchange_seq = 0
             self._reduce_parts = None
-            self._coalesce_candidate = None
+            self._coalesce_candidates = {}
 
             resolved = self._rewrite(prune_columns(plan), resources)
-            if self._coalesce_candidate is not None and len(self.stats) == 1:
-                ex_id, provider, groups = self._coalesce_candidate
-                # shrinking the residual stage width is only sound when the
-                # exchange is its ONLY per-partition input — any other
-                # source would be misaligned or partially dropped
-                if self._only_source_is(resolved, ex_id):
-                    resources[ex_id] = CoalescedBlockProvider(provider, groups)
-                    self.stats[0].coalesced_groups = groups
-                    self._reduce_parts = len(groups)
+            n_reduce = self._maybe_coalesce_inputs(resolved, resources)
+            self._reduce_parts = n_reduce if n_reduce != self.n_parts else None
             outs: list[list[Batch]] = []
-            n_reduce = self._reduce_parts or self.n_parts
-            for p in range(n_reduce):
+            for p in range(self._reduce_parts or self.n_parts):
                 op = plan_from_proto(resolved)
                 ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
                                        resources=resources)
@@ -150,9 +143,8 @@ class MeshQueryDriver:
             self._cleanup_tmp()
 
     @staticmethod
-    def _only_source_is(plan: pb.PhysicalPlanNode, ex_id: str) -> bool:
-        """True iff the plan's only source/leaf node is the exchange's
-        spliced ipc_reader."""
+    def _collect_sources(plan: pb.PhysicalPlanNode) -> list[tuple[str, str]]:
+        """All leaf source nodes of a resolved sub-plan as (kind, rid)."""
         sources: list[tuple[str, str]] = []
 
         def rec(node):
@@ -176,7 +168,46 @@ class MeshQueryDriver:
                 sources.append((which, rid))
 
         rec(plan)
-        return sources == [("ipc_reader", ex_id)]
+        return sources
+
+    def _maybe_coalesce_inputs(self, plan: pb.PhysicalPlanNode, resources: dict) -> int:
+        """AQE post-shuffle coalescing, per consuming stage (the reference
+        re-plans each stage from map-output statistics the same way —
+        CoalesceShufflePartitions over every shuffle feeding the stage).
+
+        Sound iff EVERY leaf of the stage is a just-resolved file exchange:
+        the same partition grouping is then applied to all of them, which
+        preserves hash co-partitioning across the stage's inputs (a
+        multi-shuffle join stays aligned). Returns the stage width."""
+        leaves = self._collect_sources(plan)
+        ex_ids = [
+            rid
+            for kind, rid in leaves
+            if kind == "ipc_reader" and rid in self._coalesce_candidates
+        ]
+        if not ex_ids or len(ex_ids) != len(leaves):
+            return self.n_parts
+        # a self-join may read the SAME exchange on both sides: one grouping
+        # decision, sizes counted once
+        ex_ids = list(dict.fromkeys(ex_ids))
+        from auron_tpu.parallel.broadcast import plan_coalesced_partitions
+
+        combined = None
+        for ex in ex_ids:
+            _, sizes = self._coalesce_candidates[ex]
+            combined = sizes if combined is None else combined + sizes
+        groups = plan_coalesced_partitions(
+            combined, self.conf.get(EXCHANGE_COALESCE_TARGET_BYTES)
+        )
+        if len(groups) >= self.n_parts:
+            return self.n_parts
+        by_id = {s.exchange_id: s for s in self.stats}
+        for ex in ex_ids:
+            provider, _ = self._coalesce_candidates.pop(ex)
+            resources[ex] = CoalescedBlockProvider(provider, groups)
+            if ex in by_id:
+                by_id[ex].coalesced_groups = groups
+        return len(groups)
 
     def _cleanup_tmp(self) -> None:
         import shutil
@@ -220,12 +251,14 @@ class MeshQueryDriver:
         ex_id = spec.exchange_id or f"__mesh_exchange_{self._exchange_seq}"
         self._exchange_seq += 1
 
-        # ---- map stage: run the child sub-plan per shard
+        # ---- map stage: run the child sub-plan per shard (AQE may have
+        # coalesced this stage's shuffle inputs, shrinking its width)
+        n_src = self._maybe_coalesce_inputs(child, resources)
         op = plan_from_proto(child)
         schema = op.schema
         shard_batches: list[Batch] = []
         pids: list[jnp.ndarray] = []
-        for p in range(self.n_parts):
+        for p in range(n_src):
             ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
                                    resources=resources)
             got = list(op.execute(p, ctx))
@@ -245,6 +278,10 @@ class MeshQueryDriver:
                 if est_shard_bytes <= self.conf.get(EXCHANGE_MESH_MAX_BYTES)
                 else "file"
             )
+        if n_src != self.n_parts:
+            # ICI all_to_all is square (P src = P dst); a coalesced map
+            # stage routes through the file transport
+            mode = "file"
         self.stats.append(ExchangeStats(ex_id, mode, counts, est_shard_bytes))
 
         if mode == "file":
@@ -262,7 +299,7 @@ class MeshQueryDriver:
             use_pallas,
         )
 
-        counts = np.zeros((self.n_parts, self.n_parts), dtype=np.int64)
+        counts = np.zeros((len(batches), self.n_parts), dtype=np.int64)
         on_tpu = use_pallas()
         for src, (b, pid) in enumerate(zip(batches, pids)):
             if on_tpu:
@@ -373,7 +410,7 @@ class MeshQueryDriver:
         src_id = ex_id + "__src"
         resources[src_id] = [[b] for b in batches]
         try:
-            for p in range(self.n_parts):
+            for p in range(len(batches)):
                 data_f = os.path.join(work, f"{ex_id}_map{p}.data")
                 index_f = os.path.join(work, f"{ex_id}_map{p}.index")
                 w = ShuffleWriterExec(
@@ -388,21 +425,14 @@ class MeshQueryDriver:
             resources.pop(src_id, None)
         provider = MultiMapBlockProvider(pairs)
         # ---- AQE: statistics-driven post-shuffle coalescing candidate.
-        # Applied AFTER the whole rewrite, and only when this exchange is
-        # the plan's only one — shrinking the residual stage width is only
-        # sound when every stage input agrees on it.
+        # The grouping decision is made PER CONSUMING STAGE
+        # (_maybe_coalesce_inputs): every shuffle feeding a stage gets the
+        # same groups, so hash co-partitioning across inputs is preserved.
         if self.conf.get(EXCHANGE_COALESCE_ENABLE):
-            from auron_tpu.parallel.broadcast import (
-                map_output_stats,
-                plan_coalesced_partitions,
-            )
+            from auron_tpu.parallel.broadcast import map_output_stats
 
             sizes = map_output_stats([i for _, i in pairs])
-            groups = plan_coalesced_partitions(
-                sizes, self.conf.get(EXCHANGE_COALESCE_TARGET_BYTES)
-            )
-            if len(groups) < self.n_parts:
-                self._coalesce_candidate = (ex_id, provider, groups)
+            self._coalesce_candidates[ex_id] = (provider, sizes)
         resources[ex_id] = provider
         return pb.PhysicalPlanNode(
             ipc_reader=pb.IpcReaderNode(

@@ -33,6 +33,7 @@ class TaskRuntime:
         self,
         task: pb.TaskDefinition | bytes,
         resources: dict | None = None,
+        shared: dict | None = None,
     ):
         if isinstance(task, (bytes, bytearray)):
             t = pb.TaskDefinition()
@@ -47,6 +48,7 @@ class TaskRuntime:
             conf=conf,
             metrics=MetricNode(self.plan.name),
             resources=resources or {},
+            shared=shared,
         )
         depth = conf.get(TOKIO_EQUIV_PREFETCH_DEPTH)
         self._queue: queue.Queue = queue.Queue(maxsize=max(depth, 1))

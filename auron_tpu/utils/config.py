@@ -159,6 +159,13 @@ JOIN_COMPACT_OUTPUT = str_conf(
     "(costs one host sync per probe batch): auto = on for CPU hosts, off "
     "on accelerators where the sync round-trip outweighs the saved gather",
 )
+HOST_SORT_MODE = str_conf(
+    "exec.host.sort", "auto", "exec",
+    "compute order permutations host-side via a callback lexsort instead of "
+    "lax.sort (XLA:CPU lowers lax.sort to a comparator sort ~100x slower "
+    "than a radix/lexicographic sort): auto = on for the CPU backend, off "
+    "on accelerators where data is HBM-resident",
+)
 SMJ_FALLBACK_ENABLE = bool_conf(
     "smj.fallback.enable", True, "join",
     "fall back from hash join to sort-merge when the build side exceeds budget (SMJ_FALLBACK_* in conf.rs:53-55)",

@@ -95,10 +95,13 @@ def main() -> None:
     n_rows = data.fact_rows()
     n_bytes = int(data.store_sales.memory_usage(index=False, deep=False).sum())
 
-    # --- pandas baseline (single-thread CPU, data in RAM) ---
-    t0 = time.perf_counter()
-    want = tpcds.q3_class_oracle(data)
-    baseline_s = time.perf_counter() - t0
+    # --- pandas baseline (single-thread CPU, data in RAM; best-of-2 like
+    # the engine's timed runs, so neighbor noise hits both sides equally) ---
+    baseline_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        want = tpcds.q3_class_oracle(data)
+        baseline_s = min(baseline_s, time.perf_counter() - t0)
 
     # --- ingest: RAM -> HBM, timed separately ---
     import jax

@@ -136,6 +136,36 @@ def test_dense_survival_chain_matches_oracle():
     pd.testing.assert_frame_equal(got, exp, check_dtype=False)
 
 
+def test_dense_accelerator_mode_no_sync():
+    # compact off (accelerator default): the chain must still fuse, emitting
+    # dense outputs with no host sync
+    from auron_tpu.utils.config import JOIN_COMPACT_OUTPUT, active_conf
+
+    fact, d1, d2 = _fact_dims(n=300, seed=7)
+    top = _star(fact, [d1, d2], [0, 1])
+    calls = {"fused": 0}
+    orig = chain_mod._run_chain
+
+    def spy(*a, **k):
+        calls["fused"] += 1
+        return orig(*a, **k)
+
+    conf = active_conf()
+    saved_mode = conf.get(JOIN_COMPACT_OUTPUT)
+    conf.set(JOIN_COMPACT_OUTPUT, "off")
+    chain_mod._run_chain = spy
+    try:
+        got = _collect_sorted(top)
+    finally:
+        chain_mod._run_chain = orig
+        conf.set(JOIN_COMPACT_OUTPUT, saved_mode)
+    assert calls["fused"] == 1
+    exp = _oracle(fact, [d1, d2], ["k0", "k1"])
+    exp.columns = got.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
 def test_three_level_chain_with_nulls():
     rng = np.random.default_rng(2)
     n = 400
