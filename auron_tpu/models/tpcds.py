@@ -868,6 +868,472 @@ def windowed2_class_oracle(data: TpcdsData) -> pd.DataFrame:
 
 
 # ---------------------------------------------------------------------------
+# round-3 gate widening (VERDICT r2 #6): multi-exchange plans, rollup/expand,
+# scalar subqueries, windowed joins, union, conditional/distinct aggregation
+# ---------------------------------------------------------------------------
+
+
+def _drain_task(plan, stage_id=0, partition_id=0) -> list[pd.DataFrame]:
+    h = api.call_native(
+        B.task(plan, stage_id=stage_id, partition_id=partition_id).SerializeToString()
+    )
+    frames = []
+    while (rb := api.next_batch(h)) is not None:
+        frames.append(rb.to_pandas())
+    api.finalize_native(h)
+    return frames
+
+
+def _shuffle_stage(plan, out_schema, key_cols, n_map, n_reduce, work, rid, stage_id=1):
+    """Run `plan` as n_map map tasks hash-shuffled into files; returns the
+    reduce-side ipc_reader node (the manual analog of one mesh_exchange)."""
+    part = B.hash_partitioning([col(c) for c in key_cols], n_reduce)
+    pairs = []
+    for p in range(n_map):
+        d = os.path.join(work, f"{rid}_m{p}.data")
+        i = os.path.join(work, f"{rid}_m{p}.index")
+        w = B.shuffle_writer(plan, part, d, i)
+        h = api.call_native(
+            B.task(w, stage_id=stage_id, partition_id=p).SerializeToString()
+        )
+        while api.next_batch(h) is not None:
+            pass
+        api.finalize_native(h)
+        pairs.append((d, i))
+    api.put_resource(rid, MultiMapBlockProvider(pairs))
+    return B.ipc_reader(out_schema, rid)
+
+
+def run_q14_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.DataFrame:
+    """COUNT(DISTINCT item) per year — Spark's distinct-agg rewrite: group by
+    (year, item) across one shuffle, then regroup by year across a SECOND
+    shuffle (two chained exchanges)."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q14_")
+    os.makedirs(work, exist_ok=True)
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    api.put_resource("q14_fact", to_batches(data.store_sales, n_map))
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    api.put_resource("q14_dd", [dd] * max(n_map, n_reduce))
+    try:
+        scan = B.memory_scan(fact_schema, "q14_fact")
+        j = B.hash_join(scan, B.memory_scan(dd_schema, "q14_dd"),
+                        [col(0)], [col(0)], "inner", build_side="right")
+        proj = B.project(j, [(col(6), "y"), (col(1), "i")])
+        p1 = B.hash_agg(proj, [(col(0), "y"), (col(1), "i")],
+                        [("count_star", None, "c")], "partial")
+        inter1 = _agg_inter_schema(p1)
+        read1 = _shuffle_stage(p1, inter1, [0, 1], n_map, n_reduce, work, "q14_ex0", 1)
+        f1 = B.hash_agg(read1, [(col(0), "y"), (col(1), "i")],
+                        [("count_star", None, "c")], "final")
+        # stage 2: regroup by year over a second exchange
+        p2 = B.hash_agg(f1, [(col(0), "y")], [("count_star", None, "d_items")],
+                        "partial")
+        inter2 = _agg_inter_schema(p2)
+        read2 = _shuffle_stage(p2, inter2, [0], n_reduce, n_reduce, work, "q14_ex1", 2)
+        f2 = B.hash_agg(read2, [(col(0), "y")], [("count_star", None, "d_items")],
+                        "final")
+        frames = []
+        for p in range(n_reduce):
+            frames.extend(_drain_task(f2, stage_id=3, partition_id=p))
+        out = pd.concat(frames) if frames else pd.DataFrame({"y": [], "d_items": []})
+        return out.sort_values("y").reset_index(drop=True)
+    finally:
+        for k in ("q14_fact", "q14_dd", "q14_ex0", "q14_ex1"):
+            api.remove_resource(k)
+
+
+def q14_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    m = data.store_sales.merge(data.date_dim, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    out = (m.groupby("d_year").ss_item_sk.nunique().reset_index()
+           .rename(columns={"d_year": "y", "ss_item_sk": "d_items"}))
+    out["d_items"] = out["d_items"].astype(np.int64)
+    return out.sort_values("y").reset_index(drop=True)
+
+
+def run_q67_class(data: TpcdsData) -> pd.DataFrame:
+    """GROUP BY ROLLUP(date, item): ExpandExec emits the three grouping
+    sets with a grouping id, one aggregation over the expanded stream."""
+    from auron_tpu.exprs.ir import Literal
+
+    sample = data.store_sales.iloc[:3000]
+    fact_schema = _schema_of(sample)
+    api.put_resource("q67_fact", [[Batch.from_arrow(
+        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    try:
+        scan = B.memory_scan(fact_schema, "q67_fact")
+        null_i64 = Literal(None, T.INT64)
+        ex = B.expand(scan, [
+            [col(0), col(1), col(4), lit(0)],
+            [col(0), null_i64, col(4), lit(1)],
+            [null_i64, null_i64, col(4), lit(3)],
+        ], ["d", "i", "price", "gid"])
+        p = B.hash_agg(ex, [(col(0), "d"), (col(1), "i"), (col(3), "gid")],
+                       [("sum", col(2), "s")], "partial")
+        f = B.hash_agg(p, [(col(0), "d"), (col(1), "i"), (col(3), "gid")],
+                       [("sum", col(2), "s")], "final")
+        out = pd.concat(_drain_task(f))
+        return out.sort_values(["gid", "d", "i"], na_position="first").reset_index(drop=True)
+    finally:
+        api.remove_resource("q67_fact")
+
+
+def q67_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    sample = data.store_sales.iloc[:3000]
+    lv0 = (sample.groupby(["ss_sold_date_sk", "ss_item_sk"])
+           .agg(s=("ss_ext_sales_price", "sum")).reset_index())
+    lv0.columns = ["d", "i", "s"]
+    lv0["gid"] = 0
+    lv1 = sample.groupby("ss_sold_date_sk").agg(s=("ss_ext_sales_price", "sum")).reset_index()
+    lv1.columns = ["d", "s"]
+    lv1["i"] = pd.NA
+    lv1["gid"] = 1
+    lv3 = pd.DataFrame({"d": [pd.NA], "i": [pd.NA],
+                        "s": [sample.ss_ext_sales_price.sum()], "gid": [3]})
+    out = pd.concat([lv0, lv1, lv3])[["d", "i", "s", "gid"]]
+    return out.sort_values(["gid", "d", "i"], na_position="first").reset_index(drop=True)
+
+
+def run_q9_class(data: TpcdsData) -> pd.DataFrame:
+    """Scalar-subquery filter: rows above the (subquery-computed) global
+    average price, counted and summed."""
+    from auron_tpu.exprs.ir import ScalarSubquery
+
+    fact_schema = _schema_of(data.store_sales)
+    api.put_resource("q9_fact", to_batches(data.store_sales, 1))
+    try:
+        # subquery task: global avg
+        sub_p = B.hash_agg(B.memory_scan(fact_schema, "q9_fact"), [],
+                           [("avg", col(4), "a")], "partial")
+        sub = B.hash_agg(sub_p, [], [("avg", col(4), "a")], "final")
+        avg_val = float(pd.concat(_drain_task(sub)).iloc[0, 0])
+        api.put_resource("q9_avg", avg_val)
+
+        flt = B.filter_(B.memory_scan(fact_schema, "q9_fact"),
+                        [BinaryOp("gt", col(4), ScalarSubquery("q9_avg", T.FLOAT64))])
+        agg_p = B.hash_agg(flt, [], [("count_star", None, "c"),
+                                     ("sum", col(4), "s")], "partial")
+        agg_f = B.hash_agg(agg_p, [], [("count_star", None, "c"),
+                                       ("sum", col(4), "s")], "final")
+        return pd.concat(_drain_task(agg_f)).reset_index(drop=True)
+    finally:
+        api.remove_resource("q9_fact")
+        api.remove_resource("q9_avg")
+
+
+def q9_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    avg = data.store_sales.ss_ext_sales_price.mean()
+    keep = data.store_sales[data.store_sales.ss_ext_sales_price > avg]
+    return pd.DataFrame({"c": [np.int64(len(keep))],
+                         "s": [keep.ss_ext_sales_price.sum()]})
+
+
+def run_q48_class(data: TpcdsData, n_map=2) -> pd.DataFrame:
+    """Conditional aggregation: sum(CASE WHEN quantity < 25 THEN price
+    ELSE 0 END) per year over a broadcast date join."""
+    from auron_tpu.exprs.ir import Case
+
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    api.put_resource("q48_fact", to_batches(data.store_sales, n_map))
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    api.put_resource("q48_dd", [dd] * n_map)
+    try:
+        j = B.hash_join(B.memory_scan(fact_schema, "q48_fact"),
+                        B.memory_scan(dd_schema, "q48_dd"),
+                        [col(0)], [col(0)], "inner", build_side="right")
+        cheap = Case(((BinaryOp("lt", col(3), lit(25)), col(4)),), lit(0.0))
+        proj = B.project(j, [(col(6), "y"), (cheap, "cheap"), (col(4), "price")])
+        p = B.hash_agg(proj, [(col(0), "y")],
+                       [("sum", col(1), "cheap_s"), ("sum", col(2), "all_s")],
+                       "partial")
+        f = B.hash_agg(p, [(col(0), "y")],
+                       [("sum", col(1), "cheap_s"), ("sum", col(2), "all_s")],
+                       "final")
+        frames = []
+        for p_i in range(n_map):
+            frames.extend(_drain_task(f, partition_id=p_i))
+        out = pd.concat(frames)
+        out = (out.groupby("y").agg(cheap_s=("cheap_s", "sum"),
+                                    all_s=("all_s", "sum")).reset_index())
+        return out.sort_values("y").reset_index(drop=True)
+    finally:
+        api.remove_resource("q48_fact")
+        api.remove_resource("q48_dd")
+
+
+def q48_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    m = data.store_sales.merge(data.date_dim, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    m["cheap"] = np.where(m.ss_quantity < 25, m.ss_ext_sales_price, 0.0)
+    out = (m.groupby("d_year")
+           .agg(cheap_s=("cheap", "sum"), all_s=("ss_ext_sales_price", "sum"))
+           .reset_index().rename(columns={"d_year": "y"}))
+    return out.sort_values("y").reset_index(drop=True)
+
+
+def run_q88_class(data: TpcdsData) -> pd.DataFrame:
+    """UNION of three filtered scans (quantity bands), counted per band."""
+    fact_schema = _schema_of(data.store_sales)
+    api.put_resource("q88_fact", to_batches(data.store_sales, 1))
+    try:
+        scan = B.memory_scan(fact_schema, "q88_fact")
+        bands = [(0, 20), (20, 60), (60, 100)]
+        branches = []
+        for bi, (lo, hi) in enumerate(bands):
+            flt = B.filter_(scan, [BinaryOp("gteq", col(3), lit(lo)),
+                                   BinaryOp("lt", col(3), lit(hi))])
+            branches.append(B.project(flt, [(lit(bi), "band"), (col(4), "price")]))
+        u = B.union(branches)
+        p = B.hash_agg(u, [(col(0), "band")],
+                       [("count_star", None, "c"), ("sum", col(1), "s")], "partial")
+        f = B.hash_agg(p, [(col(0), "band")],
+                       [("count_star", None, "c"), ("sum", col(1), "s")], "final")
+        out = pd.concat(_drain_task(f))
+        return out.sort_values("band").reset_index(drop=True)
+    finally:
+        api.remove_resource("q88_fact")
+
+
+def q88_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    rows = []
+    for bi, (lo, hi) in enumerate([(0, 20), (20, 60), (60, 100)]):
+        m = data.store_sales[(data.store_sales.ss_quantity >= lo)
+                             & (data.store_sales.ss_quantity < hi)]
+        rows.append({"band": bi, "c": np.int64(len(m)),
+                     "s": m.ss_ext_sales_price.sum()})
+    return pd.DataFrame(rows)
+
+
+def run_q37_class(data: TpcdsData) -> pd.DataFrame:
+    """IN-subquery as semi join: sales of items whose category IN (1,2,3)."""
+    from auron_tpu.exprs.ir import In, Literal
+
+    fact_schema = _schema_of(data.store_sales)
+    it_schema = _schema_of(data.item)
+    api.put_resource("q37_fact", to_batches(data.store_sales, 1))
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    api.put_resource("q37_item", [it])
+    try:
+        cats = In(col(2), tuple(Literal(v, T.INT32) for v in (1, 2, 3)))
+        good = B.filter_(B.memory_scan(it_schema, "q37_item"), [cats])
+        semi = B.hash_join(B.memory_scan(fact_schema, "q37_fact"), good,
+                           [col(1)], [col(0)], "left_semi", build_side="right")
+        p = B.hash_agg(semi, [], [("count_star", None, "c"), ("sum", col(4), "s")],
+                       "partial")
+        f = B.hash_agg(p, [], [("count_star", None, "c"), ("sum", col(4), "s")],
+                       "final")
+        return pd.concat(_drain_task(f)).reset_index(drop=True)
+    finally:
+        api.remove_resource("q37_fact")
+        api.remove_resource("q37_item")
+
+
+def q37_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    good = set(data.item[data.item.i_category_id.isin([1, 2, 3])].i_item_sk)
+    keep = data.store_sales[data.store_sales.ss_item_sk.isin(good)]
+    return pd.DataFrame({"c": [np.int64(len(keep))],
+                         "s": [keep.ss_ext_sales_price.sum()]})
+
+
+def run_q51_class(data: TpcdsData) -> pd.DataFrame:
+    """Windowed join: per-item yearly revenue (broadcast date join + agg)
+    with a running total over years — window over a join output."""
+    sample = data.store_sales.iloc[:6000]
+    fact_schema = _schema_of(sample)
+    dd_schema = _schema_of(data.date_dim)
+    api.put_resource("q51_fact", [[Batch.from_arrow(
+        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    api.put_resource("q51_dd", [dd])
+    try:
+        j = B.hash_join(B.memory_scan(fact_schema, "q51_fact"),
+                        B.memory_scan(dd_schema, "q51_dd"),
+                        [col(0)], [col(0)], "inner", build_side="right")
+        proj = B.project(j, [(col(1), "item"), (col(6), "y"), (col(4), "price")])
+        p = B.hash_agg(proj, [(col(0), "item"), (col(1), "y")],
+                       [("sum", col(2), "rev")], "partial")
+        f = B.hash_agg(p, [(col(0), "item"), (col(1), "y")],
+                       [("sum", col(2), "rev")], "final")
+        w = B.window(f, [col(0)], [(col(1), SortSpec())],
+                     [("agg", "sum", col(2), 1, False, "run_rev")])
+        out = pd.concat(_drain_task(w))
+        return out.sort_values(["item", "y"]).reset_index(drop=True)
+    finally:
+        api.remove_resource("q51_fact")
+        api.remove_resource("q51_dd")
+
+
+def q51_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    sample = data.store_sales.iloc[:6000]
+    m = sample.merge(data.date_dim, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    g = (m.groupby(["ss_item_sk", "d_year"])
+         .agg(rev=("ss_ext_sales_price", "sum")).reset_index())
+    g.columns = ["item", "y", "rev"]
+    g = g.sort_values(["item", "y"], kind="stable")
+    g["run_rev"] = g.groupby("item")["rev"].cumsum()
+    return g.reset_index(drop=True)
+
+
+def run_q23_class(data: TpcdsData) -> pd.DataFrame:
+    """Grouped top-k: top-3 brands by revenue within each category —
+    window rank over an aggregated broadcast-join stream."""
+    fact_schema = _schema_of(data.store_sales)
+    it_schema = _schema_of(data.item)
+    api.put_resource("q23_fact", to_batches(data.store_sales, 1))
+    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    api.put_resource("q23_item", [it])
+    try:
+        j = B.hash_join(B.memory_scan(fact_schema, "q23_fact"),
+                        B.memory_scan(it_schema, "q23_item"),
+                        [col(1)], [col(0)], "inner", build_side="right")
+        proj = B.project(j, [(col(7), "cat"), (col(6), "brand"), (col(4), "price")])
+        p = B.hash_agg(proj, [(col(0), "cat"), (col(1), "brand")],
+                       [("sum", col(2), "rev")], "partial")
+        f = B.hash_agg(p, [(col(0), "cat"), (col(1), "brand")],
+                       [("sum", col(2), "rev")], "final")
+        w = B.window(f, [col(0)], [(col(2), SortSpec(asc=False)), (col(1), SortSpec())],
+                     [("rank", None, None, 1, False, "rk")])
+        out = pd.concat(_drain_task(w))
+        out = out[out.rk <= 3]
+        return out.sort_values(["cat", "rk", "brand"]).reset_index(drop=True)
+    finally:
+        api.remove_resource("q23_fact")
+        api.remove_resource("q23_item")
+
+
+def q23_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    m = data.store_sales.merge(data.item, left_on="ss_item_sk", right_on="i_item_sk")
+    g = (m.groupby(["i_category_id", "i_brand_id"])
+         .agg(rev=("ss_ext_sales_price", "sum")).reset_index())
+    g.columns = ["cat", "brand", "rev"]
+    g = g.sort_values(["cat", "rev", "brand"], ascending=[True, False, True],
+                      kind="stable")
+    # the plan ranks by (rev DESC, brand ASC) where (cat, brand) is the group
+    # key: every row is its own peer group, so rank == row_number — mirror
+    # that exactly (a min-rank over rev alone would tie-flake the gate)
+    g["rk"] = g.groupby("cat").cumcount() + 1
+    out = g[g.rk <= 3]
+    return out.sort_values(["cat", "rk", "brand"]).reset_index(drop=True)
+
+
+def run_q16_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.DataFrame:
+    """Anti join after a shuffle: rows of customers with no high-value
+    purchase (price > 400) anywhere, counted — NOT-EXISTS over the
+    co-partitioned stream."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q16_")
+    os.makedirs(work, exist_ok=True)
+    fact_schema = _schema_of(data.store_sales)
+    api.put_resource("q16_fact", to_batches(data.store_sales, n_map))
+    try:
+        scan = B.memory_scan(fact_schema, "q16_fact")
+        read = _shuffle_stage(scan, fact_schema, [2], n_map, n_reduce, work, "q16_ex0", 1)
+        high = B.filter_(read, [BinaryOp("gt", col(4), lit(400.0))])
+        high_c = B.project(high, [(col(2), "hc")])
+        anti = B.hash_join(read, high_c, [col(2)], [col(0)], "left_anti",
+                           build_side="right")
+        p = B.hash_agg(anti, [], [("count_star", None, "c")], "partial")
+        f = B.hash_agg(p, [], [("count_star", None, "c")], "final")
+        frames = []
+        for pi in range(n_reduce):
+            frames.extend(_drain_task(f, stage_id=2, partition_id=pi))
+        out = pd.concat(frames)
+        return pd.DataFrame({"c": [np.int64(out["c"].sum())]})
+    finally:
+        api.remove_resource("q16_fact")
+        api.remove_resource("q16_ex0")
+
+
+def q16_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    ss = data.store_sales
+    bad = set(ss[ss.ss_ext_sales_price > 400.0].ss_customer_sk.dropna())
+    keep = ss[~ss.ss_customer_sk.isin(bad)]
+    return pd.DataFrame({"c": [np.int64(len(keep))]})
+
+
+def run_q65_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.DataFrame:
+    """Join of two aggregated subqueries: per-item avg and max price arrive
+    over TWO separate shuffles into one join stage."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q65_")
+    os.makedirs(work, exist_ok=True)
+    fact_schema = _schema_of(data.store_sales)
+    api.put_resource("q65_fact", to_batches(data.store_sales, n_map))
+    try:
+        scan = B.memory_scan(fact_schema, "q65_fact")
+        pa_avg = B.hash_agg(scan, [(col(1), "i")], [("avg", col(4), "a")], "partial")
+        read_a = _shuffle_stage(pa_avg, _agg_inter_schema(pa_avg), [0],
+                                n_map, n_reduce, work, "q65_exA", 1)
+        fin_a = B.hash_agg(read_a, [(col(0), "i")], [("avg", col(4), "a")], "final")
+
+        pa_max = B.hash_agg(scan, [(col(1), "i")], [("max", col(4), "m")], "partial")
+        read_b = _shuffle_stage(pa_max, _agg_inter_schema(pa_max), [0],
+                                n_map, n_reduce, work, "q65_exB", 2)
+        fin_b = B.hash_agg(read_b, [(col(0), "i")], [("max", col(4), "m")], "final")
+
+        j = B.hash_join(fin_a, fin_b, [col(0)], [col(0)], "inner",
+                        build_side="right")
+        flt = B.filter_(j, [BinaryOp("gt", col(3), BinaryOp("mul", col(1), lit(2.0)))])
+        frames = []
+        for pi in range(n_reduce):
+            frames.extend(_drain_task(flt, stage_id=3, partition_id=pi))
+        cols = ["i", "a", "i2", "m"]
+        out = (pd.concat(frames) if frames else
+               pd.DataFrame(columns=cols))
+        out.columns = cols
+        return out[["i", "a", "m"]].sort_values("i").reset_index(drop=True)
+    finally:
+        for k in ("q65_fact", "q65_exA", "q65_exB"):
+            api.remove_resource(k)
+
+
+def q65_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    g = (data.store_sales.groupby("ss_item_sk")
+         .agg(a=("ss_ext_sales_price", "mean"), m=("ss_ext_sales_price", "max"))
+         .reset_index().rename(columns={"ss_item_sk": "i"}))
+    out = g[g.m > 2.0 * g.a]
+    return out.sort_values("i").reset_index(drop=True)
+
+
+def run_q5_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.DataFrame:
+    """UNION of two separately-shuffled streams re-aggregated together:
+    cheap and expensive sales flow through different exchanges."""
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q5_")
+    os.makedirs(work, exist_ok=True)
+    fact_schema = _schema_of(data.store_sales)
+    api.put_resource("q5_fact", to_batches(data.store_sales, n_map))
+    try:
+        scan = B.memory_scan(fact_schema, "q5_fact")
+        cheap = B.filter_(scan, [BinaryOp("lteq", col(4), lit(50.0))])
+        pricey = B.filter_(scan, [BinaryOp("gt", col(4), lit(50.0))])
+        read_a = _shuffle_stage(cheap, fact_schema, [1], n_map, n_reduce,
+                                work, "q5_exA", 1)
+        read_b = _shuffle_stage(pricey, fact_schema, [1], n_map, n_reduce,
+                                work, "q5_exB", 2)
+        u = B.union([read_a, read_b])
+        p = B.hash_agg(u, [(col(1), "i")],
+                       [("count_star", None, "c"), ("sum", col(4), "s")], "partial")
+        f = B.hash_agg(p, [(col(1), "i")],
+                       [("count_star", None, "c"), ("sum", col(4), "s")], "final")
+        frames = []
+        for pi in range(n_reduce):
+            frames.extend(_drain_task(f, stage_id=3, partition_id=pi))
+        out = pd.concat(frames)
+        return out.sort_values("i").reset_index(drop=True)
+    finally:
+        for k in ("q5_fact", "q5_exA", "q5_exB"):
+            api.remove_resource(k)
+
+
+def q5_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    g = (data.store_sales.groupby("ss_item_sk")
+         .agg(c=("ss_ext_sales_price", "size"), s=("ss_ext_sales_price", "sum"))
+         .reset_index().rename(columns={"ss_item_sk": "i"}))
+    g["c"] = g["c"].astype(np.int64)
+    return g.sort_values("i").reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
 # the gate runner (QueryRunner + QueryResultComparator analog)
 # ---------------------------------------------------------------------------
 
@@ -936,6 +1402,25 @@ def run_gate(sf: float = 0.05, seed: int = 42, verbose: bool = True):
                                        windowed2_class_oracle(data))),
         ("generate_explode", lambda: (run_generate_class(data),
                                       generate_class_oracle(data))),
+        ("q14_distinct_two_shuffles", lambda: (
+            run_q14_class(data, work_dir=os.path.join(ws, "q14")),
+            q14_class_oracle(data))),
+        ("q67_rollup_expand", lambda: (run_q67_class(data), q67_class_oracle(data))),
+        ("q9_scalar_subquery", lambda: (run_q9_class(data), q9_class_oracle(data))),
+        ("q48_case_when_agg", lambda: (run_q48_class(data), q48_class_oracle(data))),
+        ("q88_union_bands", lambda: (run_q88_class(data), q88_class_oracle(data))),
+        ("q37_in_subquery_semi", lambda: (run_q37_class(data), q37_class_oracle(data))),
+        ("q51_window_over_join", lambda: (run_q51_class(data), q51_class_oracle(data))),
+        ("q23_grouped_topk", lambda: (run_q23_class(data), q23_class_oracle(data))),
+        ("q16_anti_after_shuffle", lambda: (
+            run_q16_class(data, work_dir=os.path.join(ws, "q16")),
+            q16_class_oracle(data))),
+        ("q65_two_shuffle_join_stage", lambda: (
+            run_q65_class(data, work_dir=os.path.join(ws, "q65")),
+            q65_class_oracle(data))),
+        ("q5_union_two_shuffles", lambda: (
+            run_q5_class(data, work_dir=os.path.join(ws, "q5")),
+            q5_class_oracle(data))),
     ]
     results = []
     for name, fn in cases:

@@ -179,6 +179,16 @@ def union(children: list[pb.PhysicalPlanNode]) -> pb.PhysicalPlanNode:
     return _wrap(union=pb.UnionNode(children=children))
 
 
+def expand(child, projections: list[list[ir.Expr]], names: list[str]) -> pb.PhysicalPlanNode:
+    """ROLLUP/CUBE lowering: one output batch per projection per input."""
+    n = pb.ExpandNode(child=child, names=names)
+    for proj in projections:
+        p = n.projections.add()
+        for e in proj:
+            p.exprs.append(expr_to_proto(e))
+    return _wrap(expand=n)
+
+
 def hash_agg(child: pb.PhysicalPlanNode, groupings: list[tuple[ir.Expr, str]],
              aggs: list[tuple], mode: str) -> pb.PhysicalPlanNode:
     """aggs: (func, expr, name) or (func, expr, name, udaf_name) tuples."""

@@ -147,3 +147,101 @@ def test_q18_plan_stability_golden(data, tmp_path):
         [("avg", col(2), "q_avg"), ("sum", col(3), "p_sum")], "partial"))
     golden = _os.path.join(_os.path.dirname(__file__), "goldens", "q18_map_plan.txt")
     check_stability(plan_from_proto(partial), golden)
+
+
+def _golden(name):
+    import os as _os
+
+    return _os.path.join(_os.path.dirname(__file__), "goldens", name)
+
+
+def test_new_classes_match_oracles(data):
+    for run, oracle in [
+        (tpcds.run_q67_class, tpcds.q67_class_oracle),
+        (tpcds.run_q9_class, tpcds.q9_class_oracle),
+        (tpcds.run_q88_class, tpcds.q88_class_oracle),
+        (tpcds.run_q37_class, tpcds.q37_class_oracle),
+        (tpcds.run_q23_class, tpcds.q23_class_oracle),
+    ]:
+        got, want = run(data), oracle(data)
+        assert tpcds._cmp_frames(got, want) is None, run.__name__
+
+
+def test_q67_rollup_plan_golden(data):
+    from auron_tpu.exprs.ir import Literal, col
+    from auron_tpu.plan import builders as B
+    from auron_tpu.plan.explain import check_stability
+    from auron_tpu.plan.optimizer import prune_columns
+    from auron_tpu.plan.planner import plan_from_proto
+    from auron_tpu import types as T
+
+    fact_schema = tpcds._schema_of(data.store_sales)
+    scan = B.memory_scan(fact_schema, "g_fact")
+    null_i64 = Literal(None, T.INT64)
+    ex = B.expand(scan, [
+        [col(0), col(1), col(4), tpcds.lit(0)],
+        [col(0), null_i64, col(4), tpcds.lit(1)],
+        [null_i64, null_i64, col(4), tpcds.lit(3)],
+    ], ["d", "i", "price", "gid"])
+    p = prune_columns(B.hash_agg(
+        ex, [(col(0), "d"), (col(1), "i"), (col(3), "gid")],
+        [("sum", col(2), "s")], "partial"))
+    check_stability(plan_from_proto(p), _golden("q67_rollup_plan.txt"))
+
+
+def test_q23_window_topk_plan_golden(data):
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.ops.sortkeys import SortSpec
+    from auron_tpu.plan import builders as B
+    from auron_tpu.plan.explain import check_stability
+    from auron_tpu.plan.optimizer import prune_columns
+    from auron_tpu.plan.planner import plan_from_proto
+
+    fact_schema = tpcds._schema_of(data.store_sales)
+    it_schema = tpcds._schema_of(data.item)
+    j = B.hash_join(B.memory_scan(fact_schema, "g_fact"),
+                    B.memory_scan(it_schema, "g_item"),
+                    [col(1)], [col(0)], "inner", build_side="right")
+    proj = B.project(j, [(col(7), "cat"), (col(6), "brand"), (col(4), "price")])
+    p = B.hash_agg(proj, [(col(0), "cat"), (col(1), "brand")],
+                   [("sum", col(2), "rev")], "partial")
+    f = B.hash_agg(p, [(col(0), "cat"), (col(1), "brand")],
+                   [("sum", col(2), "rev")], "final")
+    w = prune_columns(B.window(
+        f, [col(0)], [(col(2), SortSpec(asc=False)), (col(1), SortSpec())],
+        [("rank", None, None, 1, False, "rk")]))
+    check_stability(plan_from_proto(w), _golden("q23_window_topk_plan.txt"))
+
+
+def test_q14_stage1_plan_golden(data):
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.plan import builders as B
+    from auron_tpu.plan.explain import check_stability
+    from auron_tpu.plan.optimizer import prune_columns
+    from auron_tpu.plan.planner import plan_from_proto
+
+    fact_schema = tpcds._schema_of(data.store_sales)
+    dd_schema = tpcds._schema_of(data.date_dim)
+    scan = B.memory_scan(fact_schema, "g_fact")
+    j = B.hash_join(scan, B.memory_scan(dd_schema, "g_dd"),
+                    [col(0)], [col(0)], "inner", build_side="right")
+    proj = B.project(j, [(col(6), "y"), (col(1), "i")])
+    p1 = prune_columns(B.hash_agg(proj, [(col(0), "y"), (col(1), "i")],
+                                  [("count_star", None, "c")], "partial"))
+    check_stability(plan_from_proto(p1), _golden("q14_stage1_plan.txt"))
+
+
+def test_q9_scalar_subquery_plan_golden(data):
+    from auron_tpu.exprs.ir import BinaryOp, ScalarSubquery, col
+    from auron_tpu.plan import builders as B
+    from auron_tpu.plan.explain import check_stability
+    from auron_tpu.plan.optimizer import prune_columns
+    from auron_tpu.plan.planner import plan_from_proto
+    from auron_tpu import types as T
+
+    fact_schema = tpcds._schema_of(data.store_sales)
+    flt = B.filter_(B.memory_scan(fact_schema, "g_fact"),
+                    [BinaryOp("gt", col(4), ScalarSubquery("g_avg", T.FLOAT64))])
+    p = prune_columns(B.hash_agg(flt, [], [("count_star", None, "c"),
+                                           ("sum", col(4), "s")], "partial"))
+    check_stability(plan_from_proto(p), _golden("q9_scalar_plan.txt"))
