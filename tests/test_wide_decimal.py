@@ -329,12 +329,16 @@ def test_wide_decimal_literal_arithmetic_exact():
     assert rows[0]["p"] == pydec.Decimal("1e24") + 100
     assert rows[1]["p"] == pydec.Decimal("-150.5")
     assert rows[3]["d"] == pydec.Decimal("0.0001") / 4  # HALF_UP at div scale
-    # column-pair wide arithmetic still fails loudly
+    # column-pair wide arithmetic is now exact (pair-table path)
     plan2 = B.project(B.memory_scan(b.schema, "wa"),
                       [(BinaryOp("add", col(0), col(0)), "x")])
     op2 = plan_from_proto(plan2)
-    with pytest.raises(RuntimeError):
-        list(op2.execute(0, ExecutionContext(resources={"wa": [[b]]})))
+    got2 = op2.collect(
+        ctx=ExecutionContext(resources={"wa": [[b]]})
+    ).to_arrow().to_pylist()
+    assert got2[0]["x"] == pydec.Decimal("2e24")
+    assert got2[1]["x"] == pydec.Decimal("-501.0")
+    assert got2[2]["x"] is None
 
 
 def test_wide_decimal_filter_with_literal_arith():
@@ -390,3 +394,126 @@ def test_window_wide_decimal_running_sum_and_avg():
         )
     assert got[0]["av"] == want_av
     assert got[3]["av"] == pydec.Decimal("7")
+
+
+def test_wide_decimal_column_pair_arith_pipeline(tmp_path):
+    """col x col wide arithmetic through scan -> join -> agg -> window
+    (VERDICT r2 #9): price * qty over two decimal(38,x) columns, grouped
+    sums, then a running windowed sum — all EXACT vs python Decimals."""
+    from auron_tpu.exprs.ir import BinaryOp
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    rng = np.random.default_rng(5)
+    n = 300
+    price = _dec38(rng, n, scale=4)
+    qty = [pydec.Decimal(int(rng.integers(1, 50))).scaleb(-1) for _ in range(n)]
+    fk = rng.integers(0, 8, n).astype(np.int64).tolist()
+    schema = T.Schema.of(
+        T.Field("fk", T.INT64),
+        T.Field("price", T.decimal(38, 4)),
+        T.Field("qty", T.decimal(20, 1)),
+    )
+    path = str(tmp_path / "pairs.parquet")
+    pq.write_table(
+        pa.table({
+            "fk": pa.array(fk, pa.int64()),
+            "price": pa.array(price, pa.decimal128(38, 4)),
+            "qty": pa.array(qty, pa.decimal128(20, 1)),
+        }),
+        path, row_group_size=64,
+    )
+    dim = {"dk": np.arange(8, dtype=np.int64).tolist(),
+           "grp": (np.arange(8) % 2).astype(np.int64).tolist()}
+    dim_b = Batch.from_pydict(dim, schema=DIM_SCHEMA)
+
+    scan = B.parquet_scan(schema, [path])
+    j = B.hash_join(scan, B.memory_scan(DIM_SCHEMA, "wp_dim"),
+                    [col(0)], [col(0)], "inner", build_side="right")
+    ext = B.project(j, [(col(4), "grp"),
+                        (BinaryOp("mul", col(1), col(2)), "ext")])
+    aggs = [("sum", col(1), "s"), ("count", col(1), "c")]
+    partial = B.hash_agg(ext, [(col(0), "grp")], aggs, "partial")
+    final = B.hash_agg(partial, [(col(0), "grp")], aggs, "final")
+    w = B.window(final, [], [(col(0), SortSpec())],
+                 [("agg", "sum", col(1), 1, False, "run")])
+
+    op = plan_from_proto(w)
+    ctx = ExecutionContext(resources={"wp_dim": [[dim_b]]})
+    got = op.collect(ctx=ctx).to_arrow().to_pylist()
+    got = {r["grp"]: r for r in got}
+
+    # exact oracle: Spark result type of decimal(38,4)*decimal(20,1) is
+    # decimal(38, 5) after bounding; mirror the engine's declared type
+    from auron_tpu.exprs import ir as _ir
+
+    out_t = _ir.arith_result_type("mul", T.decimal(38, 4), T.decimal(20, 1))
+    q = pydec.Decimal(1).scaleb(-out_t.scale)
+    bound = pydec.Decimal(10) ** (out_t.precision - out_t.scale)
+    grp_of = dict(zip(dim["dk"], dim["grp"]))
+    want: dict = {}
+    with pydec.localcontext() as hp:
+        hp.prec = 100
+        for k, p, qv in zip(fk, price, qty):
+            v = (p * qv).quantize(q, rounding=pydec.ROUND_HALF_UP)
+            g = grp_of[k]
+            s, c = want.get(g, (pydec.Decimal(0), 0))
+            if abs(v) >= bound:
+                want[g] = (s, c)  # overflowed product -> NULL, not summed
+            else:
+                want[g] = (s + v, c + 1)
+    run = pydec.Decimal(0)
+    for g in sorted(want):
+        s, c = want[g]
+        r = got[g]
+        assert r["c"] == c, (g, r["c"], c)
+        assert pydec.Decimal(str(r["s"])) == s, (g, r["s"], s)
+        run += s
+        assert pydec.Decimal(str(r["run"])) == run, (g, r["run"], run)
+
+
+def test_wide_decimal_pair_div_mod_and_extreme_scales():
+    """wide / wide and wide % wide column pairs, plus the decimal(38,0) vs
+    decimal(38,38) comparison that overflowed the fixed word budget
+    (ADVICE r2 #3)."""
+    from auron_tpu.exprs.ir import BinaryOp
+
+    a = [pydec.Decimal("1e25"), pydec.Decimal("-7.5"), pydec.Decimal("100"), None]
+    bvals = [pydec.Decimal("3"), pydec.Decimal("2"), pydec.Decimal("0"),
+             pydec.Decimal("4")]
+    b = Batch.from_pydict(
+        {"a": a, "b": bvals},
+        schema=T.Schema.of(T.Field("a", T.decimal(38, 4)),
+                           T.Field("b", T.decimal(20, 4))),
+    )
+    plan = B.project(B.memory_scan(b.schema, "wdm"), [
+        (BinaryOp("div", col(0), col(1)), "d"),
+        (BinaryOp("mod", col(0), col(1)), "m"),
+    ])
+    op = plan_from_proto(plan)
+    got = op.collect(ctx=ExecutionContext(resources={"wdm": [[b]]})).to_arrow().to_pylist()
+    from auron_tpu.exprs import ir as _ir
+
+    dt = _ir.arith_result_type("div", T.decimal(38, 4), T.decimal(20, 4))
+    qd = pydec.Decimal(1).scaleb(-dt.scale)
+    with pydec.localcontext() as hp:
+        hp.prec = 100
+        assert got[0]["d"] == (a[0] / bvals[0]).quantize(qd, rounding=pydec.ROUND_HALF_UP)
+        assert got[1]["d"] == pydec.Decimal("-3.75")
+    assert got[2]["d"] is None  # div by zero -> NULL
+    assert got[3]["d"] is None  # NULL operand
+    assert got[1]["m"] == pydec.Decimal("-1.5")  # sign of the dividend
+    assert got[2]["m"] is None
+
+    # extreme scale-spread comparison no longer overflows
+    wide0 = Batch.from_pydict(
+        {"x": [pydec.Decimal(10) ** 37, pydec.Decimal(1)],
+         "y": [pydec.Decimal("0." + "9" * 38), pydec.Decimal("0.5")]},
+        schema=T.Schema.of(T.Field("x", T.decimal(38, 0)),
+                           T.Field("y", T.decimal(38, 38))),
+    )
+    cmp_plan = B.project(B.memory_scan(wide0.schema, "wcmp"), [
+        (BinaryOp("gt", col(0), col(1)), "g"),
+    ])
+    op2 = plan_from_proto(cmp_plan)
+    got2 = op2.collect(ctx=ExecutionContext(resources={"wcmp": [[wide0]]})).to_arrow().to_pylist()
+    assert got2[0]["g"] is True and got2[1]["g"] is True
