@@ -45,7 +45,19 @@ from auron_tpu.convert.converters import (
     convert_plan,
 )
 from auron_tpu.convert.hostplan import HostNode
-from auron_tpu.convert.stages import split_stages
+from auron_tpu.convert.stages import ffi_reader_ids, split_stages
+
+# Conversion counter + pid salt: namespaces stage exchange ids so queries
+# converted concurrently (same engine process) or by different driver
+# processes feeding one executor can never collide on reduce-side shuffle
+# resource keys.
+_conversion_seq = __import__("itertools").count()
+
+
+def _namespace() -> str:
+    import os
+
+    return f"c{os.getpid()}_{next(_conversion_seq)}_"
 
 
 def convert_host_plan_json(payload: bytes | str) -> bytes:
@@ -81,14 +93,32 @@ def _response(res: ConversionResult) -> dict:
         my_abs = paths.get(id(host_of(n)), [])
         if isinstance(n, NativeSegment):
             any_native[0] = True
+            segment_rids = {rid for rid, _ in n.inputs}
+            namespace = _namespace()
             stages = [
                 {
                     "plan_b64": base64.b64encode(s.plan.SerializeToString()).decode(),
                     "exchange_id": s.exchange_id,
                     "num_output_partitions": s.num_output_partitions,
                     "input_exchange_ids": s.input_exchange_ids,
+                    # {work_dir}/{partition} placeholders: the host derives
+                    # task shuffle-file paths (and the reduce manifest) by
+                    # string substitution only — it never parses plan protos
+                    "output_data_template": s.data_template,
+                    "output_index_template": s.index_template,
+                    # which of the segment's FFI inputs feed THIS stage: the
+                    # host must run the stage's tasks over those children's
+                    # partitions and register "rid.pid" batch resources
+                    "ffi_input_ids": [
+                        r for r in ffi_reader_ids(s.plan) if r in segment_rids
+                    ],
+                    # per-stage scan pinning: a stage whose plan carries
+                    # host-decided file groups must run exactly that many
+                    # tasks (segment-level task_partitions is the max, kept
+                    # for single-stage splicers)
+                    "task_partitions": _pinned_task_partitions(s.plan),
                 }
-                for s in split_stages(n.plan)
+                for s in split_stages(n.plan, namespace=namespace)
             ]
             return {
                 "kind": "segment",

@@ -51,10 +51,47 @@ class StageSpec:
     def is_final(self) -> bool:
         return self.exchange_id is None
 
+    @property
+    def data_template(self) -> str | None:
+        """Shuffle data-file path template with {work_dir}/{partition}
+        placeholders — the host computes task file paths by plain string
+        substitution, never touching the plan proto (TaskDefs contract)."""
+        if self.exchange_id is None:
+            return None
+        return DATA_TEMPLATE.replace("{exchange_id}", self.exchange_id)
 
-def split_stages(plan: pb.PhysicalPlanNode) -> list[StageSpec]:
+    @property
+    def index_template(self) -> str | None:
+        if self.exchange_id is None:
+            return None
+        return INDEX_TEMPLATE.replace("{exchange_id}", self.exchange_id)
+
+
+def ffi_reader_ids(plan: pb.PhysicalPlanNode) -> list[str]:
+    """Resource ids of every ffi_reader in a plan subtree (dedup, in
+    tree order) — tells a host which segment inputs feed which stage."""
+    out: list[str] = []
+
+    def rec(node: pb.PhysicalPlanNode) -> None:
+        if node.WhichOneof("plan") == "ffi_reader":
+            rid = node.ffi_reader.resource_id
+            if rid not in out:
+                out.append(rid)
+        for c in child_nodes(node):
+            rec(c)
+
+    rec(plan)
+    return out
+
+
+def split_stages(
+    plan: pb.PhysicalPlanNode, namespace: str = ""
+) -> list[StageSpec]:
     """Decompose a plan with mesh_exchange nodes into host-schedulable
-    stages, producers before consumers (post-order)."""
+    stages, producers before consumers (post-order). ``namespace``
+    prefixes every exchange id (writer paths AND reader resource ids) so
+    concurrent conversions in one engine process can't collide on
+    executor-side resource keys."""
     stages: list[StageSpec] = []
     counter = [0]
 
@@ -64,7 +101,9 @@ def split_stages(plan: pb.PhysicalPlanNode) -> list[StageSpec]:
             ex = node.mesh_exchange
             child_inputs: list[str] = []
             child = rewrite(ex.child, child_inputs)
-            ex_id = ex.exchange_id or f"__stage_exchange_{counter[0]}"
+            ex_id = namespace + (
+                ex.exchange_id or f"__stage_exchange_{counter[0]}"
+            )
             counter[0] += 1
             writer = pb.PhysicalPlanNode(
                 shuffle_writer=pb.ShuffleWriterNode(

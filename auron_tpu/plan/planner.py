@@ -28,9 +28,30 @@ class ResourceScanExec(ExecOperator):
         self.resource_id = resource_id
 
     def _execute(self, partition: int, ctx: ExecutionContext):
-        source = ctx.resources[self.resource_id]
-        parts = source(partition) if callable(source) else source[partition]
-        yield from parts
+        # per-partition form first ("rid.pid" — what a per-task host
+        # executor registers; the payload IS this partition's stream),
+        # then the shared per-partition-indexed source
+        parts = ctx.resources.get(f"{self.resource_id}.{partition}")
+        if parts is None:
+            source = ctx.resources[self.resource_id]
+            import pyarrow as _pa
+
+            if callable(source):
+                parts = source(partition)
+            elif source and isinstance(source[0], _pa.RecordBatch):
+                # flat RecordBatch list — the unambiguous C-ABI host form
+                # (put_resource decodes one IPC payload per task); every
+                # other shape keeps the per-partition indexing semantics
+                parts = source
+            else:
+                parts = source[partition]
+        from auron_tpu.columnar.batch import Batch as _B
+
+        for b in parts:
+            if isinstance(b, _B):
+                yield b
+            elif b.num_rows:
+                yield _B.from_arrow(b)
 
 # ---------------------------------------------------------------------------
 # types
@@ -494,6 +515,39 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
 
 def task_from_proto(task: pb.TaskDefinition):
     """Returns (root exec, stage_id, partition_id, Configuration)."""
+    _resolve_shuffle_templates(task)
     plan = plan_from_proto(task.plan)
     conf = Configuration(dict(task.conf))
     return plan, task.stage_id, task.partition_id, conf
+
+
+def _resolve_shuffle_templates(task: pb.TaskDefinition) -> None:
+    """Fill {work_dir}/{partition} placeholders in shuffle-writer paths from
+    the task conf + partition id. Lets a host assemble stage tasks from the
+    conversion service's per-stage plan template with byte-level surgery
+    only (TaskDefs appends partition_id + conf; it never edits nested plan
+    strings) — the host computes the same paths from the stage's
+    output_*_template fields to commit/fetch map outputs."""
+    from auron_tpu.plan.protowalk import child_nodes
+
+    work_dir = task.conf.get("auron.work_dir", "")
+
+    def rec(node: pb.PhysicalPlanNode) -> None:
+        if node.WhichOneof("plan") == "shuffle_writer":
+            w = node.shuffle_writer
+            for attr in ("output_data_file", "output_index_file"):
+                v = getattr(w, attr)
+                if "{work_dir}" in v or "{partition}" in v:
+                    if "{work_dir}" in v and not work_dir:
+                        raise ValueError(
+                            "shuffle path template needs task conf auron.work_dir"
+                        )
+                    setattr(
+                        w, attr,
+                        v.replace("{work_dir}", work_dir)
+                        .replace("{partition}", str(task.partition_id)),
+                    )
+        for c in child_nodes(node):
+            rec(c)
+
+    rec(task.plan)

@@ -95,56 +95,104 @@ object NativeSegmentSplicer {
 
   /** plan: the Spark subtree this segment covers (segRoot itself). */
   private def segmentExec(plan: SparkPlan, seg: JValue): SparkPlan = {
-    val planB64 = (seg \ "plan_b64") match {
-      case JString(s) => s
-      case _ => return plan
+    // any malformed stage entry (missing plan_b64, bad base64) bails the
+    // whole segment to host execution — never a partial stage list
+    val stages = try parseStages(seg \ "stages") catch {
+      case _: Throwable => return plan
     }
-    val stages = (seg \ "stages") match {
-      case JArray(ss) => ss
-      case _ => Nil
-    }
-    // multi-stage segments (mesh_exchange inside) need the host's stage
-    // scheduler wired through the ShuffleManager contract; splicing them
-    // as one task would fail at plan_from_proto. Until the Spark shuffle
-    // integration lands, leave those subtrees on the host.
-    if (stages.length > 1) return plan
-    val template = java.util.Base64.getDecoder.decode(planB64)
-    val inputs = (seg \ "inputs") match {
+    if (stages.isEmpty || stages.exists(_.planProto.isEmpty)) return plan
+    // FFI boundary children: each keeps running on Spark (recursively
+    // spliced); paths are relative to THIS segment's root
+    val ffiInputs = ((seg \ "inputs") match {
       case JArray(is) => is
       case _ => Nil
-    }
-    // one FFI boundary is supported operator-side (NativeSegmentExec);
-    // multi-input segments fall back to the host plan for now
-    if (inputs.length > 1) return plan
-    val ffi = inputs.headOption.map { i =>
+    }).map { i =>
       val JString(rid) = (i \ "resource_id"): @unchecked
-      // the boundary child keeps running on Spark (recursively spliced);
-      // its path is relative to THIS segment's root
       val childJson = i \ "child"
       val childPlan = navigate(plan, pathOf(childJson))
-      (rid, spliceNode(childPlan, childJson))
+      FfiInput(rid, spliceNode(childPlan, childJson))
     }
-    // scan file placement pins the task count (service task_partitions);
-    // ignoring it would silently drop file groups
-    val pinnedParts = (seg \ "task_partitions") match {
-      case JInt(n) => Some(n.toInt)
-      case _ => None
+    // zipPartitions supports at most 4 streamed inputs per stage
+    if (stages.exists(_.ffiInputIds.length > 4)) return plan
+    // a pinned scan AND an FFI child in the SAME stage cannot both
+    // dictate the task count — leave such segments on the host rather
+    // than risk dropping file groups or mis-aligning the boundary stream
+    if (stages.exists(s => s.taskPartitions.nonEmpty && s.ffiInputIds.nonEmpty))
+      return plan
+    // likewise an input exchange (width = producer's reduce count) and an
+    // FFI child or pinned scan cannot both dictate one stage's width:
+    // mismatch would silently drop reduce partitions — host execution is
+    // the safe path
+    if (stages.exists(s => s.inputExchangeIds.nonEmpty
+        && (s.ffiInputIds.nonEmpty || s.taskPartitions.nonEmpty)))
+      return plan
+    // all FFI children feeding one stage must be co-partitioned; 0 means
+    // UnknownPartitioning, which only the runtime can size (zipPartitions
+    // still throws on a true mismatch there)
+    if (stages.exists { s =>
+          val widths = s.ffiInputIds
+            .flatMap(id => ffiInputs.find(_.resourceId == id))
+            .map(_.child.outputPartitioning.numPartitions)
+            .filter(_ > 0).distinct
+          widths.length > 1
+        }) return plan
+
+    if (stages.length == 1) {
+      val s = stages.head
+      val template = s.planProto
+      val taskOf: Int => Array[Byte] = pid => TaskDefs.assemble(template, pid, Nil)
+      NativeSegmentExec(
+        plan.output, taskOf,
+        ffiInputs,
+        s.taskPartitions)
+    } else {
+      // multi-stage: host-scheduled stage execution over the shuffle-
+      // manifest contract (NativeShuffleExchangeBase.scala:124-296 analog)
+      val root = org.apache.spark.sql.internal.SQLConf.get.getConfString(
+        "spark.auron_tpu.work_dir", System.getProperty("java.io.tmpdir"))
+      val workDir = root + "/auron-" + java.util.UUID.randomUUID().toString
+      NativeStagedSegmentExec(plan.output, stages, ffiInputs, workDir)
     }
-    // a pinned scan AND an FFI child cannot both dictate the partition
-    // count — leave such segments on the host rather than risk dropping
-    // file groups or mis-aligning the boundary stream
-    if (pinnedParts.nonEmpty && ffi.nonEmpty) return plan
-    // the engine's FFIReaderExec prefers the per-partition resource form
-    // "rid.pid" (what NativeSegmentExec registers), so the template needs
-    // only the partition id stamped per task
-    val taskOf: Int => Array[Byte] =
-      pid => TaskDefs.withPartition(template, pid)
-    NativeSegmentExec(
-      plan.output,
-      taskOf,
-      ffi.map(_._1),
-      ffi.map(_._2),
-      pinnedParts)
+  }
+
+  private def parseStages(v: JValue): Seq[StageDesc] = v match {
+    case JArray(ss) =>
+      ss.map { s =>
+        StageDesc(
+          planProto = (s \ "plan_b64") match {
+            case JString(b) => java.util.Base64.getDecoder.decode(b)
+            case _ => Array.emptyByteArray
+          },
+          exchangeId = (s \ "exchange_id") match {
+            case JString(e) => Some(e)
+            case _ => None
+          },
+          numOutputPartitions = (s \ "num_output_partitions") match {
+            case JInt(n) => Some(n.toInt)
+            case _ => None
+          },
+          inputExchangeIds = (s \ "input_exchange_ids") match {
+            case JArray(xs) => xs.collect { case JString(x) => x }
+            case _ => Nil
+          },
+          ffiInputIds = (s \ "ffi_input_ids") match {
+            case JArray(xs) => xs.collect { case JString(x) => x }
+            case _ => Nil
+          },
+          dataTemplate = (s \ "output_data_template") match {
+            case JString(t) => Some(t)
+            case _ => None
+          },
+          indexTemplate = (s \ "output_index_template") match {
+            case JString(t) => Some(t)
+            case _ => None
+          },
+          taskPartitions = (s \ "task_partitions") match {
+            case JInt(n) => Some(n.toInt)
+            case _ => None
+          })
+      }
+    case _ => Nil
   }
 
   private def pathOf(node: JValue): List[Int] = (node \ "path") match {
@@ -168,11 +216,19 @@ object NativeSegmentSplicer {
 }
 
 /** TaskDefinition assembly: wrap the engine's plan-proto template with the
- * per-task partition id. The protobuf surgery uses the lightweight
- * wire-format (field 1 = plan message, field 3 = partition_id varint) to
- * avoid a generated-proto dependency. */
+ * per-task partition id and conf entries. The protobuf surgery uses the
+ * lightweight wire-format (TaskDefinition: field 1 = plan message, field 3
+ * = partition_id varint, field 4 = conf map entries {1: key, 2: value}) to
+ * avoid a generated-proto dependency. The engine resolves {work_dir}/
+ * {partition} placeholders in shuffle-writer paths from the conf + stamped
+ * partition id (plan/planner.py _resolve_shuffle_templates), so this never
+ * edits strings nested inside the plan message. */
 object TaskDefs {
-  def withPartition(planProto: Array[Byte], partitionId: Int): Array[Byte] = {
+  def withPartition(planProto: Array[Byte], partitionId: Int): Array[Byte] =
+    assemble(planProto, partitionId, Nil)
+
+  def assemble(planProto: Array[Byte], partitionId: Int,
+               conf: Seq[(String, String)]): Array[Byte] = {
     val out = new java.io.ByteArrayOutputStream()
     // field 1 (plan), wire type 2 (length-delimited)
     writeVarint(out, (1 << 3) | 2)
@@ -181,6 +237,23 @@ object TaskDefs {
     // field 3 (partition_id), wire type 0
     writeVarint(out, (3 << 3) | 0)
     writeVarint(out, partitionId)
+    // field 4 (conf map<string,string>): one length-delimited entry per
+    // pair, each a nested message {field 1: key, field 2: value}
+    conf.foreach { case (k, v) =>
+      val kb = k.getBytes("UTF-8")
+      val vb = v.getBytes("UTF-8")
+      val entry = new java.io.ByteArrayOutputStream()
+      writeVarint(entry, (1 << 3) | 2)
+      writeVarint(entry, kb.length)
+      entry.write(kb)
+      writeVarint(entry, (2 << 3) | 2)
+      writeVarint(entry, vb.length)
+      entry.write(vb)
+      val eb = entry.toByteArray
+      writeVarint(out, (4 << 3) | 2)
+      writeVarint(out, eb.length)
+      out.write(eb)
+    }
     out.toByteArray
   }
 

@@ -1,10 +1,29 @@
 /*
- * The Spark physical operator executing one native segment
- * (NativeSupports/NativeRDD analog): per partition it exports FFI inputs
- * (unconvertible child output as Arrow IPC), starts the task through the
- * C ABI, and decodes the engine's Arrow IPC output stream into
- * InternalRows. Task/resource lifecycle rides Spark's task-completion
- * listener so early termination (LIMIT) still finalizes the native task.
+ * Spark physical operators executing native segments
+ * (NativeSupports/NativeRDD analog, reference
+ * spark-extension/.../NativeHelper.scala:94-165 + NativeRDD.scala:36-80):
+ *
+ *  - NativeSegmentExec: a single-stage segment. Per partition it exports
+ *    the FFI inputs (unconvertible child output as Arrow IPC, one resource
+ *    per child — multi-input segments zip the children's partitions, the
+ *    AuronConverters.scala:436-1186 whole-join-tree analog), registers
+ *    reduce-side shuffle manifests, starts the task through the C ABI and
+ *    decodes the engine's Arrow IPC output into InternalRows.
+ *
+ *  - NativeStagedSegmentExec: a multi-stage segment (mesh_exchange inside).
+ *    The host schedules the stages itself — the AuronShuffleManager /
+ *    NativeShuffleExchangeBase.scala:124-296 contract: each producer stage
+ *    runs as its own Spark job whose tasks end in a native shuffle writer;
+ *    the driver commits the (deterministic, template-derived) output files
+ *    as the exchange manifest (MapStatus analog, Shims.scala:249) and ships
+ *    it to consumer tasks through auron_put_resource_shuffle. Shuffle files
+ *    live under spark.auron_tpu.work_dir, which MUST be shared storage when
+ *    executors span machines (the reference instead rides Spark's netty
+ *    block transfer; the manifest contract keeps the engine side identical
+ *    for both transports).
+ *
+ * Task/resource lifecycle rides Spark's task-completion listener so early
+ * termination (LIMIT) still finalizes the native task.
  */
 package org.apache.spark.sql.auron_tpu
 
@@ -19,51 +38,261 @@ import org.apache.spark.sql.catalyst.expressions.{Attribute, UnsafeProjection}
 import org.apache.spark.sql.execution.SparkPlan
 import org.apache.spark.sql.util.ArrowUtils
 
+/** One FFI boundary: the engine reads resource "<resourceId>.<pid>". */
+case class FfiInput(resourceId: String, child: SparkPlan)
+
+/** One host-schedulable stage of a segment (conversion-response stage
+ * entry; see auron_tpu/convert/service.py response schema). */
+case class StageDesc(
+    planProto: Array[Byte],
+    exchangeId: Option[String],
+    numOutputPartitions: Option[Int],
+    inputExchangeIds: Seq[String],
+    ffiInputIds: Seq[String],
+    dataTemplate: Option[String],
+    indexTemplate: Option[String],
+    taskPartitions: Option[Int])
+
 /**
- * @param taskProtoPerPartition serialized TaskDefinition bytes (the
- *   engine conversion layer emits one template; the partition id is
- *   patched per task, exactly like NativeRDD's per-partition closure)
- * @param ffiInput optional (resourceId) of ONE unconvertible child whose
- *   rows stream to the engine as Arrow IPC (multi-input segments are
- *   planned engine-side as separate stages joined through exchanges)
+ * Single-stage segment operator.
+ *
+ * @param taskProtoPerPartition serialized TaskDefinition bytes (the engine
+ *   conversion layer emits one template per stage; TaskDefs stamps the
+ *   partition id + conf per task, like NativeRDD's per-partition closure)
+ * @param ffiInputs unconvertible children streaming to the engine as Arrow
+ *   IPC; all children must have equal partition counts (zipped)
  */
 case class NativeSegmentExec(
     output: Seq[Attribute],
     taskProtoPerPartition: Int => Array[Byte],
-    ffiInput: Option[String],
-    child: Option[SparkPlan],
+    ffiInputs: Seq[FfiInput],
     pinnedPartitions: Option[Int] = None)
   extends SparkPlan {
 
-  override def children: Seq[SparkPlan] = child.toSeq
+  override def children: Seq[SparkPlan] = ffiInputs.map(_.child)
 
   override protected def doExecute(): RDD[InternalRow] = {
     val out = output
-    val ffi = ffiInput
     val protoOf = taskProtoPerPartition
-    child match {
-      case Some(c) =>
-        // drive the child iterator ON the executor (no RDD capture —
-        // SPARK-5063) and hand its Arrow IPC to the engine before start
-        c.execute().mapPartitionsWithIndex { (pid, rows) =>
-          val rid = s"${ffi.get}.$pid"
-          NativeBridge.putResource(rid, ArrowIpcExport.encode(rows, c.schema))
-          segmentIterator(protoOf(pid), out, Some(rid))
-        }
-      case None =>
-        // scan file placement pins the task count; fewer tasks than file
-        // groups would silently drop data (conversion service contract)
-        val nParts = pinnedPartitions.getOrElse(1.max(conf.numShufflePartitions))
-        sparkContext.parallelize(0 until nParts, nParts).mapPartitionsWithIndex {
-          (pid, _) => segmentIterator(protoOf(pid), out, None)
-        }
+    val boundary = NativeTaskRun.boundarySpecs(ffiInputs)
+    NativeTaskRun.overInputs(this, ffiInputs, pinnedPartitions, conf) {
+      (pid, rowIters) =>
+        val keys = NativeTaskRun.registerInputs(boundary, pid, rowIters)
+        NativeTaskRun.resultIterator(protoOf(pid), out, keys, Map.empty)
     }
   }
 
-  private def segmentIterator(
+  override def withNewChildrenInternal(newChildren: IndexedSeq[SparkPlan]): SparkPlan =
+    copy(ffiInputs = ffiInputs.zip(newChildren).map { case (f, c) => f.copy(child = c) })
+}
+
+/**
+ * Multi-stage segment operator: host-scheduled stage execution.
+ *
+ * Producer stages run eagerly (one Spark job each, producers before
+ * consumers — the conversion service emits them in that order); the final
+ * stage is returned as this operator's RDD. Stage widths follow the
+ * contract: input exchanges pin the width to the producer's reduce count,
+ * else scan file groups pin it, else the FFI children's partitioning, else
+ * spark.sql.shuffle.partitions.
+ */
+case class NativeStagedSegmentExec(
+    output: Seq[Attribute],
+    stages: Seq[StageDesc],
+    ffiInputs: Seq[FfiInput],
+    workDirRoot: String)
+  extends SparkPlan {
+
+  override def children: Seq[SparkPlan] = ffiInputs.map(_.child)
+
+  private def inputsOf(s: StageDesc): Seq[FfiInput] =
+    s.ffiInputIds.flatMap(id => ffiInputs.find(_.resourceId == id))
+
+  /** exchangeId -> producing stage, for width + manifest derivation. */
+  private lazy val producerOf: Map[String, StageDesc] =
+    stages.flatMap(s => s.exchangeId.map(_ -> s)).toMap
+
+  private def widthOf(s: StageDesc): Int = {
+    if (s.inputExchangeIds.nonEmpty) {
+      // the splicer bails on exchange+FFI and exchange+pinned stages, so
+      // the exchange width is authoritative here; the requires are defense
+      // against splicer drift
+      require(s.ffiInputIds.isEmpty,
+        "stage with both input exchanges and FFI children must not splice")
+      require(s.taskPartitions.isEmpty,
+        "stage with both input exchanges and a pinned scan must not splice")
+      val widths = s.inputExchangeIds
+        .flatMap(producerOf.get).flatMap(_.numOutputPartitions).distinct
+      require(widths.length == 1,
+        s"stage input exchanges disagree on width: $widths")
+      widths.head
+    } else {
+      s.taskPartitions.getOrElse {
+        val kids = inputsOf(s)
+        if (kids.nonEmpty) kids.head.child.execute().getNumPartitions
+        else 1.max(conf.numShufflePartitions)
+      }
+    }
+  }
+
+  /** Manifest of a completed producer stage: file paths are deterministic
+   * (template substitution), so the commit is driver-side bookkeeping —
+   * the MapStatus analog without a block-manager round trip. */
+  private def manifestOf(exchangeId: String): Array[Byte] = {
+    val s = producerOf(exchangeId)
+    val width = widthOf(s)
+    val entries = (0 until width).map { pid =>
+      val d = NativeTaskRun.fillTemplate(s.dataTemplate.get, workDirRoot, pid)
+      val i = NativeTaskRun.fillTemplate(s.indexTemplate.get, workDirRoot, pid)
+      s"""{"data":${NativeTaskRun.jsonStr(d)},"index":${NativeTaskRun.jsonStr(i)}}"""
+    }
+    entries.mkString("[", ",", "]").getBytes("UTF-8")
+  }
+
+  override protected def doExecute(): RDD[InternalRow] = {
+    new java.io.File(workDirRoot).mkdirs()
+    NativeTaskRun.deleteOnExit(workDirRoot) // shuffle files live for the app
+    // producer stages, in order (service emits producers before consumers)
+    stages.init.foreach { s =>
+      val stageRdd = stageRddOf(s, drain = true)
+      stageRdd.count() // run the stage job to completion before consumers
+    }
+    stageRddOf(stages.last, drain = false)
+  }
+
+  private def stageRddOf(s: StageDesc, drain: Boolean): RDD[InternalRow] = {
+    val mans = s.inputExchangeIds.map(id => id -> manifestOf(id)).toMap
+    val workDir = workDirRoot
+    val proto = s.planProto
+    val out = if (drain) Nil else output
+    val boundary = NativeTaskRun.boundarySpecs(inputsOf(s))
+    // widthOf is the single width authority (exchange > pinned scan > FFI
+    // children > default) — manifests and task counts must agree
+    NativeTaskRun.overInputs(this, inputsOf(s), Some(widthOf(s)), conf) {
+      (pid, rowIters) =>
+        val keys = NativeTaskRun.registerInputs(boundary, pid, rowIters)
+        val task = TaskDefs.assemble(proto, pid,
+          Seq("auron.work_dir" -> workDir))
+        val it = NativeTaskRun.resultIterator(task, out, keys, mans)
+        if (drain) {
+          // writer stages emit no rows; drain to completion
+          require(!it.hasNext, "shuffle-writer stage emitted rows")
+          Iterator.empty
+        } else it
+    }
+  }
+
+  override def withNewChildrenInternal(newChildren: IndexedSeq[SparkPlan]): SparkPlan =
+    copy(ffiInputs = ffiInputs.zip(newChildren).map { case (f, c) => f.copy(child = c) })
+}
+
+/** Shared task-run machinery for segment operators. */
+object NativeTaskRun {
+
+  def fillTemplate(template: String, workDir: String, pid: Int): String =
+    template.replace("{work_dir}", workDir).replace("{partition}", pid.toString)
+
+  /** Serializable (resourceId, schema) pairs for FFI boundary children —
+   * captured once so task closures don't drag SparkPlan references. */
+  def boundarySpecs(inputs: Seq[FfiInput])
+      : Seq[(String, org.apache.spark.sql.types.StructType)] =
+    inputs.map(f => (f.resourceId, f.child.schema))
+
+  /** Export each boundary child's partition rows to the engine as an Arrow
+   * IPC resource "rid.pid"; returns the registered keys (cleaned up by
+   * resultIterator on task completion). */
+  def registerInputs(
+      boundary: Seq[(String, org.apache.spark.sql.types.StructType)],
+      pid: Int,
+      rowIters: Seq[Iterator[InternalRow]]): Seq[String] =
+    boundary.zip(rowIters).map { case ((rid, sch), rows) =>
+      val key = s"$rid.$pid"
+      NativeBridge.putResource(key, ArrowIpcExport.encode(rows, sch))
+      key
+    }
+
+  private val cleanupDirs =
+    java.util.concurrent.ConcurrentHashMap.newKeySet[String]()
+  private lazy val cleanupHook: Unit = Runtime.getRuntime.addShutdownHook(
+    new Thread(() => cleanupDirs.forEach { d =>
+      try deleteRecursively(new java.io.File(d))
+      catch { case _: Throwable => }
+    }))
+
+  /** Per-query staged-shuffle directories are retained for the app's
+   * lifetime (AQE retries / task reruns re-read them) and removed on JVM
+   * exit — the analog of Spark's shuffle-file lifecycle. */
+  def deleteOnExit(dir: String): Unit = {
+    cleanupHook
+    cleanupDirs.add(dir)
+  }
+
+  private def deleteRecursively(f: java.io.File): Unit = {
+    val kids = f.listFiles()
+    if (kids != null) kids.foreach(deleteRecursively)
+    f.delete()
+  }
+
+  def jsonStr(s: String): String =
+    "\"" + s.flatMap {
+      case '"' => "\\\""
+      case '\\' => "\\\\"
+      case c if c < ' ' => f"\\u${c.toInt}%04x"
+      case c => c.toString
+    } + "\""
+
+  /** Build the segment RDD over N zipped FFI children (0..4 supported;
+   * the splicer bails to host execution beyond that). All children must
+   * agree on partition count — Spark's zipPartitions enforces it. */
+  def overInputs(
+      plan: SparkPlan,
+      inputs: Seq[FfiInput],
+      pinnedPartitions: Option[Int],
+      conf: org.apache.spark.sql.internal.SQLConf)(
+      f: (Int, Seq[Iterator[InternalRow]]) => Iterator[InternalRow]): RDD[InternalRow] = {
+    val sc = plan.session.sparkContext
+    inputs.map(_.child.execute()) match {
+      case Seq() =>
+        val n = pinnedPartitions.getOrElse(1.max(conf.numShufflePartitions))
+        sc.parallelize(0 until n, n).mapPartitionsWithIndex {
+          (pid, _) => f(pid, Nil)
+        }
+      case Seq(a) =>
+        a.mapPartitionsWithIndex { (pid, rows) => f(pid, Seq(rows)) }
+      case Seq(a, b) =>
+        a.zipPartitions(b) { (ra, rb) =>
+          val pid = TaskContext.getPartitionId()
+          f(pid, Seq(ra, rb))
+        }
+      case Seq(a, b, c) =>
+        a.zipPartitions(b, c) { (ra, rb, rc) =>
+          val pid = TaskContext.getPartitionId()
+          f(pid, Seq(ra, rb, rc))
+        }
+      case Seq(a, b, c, d) =>
+        a.zipPartitions(b, c, d) { (ra, rb, rc, rd) =>
+          val pid = TaskContext.getPartitionId()
+          f(pid, Seq(ra, rb, rc, rd))
+        }
+      case more =>
+        throw new IllegalStateException(
+          s"unsupported FFI input count ${more.length} (splicer must bail)")
+    }
+  }
+
+  /** Start one native task and expose its output as InternalRows.
+   * Registers shuffle manifests first (call_native snapshots the resource
+   * map at start); cleans up per-task input resources on task completion.
+   * Manifest keys are SHARED by sibling reduce tasks in one executor and
+   * are never removed mid-query — removing after callNative would race a
+   * sibling between its put and its snapshot. They are tiny (file-path
+   * JSON), namespaced per conversion, and die with the process. */
+  def resultIterator(
       taskProto: Array[Byte],
       out: Seq[Attribute],
-      resource: Option[String]): Iterator[InternalRow] = {
+      inputResources: Seq[String],
+      manifests: Map[String, Array[Byte]]): Iterator[InternalRow] = {
+    manifests.foreach { case (ex, m) => NativeBridge.putResourceShuffle(ex, m) }
     val handle = NativeBridge.callNative(taskProto)
     val allocator = new RootAllocator(Long.MaxValue)
     var finalized = false
@@ -71,7 +300,9 @@ case class NativeSegmentExec(
     def cleanup(): Unit = if (!finalized) {
       finalized = true
       try NativeBridge.finalizeNative(handle) finally {
-        resource.foreach(NativeBridge.removeResource)
+        inputResources.foreach { k =>
+          try NativeBridge.removeResource(k) catch { case _: Throwable => }
+        }
         allocator.close()
       }
     }
@@ -108,9 +339,6 @@ case class NativeSegmentExec(
       override def next(): InternalRow = current.next()
     }
   }
-
-  override def withNewChildrenInternal(newChildren: IndexedSeq[SparkPlan]): SparkPlan =
-    copy(child = newChildren.headOption)
 }
 
 /** Arrow IPC stream encoding of a row iterator (ConvertToNative analog). */
