@@ -64,6 +64,11 @@ def get_resource(key: str) -> Any:
 def remove_resource(key: str) -> None:
     with _lock:
         _resources.pop(key, None)
+    # broadcast-build locks are keyed by resource id; evict with the
+    # resource so executors don't accumulate one lock per broadcast
+    from auron_tpu.exec.joins.bhj import evict_build_lock
+
+    evict_build_lock(key)
 
 
 # ---- task entry points ----

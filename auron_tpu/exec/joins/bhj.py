@@ -32,6 +32,15 @@ def _build_key_lock(key: str) -> threading.Lock:
         return lk
 
 
+def evict_build_lock(key: str) -> None:
+    """Drop the build lock for a cached_build_id. Called by the host's
+    resource-removal path (bridge/api.remove_resource) when a broadcast is
+    destroyed — without this, a long-lived executor leaks one Lock per
+    broadcast instance."""
+    with _key_locks_guard:
+        _key_locks.pop(key, None)
+
+
 class BroadcastHashJoinExec(ExecOperator):
     def __init__(
         self,
@@ -101,21 +110,26 @@ class BroadcastHashJoinExec(ExecOperator):
         return built
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
-        from auron_tpu.exec.joins.chain import try_fused_chain
+        from auron_tpu.exec.joins.chain import clear_chain_memos, try_fused_chain
 
         fused = try_fused_chain(self, partition, ctx)
         if fused is not None:
             yield from fused
             return
-        build = self._build(partition, ctx)
-        probe_child = 1 if self.build_side == "left" else 0
-        for pb in self.child_stream(probe_child, partition, ctx):
-            ctx.check_cancelled()
-            # no empty-batch pre-check: it costs a host sync per batch, and
-            # the probe itself already syncs once on the match total
-            with ctx.metrics.timer("probe_time"):
-                yield from self.driver.probe_batch(build, pb)
-        yield from self.driver.finish(build)
+        try:
+            build = self._build(partition, ctx)
+            probe_child = 1 if self.build_side == "left" else 0
+            for pb in self.child_stream(probe_child, partition, ctx):
+                ctx.check_cancelled()
+                # no empty-batch pre-check: it costs a host sync per batch,
+                # and the probe itself already syncs once on the match total
+                with ctx.metrics.timer("probe_time"):
+                    yield from self.driver.probe_batch(build, pb)
+            yield from self.driver.finish(build)
+        finally:
+            # fallback memos scope to this attempt (ADVICE r3): entries for
+            # operators never reached must not outlive the chain top
+            clear_chain_memos(self, partition, ctx)
 
 
 class ShuffledHashJoinExec(BroadcastHashJoinExec):

@@ -38,6 +38,17 @@ from auron_tpu.exec.joins import core
 from auron_tpu.exec.joins.driver import _compact_join_output_enabled
 
 
+def clear_chain_memos(top, partition: int, ctx) -> None:
+    """Drop any fallback build memos this chain stashed but never consumed
+    (an operator that raised before its _build ran leaves its entry behind).
+    Called by the chain top's per-operator path on completion."""
+    keys = ctx.resources.pop(
+        ("fusion_build_memo_keys", id(top), partition), None
+    )
+    for k in keys or ():
+        ctx.resources.pop(k, None)
+
+
 def try_fused_chain(top, partition: int, ctx) -> Iterator[Batch] | None:
     """Attempt to run `top` (a BroadcastHashJoinExec) as a fused chain.
 
@@ -157,8 +168,16 @@ def try_fused_chain(top, partition: int, ctx) -> Iterator[Batch] | None:
         b = ex._build(partition, ctx)
         builds.append(b)
         if not b.unique:
+            keys = []
             for (ex2, _), b2 in zip(links, builds):
-                ctx.resources[("fusion_build_memo", id(ex2), partition)] = b2
+                k = ("fusion_build_memo", id(ex2), partition)
+                ctx.resources[k] = b2
+                keys.append(k)
+            # scope the memo to THIS fallback attempt: the chain top clears
+            # leftovers when its per-operator execution ends, so an operator
+            # never reached (e.g. an upstream raise) can't pin prepared
+            # builds for the rest of the task's lifetime
+            ctx.resources[("fusion_build_memo_keys", id(top), partition)] = keys
             return None
 
     return _run_chain(

@@ -397,6 +397,13 @@ def spark_string_to_timestamp(s: str, default_date: _dt.date | None = None) -> i
     # the year and appears in zone offsets).
     date_part, time_part = t, ""
     for i, ch in enumerate(t):
+        if ch == "T" and i == 0:
+            # Spark's bare-time form with explicit separator ("T12:34:56"):
+            # empty date part, everything after the T is time. A bare "T"
+            # or "T<zone>" has no time body and stays invalid.
+            if len(t) > 1 and t[1].isdigit():
+                date_part, time_part = "", t[1:]
+            break
         if ch in "T " and i > 0:
             date_part, time_part = t[:i], t[i + 1 :]
             break

@@ -87,17 +87,18 @@ class Segmentation(NamedTuple):
 
 @partial(jax.jit, static_argnames=("host_sort",))
 def segment_by_keys(
-    words: list[jnp.ndarray], sel: jnp.ndarray, host_sort: bool | None = None
+    words: list[jnp.ndarray], sel: jnp.ndarray, *, host_sort: bool
 ) -> Segmentation:
-    """host_sort must be threaded in as a STATIC value by jitted callers
-    (jit caches are keyed by shapes, not config — deciding inside the trace
-    would bake a stale choice into already-compiled programs)."""
+    """host_sort is a REQUIRED static value: callers must resolve it from
+    config OUTSIDE the trace (jit caches are keyed by shapes, not config —
+    a default resolved inside the trace would bake a stale choice into
+    already-compiled programs)."""
     from auron_tpu.ops import hostsort
 
     cap = sel.shape[0]
     dead_first_key = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
     iota = jnp.arange(cap, dtype=jnp.int32)
-    if hostsort.use_host_sort() if host_sort is None else host_sort:
+    if host_sort:
         order = hostsort.order_by_words((dead_first_key, *words))
         sel_sorted = sel[order]
         sorted_words = tuple(w[order] for w in words)

@@ -120,6 +120,19 @@ def test_bare_time_uses_default_date():
     assert got == _us(2020, 5, 4, 12, 30, 45)
 
 
+def test_bare_time_with_leading_t_separator():
+    """Spark accepts 'T12:34:56' (empty date part before the separator);
+    the leading T must not be misread as a zone id (ADVICE r3)."""
+    got = C.spark_string_to_timestamp("T12:34:56", default_date=dt.date(2020, 5, 4))
+    assert got == _us(2020, 5, 4, 12, 34, 56)
+    assert C.spark_string_to_timestamp("T9:05", default_date=dt.date(2020, 5, 4)) \
+        == _us(2020, 5, 4, 9, 5, 0)
+    # a bare separator (or separator + zone) has no time body: still null
+    assert C.spark_string_to_timestamp("T") is None
+    assert C.spark_string_to_timestamp("TZ") is None
+    assert C.spark_string_to_timestamp("T+01:00") is None
+
+
 def test_region_zone_if_zoneinfo_available():
     got = C.spark_string_to_timestamp("2019-01-15 12:00:00 America/New_York")
     if got is not None:  # zoneinfo db present

@@ -216,3 +216,60 @@ def test_empty_sides(kind):
     assert got2["rv"].isna().all()
     got3 = _join(kind, LDF, RDF.iloc[0:0], INNER, [0], [0])
     assert len(got3) == 0
+
+
+def test_cached_build_lock_evicted_with_resource():
+    """Executor-shared broadcast builds mint one lock per cached_build_id;
+    the host's resource-removal path must evict the lock with the resource
+    or a long-lived executor leaks one Lock per broadcast (ADVICE r3)."""
+    from auron_tpu.bridge import api
+    from auron_tpu.exec.joins import bhj
+
+    ldf = pd.DataFrame({"k": [1, 2, 3], "lv": [10, 20, 30]})
+    rdf = pd.DataFrame({"k2": [1, 2], "rv": [5, 6]})
+    left, right = _mk(ldf), _mk(rdf)
+    op = BroadcastHashJoinExec(
+        left, right, [col(0)], [col(0)], INNER,
+        build_side="right", cached_build_id="bcast_evict_test",
+    )
+    from auron_tpu.exec.base import ExecutionContext
+
+    shared = {}
+    ctx = ExecutionContext(shared=shared)
+    got = op.collect(0, ctx).to_pandas()
+    assert len(got) == 2
+    assert "bcast_evict_test" in shared  # build cached executor-wide
+    assert "bcast_evict_test" in bhj._key_locks
+    # host destroys the broadcast -> resource AND lock must go
+    api.put_resource("bcast_evict_test", shared["bcast_evict_test"])
+    api.remove_resource("bcast_evict_test")
+    assert "bcast_evict_test" not in bhj._key_locks
+
+
+def test_fused_chain_fallback_memo_cleared_on_completion():
+    """On non-unique-build fallback the chain stashes prepared builds in
+    ctx.resources; the chain top must clear leftovers when its per-operator
+    execution ends so unreached entries can't pin batches (ADVICE r3)."""
+    from auron_tpu.exec.base import ExecutionContext
+
+    # duplicate build keys force the fused-chain fallback
+    ldf = pd.DataFrame({"k": [1, 1, 2, 3], "lv": [1, 2, 3, 4]})
+    mdf = pd.DataFrame({"k2": [1, 1, 2], "mv": [10, 11, 20]})  # dup key 1
+    rdf = pd.DataFrame({"k3": [1, 2], "rv": [100, 200]})
+    j1 = BroadcastHashJoinExec(
+        _mk(ldf), _mk(mdf), [col(0)], [col(0)], INNER, build_side="right"
+    )
+    top = BroadcastHashJoinExec(
+        j1, _mk(rdf), [col(0)], [col(0)], INNER, build_side="right"
+    )
+    ctx = ExecutionContext()
+    got = top.collect(0, ctx).to_pandas()
+    want = ldf.merge(mdf, left_on="k", right_on="k2").merge(
+        rdf, left_on="k", right_on="k3"
+    )
+    assert len(got) == len(want)
+    leftovers = [
+        k for k in ctx.resources
+        if isinstance(k, tuple) and k and str(k[0]).startswith("fusion_build_memo")
+    ]
+    assert leftovers == [], leftovers
