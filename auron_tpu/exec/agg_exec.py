@@ -826,8 +826,9 @@ class _AggTableConsumer:
             return total
 
     def spill(self) -> int:
-        """Park the merged state as a compressed disk run."""
-        from auron_tpu.memory.memmgr import DiskSpill
+        """Park the merged state as a compressed run (host-RAM tier first,
+        demoted to disk under ledger pressure — memmgr.make_spill)."""
+        from auron_tpu.memory.memmgr import make_spill
 
         with self._lock:
             freed = self.mem_used()
@@ -836,7 +837,7 @@ class _AggTableConsumer:
             with self.ctx.metrics.timer("spill_time"):
                 self.compact()
                 if self.state is not None:
-                    ds = DiskSpill()
+                    ds = make_spill()
                     ds.write_table(self.state.to_arrow())
                     self.parked.append(ds)
             self.ctx.metrics.add("spilled_aggs", 1)

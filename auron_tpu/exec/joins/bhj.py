@@ -32,6 +32,26 @@ def _build_key_lock(key: str) -> threading.Lock:
         return lk
 
 
+class _BuildMemGuard:
+    """Accounting-only consumer pinning a join build's footprint for the
+    probe's duration. spill() frees nothing — the build is needed — but
+    registration makes the bytes visible to fair-share math."""
+
+    def __init__(self, ex, build):
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
+        self.name = f"join-build-{id(ex):x}"
+        self._bytes = batch_nbytes(build.batch) + sum(
+            w.size * w.dtype.itemsize for w in build.words
+        )
+
+    def mem_used(self) -> int:
+        return self._bytes
+
+    def spill(self) -> int:
+        return 0
+
+
 def evict_build_lock(key: str) -> None:
     """Drop the build lock for a cached_build_id. Called by the host's
     resource-removal path (bridge/api.remove_resource) when a broadcast is
@@ -111,13 +131,22 @@ class BroadcastHashJoinExec(ExecOperator):
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         from auron_tpu.exec.joins.chain import clear_chain_memos, try_fused_chain
+        from auron_tpu.memory.memmgr import MemManager
 
         fused = try_fused_chain(self, partition, ctx)
         if fused is not None:
             yield from fused
             return
+        mm = MemManager.get()
+        guard = None
         try:
             build = self._build(partition, ctx)
+            # the build must stay resident for probing: register it as an
+            # UNSPILLABLE consumer so its footprint shrinks the managed
+            # pool others fair-share, instead of blowing the budget
+            # invisibly (auron-memmgr mem_unspillable accounting)
+            guard = _BuildMemGuard(self, build)
+            mm.register(guard, spillable=False)
             probe_child = 1 if self.build_side == "left" else 0
             for pb in self.child_stream(probe_child, partition, ctx):
                 ctx.check_cancelled()
@@ -127,6 +156,8 @@ class BroadcastHashJoinExec(ExecOperator):
                     yield from self.driver.probe_batch(build, pb)
             yield from self.driver.finish(build)
         finally:
+            if guard is not None:
+                mm.unregister(guard)
             # fallback memos scope to this attempt (ADVICE r3): entries for
             # operators never reached must not outlive the chain top
             clear_chain_memos(self, partition, ctx)

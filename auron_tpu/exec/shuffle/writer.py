@@ -47,42 +47,142 @@ class ShuffleWriterExec(ExecOperator):
         self.index_file = index_file
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        from auron_tpu.memory.memmgr import MemManager
+
         n_out = self.partitioning.num_partitions
-        # staged per-partition arrow tables awaiting a flush into blocks
-        staged: list[list[pa.RecordBatch]] = [[] for _ in range(n_out)]
-        staged_bytes = [0] * n_out
-        regions: list[list[bytes]] = [[] for _ in range(n_out)]
-        target = ctx.conf.get(SHUFFLE_COMPRESSION_TARGET_BUF_SIZE)
+        mm = MemManager.get()
+        staging = _ShuffleStaging(n_out, ctx)
+        # staging (raw arrow buffers + compressed runs awaiting the final
+        # write) is spill-managed: under pressure it compresses and parks
+        # runs on disk, merged back per partition at write time — the
+        # reference's spill-merge path (sort_repartitioner.rs:98-151)
+        mm.register(staging)
+        try:
+            for b in self.child_stream(0, partition, ctx):
+                ctx.check_cancelled()
+                with ctx.metrics.timer("repart_time"):
+                    parts = partition_batch(b, self.partitioning, ctx)
+                nbytes = sum(rb.nbytes for _, rb in parts)
+                mm.acquire(staging, nbytes)
+                staging.add_all(parts)
 
-        for b in self.child_stream(0, partition, ctx):
-            ctx.check_cancelled()
-            with ctx.metrics.timer("repart_time"):
-                parts = partition_batch(b, self.partitioning, ctx)
-            for pid, rb in parts:
-                staged[pid].append(rb)
-                staged_bytes[pid] += rb.nbytes
-                if staged_bytes[pid] >= target:
-                    with ctx.metrics.timer("compress_time"):
-                        regions[pid].append(
-                            encode_block(pa.Table.from_batches(staged[pid]))
-                        )
-                    staged[pid], staged_bytes[pid] = [], 0
-
-        offsets = [0]
-        with ctx.metrics.timer("write_time"):
-            with open(self.data_file, "wb") as f:
-                for pid in range(n_out):
-                    if staged[pid]:
-                        regions[pid].append(
-                            encode_block(pa.Table.from_batches(staged[pid]))
-                        )
-                    for blk in regions[pid]:
-                        f.write(blk)
-                    offsets.append(f.tell())
-            write_index(self.index_file, offsets)
+            offsets = [0]
+            with ctx.metrics.timer("write_time"):
+                with open(self.data_file, "wb") as f:
+                    for pid in range(n_out):
+                        for blk in staging.blocks_of(pid):
+                            f.write(blk)
+                        offsets.append(f.tell())
+                write_index(self.index_file, offsets)
+        finally:
+            mm.unregister(staging)
+            staging.release()
         ctx.metrics.add("data_size", offsets[-1])
         return
         yield  # pragma: no cover — generator with no items
+
+
+class _ShuffleStaging:
+    """Per-task shuffle staging buffers as a spillable MemConsumer.
+
+    Layout per reduce partition: ``staged`` raw RecordBatches (uncompressed,
+    awaiting a compression flush once they reach the target buffer size),
+    ``regions`` compressed blocks in RAM, and ``spilled`` (file, [spans])
+    compressed blocks parked on disk by a spill. blocks_of() streams a
+    partition's blocks spill-order-first so the .data file keeps every
+    partition's bytes contiguous."""
+
+    def __init__(self, n_out: int, ctx: ExecutionContext):
+        import threading
+
+        self.name = f"shuffle-staging-{id(self):x}"
+        self.n_out = n_out
+        self.ctx = ctx
+        self.target = ctx.conf.get(SHUFFLE_COMPRESSION_TARGET_BUF_SIZE)
+        self.staged: list[list[pa.RecordBatch]] = [[] for _ in range(n_out)]
+        self.staged_bytes = [0] * n_out
+        self.regions: list[list[bytes]] = [[] for _ in range(n_out)]
+        self._region_bytes = 0
+        self._spill_files: list[tuple[str, list[list[tuple[int, int]]]]] = []
+        # concurrent tasks: MemManager may spill this consumer from another
+        # thread (lock order manager -> consumer, like agg/sort consumers)
+        self._lock = threading.RLock()
+
+    def add_all(self, parts) -> None:
+        with self._lock:
+            for pid, rb in parts:
+                self.staged[pid].append(rb)
+                self.staged_bytes[pid] += rb.nbytes
+                if self.staged_bytes[pid] >= self.target:
+                    self._flush(pid)
+
+    def _flush(self, pid: int) -> None:
+        if not self.staged[pid]:
+            return
+        with self.ctx.metrics.timer("compress_time"):
+            blk = encode_block(pa.Table.from_batches(self.staged[pid]))
+        self.regions[pid].append(blk)
+        self._region_bytes += len(blk)
+        self.staged[pid], self.staged_bytes[pid] = [], 0
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return sum(self.staged_bytes) + self._region_bytes
+
+    def spill(self) -> int:
+        """Compress all staged buffers, park every in-RAM region on disk."""
+        import tempfile
+
+        with self._lock:
+            freed = self.mem_used()
+            if freed == 0:
+                return 0
+            with self.ctx.metrics.timer("spill_time"):
+                for pid in range(self.n_out):
+                    self._flush(pid)
+                fd, path = tempfile.mkstemp(suffix=".shuffle.spill")
+                import os
+
+                spans: list[list[tuple[int, int]]] = []
+                with os.fdopen(fd, "wb") as f:
+                    for pid in range(self.n_out):
+                        pid_spans = []
+                        for blk in self.regions[pid]:
+                            pid_spans.append((f.tell(), len(blk)))
+                            f.write(blk)
+                        spans.append(pid_spans)
+                self._spill_files.append((path, spans))
+                self.regions = [[] for _ in range(self.n_out)]
+                self._region_bytes = 0
+            self.ctx.metrics.add("spilled_shuffle_runs", 1)
+            return freed
+
+    def blocks_of(self, pid: int) -> list[bytes]:
+        """All of a partition's blocks: spilled runs first (oldest first),
+        then resident regions, then a final flush of leftovers. Materialized
+        under the lock so a concurrent spill can't move a region to disk
+        mid-iteration (one partition's compressed bytes at a time)."""
+        with self._lock:
+            self._flush(pid)
+            out: list[bytes] = []
+            for path, spans in self._spill_files:
+                with open(path, "rb") as f:
+                    for off, ln in spans[pid]:
+                        f.seek(off)
+                        out.append(f.read(ln))
+            out.extend(self.regions[pid])
+            return out
+
+    def release(self) -> None:
+        import os
+
+        with self._lock:
+            files, self._spill_files = self._spill_files, []
+        for path, _ in files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 from functools import partial

@@ -4,17 +4,31 @@ Analog of the reference's memory manager (native-engine/auron-memmgr/src/
 lib.rs): a global budget (total = overhead * memory_fraction, set at session
 init — exec.rs:80-88), consumers register and report usage
 (MemConsumer trait, lib.rs:46,202), per-consumer fair share drives who
-spills (mem_used_percent, lib.rs:213-225), and spills cascade until the
-budget is met (lib.rs:393-410). The reference spills to JVM-heap blocks or
-local files (spill.rs:90-101); the TPU-native tiers are:
+spills (mem_used_percent, lib.rs:213-225), and growth beyond the managed
+pool either self-spills or WAITS for siblings to release memory
+(Operation::Spill/Wait, lib.rs:330-410). The reference spills to JVM-heap
+blocks or local files (spill.rs:90-101); the TPU-native tiers are:
 
-    HBM (device arrays) -> host RAM (numpy, this module's HostSpill)
-                        -> local disk (zstd-compressed Arrow IPC files)
+    HBM (device arrays) -> host RAM (``HostSpill``: compressed blocks in
+                           RAM, demoted when the host ledger fills)
+                        -> local disk (``DiskSpill``: zstd-compressed
+                           Arrow IPC files)
 
 Stateful operators (sort runs, agg states, shuffle staging, join builds)
-register as consumers; when an ``acquire`` would exceed the budget the
-manager asks the largest-usage consumers to spill first (the requester
-last), exactly the ordering policy the reference uses.
+register as consumers. Unspillable consumers (e.g. a hash-join build that
+must stay resident for probing) still register so their usage shrinks the
+managed pool others fair-share — the reference's mem_unspillable
+accounting (lib.rs:355-364).
+
+Two growth protocols coexist:
+
+- ``update_mem_used(consumer, new_used)`` — the reference's protocol:
+  fair-share limits (consumer_mem_max = managed/num_spillables, min =
+  max/8), self-spill when over, condition-variable wait (with timeout →
+  forced spill) when under min share.
+- ``acquire(consumer, additional)`` — cascade protocol used by streaming
+  operators: spill the largest *other* spillable consumers first, the
+  requester last, so small consumers can grow at dominant ones' expense.
 """
 
 from __future__ import annotations
@@ -24,7 +38,16 @@ import tempfile
 import threading
 from typing import Protocol
 
-from auron_tpu.utils.config import HBM_BUDGET_BYTES, MEMORY_FRACTION, active_conf
+from auron_tpu.utils.config import (
+    HBM_BUDGET_BYTES,
+    HOST_SPILL_BUDGET_BYTES,
+    MEM_WAIT_TIMEOUT_S,
+    MEMORY_FRACTION,
+    active_conf,
+)
+
+# growth below this never triggers spill/wait (reference MIN_TRIGGER_SIZE)
+_MIN_TRIGGER_BYTES = 1 << 20
 
 
 class MemConsumer(Protocol):
@@ -45,8 +68,12 @@ class MemManager:
         total = budget_bytes if budget_bytes is not None else conf.get(HBM_BUDGET_BYTES)
         self.budget = int(total * conf.get(MEMORY_FRACTION))
         self._lock = threading.RLock()
+        self._released = threading.Condition(self._lock)
         self._consumers: list[MemConsumer] = []
+        self._spillable: dict[int, bool] = {}
         self.num_spills = 0
+        self.num_waits = 0
+        self._wait_timeout = float(conf.get(MEM_WAIT_TIMEOUT_S))
 
     # ---- lifecycle ----
 
@@ -63,39 +90,105 @@ class MemManager:
 
     # ---- consumer API ----
 
-    def register(self, consumer: MemConsumer) -> None:
+    def register(self, consumer: MemConsumer, spillable: bool = True) -> None:
         with self._lock:
             self._consumers.append(consumer)
+            self._spillable[id(consumer)] = spillable
 
     def unregister(self, consumer: MemConsumer) -> None:
         with self._lock:
             if consumer in self._consumers:
                 self._consumers.remove(consumer)
+            self._spillable.pop(id(consumer), None)
+            # freed capacity: wake waiters blocked on the managed pool
+            self._released.notify_all()
+
+    def notify_released(self) -> None:
+        """Consumers call this after shrinking (spill, drain, finish) so
+        waiters blocked in update_mem_used can re-check the pool."""
+        with self._lock:
+            self._released.notify_all()
 
     def total_used(self) -> int:
         with self._lock:
             return sum(c.mem_used() for c in self._consumers)
 
+    def _pool_state(self) -> tuple[int, int, int]:
+        """(total_used, managed_pool, num_spillables) — managed pool =
+        budget minus unspillable usage (lib.rs:355-364)."""
+        total_used = 0
+        unspillable = 0
+        n_spillables = 0
+        for c in self._consumers:
+            u = c.mem_used()
+            total_used += u
+            if self._spillable.get(id(c), True):
+                n_spillables += 1
+            else:
+                unspillable += u
+        return total_used, max(self.budget - unspillable, 0), max(n_spillables, 1)
+
     def mem_used_percent(self, consumer: MemConsumer) -> float:
-        """Consumer's share of the budget (fair-share signal)."""
-        return consumer.mem_used() / max(self.budget, 1)
+        """Consumer's share of its fair-share maximum (lib.rs:213-225)."""
+        with self._lock:
+            _, managed, n = self._pool_state()
+            return consumer.mem_used() / max(managed / n, 1)
+
+    def update_mem_used(self, consumer: MemConsumer, old_used: int, new_used: int) -> None:
+        """Reference growth protocol (lib.rs:330-410): growing past the
+        managed pool or the consumer's fair share triggers a self-spill;
+        consumers under min share (fair/8) wait for siblings to release
+        before spilling tiny states, with a timeout escape."""
+        if new_used <= old_used or new_used < _MIN_TRIGGER_BYTES:
+            if new_used < old_used:
+                self.notify_released()
+            return
+        with self._lock:
+            spillable = self._spillable.get(id(consumer), True)
+            total_used, managed, n = self._pool_state()
+            consumer_max = managed // n
+            consumer_min = consumer_max // 8
+            over = total_used > managed or new_used > consumer_max
+            if not over:
+                return
+            if spillable and new_used > consumer_min:
+                pass  # self-spill below (outside the wait path)
+            else:
+                # below min share (or unspillable): wait for the pool
+                self.num_waits += 1
+                ok = self._released.wait_for(
+                    lambda: self._pool_state()[0] <= self._pool_state()[1],
+                    timeout=self._wait_timeout,
+                )
+                if ok or not spillable:
+                    return
+        # self-spill without holding the manager lock (consumer locks are
+        # ordered manager -> consumer; spill takes the consumer lock)
+        freed = consumer.spill()
+        if freed:
+            self.num_spills += 1
+            self.notify_released()
 
     def acquire(self, consumer: MemConsumer, additional: int) -> None:
-        """Declare intent to grow; triggers spills if over budget.
-
-        Spill order: largest other consumers first, the requester last —
-        so small consumers can grow at the expense of dominant ones.
-        """
+        """Cascade protocol: declare intent to grow; spills largest other
+        spillable consumers first, the requester last."""
         with self._lock:
             needed = self.total_used() + additional - self.budget
             if needed <= 0:
                 return
             others = sorted(
-                (c for c in self._consumers if c is not consumer),
+                (
+                    c
+                    for c in self._consumers
+                    if c is not consumer and self._spillable.get(id(c), True)
+                ),
                 key=lambda c: c.mem_used(),
                 reverse=True,
             )
-            for c in others + [consumer]:
+            victims = others + (
+                [consumer] if self._spillable.get(id(consumer), True) else []
+            )
+            for c in victims:
                 if needed <= 0:
                     break
                 if c.mem_used() == 0:
@@ -103,6 +196,7 @@ class MemManager:
                 freed = c.spill()
                 self.num_spills += 1
                 needed -= freed
+            self._released.notify_all()
 
 
 # ---------------------------------------------------------------------------
@@ -141,3 +235,114 @@ class DiskSpill:
             os.unlink(self.path)
         except OSError:
             pass
+
+
+class _HostLedger:
+    """Process-wide accounting of host-RAM spill bytes. When the ledger
+    would exceed the configured host budget, the OLDEST resident HostSpills
+    demote to disk first (they are the coldest; the reference's analog is
+    the JVM on-heap spill manager handing blocks to the block manager when
+    heap runs short, SparkOnHeapSpillManager.scala:37-199)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._resident: list["HostSpill"] = []
+        self._bytes = 0
+
+    def admit(self, spill: "HostSpill", nbytes: int) -> None:
+        budget = int(active_conf().get(HOST_SPILL_BUDGET_BYTES))
+        to_demote: list[HostSpill] = []
+        with self._lock:
+            self._bytes += nbytes
+            if spill not in self._resident:
+                self._resident.append(spill)
+            while self._bytes > budget and self._resident:
+                victim = self._resident.pop(0)
+                to_demote.append(victim)
+        for v in to_demote:
+            v._demote()
+
+    def forget(self, spill: "HostSpill", nbytes: int) -> None:
+        with self._lock:
+            self._bytes -= nbytes
+            if spill in self._resident:
+                self._resident.remove(spill)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+_host_ledger = _HostLedger()
+
+
+class HostSpill:
+    """Host-RAM tier: compressed blocks kept in RAM (device -> host is one
+    transfer; re-reading skips the disk round trip). Demotes itself to a
+    DiskSpill when the process host ledger fills. Interface-compatible
+    with DiskSpill (write_table / read_tables / release)."""
+
+    def __init__(self, spill_dir: str | None = None):
+        self._blocks: list[bytes] | None = []
+        self._nbytes = 0
+        self._disk: DiskSpill | None = None
+        self._spill_dir = spill_dir
+        self._lock = threading.Lock()
+
+    def write_table(self, tbl) -> None:
+        from auron_tpu.exec.shuffle.format import encode_block
+
+        blk = encode_block(tbl)
+        with self._lock:
+            if self._disk is not None:
+                with open(self._disk.path, "ab") as f:
+                    f.write(blk)
+                return
+            self._blocks.append(blk)
+            self._nbytes += len(blk)
+        _host_ledger.admit(self, len(blk))
+
+    def _demote(self) -> None:
+        """Move resident blocks to disk (ledger pressure)."""
+        with self._lock:
+            if self._disk is not None or self._blocks is None:
+                return
+            disk = DiskSpill(self._spill_dir)
+            with open(disk.path, "ab") as f:
+                for blk in self._blocks:
+                    f.write(blk)
+            freed = self._nbytes
+            self._blocks, self._nbytes = [], 0
+            self._disk = disk
+        _host_ledger.forget(self, freed)
+
+    @property
+    def demoted(self) -> bool:
+        with self._lock:
+            return self._disk is not None
+
+    def read_tables(self):
+        from auron_tpu.exec.shuffle.format import decode_blocks
+
+        with self._lock:
+            disk, blocks = self._disk, list(self._blocks or ())
+        if disk is not None:
+            yield from disk.read_tables()
+            return
+        yield from decode_blocks(b"".join(blocks))
+
+    def release(self) -> None:
+        with self._lock:
+            disk, freed = self._disk, self._nbytes
+            self._blocks, self._nbytes, self._disk = None, 0, None
+        if disk is not None:
+            disk.release()
+        if freed:
+            _host_ledger.forget(self, freed)
+
+
+def make_spill(spill_dir: str | None = None):
+    """Spill container for operator state: host-RAM tier first, demoting
+    to disk under ledger pressure (the promised HBM -> host RAM -> disk
+    cascade)."""
+    return HostSpill(spill_dir)

@@ -149,6 +149,16 @@ HBM_BUDGET_BYTES = int_conf(
 SPILL_COMPRESSION_CODEC = str_conf(
     "spill.compression.codec", "zstd", "memory", "codec for spill files and shuffle runs (zstd|lz4|none)"
 )
+HOST_SPILL_BUDGET_BYTES = int_conf(
+    "memory.host.spill.budget.bytes", 2 << 30, "memory",
+    "host-RAM bytes the spill ledger may keep resident before demoting the "
+    "coldest HostSpills to disk (the host tier of HBM -> RAM -> disk)",
+)
+MEM_WAIT_TIMEOUT_S = float_conf(
+    "memory.wait.timeout.seconds", 10.0, "memory",
+    "how long a below-fair-share consumer waits for siblings to release "
+    "memory before it is forced to spill (auron-memmgr lib.rs WAIT_TIME)",
+)
 BATCH_SIZE_BUCKETS = str_conf(
     "batch.capacity.buckets", "auto", "exec",
     "capacity bucketing policy for static shapes: auto = next_pow2",
