@@ -225,6 +225,21 @@ class HashAggExec(ExecOperator):
             tuple((a, t) for (a, _), t in zip(aggs, self._agg_input_types)),
         )
 
+    def _sort_flags(self, sel) -> tuple:
+        """(host_sort, device_impl) resolved from config at call time —
+        static members of the reduce cfg so the jit cache retraces on a
+        config change instead of reusing a stale compiled sort choice."""
+        if hostsort.use_host_sort():
+            return (True, "lax")
+        from auron_tpu.ops import bitonic
+
+        n_words = self.n_keys + (1 if self.n_keys else 0)  # + null-bits word
+        n_narrow = 1 if 0 < self.n_keys <= 32 else 0  # null-bits word rides narrow
+        return (
+            False,
+            bitonic.sort_impl_for(n_words, int(sel.shape[0]), n_narrow),
+        )
+
     # ------------------------------------------------------------------
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
@@ -438,7 +453,7 @@ class HashAggExec(ExecOperator):
             )
             out_v, out_m, group_valid = _reduce_arrays_jit(
                 sel, key_v, key_m, agg_v, agg_m, agg_aux,
-                cfg=self._reduce_cfg + (hostsort.use_host_sort(),), raw=raw,
+                cfg=self._reduce_cfg + self._sort_flags(sel), raw=raw,
             )
             out_vals = []
             dict_map = self._output_dicts(keys, agg_cols)
@@ -471,7 +486,7 @@ class HashAggExec(ExecOperator):
     ) -> Batch:
         out_vals, group_valid = _reduce_columns(
             sel, keys, agg_cols, raw,
-            self._reduce_cfg + (hostsort.use_host_sort(),),
+            self._reduce_cfg + self._sort_flags(sel),
             collect_cb=self._host_agg_cb
         )
         out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
@@ -941,11 +956,12 @@ def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
 def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None):
     """Segment + reduce already-evaluated columns.
 
-    cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...), host_sort) — pure
+    cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...), host_sort,
+    device_impl) — pure
     values, so the jitted wrapper's compile cache is shared by every operator
     instance with the same aggregate signature; host_sort rides in cfg so a
     config change retraces instead of hitting a stale compiled choice."""
-    n_keys, key_dtypes, agg_specs, host_sort = cfg
+    n_keys, key_dtypes, agg_specs, host_sort, device_impl = cfg
     cap = int(sel.shape[0])
     if n_keys == 0:
         # global aggregation: single segment containing all live rows
@@ -959,7 +975,10 @@ def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None
         )
     else:
         words = S.key_words(keys)
-        seg = S.segment_by_keys(words, sel, host_sort=host_sort)
+        seg = S.segment_by_keys(
+            words, sel, host_sort=host_sort, device_impl=device_impl,
+            n_key_cols=n_keys,
+        )
     order = seg.order
 
     out_vals: list[ColumnVal] = []
@@ -1160,7 +1179,7 @@ def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid, aux=None):
 
 
 def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, cfg, raw):
-    n_keys, key_dtypes, agg_specs, _host_sort = cfg
+    n_keys, key_dtypes, agg_specs, _host_sort, _device_impl = cfg
     keys = [
         ColumnVal(v, m, dt, None) for (v, m, dt) in zip(key_v, key_m, key_dtypes)
     ]

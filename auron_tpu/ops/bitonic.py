@@ -1,0 +1,253 @@
+"""Bitonic cluster sort: the engine's sort primitive as a TPU-shaped network.
+
+The engine is sort-shaped: grouping (ops/segments.py), ordering
+(ops/sortkeys.py), and shuffle clustering all reduce to "stable ascending
+sort of a tuple of uint64 key words with an int32 payload". The default
+device path is a multi-operand ``lax.sort`` whose lexicographic comparator
+forces XLA:TPU onto its generic (slow) sort lowering — the same hot spot
+the reference attacks with a hand-written radix sort
+(datafusion-ext-commons/src/algorithm/rdx_sort.rs). Radix scatters don't
+vectorize on the VPU, so the TPU-native design is a **bitonic merge
+network**:
+
+- each uint64 operand splits into hi/lo uint32 planes (32-bit lane math;
+  no 64-bit emulation inside the network), the int32 payload is one more
+  plane; planes stack into one (planes, rows, 128) array;
+- a compare-exchange between partners ``i`` and ``i ^ j`` (j a power of
+  two) is TWO STATIC ROLLS + a select: for elements with bit j clear the
+  partner sits at ``i + j`` (roll by -j), for the rest at ``i - j``
+  (roll by +j). Lane rolls (j < 128) and sublane rolls (j >= 128) are
+  native VPU data movement — the network never gathers;
+- the payload plane participates as the LAST compare key, making the
+  order a total order and the result bit-identical to the stable
+  ``lax.sort`` it replaces (bitonic networks are not otherwise stable);
+- the whole network runs in one Pallas kernel with every plane
+  VMEM-resident: ~log2(P)*(log2(P)+1)/2 substages touch VMEM only,
+  where the equivalent XLA sort round-trips HBM per pass.
+
+The same network runs as plain jitted jnp (``impl="jnp"``) on any
+backend — that is the measurable CPU proxy for the kernel (identical
+algorithm, XLA-scheduled) and the fallback when the problem exceeds the
+VMEM gate. Correctness of both paths is pinned to ``lax.sort`` in
+tests/test_bitonic.py (Pallas in interpret mode off-TPU).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from auron_tpu.utils.config import DEVICE_SORT_IMPL, active_conf
+
+_LANES = 128
+# the network is only worth its setup below lax.sort for real batches;
+# tiny caps stay on lax.sort
+_MIN_P = 2048
+# single-block kernel: x + partner + compare temps must sit in VMEM
+_VMEM_GATE_BYTES = 12 << 20
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _split_planes(operands: tuple, narrow: tuple) -> list[jnp.ndarray]:
+    """uint64 operands -> hi/lo uint32 planes (most-significant first);
+    int32/uint32 operands -> one plane. Plane order = compare order.
+    narrow[i] marks a uint64 operand whose hi word is STATICALLY ZERO
+    (caller's guarantee — e.g. the 0/1 dead-rows key, or a null-bits word
+    covering <= 32 key columns): it rides as its lo plane alone, cutting
+    network work per substage.
+
+    Signed operands are sign-biased (hi/only plane XOR 0x80000000) so the
+    network's unsigned plane compare matches lax.sort's signed order;
+    narrow is ignored for signed operands (a signed value with a
+    guaranteed-zero hi word would be non-negative anyway)."""
+    planes: list[jnp.ndarray] = []
+    for op, nw in zip(operands, narrow):
+        if op.dtype == jnp.uint64:
+            if not nw:
+                planes.append((op >> jnp.uint64(32)).astype(jnp.uint32))
+            planes.append((op & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+        elif op.dtype == jnp.uint32:
+            planes.append(op)
+        elif op.dtype == jnp.int32:
+            planes.append(op.view(jnp.uint32) ^ jnp.uint32(0x80000000))
+        elif op.dtype == jnp.int64:
+            u = op.view(jnp.uint64)
+            planes.append(
+                ((u >> jnp.uint64(32)).astype(jnp.uint32)) ^ jnp.uint32(0x80000000)
+            )
+            planes.append((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+        else:
+            raise TypeError(f"bitonic operand dtype {op.dtype}")
+    return planes
+
+
+def _network(x: jnp.ndarray, P: int) -> jnp.ndarray:
+    """The bitonic merge network over stacked planes x: (NP, R, 128).
+
+    Fully unrolled (strides are static -> rolls are static shifts). For
+    substage (k, j): want_max[i] = bit_j(i) != bit_k(i); partner by two
+    rolls + select; lexicographic uint32 compare chain across planes.
+    """
+    R = P // _LANES
+    rows = lax.broadcasted_iota(jnp.int32, (R, _LANES), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (R, _LANES), 1)
+    flat = rows * _LANES + cols
+
+    def substage(x, k, j):
+        jbit = (flat & j) != 0
+        kbit = (flat & k) != 0
+        want_max = jbit != kbit
+        if j >= _LANES:
+            sh, ax = j // _LANES, 1
+        else:
+            sh, ax = j, 2
+        partner = jnp.where(
+            jbit[None], jnp.roll(x, sh, axis=ax), jnp.roll(x, -sh, axis=ax)
+        )
+        # x < partner, lexicographic over planes (payload plane = last key
+        # -> never equal, the order is total)
+        lt = jnp.zeros((R, _LANES), dtype=bool)
+        eq = jnp.ones((R, _LANES), dtype=bool)
+        for p in range(x.shape[0]):
+            a, b = x[p], partner[p]
+            lt = lt | (eq & (a < b))
+            eq = eq & (a == b)
+        take_partner = lt == want_max
+        return jnp.where(take_partner[None], partner, x)
+
+    k = 2
+    while k <= P:
+        j = k // 2
+        while j >= 1:
+            x = substage(x, k, j)
+            j //= 2
+        k *= 2
+    return x
+
+
+@partial(jax.jit, static_argnames=("P",))
+def _run_jnp(x: jnp.ndarray, P: int) -> jnp.ndarray:
+    return _network(x, P)
+
+
+def _bitonic_kernel(x_ref, out_ref, *, P: int):
+    out_ref[:] = _network(x_ref[:], P)
+
+
+@partial(jax.jit, static_argnames=("P", "interpret"))
+def _run_pallas(x: jnp.ndarray, P: int, interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        partial(_bitonic_kernel, P=P),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+def bitonic_sort(
+    operands: tuple,
+    *,
+    impl: str = "jnp",
+    interpret: bool | None = None,
+    narrow: tuple | None = None,
+) -> tuple:
+    """Stable ascending sort of an operand tuple; drop-in for
+    ``lax.sort(operands, num_keys=len(operands)-1)`` where the last
+    operand is a distinct int32 payload (iota). Requires that contract —
+    the payload doubles as the stability tiebreak inside the network.
+    interpret=None resolves to interpret-mode off-TPU (CPU tests exercise
+    the kernel through the Pallas interpreter)."""
+    if interpret is None:
+        try:
+            interpret = jax.default_backend() not in ("tpu", "axon")
+        except Exception:
+            interpret = True
+    if narrow is None:
+        narrow = (False,) * len(operands)
+    cap = operands[0].shape[0]
+    P = max(_next_pow2(cap), 8 * _LANES)
+    planes = _split_planes(operands, narrow)
+    # padding sorts last: all-ones exceeds every real key (dead-rows-last
+    # keys are 0/1) and the payload slice below discards it anyway
+    pad = jnp.full(P - cap, jnp.uint32(0xFFFFFFFF))
+    stacked = jnp.stack(
+        [jnp.concatenate([p, pad]).reshape(P // _LANES, _LANES) for p in planes]
+    )
+    if impl == "pallas":
+        out = _run_pallas(stacked, P, interpret)
+    elif impl == "jnp":
+        out = _run_jnp(stacked, P)
+    else:
+        raise ValueError(f"bitonic impl {impl!r} (use lax.sort for 'lax')")
+    flat = out.reshape(out.shape[0], P)[:, :cap]
+    # recombine planes -> original operand dtypes (narrow: hi is zero;
+    # signed: undo the sign bias applied in _split_planes)
+    result = []
+    i = 0
+    for op, nw in zip(operands, narrow):
+        if op.dtype == jnp.uint64:
+            if nw:
+                w = flat[i].astype(jnp.uint64)
+                i += 1
+            else:
+                w = (flat[i].astype(jnp.uint64) << jnp.uint64(32)) | flat[
+                    i + 1
+                ].astype(jnp.uint64)
+                i += 2
+            result.append(w)
+        elif op.dtype == jnp.int64:
+            hi = flat[i] ^ jnp.uint32(0x80000000)
+            w = (hi.astype(jnp.uint64) << jnp.uint64(32)) | flat[i + 1].astype(
+                jnp.uint64
+            )
+            result.append(w.view(jnp.int64))
+            i += 2
+        elif op.dtype == jnp.int32:
+            result.append((flat[i] ^ jnp.uint32(0x80000000)).view(jnp.int32))
+            i += 1
+        else:
+            result.append(flat[i].astype(op.dtype))
+            i += 1
+    return tuple(result)
+
+
+def sort_impl_for(n_words: int, cap: int, n_narrow_words: int = 1) -> str:
+    """Trace-time choice of the cluster-sort implementation for a
+    (dead_key, *words, iota) operand tuple: 'lax' | 'jnp' | 'pallas'.
+    Resolved from config OUTSIDE jit (like hostsort.use_host_sort) —
+    callers must thread it as a static argument. n_narrow_words = how many
+    of the words ride as single planes (segment_by_keys narrows the
+    null-bits word for <= 32 key columns)."""
+    mode = active_conf().get(DEVICE_SORT_IMPL)
+    if mode in ("lax", "jnp", "pallas"):
+        return mode
+    # auto: the network pays off on accelerators where lax.sort's
+    # comparator path is the bottleneck; CPU keeps hostsort/lax
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend not in ("tpu", "axon"):
+        return "lax"
+    P = max(_next_pow2(cap), 8 * _LANES)
+    # dead key rides narrow (1 plane) + words as hi/lo minus the narrow
+    # ones + the payload plane — mirror segment_by_keys' actual stacking
+    n_planes = 1 + 2 * n_words - min(n_narrow_words, n_words) + 1
+    if P < _MIN_P:
+        return "lax"
+    if n_planes * P * 4 * 3 <= _VMEM_GATE_BYTES:
+        return "pallas"
+    return "jnp"

@@ -85,14 +85,21 @@ class Segmentation(NamedTuple):
     sel_sorted: jnp.ndarray  # liveness in sorted order
 
 
-@partial(jax.jit, static_argnames=("host_sort",))
+@partial(jax.jit, static_argnames=("host_sort", "device_impl", "n_key_cols"))
 def segment_by_keys(
-    words: list[jnp.ndarray], sel: jnp.ndarray, *, host_sort: bool
+    words: list[jnp.ndarray],
+    sel: jnp.ndarray,
+    *,
+    host_sort: bool,
+    device_impl: str = "lax",
+    n_key_cols: int = 0,
 ) -> Segmentation:
-    """host_sort is a REQUIRED static value: callers must resolve it from
-    config OUTSIDE the trace (jit caches are keyed by shapes, not config —
-    a default resolved inside the trace would bake a stale choice into
-    already-compiled programs)."""
+    """host_sort and device_impl are REQUIRED static values: callers must
+    resolve them from config OUTSIDE the trace (jit caches are keyed by
+    shapes, not config — a default resolved inside the trace would bake a
+    stale choice into already-compiled programs). device_impl picks the
+    on-device sort when host_sort is False: 'lax' | 'jnp' | 'pallas'
+    (ops/bitonic.py network paths)."""
     from auron_tpu.ops import hostsort
 
     cap = sel.shape[0]
@@ -104,7 +111,20 @@ def segment_by_keys(
         sorted_words = tuple(w[order] for w in words)
     else:
         operands = [dead_first_key, *words, iota]
-        sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1)
+        if device_impl in ("jnp", "pallas"):
+            from auron_tpu.ops import bitonic
+
+            # statically-zero hi planes skip the network: the 0/1 dead key
+            # always; the null-bits word (last, by key_words construction)
+            # when <= 32 key columns set bits in its low half only
+            narrow = [True] + [False] * len(words) + [False]
+            if 0 < n_key_cols <= 32 and len(words) == n_key_cols + 1:
+                narrow[len(words)] = True
+            sorted_ops = bitonic.bitonic_sort(
+                tuple(operands), impl=device_impl, narrow=tuple(narrow)
+            )
+        else:
+            sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1)
         sel_sorted = sorted_ops[0] == 0
         sorted_words = sorted_ops[1:-1]
         order = sorted_ops[-1]

@@ -176,6 +176,13 @@ HOST_SORT_MODE = str_conf(
     "than a radix/lexicographic sort): auto = on for the CPU backend, off "
     "on accelerators where data is HBM-resident",
 )
+DEVICE_SORT_IMPL = str_conf(
+    "exec.device.sort.impl", "auto", "exec",
+    "cluster-sort implementation when sorting on-device (host sort off): "
+    "lax = multi-operand lax.sort; jnp = jitted bitonic merge network; "
+    "pallas = VMEM-resident bitonic Pallas kernel; auto = pallas on TPU "
+    "when the problem fits the VMEM gate, else lax (ops/bitonic.py)",
+)
 SMJ_FALLBACK_ENABLE = bool_conf(
     "smj.fallback.enable", True, "join",
     "fall back from hash join to sort-merge when the build side exceeds budget (SMJ_FALLBACK_* in conf.rs:53-55)",

@@ -1,0 +1,192 @@
+"""Bitonic cluster-sort network vs the stable lax.sort it replaces.
+
+Both implementations (jitted jnp network, Pallas kernel via interpreter on
+CPU) must be bit-identical to ``lax.sort(operands, num_keys=n-1)`` with an
+iota payload — including stability, dead-row clustering, and non-power-of-2
+capacities (padding must never leak into the real slots).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from auron_tpu.ops import bitonic
+
+_pallas_state: list = []
+
+
+def _skip_unless_pallas(impl):
+    """Interpret-mode Pallas needs a jaxlib with TPU lowering registries;
+    this CPU-only build raises NotImplementedError (same skip as
+    test_native.py's kernel tests). Probe once."""
+    if impl != "pallas":
+        return
+    if not _pallas_state:
+        probe = (
+            jnp.zeros(8, jnp.uint64),
+            jnp.arange(8, dtype=jnp.int32),
+        )
+        try:
+            bitonic.bitonic_sort(probe, impl="pallas", interpret=True)
+            _pallas_state.append(None)
+        except NotImplementedError as e:
+            _pallas_state.append(str(e))
+    if _pallas_state[0] is not None:
+        pytest.skip(f"pallas unavailable on this jaxlib build: {_pallas_state[0]}")
+
+
+def _operands(cap, n_words, n_distinct, seed, dead_frac=0.0):
+    rng = np.random.default_rng(seed)
+    sel = rng.random(cap) >= dead_frac
+    dead_first = jnp.where(jnp.asarray(sel), jnp.uint64(0), jnp.uint64(1))
+    words = [
+        jnp.asarray(rng.integers(0, n_distinct, cap).astype(np.uint64))
+        for _ in range(n_words)
+    ]
+    if n_words:
+        # exercise high-plane bits too
+        words[0] = words[0] | (words[0] << jnp.uint64(33))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    return (dead_first, *words, iota)
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+@pytest.mark.parametrize(
+    "cap,n_words,n_distinct,dead_frac",
+    [
+        (1024, 1, 37, 0.0),
+        (1024, 1, 5, 0.3),
+        (2048, 2, 400, 0.1),
+        (1500, 2, 64, 0.2),  # non-power-of-2 capacity
+        (4096, 3, 11, 0.5),  # many duplicates -> stability visible
+        (1024, 1, 1, 0.0),  # single group
+    ],
+)
+def test_matches_stable_lax_sort(impl, cap, n_words, n_distinct, dead_frac):
+    _skip_unless_pallas(impl)
+    ops = _operands(cap, n_words, n_distinct, seed=cap + n_words, dead_frac=dead_frac)
+    want = lax.sort(ops, num_keys=len(ops) - 1)
+    got = bitonic.bitonic_sort(ops, impl=impl, interpret=True)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+def test_signed_operands_match_lax(impl):
+    """int64/int32 key operands compare signed (sign-biased planes)."""
+    _skip_unless_pallas(impl)
+    rng = np.random.default_rng(21)
+    cap = 1024
+    k = jnp.asarray(rng.integers(-(2**62), 2**62, cap).astype(np.int64))
+    v = jnp.asarray(rng.integers(-(2**30), 2**30, cap).astype(np.int32))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    ops = (k, v, iota)
+    want = lax.sort(ops, num_keys=2)
+    got = bitonic.bitonic_sort(ops, impl=impl, interpret=True)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+def test_narrow_planes_match(impl):
+    """narrow=True operands (statically-zero hi words) sort identically."""
+    _skip_unless_pallas(impl)
+    ops = _operands(2048, 2, 100, seed=9, dead_frac=0.25)
+    # dead key (0/1) and second word masked to 32 bits -> narrowable
+    ops = (ops[0], ops[1], ops[2] & jnp.uint64(0xFFFFFFFF), ops[3])
+    want = lax.sort(ops, num_keys=len(ops) - 1)
+    got = bitonic.bitonic_sort(
+        ops, impl=impl, interpret=True, narrow=(True, False, True, False)
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+def test_segment_by_keys_device_impl(impl):
+    _skip_unless_pallas(impl)
+    from auron_tpu.exprs.eval import ColumnVal
+    from auron_tpu import types as T
+    from auron_tpu.ops import segments as S
+
+    rng = np.random.default_rng(7)
+    cap = 2048
+    vals = jnp.asarray(rng.integers(-50, 50, cap).astype(np.int64))
+    validity = jnp.asarray(rng.random(cap) > 0.1)
+    sel = jnp.asarray(rng.random(cap) > 0.2)
+    words = S.key_words([ColumnVal(vals, validity, T.INT64, None)])
+
+    ref = S.segment_by_keys(words, sel, host_sort=False, device_impl="lax")
+    got = S.segment_by_keys(words, sel, host_sort=False, device_impl=impl)
+    for name in ("order", "seg_ids", "boundary", "group_of_slot", "sel_sorted"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, name)), np.asarray(getattr(got, name)), err_msg=name
+        )
+    assert int(ref.num_groups) == int(got.num_groups)
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+def test_agg_end_to_end_with_bitonic(impl):
+    """A grouped aggregation with the bitonic sort forced stays exact."""
+    _skip_unless_pallas(impl)
+    import pandas as pd
+    import pyarrow as pa
+
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exec.agg_exec import AggExpr, HashAggExec
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.utils.config import (
+        DEVICE_SORT_IMPL,
+        HOST_SORT_MODE,
+        Configuration,
+        conf_scope,
+    )
+
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({
+        "g": rng.integers(0, 40, 6000).astype(np.int64),
+        "v": rng.integers(-100, 100, 6000).astype(np.int64),
+    })
+    scan = MemoryScanExec.single([
+        Batch.from_arrow(pa.RecordBatch.from_pandas(
+            df.iloc[i : i + 1500], preserve_index=False))
+        for i in range(0, len(df), 1500)
+    ])
+    partial = HashAggExec(
+        scan, [(col(0), "g")],
+        [(AggExpr("sum", col(1)), "s"), (AggExpr("count", col(1)), "c")],
+        "partial",
+    )
+    agg = HashAggExec(
+        partial, [(col(0), "g")],
+        [(AggExpr("sum", col(1)), "s"), (AggExpr("count", col(2)), "c")],
+        "final",
+    )
+    # host sort owns CPU by default — force it off so the device impl runs
+    conf = Configuration().set(HOST_SORT_MODE, "off").set(DEVICE_SORT_IMPL, impl)
+    with conf_scope(conf):
+        got = (
+            agg.collect(0, ExecutionContext()).to_pandas()
+            .sort_values("g").reset_index(drop=True)
+        )
+    want = (
+        df.groupby("g").agg(s=("v", "sum"), c=("v", "count")).reset_index()
+        .sort_values("g").reset_index(drop=True)
+    )
+    import pandas.testing as pdt
+
+    pdt.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_sort_impl_for_gates():
+    from auron_tpu.utils.config import DEVICE_SORT_IMPL, Configuration, conf_scope
+
+    # explicit override wins regardless of backend
+    with conf_scope(Configuration().set(DEVICE_SORT_IMPL, "jnp")):
+        assert bitonic.sort_impl_for(2, 1 << 16) == "jnp"
+    # auto on the CPU test backend -> lax (hostsort owns CPU)
+    with conf_scope(Configuration().set(DEVICE_SORT_IMPL, "auto")):
+        assert bitonic.sort_impl_for(2, 1 << 16) == "lax"
