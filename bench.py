@@ -19,7 +19,8 @@ Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
      "backend": ..., "cpu_fallback": bool, "sf": N,
      "engine_s": N, "baseline_s": N, "ingest_s": N, "ingest_gb_s": N,
-     "fact_gb_per_s": N, "hbm_util_pct": N}
+     "fact_gb_per_s": N, "mem_roofline_est_pct": N,
+     "sort_bench": [...] | "sort_bench_error": str   # accelerator only}
 
 Env knobs: BENCH_SF, BENCH_PARTS (map partitions, default 2),
 BENCH_TPU_PROBE_TIMEOUT (seconds per probe attempt, default 240),
@@ -37,7 +38,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Rough sequential-read bandwidth ceiling used for the device-utilization
 # estimate: TPU v5e HBM ~819 GB/s; a single CPU core's DRAM stream ~15 GB/s.
-_PEAK_GB_S = {"tpu": 819.0, "cpu": 15.0}
+_PEAK_GB_S = {"tpu": 819.0, "axon": 819.0, "cpu": 15.0}
 
 
 def _probe_backend_once(timeout_s: int) -> tuple[bool, str]:
@@ -57,10 +58,35 @@ def _probe_backend_once(timeout_s: int) -> tuple[bool, str]:
         return False, f"timeout after {timeout_s}s"
 
 
+def _daemon_says_live() -> bool:
+    """The round-long probe daemon (.tpu_probe/, started at round open)
+    retries the wedging tunnel every ~17 min; a fresh OK there means the
+    chip is reachable without re-paying a probe here (VERDICT r3 #1:
+    acquisition must survive the wedge across the round, not just at
+    bench time)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".tpu_probe", "status.json")
+    try:
+        with open(path) as f:
+            st = json.load(f)
+        fresh = time.time() - float(st.get("ts", 0)) < 15 * 60
+        return (
+            bool(st.get("ok"))
+            and st.get("platform") not in (None, "cpu")
+            and fresh  # the daemon exits after its first OK; a stale OK
+            # must not bypass the subprocess probe (tunnel re-wedges)
+        )
+    except Exception:
+        return False
+
+
 def _ensure_live_backend() -> None:
     """Diagnose the accelerator tunnel with retries + logging; fall back to
     CPU only after the evidence is on stderr (VERDICT r2 #1)."""
     if os.environ.get("_AURON_BENCH_REEXEC"):
+        return
+    if _daemon_says_live():
+        sys.stderr.write("bench.py: probe daemon reports TPU live\n")
         return
     tries = int(os.environ.get("BENCH_TPU_PROBE_TRIES", "3"))
     timeout_s = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
@@ -141,28 +167,48 @@ def main() -> None:
     fact_gb_per_s = n_bytes / engine_s / 1e9
     peak = _PEAK_GB_S.get(backend, _PEAK_GB_S["cpu"])
     # the pipeline touches the fact columns ~3x (probe keys x2, measure,
-    # compaction) — a coarse roofline estimate of achieved HBM traffic
-    hbm_util_pct = round(100.0 * 3.0 * fact_gb_per_s / peak, 2)
+    # compaction) — a coarse ROOFLINE ESTIMATE against the table above,
+    # not a measured counter (VERDICT r3: don't mislabel it as HBM util)
+    roofline_est_pct = round(100.0 * 3.0 * fact_gb_per_s / peak, 2)
 
-    print(
-        json.dumps(
-            {
-                "metric": "tpcds_q3_class_throughput",
-                "value": round(rows_per_s, 1),
-                "unit": "fact_rows/s",
-                "vs_baseline": round(rows_per_s / baseline_rows_per_s, 4),
-                "backend": backend,
-                "cpu_fallback": bool(os.environ.get("_AURON_BENCH_REEXEC")),
-                "sf": sf,
-                "engine_s": round(engine_s, 3),
-                "baseline_s": round(baseline_s, 3),
-                "ingest_s": round(ingest_s, 3),
-                "ingest_gb_s": round(n_bytes / ingest_s / 1e9, 3),
-                "fact_gb_per_s": round(fact_gb_per_s, 3),
-                "hbm_util_pct": hbm_util_pct,
-            }
-        )
-    )
+    record = {
+        "metric": "tpcds_q3_class_throughput",
+        "value": round(rows_per_s, 1),
+        "unit": "fact_rows/s",
+        "vs_baseline": round(rows_per_s / baseline_rows_per_s, 4),
+        "backend": backend,
+        "cpu_fallback": bool(os.environ.get("_AURON_BENCH_REEXEC")),
+        "sf": sf,
+        "engine_s": round(engine_s, 3),
+        "baseline_s": round(baseline_s, 3),
+        "ingest_s": round(ingest_s, 3),
+        "ingest_gb_s": round(n_bytes / ingest_s / 1e9, 3),
+        "fact_gb_per_s": round(fact_gb_per_s, 3),
+        "mem_roofline_est_pct": roofline_est_pct,
+    }
+    if backend in ("tpu", "axon"):
+        # settle the cluster-sort verdict on real hardware while we have
+        # the chip: lax.sort vs bitonic network (jnp + pallas kernel).
+        # Subprocess + timeout: a kernel crash/hang must not lose the
+        # headline record this process is about to print.
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_sort.py")],
+                timeout=900, capture_output=True, text=True,
+            )
+            rows = [json.loads(ln) for ln in r.stdout.splitlines()
+                    if ln.strip().startswith("{")]
+            if rows:
+                record["sort_bench"] = rows
+            else:
+                record["sort_bench_error"] = (
+                    f"rc={r.returncode} {r.stderr.strip()[-200:]}"
+                )
+        except Exception as e:
+            record["sort_bench_error"] = repr(e)[-200:]
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

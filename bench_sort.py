@@ -30,7 +30,7 @@ def _time(fn, *args, reps=5):
     return (time.perf_counter() - t0) / reps * 1000.0
 
 
-def main():
+def main(quiet: bool = False):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -68,7 +68,8 @@ def main():
                 "vs_lax": round(ms_lax / ms, 2) if ms else None,
             }
             results.append(rec)
-            print(json.dumps(rec), flush=True)
+            if not quiet:
+                print(json.dumps(rec), flush=True)
     return results
 
 
