@@ -1371,6 +1371,264 @@ def _cmp_frames(got: pd.DataFrame, want: pd.DataFrame, float_tol=1e-6) -> str | 
     return None
 
 
+def run_q14b_class(data: TpcdsData) -> pd.DataFrame:
+    """INTERSECT / EXCEPT shape (q14-class set ops): items sold in 1998
+    INTERSECT items sold in 1999, EXCEPT items sold in 2000 — Spark lowers
+    INTERSECT to distinct + left-semi and EXCEPT to distinct + left-anti
+    (reference AuronConverters handles them post-rewrite as joins)."""
+    fact_schema = _schema_of(data.store_sales)
+    dd_schema = _schema_of(data.date_dim)
+    api.put_resource("q14b_fact", to_batches(data.store_sales, 1))
+    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    api.put_resource("q14b_dd", [dd])
+    try:
+        from auron_tpu.exprs.ir import Literal
+
+        def distinct_items(year: int, tag: str):
+            j = B.hash_join(
+                B.memory_scan(fact_schema, "q14b_fact"),
+                B.filter_(B.memory_scan(dd_schema, "q14b_dd"),
+                          [BinaryOp("eq", col(1), Literal(year, T.INT32))]),
+                [col(0)], [col(0)], "inner",
+                build_side="right", cached_build_id=f"q14b_dd_{tag}",
+            )
+            # partial+final pair: partial-mode alone may legally skip
+            # dedup (partial.agg.skipping), which would leak duplicates
+            # into the semi/anti probe and inflate the counts
+            p = B.hash_agg(B.project(j, [(col(1), "i")]),
+                           [(col(0), "i")], [], "partial")
+            return B.hash_agg(p, [(col(0), "i")], [], "final")
+
+        d98, d99, d00 = (distinct_items(y, str(y)) for y in (1998, 1999, 2000))
+        inter = B.hash_join(d98, d99, [col(0)], [col(0)], "left_semi",
+                            build_side="right")
+        exc = B.hash_join(inter, d00, [col(0)], [col(0)], "left_anti",
+                          build_side="right")
+        p = B.hash_agg(exc, [], [("count_star", None, "c"),
+                                 ("min", col(0), "lo"), ("max", col(0), "hi")],
+                       "partial")
+        f = B.hash_agg(p, [], [("count_star", None, "c"),
+                               ("min", col(0), "lo"), ("max", col(0), "hi")],
+                       "final")
+        return pd.concat(_drain_task(f)).reset_index(drop=True)
+    finally:
+        for k in ("q14b_fact", "q14b_dd", "q14b_dd_1998", "q14b_dd_1999",
+                  "q14b_dd_2000"):
+            api.remove_resource(k)
+
+
+def q14b_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    m = data.store_sales.merge(data.date_dim, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    by_year = {y: set(m[m.d_year == y].ss_item_sk) for y in (1998, 1999, 2000)}
+    keep = (by_year[1998] & by_year[1999]) - by_year[2000]
+    return pd.DataFrame({
+        "c": [np.int64(len(keep))],
+        "lo": [np.int64(min(keep))] if keep else [pd.NA],
+        "hi": [np.int64(max(keep))] if keep else [pd.NA],
+    })
+
+
+def run_q67b_class(data: TpcdsData) -> pd.DataFrame:
+    """GROUP BY CUBE(date, item) — all four grouping sets through one
+    ExpandExec (rollup's q67 sibling; Spark emits gid 0/1/2/3)."""
+    from auron_tpu.exprs.ir import Literal
+
+    sample = data.store_sales.iloc[:2500]
+    fact_schema = _schema_of(sample)
+    api.put_resource("q67b_fact", [[Batch.from_arrow(
+        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    try:
+        scan = B.memory_scan(fact_schema, "q67b_fact")
+        null_i64 = Literal(None, T.INT64)
+        ex = B.expand(scan, [
+            [col(0), col(1), col(4), lit(0)],
+            [col(0), null_i64, col(4), lit(1)],
+            [null_i64, col(1), col(4), lit(2)],
+            [null_i64, null_i64, col(4), lit(3)],
+        ], ["d", "i", "price", "gid"])
+        p = B.hash_agg(ex, [(col(0), "d"), (col(1), "i"), (col(3), "gid")],
+                       [("sum", col(2), "s"), ("count_star", None, "c")],
+                       "partial")
+        f = B.hash_agg(p, [(col(0), "d"), (col(1), "i"), (col(3), "gid")],
+                       [("sum", col(2), "s"), ("count_star", None, "c")],
+                       "final")
+        out = pd.concat(_drain_task(f))
+        return out.sort_values(["gid", "d", "i"], na_position="first").reset_index(drop=True)
+    finally:
+        api.remove_resource("q67b_fact")
+
+
+def q67b_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    sample = data.store_sales.iloc[:2500]
+    frames = []
+    for gid, keys in ((0, ["ss_sold_date_sk", "ss_item_sk"]),
+                      (1, ["ss_sold_date_sk"]), (2, ["ss_item_sk"]), (3, [])):
+        if keys:
+            g = (sample.groupby(keys)
+                 .agg(s=("ss_ext_sales_price", "sum"),
+                      c=("ss_ext_sales_price", "size")).reset_index())
+        else:
+            g = pd.DataFrame({"s": [sample.ss_ext_sales_price.sum()],
+                              "c": [len(sample)]})
+        g = g.rename(columns={"ss_sold_date_sk": "d", "ss_item_sk": "i"})
+        for missing in ("d", "i"):
+            if missing not in g:
+                g[missing] = pd.NA
+        g["gid"] = gid
+        g["c"] = g["c"].astype(np.int64)
+        frames.append(g[["d", "i", "s", "c", "gid"]])
+    out = pd.concat(frames)
+    return out.sort_values(["gid", "d", "i"], na_position="first").reset_index(drop=True)
+
+
+def run_q93_class(data: TpcdsData, n_map=2, n_reduce=3, work_dir=None) -> pd.DataFrame:
+    """Null-skew join: ~84% of join keys are NULL after a CASE rewrite
+    (quantity < 85 -> NULL customer). The nullable key hash-shuffles all
+    null rows into one reduce partition (Spark pids: murmur3(NULL)=seed),
+    and a left-outer join must keep them all unmatched — the null-skew
+    shape that breaks naive hash joins."""
+    from auron_tpu.exprs.ir import If, IsNull, Literal
+
+    work = work_dir or tempfile.mkdtemp(prefix="auron_q93_")
+    os.makedirs(work, exist_ok=True)
+    fact_schema = _schema_of(data.store_sales)
+    api.put_resource("q93_fact", to_batches(data.store_sales, n_map))
+    cust = pd.DataFrame({
+        "c_customer_sk": np.arange(1, 5001, dtype=np.int64),
+        "c_band": (np.arange(1, 5001, dtype=np.int64) % 5),
+    })
+    cu = [Batch.from_arrow(pa.RecordBatch.from_pandas(cust, preserve_index=False))]
+    api.put_resource("q93_cust", [cu] * n_reduce)
+    cu_schema = _schema_of(cust)
+    try:
+        scan = B.memory_scan(fact_schema, "q93_fact")
+        # CASE WHEN ss_quantity < 85 THEN NULL ELSE ss_customer_sk END
+        key = If(BinaryOp("lt", col(3), Literal(85, T.INT32)),
+                 Literal(None, T.INT64), col(2))
+        proj = B.project(scan, [(key, "k"), (col(4), "price")])
+        inter_schema = T.Schema((T.Field("k", T.INT64, True),
+                                 T.Field("price", T.FLOAT64, True)))
+        read = _shuffle_stage(proj, inter_schema, [0], n_map, n_reduce, work,
+                              "q93_ex0", 1)
+        j = B.hash_join(read, B.memory_scan(cu_schema, "q93_cust"),
+                        [col(0)], [col(0)], "left", build_side="right")
+        # group by key-null-ness and matched-ness
+        nullk = IsNull(col(0))
+        p = B.hash_agg(j, [(nullk, "k_null")],
+                       [("count_star", None, "rows"), ("count", col(2), "matched"),
+                        ("sum", col(1), "s")], "partial")
+        f = B.hash_agg(p, [(col(0), "k_null")],
+                       [("count_star", None, "rows"), ("count", col(1), "matched"),
+                        ("sum", col(2), "s")], "final")
+        frames = []
+        for part in range(n_reduce):
+            frames.extend(_drain_task(f, stage_id=2, partition_id=part))
+        out = pd.concat(frames)
+        out = (out.groupby("k_null", dropna=False)
+               .agg(rows=("rows", "sum"), matched=("matched", "sum"),
+                    s=("s", "sum")).reset_index())
+        return out.sort_values("k_null").reset_index(drop=True)
+    finally:
+        for k in ("q93_fact", "q93_cust", "q93_ex0"):
+            api.remove_resource(k)
+
+
+def q93_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    df = data.store_sales.copy()
+    k = df.ss_customer_sk.where(df.ss_quantity >= 85)
+    keep = pd.DataFrame({"k": k.astype("Int64"), "price": df.ss_ext_sales_price})
+    matched = keep.k.isin(set(range(1, 5001))) & keep.k.notna()
+    out = (pd.DataFrame({"k_null": keep.k.isna(), "matched_f": matched,
+                         "price": keep.price})
+           .groupby("k_null")
+           .agg(rows=("price", "size"), matched=("matched_f", "sum"),
+                s=("price", "sum")).reset_index())
+    out["rows"] = out["rows"].astype(np.int64)
+    out["matched"] = out["matched"].astype(np.int64)
+    return out.sort_values("k_null").reset_index(drop=True)
+
+
+def _q9b_amounts(n: int):
+    """Shared deterministic generator for the wide-decimal class: group ids
+    and decimal(38,4)-domain amounts (~1e30-1e31). Groups 0-6 mix signs
+    (1/3 negative) so sums stay ~1e33, inside 38 digits; group 7 is
+    all-positive near-max (9.9e30 each) so any >=1011 rows overflow."""
+    import decimal as pydec
+
+    rng = np.random.default_rng(99)
+    g = rng.integers(0, 8, n)
+    digits = rng.integers(10**14, 10**15, n)
+    amounts = []
+    for i in range(n):
+        if g[i] == 7:
+            base = 990_000_000_000_000
+        else:
+            base = int(digits[i]) * (-1 if i % 3 == 0 else 1)
+        amounts.append(pydec.Decimal(base).scaleb(16))
+    return g, amounts
+
+
+def run_q9b_class(data: TpcdsData) -> pd.DataFrame:
+    """Wide-decimal aggregation with overflow: decimal(38,4) amounts whose
+    group sums exercise the exact column-pair path; one poisoned group
+    overflows 38 digits and must go NULL (Spark non-ANSI overflow)."""
+    n = min(len(data.store_sales), 20_000)
+    g, amounts = _q9b_amounts(n)
+    dec_t = pa.decimal128(38, 4)
+    tbl = pa.table({
+        "g": pa.array(g.astype(np.int64)),
+        "amount": pa.array(amounts, dec_t),
+    })
+    rb = tbl.combine_chunks().to_batches()[0]
+    api.put_resource("q9b_fact", [[Batch.from_arrow(rb)]])
+    schema = T.Schema((
+        T.Field("g", T.INT64, False),
+        T.Field("amount", T.DataType(T.TypeKind.DECIMAL, precision=38, scale=4), True),
+    ))
+    try:
+        scan = B.memory_scan(schema, "q9b_fact")
+        p = B.hash_agg(scan, [(col(0), "g")],
+                       [("sum", col(1), "s"), ("min", col(1), "mn"),
+                        ("max", col(1), "mx"), ("count", col(1), "c")],
+                       "partial")
+        f = B.hash_agg(p, [(col(0), "g")],
+                       [("sum", col(1), "s"), ("min", col(1), "mn"),
+                        ("max", col(1), "mx"), ("count", col(1), "c")],
+                       "final")
+        out = pd.concat(_drain_task(f))
+        return out.sort_values("g").reset_index(drop=True)
+    finally:
+        api.remove_resource("q9b_fact")
+
+
+def q9b_class_oracle(data: TpcdsData) -> pd.DataFrame:
+    import decimal as pydec
+
+    n = min(len(data.store_sales), 20_000)
+    g, amounts = _q9b_amounts(n)
+    rows: dict = {}
+    limit = pydec.Decimal(10) ** 34  # 38 digits at scale 4
+    with pydec.localcontext() as ctx:
+        ctx.prec = 80
+        for i in range(n):
+            a = amounts[i]
+            s, mn, mx, c = rows.get(int(g[i]), (pydec.Decimal(0), None, None, 0))
+            s = s + a
+            mn = a if mn is None or a < mn else mn
+            mx = a if mx is None or a > mx else mx
+            rows[int(g[i])] = (s, mn, mx, c + 1)
+    recs = []
+    for gk in sorted(rows):
+        s, mn, mx, c = rows[gk]
+        recs.append({
+            "g": np.int64(gk),
+            "s": None if abs(s) >= limit else s,  # overflow -> NULL
+            "mn": mn, "mx": mx, "c": np.int64(c),
+        })
+    return pd.DataFrame(recs)
+
+
 def run_gate(sf: float = 0.05, seed: int = 42, verbose: bool = True):
     """Run every query class with its oracle; returns [(name, ok, error,
     seconds)]. The single pass/fail gate VERDICT r1 item 8 asks for."""
@@ -1421,6 +1679,15 @@ def run_gate(sf: float = 0.05, seed: int = 42, verbose: bool = True):
         ("q5_union_two_shuffles", lambda: (
             run_q5_class(data, work_dir=os.path.join(ws, "q5")),
             q5_class_oracle(data))),
+        ("q14b_intersect_except", lambda: (run_q14b_class(data),
+                                           q14b_class_oracle(data))),
+        ("q67b_cube_expand", lambda: (run_q67b_class(data),
+                                      q67b_class_oracle(data))),
+        ("q93_null_skew_join", lambda: (
+            run_q93_class(data, work_dir=os.path.join(ws, "q93")),
+            q93_class_oracle(data))),
+        ("q9b_decimal_wide_overflow", lambda: (run_q9b_class(data),
+                                               q9b_class_oracle(data))),
     ]
     results = []
     for name, fn in cases:

@@ -1,0 +1,121 @@
+"""Large-scale differential gate: shuffle/join-heavy classes at SF>=100.
+
+BASELINE.md's configs call for sf=100/1000 on the join/shuffle-heavy
+shapes; the unit gate (tests/test_tpcds.py) runs every class at toy scale,
+this script runs the heavy subset at real scale as a combined
+perf + correctness gate (the in-process analog of dev/auron-it's
+QueryRunner over the big scale factors).
+
+Each class prints one JSON line:
+    {"class": ..., "sf": N, "ok": bool, "engine_s": N, "oracle_s": N,
+     "speedup": N, "backend": ..., "error": str|null}
+and a final summary line {"metric": "perf_gate", ...}.
+
+Env: PERF_GATE_SF (default 100), PERF_GATE_CLASSES (comma list, default
+the heavy subset), BENCH_PARTS (default 2).
+
+Run on the TPU backend when the tunnel is up (same backend-probe fallback
+as bench.py); CPU runs are still a valid correctness gate at scale.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+HEAVY = ["q3", "q18", "q72", "q95", "q65", "q5", "q93", "q14"]
+
+
+def main() -> None:
+    import auron_tpu  # noqa: F401
+    import jax
+
+    from auron_tpu.models import tpcds
+
+    sf = float(os.environ.get("PERF_GATE_SF", "100"))
+    n_parts = int(os.environ.get("BENCH_PARTS", "2"))
+    names = os.environ.get("PERF_GATE_CLASSES", ",".join(HEAVY)).split(",")
+    backend = jax.devices()[0].platform
+
+    t0 = time.perf_counter()
+    data = tpcds.generate(sf=sf, seed=42)
+    gen_s = time.perf_counter() - t0
+    sys.stderr.write(
+        f"perf_gate: generated sf={sf} ({data.fact_rows():,} fact rows) "
+        f"in {gen_s:.1f}s; backend={backend}\n"
+    )
+
+    ws = tempfile.mkdtemp(prefix="auron_perf_gate_")
+
+    def shuffle_cls(run, oracle, name, **kw):
+        def go():
+            t0 = time.perf_counter()
+            got = run(data, work_dir=os.path.join(ws, name), **kw)
+            eng = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            want = oracle(data)
+            orc = time.perf_counter() - t0
+            return got, want, eng, orc
+        return go
+
+    def q72():
+        t0 = time.perf_counter()
+        got, sr = tpcds.run_q72_class(
+            data, n_map=n_parts, n_reduce=n_parts,
+            work_dir=os.path.join(ws, "q72"))
+        eng = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        want = tpcds.q72_class_oracle(data, sr)
+        return got, want, eng, time.perf_counter() - t0
+
+    cases = {
+        "q3": shuffle_cls(tpcds.run_q3_class, tpcds.q3_class_oracle, "q3",
+                          n_map=n_parts, n_reduce=n_parts),
+        "q18": shuffle_cls(tpcds.run_q18_class, tpcds.q18_class_oracle, "q18"),
+        "q72": q72,
+        "q95": shuffle_cls(tpcds.run_q95_class, tpcds.q95_class_oracle, "q95"),
+        "q65": shuffle_cls(tpcds.run_q65_class, tpcds.q65_class_oracle, "q65"),
+        "q5": shuffle_cls(tpcds.run_q5_class, tpcds.q5_class_oracle, "q5"),
+        "q93": shuffle_cls(tpcds.run_q93_class, tpcds.q93_class_oracle, "q93"),
+        "q14": shuffle_cls(tpcds.run_q14_class, tpcds.q14_class_oracle, "q14"),
+    }
+
+    results = []
+    for name in names:
+        name = name.strip()
+        if name not in cases:
+            continue
+        rec = {"class": name, "sf": sf, "ok": False, "engine_s": None,
+               "oracle_s": None, "speedup": None, "backend": backend,
+               "error": None}
+        try:
+            got, want, eng, orc = cases[name]()
+            err = tpcds._cmp_frames(got, want)
+            rec.update(ok=err is None, engine_s=round(eng, 3),
+                       oracle_s=round(orc, 3),
+                       speedup=round(orc / eng, 3) if eng else None,
+                       error=err)
+        except Exception as e:  # noqa: BLE001 — gate reports, not raises
+            rec["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        finally:
+            # shuffle files at SF=100 run ~10GB per class: reclaim between
+            # classes so the gate fits the disk
+            import shutil
+
+            shutil.rmtree(os.path.join(ws, name), ignore_errors=True)
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    n_ok = sum(r["ok"] for r in results)
+    print(json.dumps({
+        "metric": "perf_gate", "sf": sf, "classes": len(results),
+        "passed": n_ok, "backend": backend,
+        "gen_s": round(gen_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
