@@ -38,6 +38,7 @@ from __future__ import annotations
 import os
 import tempfile
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -102,7 +103,7 @@ class MeshQueryDriver:
     """Executes a protobuf plan containing mesh_exchange nodes on a Mesh."""
 
     def __init__(self, mesh, conf: Configuration | None = None,
-                 work_dir: str | None = None):
+                 work_dir: str | None = None, spmd: bool = False):
         self.mesh = mesh
         self.n_parts = mesh.shape[PARTITION_AXIS]
         self.conf = conf or Configuration()
@@ -113,6 +114,37 @@ class MeshQueryDriver:
         self._reduce_parts: int | None = None  # AQE-coalesced stage width
         #: pending per-exchange AQE candidates: ex_id -> (provider, sizes)
         self._coalesce_candidates: dict[str, tuple] = {}
+        #: SPMD multi-host mode: every process runs this SAME driver over
+        #: the global mesh (parallel/multihost.py), executing only the
+        #: partitions whose mesh device it owns; exchanges ride the global
+        #: all_to_all (ICI within a slice, DCN across). Single-process runs
+        #: ignore the flag. The reference's analog is executor-fleet tasks
+        #: + netty shuffle (SURVEY §2.3); here XLA partitions the
+        #: collective and the driver partitions the host-side stages.
+        self.spmd = bool(spmd) and jax.process_count() > 1
+        devs = list(mesh.devices.flat)
+        self.local_parts = (
+            [i for i, d in enumerate(devs)
+             if d.process_index == jax.process_index()]
+            if self.spmd else list(range(self.n_parts))
+        )
+        if self.spmd:
+            lp = self.local_parts
+            assert lp, (
+                "SPMD driver: this process owns no device of the mesh — "
+                "every participating process must contribute devices"
+            )
+            assert len(lp) * jax.process_count() == self.n_parts, (
+                "SPMD driver needs an equal device count per process "
+                f"(local {len(lp)} x {jax.process_count()} != {self.n_parts})"
+            )
+            # make_array_from_process_local_data hands this process's rows
+            # to its addressable shards in GLOBAL order — require the
+            # standard process-contiguous device layout so local row order
+            # matches shard order
+            assert lp == list(range(lp[0], lp[0] + len(lp))), (
+                "SPMD driver needs process-contiguous mesh device order"
+            )
 
     # ------------------------------------------------------------------
 
@@ -132,12 +164,18 @@ class MeshQueryDriver:
             resolved = self._rewrite(prune_columns(plan), resources)
             n_reduce = self._maybe_coalesce_inputs(resolved, resources)
             self._reduce_parts = n_reduce if n_reduce != self.n_parts else None
-            outs: list[list[Batch]] = []
-            for p in range(self._reduce_parts or self.n_parts):
+            outs: list[list[Batch]] = [
+                [] for _ in range(self._reduce_parts or self.n_parts)
+            ]
+            parts = (
+                self.local_parts if self.spmd
+                else range(self._reduce_parts or self.n_parts)
+            )
+            for p in parts:
                 op = plan_from_proto(resolved)
                 ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
                                        resources=resources)
-                outs.append(list(op.execute(p, ctx)))
+                outs[p] = list(op.execute(p, ctx))
             return outs
         finally:
             self._cleanup_tmp()
@@ -252,13 +290,15 @@ class MeshQueryDriver:
         self._exchange_seq += 1
 
         # ---- map stage: run the child sub-plan per shard (AQE may have
-        # coalesced this stage's shuffle inputs, shrinking its width)
+        # coalesced this stage's shuffle inputs, shrinking its width);
+        # SPMD: only this process's shards run here, peers run theirs
         n_src = self._maybe_coalesce_inputs(child, resources)
         op = plan_from_proto(child)
         schema = op.schema
         shard_batches: list[Batch] = []
         pids: list[jnp.ndarray] = []
-        for p in range(n_src):
+        map_parts = self.local_parts if self.spmd else range(n_src)
+        for p in map_parts:
             ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
                                    resources=resources)
             got = list(op.execute(p, ctx))
@@ -268,6 +308,10 @@ class MeshQueryDriver:
 
         # ---- statistics + transport decision
         counts = self._routing_counts(shard_batches, pids)
+        spmd_cap = None
+        if self.spmd:
+            local_cap = max((b.capacity for b in shard_batches), default=1)
+            counts, spmd_cap = self._allgather_counts(counts, local_cap)
         # the hot RECEIVING shard bounds device residency, not the mean
         max_shard_rows = int(counts.sum(axis=0).max()) if counts.size else 0
         est_shard_bytes = max_shard_rows * _row_width_bytes(schema)
@@ -282,11 +326,33 @@ class MeshQueryDriver:
             # ICI all_to_all is square (P src = P dst); a coalesced map
             # stage routes through the file transport
             mode = "file"
+        if self.spmd:
+            # cross-process exchanges ride the global-mesh collective; the
+            # file transport would need shared storage + path exchange
+            if self.conf.get(EXCHANGE_MODE) == "file":
+                raise NotImplementedError(
+                    "exchange.mode=file is not supported in SPMD mode"
+                )
+            if mode == "file":
+                # auto routed to file (payload over exchange.mesh.max.bytes):
+                # stay on the collective but say so — the budget exists to
+                # protect device residency
+                import logging
+
+                logging.getLogger("auron_tpu").warning(
+                    "SPMD exchange %s: est %d bytes/shard exceeds "
+                    "exchange.mesh.max.bytes; riding all_to_all anyway",
+                    ex_id, est_shard_bytes,
+                )
+            mode = "mesh"
         self.stats.append(ExchangeStats(ex_id, mode, counts, est_shard_bytes))
 
         if mode == "file":
             return self._file_exchange(spec, schema, shard_batches, ex_id, resources)
-        return self._mesh_exchange(schema, shard_batches, pids, counts, ex_id, resources)
+        return self._mesh_exchange(
+            schema, shard_batches, pids, counts, ex_id, resources,
+            spmd_cap=spmd_cap,
+        )
 
     def _routing_counts(self, batches: list[Batch], pids: list[jnp.ndarray]) -> np.ndarray:
         """Exact [P_src, P_dst] live-row routing matrix (one host sync).
@@ -316,6 +382,30 @@ class MeshQueryDriver:
                 counts[src] = np.bincount(pid_h, minlength=self.n_parts)
         return counts
 
+    def _allgather_counts(
+        self, local: np.ndarray, local_cap: int
+    ) -> tuple[np.ndarray, int]:
+        """SPMD: merge each process's [n_local, P] routing counts into the
+        global [P, P] matrix every process needs for slot sizing, and agree
+        on the global stacking capacity — ONE host-level allgather per
+        exchange (cap rides as an extra column)."""
+        from jax.experimental import multihost_utils
+
+        full = np.zeros((self.n_parts, self.n_parts), dtype=np.int64)
+        payload = np.concatenate(
+            [
+                np.asarray(self.local_parts, dtype=np.int64)[:, None],
+                local,
+                np.full((len(self.local_parts), 1), local_cap, dtype=np.int64),
+            ],
+            axis=1,
+        )
+        gathered = multihost_utils.process_allgather(payload)
+        rows = gathered.reshape(-1, payload.shape[1])
+        for proc_rows in rows:
+            full[int(proc_rows[0])] = proc_rows[1:-1]
+        return full, int(rows[:, -1].max())
+
     # ---- ICI transport ------------------------------------------------
 
     def _mesh_exchange(
@@ -326,6 +416,7 @@ class MeshQueryDriver:
         counts: np.ndarray,
         ex_id: str,
         resources: dict,
+        spmd_cap: int | None = None,
     ) -> pb.PhysicalPlanNode:
         ncols = len(schema)
         # unify dictionaries so codes are meaningful across shards
@@ -333,6 +424,12 @@ class MeshQueryDriver:
         remapped: dict[int, list[jnp.ndarray]] = {}
         for ci, f in enumerate(schema):
             if f.dtype.is_dict_encoded:
+                if self.spmd:
+                    # dictionary unification needs every shard's host
+                    # dictionary; cross-process merge is not wired yet
+                    raise NotImplementedError(
+                        "SPMD mesh exchange over dict-encoded columns"
+                    )
                 unified, remaps = unify_dict(batches, ci)
                 dicts[ci] = unified
                 remapped[ci] = [
@@ -340,7 +437,8 @@ class MeshQueryDriver:
                     for b, r in zip(batches, remaps)
                 ]
 
-        cap = max(b.capacity for b in batches)
+        # SPMD: capacity agreed in the counts allgather (one barrier)
+        cap = spmd_cap if spmd_cap is not None else max(b.capacity for b in batches)
 
         def padded(a, fill=False):
             pad = cap - a.shape[0]
@@ -363,21 +461,29 @@ class MeshQueryDriver:
         # slot capacity from the exact routing matrix -> overflow impossible
         slot_cap = bucket_capacity(max(int(counts.max()), 1))
         step = pid_exchange_step(self.mesh, slot_cap)
+        if self.spmd:
+            place = partial(_spmd_shard_rows, self.mesh, self.n_parts)
+        else:
+            place = partial(shard_rows, self.mesh)
         (rvals, rmasks), rsel, overflow = step(
-            shard_rows(self.mesh, (values, validity)),
-            shard_rows(self.mesh, sel),
-            shard_rows(self.mesh, pid),
+            jax.tree.map(place, (values, validity)),
+            place(sel),
+            place(pid),
         )
         assert int(jax.device_get(overflow)) == 0, "sized from exact counts"
 
-        out_parts: list[list[Batch]] = []
-        for p in range(self.n_parts):
+        # expose the addressable partitions (all of them single-process;
+        # only this process's shards in SPMD) as a partition-keyed mapping
+        # — ResourceScanExec indexes dicts and lists identically
+        shard = _local_shard if self.spmd else (lambda a, p: a[p])
+        out_parts: dict[int, list[Batch]] = {}
+        for p in self.local_parts:
             dev = DeviceBatch(
-                rsel[p],
-                tuple(v[p] for v in rvals),
-                tuple(m[p] for m in rmasks),
+                shard(rsel, p),
+                tuple(shard(v, p) for v in rvals),
+                tuple(shard(m, p) for m in rmasks),
             )
-            out_parts.append([Batch(schema, dev, tuple(dicts))])
+            out_parts[p] = [Batch(schema, dev, tuple(dicts))]
         resources[ex_id] = out_parts
         return pb.PhysicalPlanNode(
             memory_scan=pb.MemoryScanNode(
@@ -439,6 +545,28 @@ class MeshQueryDriver:
                 schema=schema_to_proto(schema), resource_id=ex_id
             )
         )
+
+
+def _spmd_shard_rows(mesh, n_parts: int, local_arr) -> jax.Array:
+    """SPMD placement: this process's stacked local rows [n_local, ...]
+    become its shards of the global [P, ...] array (every process calls
+    this with its own rows; together they form the full array)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    host = np.asarray(jax.device_get(local_arr))
+    global_shape = (n_parts,) + tuple(host.shape[1:])
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(PARTITION_AXIS)), host, global_shape
+    )
+
+
+def _local_shard(arr: jax.Array, p: int):
+    """Shard p of a leading-axis-sharded global array (must be local)."""
+    for s in arr.addressable_shards:
+        idx = s.index[0]
+        if (idx.start or 0) == p:
+            return s.data[0]
+    raise KeyError(f"partition {p} not addressable on this process")
 
 
 def _row_width_bytes(schema: T.Schema) -> int:

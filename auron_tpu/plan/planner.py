@@ -38,6 +38,10 @@ class ResourceScanExec(ExecOperator):
 
             if callable(source):
                 parts = source(partition)
+            elif isinstance(source, dict):
+                # partition-keyed mapping (SPMD drivers expose only the
+                # locally-addressable partitions this way)
+                parts = source[partition]
             elif source and isinstance(source[0], _pa.RecordBatch):
                 # flat RecordBatch list — the unambiguous C-ABI host form
                 # (put_resource decodes one IPC payload per task); every
