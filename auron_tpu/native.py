@@ -62,6 +62,13 @@ def _lib():
         ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int64),
     ]
+    try:
+        lib.crc32c_hash.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_uint32,
+        ]
+        lib.crc32c_hash.restype = ctypes.c_uint32
+    except AttributeError:
+        pass  # stale .so without the symbol: callers fall back
     _LIB = lib
     return lib
 
@@ -164,3 +171,13 @@ def loser_tree_merge_host(
     lib.loser_tree_merge(ptrs, _ptr(lens, ctypes.c_int64), n_runs, n_words,
                          _ptr(out_run, ctypes.c_int32), _ptr(out_idx, ctypes.c_int64))
     return out_run, out_idx
+
+
+def crc32c_host(data: bytes, crc: int = 0) -> int | None:
+    """CRC-32C via the native slice-by-8 kernel; None = library absent or
+    stale (caller uses its table-loop fallback)."""
+    lib = _lib()
+    if lib is None or not hasattr(lib, "crc32c_hash"):
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return int(lib.crc32c_hash(buf, len(data), ctypes.c_uint32(crc)))

@@ -193,4 +193,46 @@ void loser_tree_merge(const uint64_t* const* run_words, const int64_t* run_lens,
   }
 }
 
+// ---------------------------------------------------------------------------
+// CRC-32C (Castagnoli), slice-by-8 — kafka record-batch checksums
+// (exec/kafka_wire.py data plane; the pure-python table loop is the
+// fallback when this library is absent)
+// ---------------------------------------------------------------------------
+
+static uint32_t kCrc32cTab[8][256];
+static bool kCrc32cInit = false;
+
+static void crc32c_build_tables() {
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t c = n;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ 0x82f63b78u : c >> 1;
+    kCrc32cTab[0][n] = c;
+  }
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t c = kCrc32cTab[0][n];
+    for (int t = 1; t < 8; t++) {
+      c = kCrc32cTab[0][c & 0xff] ^ (c >> 8);
+      kCrc32cTab[t][n] = c;
+    }
+  }
+  kCrc32cInit = true;
+}
+
+uint32_t crc32c_hash(const uint8_t* data, int64_t n, uint32_t crc) {
+  if (!kCrc32cInit) crc32c_build_tables();
+  crc = ~crc;
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t w;
+    memcpy(&w, data + i, 8);
+    w ^= crc;  // little-endian hosts
+    crc = kCrc32cTab[7][w & 0xff] ^ kCrc32cTab[6][(w >> 8) & 0xff] ^
+          kCrc32cTab[5][(w >> 16) & 0xff] ^ kCrc32cTab[4][(w >> 24) & 0xff] ^
+          kCrc32cTab[3][(w >> 32) & 0xff] ^ kCrc32cTab[2][(w >> 40) & 0xff] ^
+          kCrc32cTab[1][(w >> 48) & 0xff] ^ kCrc32cTab[0][(w >> 56) & 0xff];
+  }
+  for (; i < n; i++) crc = kCrc32cTab[0][(crc ^ data[i]) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
 }  // extern "C"
