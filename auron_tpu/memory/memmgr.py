@@ -154,12 +154,20 @@ class MemManager:
             if spillable and new_used > consumer_min:
                 pass  # self-spill below (outside the wait path)
             else:
-                # below min share (or unspillable): wait for the pool
+                # below min share (or unspillable): wait for the pool.
+                # Under the CPU exec gate, siblings cannot compute while
+                # this thread blocks — waiting could only time out, so
+                # skip straight to the outcome (runtime/task.py gate).
+                from auron_tpu.runtime.task import cpu_gate_serialized
+
                 self.num_waits += 1
-                ok = self._released.wait_for(
-                    lambda: self._pool_state()[0] <= self._pool_state()[1],
-                    timeout=self._wait_timeout,
-                )
+                if cpu_gate_serialized():
+                    ok = False
+                else:
+                    ok = self._released.wait_for(
+                        lambda: self._pool_state()[0] <= self._pool_state()[1],
+                        timeout=self._wait_timeout,
+                    )
                 if ok or not spillable:
                     return
         # self-spill without holding the manager lock (consumer locks are
@@ -171,7 +179,14 @@ class MemManager:
 
     def acquire(self, consumer: MemConsumer, additional: int) -> None:
         """Cascade protocol: declare intent to grow; spills largest other
-        spillable consumers first, the requester last."""
+        spillable consumers first, the requester last.
+
+        Lock order invariant: the manager lock is NEVER held across a
+        consumer's spill() (consumer locks wrap device compute that can
+        take seconds — and on the CPU backend a blocked chain through a
+        callback-bearing computation can wedge outright). Victims are
+        chosen under the lock, spilled outside it, and the shortfall
+        re-checked per victim."""
         with self._lock:
             needed = self.total_used() + additional - self.budget
             if needed <= 0:
@@ -188,15 +203,19 @@ class MemManager:
             victims = others + (
                 [consumer] if self._spillable.get(id(consumer), True) else []
             )
-            for c in victims:
-                if needed <= 0:
-                    break
-                if c.mem_used() == 0:
-                    continue
-                freed = c.spill()
-                self.num_spills += 1
-                needed -= freed
-            self._released.notify_all()
+        for c in victims:
+            with self._lock:
+                # re-check live pool state per victim: concurrent spills/
+                # releases may have already covered the shortfall
+                needed = self.total_used() + additional - self.budget
+            if needed <= 0:
+                break
+            if c.mem_used() == 0:
+                continue
+            if c.spill():
+                with self._lock:
+                    self.num_spills += 1
+        self.notify_released()
 
 
 # ---------------------------------------------------------------------------

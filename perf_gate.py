@@ -6,20 +6,29 @@ this script runs the heavy subset at real scale as a combined
 perf + correctness gate (the in-process analog of dev/auron-it's
 QueryRunner over the big scale factors).
 
-Each class prints one JSON line:
+Each class runs in its OWN subprocess with a timeout: a wedged query
+gets a SIGUSR1 stack dump (forensics on stderr) and a kill, and the gate
+moves on — one stall can't eat the remaining classes or the summary.
+
+Per class, one JSON line:
     {"class": ..., "sf": N, "ok": bool, "engine_s": N, "oracle_s": N,
      "speedup": N, "backend": ..., "error": str|null}
 and a final summary line {"metric": "perf_gate", ...}.
 
 Env: PERF_GATE_SF (default 100), PERF_GATE_CLASSES (comma list, default
-the heavy subset), BENCH_PARTS (default 2).
+the heavy subset), BENCH_PARTS (default 2), PERF_GATE_CLASS_TIMEOUT
+(seconds per class, default 2700).
 
-Run on the TPU backend when the tunnel is up (same backend-probe fallback
-as bench.py); CPU runs are still a valid correctness gate at scale.
+Run on the TPU backend when the tunnel is up; CPU runs are still a valid
+correctness gate at scale.
 """
 
+import faulthandler
 import json
 import os
+import shutil
+import signal
+import subprocess
 import sys
 import tempfile
 import time
@@ -27,95 +36,118 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 HEAVY = ["q3", "q18", "q72", "q95", "q65", "q5", "q93", "q14"]
+CLASS_TIMEOUT_S = int(os.environ.get("PERF_GATE_CLASS_TIMEOUT", "2700"))
 
 
-def main() -> None:
-    import auron_tpu  # noqa: F401
+def run_one(name: str, ws: str) -> None:
+    """Child mode: generate data, run ONE class + oracle, print its record."""
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     import jax
 
     from auron_tpu.models import tpcds
 
     sf = float(os.environ.get("PERF_GATE_SF", "100"))
     n_parts = int(os.environ.get("BENCH_PARTS", "2"))
-    names = os.environ.get("PERF_GATE_CLASSES", ",".join(HEAVY)).split(",")
     backend = jax.devices()[0].platform
 
     t0 = time.perf_counter()
     data = tpcds.generate(sf=sf, seed=42)
-    gen_s = time.perf_counter() - t0
     sys.stderr.write(
-        f"perf_gate: generated sf={sf} ({data.fact_rows():,} fact rows) "
-        f"in {gen_s:.1f}s; backend={backend}\n"
+        f"perf_gate[{name}]: generated sf={sf} ({data.fact_rows():,} rows) "
+        f"in {time.perf_counter() - t0:.1f}s; backend={backend}\n"
     )
+    work = os.path.join(ws, name)
 
-    ws = tempfile.mkdtemp(prefix="auron_perf_gate_")
+    def timed(run, oracle, **kw):
+        t0 = time.perf_counter()
+        got = run(data, work_dir=work, **kw)
+        eng = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        want = oracle(data)
+        return got, want, eng, time.perf_counter() - t0
 
-    def shuffle_cls(run, oracle, name, **kw):
-        def go():
-            t0 = time.perf_counter()
-            got = run(data, work_dir=os.path.join(ws, name), **kw)
-            eng = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            want = oracle(data)
-            orc = time.perf_counter() - t0
-            return got, want, eng, orc
-        return go
-
-    def q72():
+    if name == "q72":
         t0 = time.perf_counter()
         got, sr = tpcds.run_q72_class(
-            data, n_map=n_parts, n_reduce=n_parts,
-            work_dir=os.path.join(ws, "q72"))
+            data, n_map=n_parts, n_reduce=n_parts, work_dir=work)
         eng = time.perf_counter() - t0
         t0 = time.perf_counter()
         want = tpcds.q72_class_oracle(data, sr)
-        return got, want, eng, time.perf_counter() - t0
+        orc = time.perf_counter() - t0
+    elif name == "q3":
+        got, want, eng, orc = timed(
+            tpcds.run_q3_class, tpcds.q3_class_oracle,
+            n_map=n_parts, n_reduce=n_parts)
+    else:
+        runs = {"q18": tpcds.run_q18_class, "q95": tpcds.run_q95_class,
+                "q65": tpcds.run_q65_class, "q5": tpcds.run_q5_class,
+                "q93": tpcds.run_q93_class, "q14": tpcds.run_q14_class}
+        oracles = {"q18": tpcds.q18_class_oracle, "q95": tpcds.q95_class_oracle,
+                   "q65": tpcds.q65_class_oracle, "q5": tpcds.q5_class_oracle,
+                   "q93": tpcds.q93_class_oracle, "q14": tpcds.q14_class_oracle}
+        got, want, eng, orc = timed(runs[name], oracles[name])
 
-    cases = {
-        "q3": shuffle_cls(tpcds.run_q3_class, tpcds.q3_class_oracle, "q3",
-                          n_map=n_parts, n_reduce=n_parts),
-        "q18": shuffle_cls(tpcds.run_q18_class, tpcds.q18_class_oracle, "q18"),
-        "q72": q72,
-        "q95": shuffle_cls(tpcds.run_q95_class, tpcds.q95_class_oracle, "q95"),
-        "q65": shuffle_cls(tpcds.run_q65_class, tpcds.q65_class_oracle, "q65"),
-        "q5": shuffle_cls(tpcds.run_q5_class, tpcds.q5_class_oracle, "q5"),
-        "q93": shuffle_cls(tpcds.run_q93_class, tpcds.q93_class_oracle, "q93"),
-        "q14": shuffle_cls(tpcds.run_q14_class, tpcds.q14_class_oracle, "q14"),
-    }
+    err = tpcds._cmp_frames(got, want)
+    print(json.dumps({
+        "class": name, "sf": sf, "ok": err is None,
+        "engine_s": round(eng, 3), "oracle_s": round(orc, 3),
+        "speedup": round(orc / eng, 3) if eng else None,
+        "backend": backend, "error": err,
+    }), flush=True)
 
+
+def main() -> None:
+    sf = float(os.environ.get("PERF_GATE_SF", "100"))
+    names = [n.strip() for n in
+             os.environ.get("PERF_GATE_CLASSES", ",".join(HEAVY)).split(",")
+             if n.strip() in HEAVY]
+    ws = tempfile.mkdtemp(prefix="auron_perf_gate_")
     results = []
     for name in names:
-        name = name.strip()
-        if name not in cases:
-            continue
-        rec = {"class": name, "sf": sf, "ok": False, "engine_s": None,
-               "oracle_s": None, "speedup": None, "backend": backend,
-               "error": None}
+        env = dict(os.environ)
+        env["PERF_GATE_CHILD"] = name
+        env["PERF_GATE_WS"] = ws
+        rec = None
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
         try:
-            got, want, eng, orc = cases[name]()
-            err = tpcds._cmp_frames(got, want)
-            rec.update(ok=err is None, engine_s=round(eng, 3),
-                       oracle_s=round(orc, 3),
-                       speedup=round(orc / eng, 3) if eng else None,
-                       error=err)
-        except Exception as e:  # noqa: BLE001 — gate reports, not raises
-            rec["error"] = f"{type(e).__name__}: {str(e)[:300]}"
-        finally:
-            # shuffle files at SF=100 run ~10GB per class: reclaim between
-            # classes so the gate fits the disk
-            import shutil
-
-            shutil.rmtree(os.path.join(ws, name), ignore_errors=True)
+            out, err_txt = proc.communicate(timeout=CLASS_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            # forensics: stack dump to the child's stderr, then kill
+            proc.send_signal(signal.SIGUSR1)
+            time.sleep(3)
+            proc.kill()
+            out, err_txt = proc.communicate()
+            rec = {"class": name, "sf": sf, "ok": False, "engine_s": None,
+                   "oracle_s": None, "speedup": None, "backend": None,
+                   "error": f"timeout after {CLASS_TIMEOUT_S}s"}
+            sys.stderr.write(
+                f"perf_gate[{name}]: TIMEOUT; child stacks:\n{err_txt[-4000:]}\n"
+            )
+        if rec is None:
+            lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+            if proc.returncode == 0 and lines:
+                rec = json.loads(lines[-1])
+            else:
+                rec = {"class": name, "sf": sf, "ok": False, "engine_s": None,
+                       "oracle_s": None, "speedup": None, "backend": None,
+                       "error": f"child rc={proc.returncode}: {err_txt[-300:]}"}
+        shutil.rmtree(os.path.join(ws, name), ignore_errors=True)
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
-    n_ok = sum(r["ok"] for r in results)
     print(json.dumps({
         "metric": "perf_gate", "sf": sf, "classes": len(results),
-        "passed": n_ok, "backend": backend,
-        "gen_s": round(gen_s, 1),
+        "passed": sum(bool(r["ok"]) for r in results),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    child = os.environ.get("PERF_GATE_CHILD")
+    if child:
+        run_one(child, os.environ["PERF_GATE_WS"])
+    else:
+        main()

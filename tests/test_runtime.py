@@ -249,3 +249,63 @@ def test_hive_partitioned_parquet_sink(tmp_path):
         assert full.num_rows == 5
     finally:
         api.remove_resource("sink_rows")
+
+
+def test_concurrent_hostsort_tasks_no_wedge():
+    """Regression: two task pumps whose programs carry hostsort
+    pure_callbacks wedged XLA:CPU (each in-flight computation parked an
+    intra-op thread waiting for a callback continuation that itself
+    needed a pool thread). The CPU exec gate in TaskRuntime._pump
+    serializes compute steps; this must finish, not hang."""
+    import threading
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+
+    from auron_tpu.bridge import api
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.plan import builders as B
+
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 500, 40_000).astype(np.int64),
+        "v": rng.integers(-10, 10, 40_000).astype(np.int64),
+    })
+    parts = [
+        [Batch.from_arrow(pa.RecordBatch.from_pandas(
+            df.iloc[i::4].reset_index(drop=True), preserve_index=False))
+         for i in range(2)]
+        for _ in range(2)
+    ]
+    b0 = parts[0][0]
+    api.put_resource("wedge_fact", parts)
+    try:
+        agg_p = B.hash_agg(B.memory_scan(b0.schema, "wedge_fact"),
+                           [(col(0), "k")], [("sum", col(1), "s")], "partial")
+        agg = B.hash_agg(agg_p, [(col(0), "k")], [("sum", col(1), "s")], "final")
+        # two concurrent pumps, each a host-sorted aggregation
+        handles = [
+            api.call_native(B.task(agg, stage_id=9, partition_id=p).SerializeToString())
+            for p in range(2)
+        ]
+        totals = []
+
+        def drain(h, out):
+            rows = 0
+            while (rb := api.next_batch(h)) is not None:
+                rows += rb.num_rows
+            api.finalize_native(h)
+            out.append(rows)
+
+        ts = [threading.Thread(target=drain, args=(h, totals), daemon=True)
+              for h in handles]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in ts), "concurrent tasks wedged"
+        assert sum(totals) > 0
+    finally:
+        api.remove_resource("wedge_fact")
