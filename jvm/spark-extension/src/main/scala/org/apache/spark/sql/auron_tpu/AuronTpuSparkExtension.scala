@@ -63,7 +63,7 @@ object EngineClient {
  * node (service.py contract), so splicing composes: every call receives
  * the Spark subtree standing at the response node's own position.
  */
-object NativeSegmentSplicer {
+object NativeSegmentSplicer extends org.apache.spark.internal.Logging {
   import org.json4s._
   import org.json4s.jackson.JsonMethods._
 
@@ -71,7 +71,15 @@ object NativeSegmentSplicer {
     val resp = parse(responseJson)
     (resp \ "converted") match {
       case JBool(true) => spliceNode(plan, resp \ "root")
-      case _ => plan
+      case _ =>
+        // keep the host plan, but surface WHY conversion bailed — the
+        // engine reports its failure in the response envelope
+        (resp \ "error") match {
+          case JString(msg) =>
+            logWarning(s"auron-tpu conversion fell back to Spark: $msg")
+          case _ => ()
+        }
+        plan
     }
   }
 
