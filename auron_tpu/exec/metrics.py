@@ -49,6 +49,23 @@ class MetricNode:
     def total(self, metric: str) -> int:
         return self.values.get(metric, 0) + sum(c.total(metric) for c in self.children)
 
+    @staticmethod
+    def flat_totals(snapshot: dict) -> dict[str, int]:
+        """Per-metric totals across a snapshot() tree — the rollup shape
+        the host engine's SQLMetric registry consumes (the JVM twin is
+        NativeMetrics.flatTotals in jvm/.../NativeMetrics.scala; both
+        sides must agree on this definition)."""
+        out: dict[str, int] = {}
+
+        def rec(node: dict) -> None:
+            for k, v in node.get("values", {}).items():
+                out[k] = out.get(k, 0) + int(v)
+            for c in node.get("children", ()):
+                rec(c)
+
+        rec(snapshot)
+        return out
+
     def render(self, indent: int = 0) -> str:
         """Human-readable metric tree (the engine-side analog of the
         reference's Spark-UI metric surfacing, auron-spark-ui)."""

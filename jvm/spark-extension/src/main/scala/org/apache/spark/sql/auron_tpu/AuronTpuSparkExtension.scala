@@ -38,16 +38,72 @@ object ConvertToNativeRule extends Rule[SparkPlan] {
         || !engineAvailable) {
       return plan
     }
+    UiEvents.postBuildInfoOnce(plan)
     val hostJson = HostPlanSerializer.serialize(plan)
     // engine-side conversion (auron_tpu/convert/service.py): tagging,
     // segmentation and stage splitting all run in the engine; the response
     // carries per-segment TaskDefinition-ready plans + tree paths, so
     // splicing here is mechanical tree surgery.
     EngineClient.convert(hostJson) match {
-      case Some(resp) => NativeSegmentSplicer.splice(plan, resp)
+      case Some(resp) =>
+        val (spliced, err) = NativeSegmentSplicer.spliceWithError(plan, resp)
+        UiEvents.postConversion(plan, spliced, err)
+        spliced
       case None => plan
     }
   }
+}
+
+/** Driver-side posts into the auron-tpu UI module (jvm/spark-ui): build
+ * identity once per SparkContext, then one conversion-outcome event per
+ * AQE stage of each execution (the listener MERGES stages by execution
+ * id). The spark-ui jar is optional: every entry point degrades to a
+ * no-op when its classes are absent or a post fails — conversion must
+ * never fail a query. */
+object UiEvents {
+
+  private val registeredApps =
+    java.util.concurrent.ConcurrentHashMap.newKeySet[String]()
+
+  private lazy val uiModulePresent: Boolean =
+    try {
+      Class.forName("org.apache.spark.sql.auron_tpu.ui.AuronTpuSQLAppStatusListener")
+      true
+    } catch { case _: Throwable => false }
+
+  def postBuildInfoOnce(plan: SparkPlan): Unit =
+    try {
+      if (!uiModulePresent) return
+      val sc = plan.session.sparkContext
+      if (!registeredApps.add(sc.applicationId)) return // per-context, not per-JVM
+      org.apache.spark.sql.auron_tpu.ui.AuronTpuSQLAppStatusListener.register(sc)
+      sc.listenerBus.post(
+        org.apache.spark.sql.auron_tpu.ui.AuronTpuBuildInfoEvent(Map(
+          "engine" -> "auron-tpu",
+          "bridge" -> "libauron_bridge.so (FFM)",
+          "sparkVersion" -> sc.version)))
+    } catch { case _: Throwable => () }
+
+  def postConversion(
+      plan: SparkPlan, spliced: SparkPlan, error: Option[String]): Unit =
+    try {
+      if (!uiModulePresent) return
+      val sc = plan.session.sparkContext
+      // outside SQLExecution there is no execution to attribute to — skip
+      // rather than collapsing every such plan onto one sentinel row
+      val executionId = Option(
+        sc.getLocalProperty("spark.sql.execution.id")).map(_.toLong)
+      if (executionId.isEmpty) return
+      val nativeSegments = spliced.collect {
+        case _: NativeSegmentExec => 1
+        case _: NativeStagedSegmentExec => 1
+      }.sum
+      sc.listenerBus.post(
+        org.apache.spark.sql.auron_tpu.ui.AuronTpuConversionEvent(
+          executionId.get, plan.nodeName, nativeSegments,
+          hostFallbacks = if (nativeSegments == 0) 1 else 0,
+          fallbackReason = error))
+    } catch { case _: Throwable => () }
 }
 
 /** Engine conversion round trip over the C ABI (auron_convert_plan). */
@@ -67,19 +123,26 @@ object NativeSegmentSplicer extends org.apache.spark.internal.Logging {
   import org.json4s._
   import org.json4s.jackson.JsonMethods._
 
-  def splice(plan: SparkPlan, responseJson: String): SparkPlan = {
+  def splice(plan: SparkPlan, responseJson: String): SparkPlan =
+    spliceWithError(plan, responseJson)._1
+
+  /** One parse serves both splicing and the fallback diagnostic (the
+   * response can be large — every segment's plan proto rides in it). */
+  def spliceWithError(
+      plan: SparkPlan, responseJson: String): (SparkPlan, Option[String]) = {
     val resp = parse(responseJson)
+    val error = (resp \ "error") match {
+      case JString(msg) => Some(msg)
+      case _ => None
+    }
     (resp \ "converted") match {
-      case JBool(true) => spliceNode(plan, resp \ "root")
+      case JBool(true) => (spliceNode(plan, resp \ "root"), error)
       case _ =>
         // keep the host plan, but surface WHY conversion bailed — the
         // engine reports its failure in the response envelope
-        (resp \ "error") match {
-          case JString(msg) =>
-            logWarning(s"auron-tpu conversion fell back to Spark: $msg")
-          case _ => ()
-        }
-        plan
+        error.foreach(msg =>
+          logWarning(s"auron-tpu conversion fell back to Spark: $msg"))
+        (plan, error)
     }
   }
 

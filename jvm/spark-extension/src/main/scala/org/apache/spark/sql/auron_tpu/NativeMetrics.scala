@@ -1,0 +1,68 @@
+/*
+ * Engine metric tree -> Spark SQLMetrics (the NativeHelper.scala:168-213
+ * metric mirror of the reference, consumed by the Spark UI through the
+ * standard SQLAppStatusListener accumulator path).
+ *
+ * finalizeNative returns the engine's per-operator metric tree as JSON
+ * ({"name":..., "values": {metric: long}, "children": [...]}) — the shape
+ * auron_tpu/exec/metrics.py snapshot() emits. flatTotals is the Scala twin
+ * of MetricNode.flat_totals; both sides must agree on the rollup.
+ */
+package org.apache.spark.sql.auron_tpu
+
+import org.apache.spark.SparkContext
+import org.apache.spark.sql.execution.metric.{SQLMetric, SQLMetrics}
+
+object NativeMetrics {
+  import org.json4s._
+  import org.json4s.jackson.JsonMethods._
+
+  /** Per-metric totals over the engine's metric tree JSON. */
+  def flatTotals(metricsJson: String): Map[String, Long] = {
+    val totals = scala.collection.mutable.Map.empty[String, Long]
+    def rec(node: JValue): Unit = {
+      node \ "values" match {
+        case JObject(fields) =>
+          fields.foreach {
+            case (k, JInt(v)) => totals(k) = totals.getOrElse(k, 0L) + v.toLong
+            case (k, JLong(v)) => totals(k) = totals.getOrElse(k, 0L) + v
+            case _ => ()
+          }
+        case _ => ()
+      }
+      node \ "children" match {
+        case JArray(kids) => kids.foreach(rec)
+        case _ => ()
+      }
+    }
+    try rec(parse(metricsJson)) catch { case _: Throwable => () }
+    totals.toMap
+  }
+
+  /** The segment operators' declared metric set. Engine metric names map
+   * 1:1; *_time values are nanos (MetricNode.timer), data/bytes names are
+   * sizes, the rest plain counters. Unknown engine metrics are ignored —
+   * the engine may grow metrics faster than the shim. */
+  def createSegmentMetrics(sc: SparkContext): Map[String, SQLMetric] = Map(
+    "output_rows" -> SQLMetrics.createMetric(sc, "native output rows"),
+    "stream_batches" -> SQLMetrics.createMetric(sc, "native output batches"),
+    "elapsed_compute" -> SQLMetrics.createNanoTimingMetric(sc, "native compute time"),
+    "repart_time" -> SQLMetrics.createNanoTimingMetric(sc, "repartition time"),
+    "compress_time" -> SQLMetrics.createNanoTimingMetric(sc, "shuffle compress time"),
+    "write_time" -> SQLMetrics.createNanoTimingMetric(sc, "shuffle write time"),
+    "merge_time" -> SQLMetrics.createNanoTimingMetric(sc, "agg merge time"),
+    "spill_time" -> SQLMetrics.createNanoTimingMetric(sc, "spill time"),
+    "data_size" -> SQLMetrics.createSizeMetric(sc, "shuffle bytes written"),
+    "spilled_aggs" -> SQLMetrics.createMetric(sc, "agg spills"),
+    "spilled_shuffle_runs" -> SQLMetrics.createMetric(sc, "shuffle staging spills"),
+    "num_merges" -> SQLMetrics.createMetric(sc, "agg merges"),
+    "partial_agg_skipped" -> SQLMetrics.createMetric(sc, "partial aggs skipped"),
+    "deserialize_errors" -> SQLMetrics.createMetric(sc, "deserialize errors"),
+    "corrupted_files_skipped" -> SQLMetrics.createMetric(sc, "corrupted files skipped"))
+
+  /** Fold the finalize JSON into the operator's SQLMetrics (task end). */
+  def update(metricsJson: String, metrics: Map[String, SQLMetric]): Unit =
+    flatTotals(metricsJson).foreach { case (name, v) =>
+      metrics.get(name).foreach(_.add(v))
+    }
+}

@@ -71,14 +71,19 @@ case class NativeSegmentExec(
 
   override def children: Seq[SparkPlan] = ffiInputs.map(_.child)
 
+  override lazy val metrics =
+    NativeMetrics.createSegmentMetrics(session.sparkContext)
+
   override protected def doExecute(): RDD[InternalRow] = {
     val out = output
     val protoOf = taskProtoPerPartition
     val boundary = NativeTaskRun.boundarySpecs(ffiInputs)
+    val m = metrics // SQLMetrics are accumulators: serializable into tasks
     NativeTaskRun.overInputs(this, ffiInputs, pinnedPartitions, conf) {
       (pid, rowIters) =>
         val keys = NativeTaskRun.registerInputs(boundary, pid, rowIters)
-        NativeTaskRun.resultIterator(protoOf(pid), out, keys, Map.empty)
+        NativeTaskRun.resultIterator(protoOf(pid), out, keys, Map.empty,
+          json => NativeMetrics.update(json, m))
     }
   }
 
@@ -104,6 +109,9 @@ case class NativeStagedSegmentExec(
   extends SparkPlan {
 
   override def children: Seq[SparkPlan] = ffiInputs.map(_.child)
+
+  override lazy val metrics =
+    NativeMetrics.createSegmentMetrics(session.sparkContext)
 
   private def inputsOf(s: StageDesc): Seq[FfiInput] =
     s.ffiInputIds.flatMap(id => ffiInputs.find(_.resourceId == id))
@@ -166,6 +174,7 @@ case class NativeStagedSegmentExec(
     val proto = s.planProto
     val out = if (drain) Nil else output
     val boundary = NativeTaskRun.boundarySpecs(inputsOf(s))
+    val m = metrics // every stage of the segment folds into one metric set
     // widthOf is the single width authority (exchange > pinned scan > FFI
     // children > default) — manifests and task counts must agree
     NativeTaskRun.overInputs(this, inputsOf(s), Some(widthOf(s)), conf) {
@@ -173,7 +182,8 @@ case class NativeStagedSegmentExec(
         val keys = NativeTaskRun.registerInputs(boundary, pid, rowIters)
         val task = TaskDefs.assemble(proto, pid,
           Seq("auron.work_dir" -> workDir))
-        val it = NativeTaskRun.resultIterator(task, out, keys, mans)
+        val it = NativeTaskRun.resultIterator(task, out, keys, mans,
+          json => NativeMetrics.update(json, m))
         if (drain) {
           // writer stages emit no rows; drain to completion
           require(!it.hasNext, "shuffle-writer stage emitted rows")
@@ -291,7 +301,8 @@ object NativeTaskRun {
       taskProto: Array[Byte],
       out: Seq[Attribute],
       inputResources: Seq[String],
-      manifests: Map[String, Array[Byte]]): Iterator[InternalRow] = {
+      manifests: Map[String, Array[Byte]],
+      onMetrics: String => Unit = _ => ()): Iterator[InternalRow] = {
     manifests.foreach { case (ex, m) => NativeBridge.putResourceShuffle(ex, m) }
     val handle = NativeBridge.callNative(taskProto)
     val allocator = new RootAllocator(Long.MaxValue)
@@ -299,7 +310,12 @@ object NativeTaskRun {
 
     def cleanup(): Unit = if (!finalized) {
       finalized = true
-      try NativeBridge.finalizeNative(handle) finally {
+      try {
+        // finalize returns the engine's metric tree: fold it into the
+        // operator's SQLMetrics so the Spark UI shows native numbers
+        val metricsJson = NativeBridge.finalizeNative(handle)
+        try onMetrics(metricsJson) catch { case _: Throwable => () }
+      } finally {
         inputResources.foreach { k =>
           try NativeBridge.removeResource(k) catch { case _: Throwable => }
         }

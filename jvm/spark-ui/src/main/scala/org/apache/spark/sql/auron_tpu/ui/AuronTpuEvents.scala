@@ -1,0 +1,42 @@
+/*
+ * UI events + kvstore rows for the auron-tpu Spark UI module (reference
+ * auron-spark-ui/.../AuronEvent.scala + AuronSQLAppStatusListener UIData).
+ *
+ * Build info is posted once per session from the driver; per-node native
+ * metrics ride the STANDARD SQLMetrics accumulator path (declared by
+ * NativeSegmentExec, folded from the engine metric tree at task end —
+ * NativeMetrics.scala), so the stock SQL tab already renders them. This
+ * module adds what the stock UI cannot know: which engine build is
+ * loaded, and per-execution native-conversion outcomes.
+ */
+package org.apache.spark.sql.auron_tpu.ui
+
+import org.apache.spark.scheduler.SparkListenerEvent
+
+/** Engine build/runtime identity (posted at extension install). */
+case class AuronTpuBuildInfoEvent(info: Map[String, String])
+  extends SparkListenerEvent
+
+/** One query's conversion outcome: how much of the plan went native. */
+case class AuronTpuConversionEvent(
+    executionId: Long,
+    description: String,
+    nativeSegments: Int,
+    hostFallbacks: Int,
+    fallbackReason: Option[String])
+  extends SparkListenerEvent
+
+/** kvstore row: build info (singleton per application). */
+class AuronTpuBuildInfoUIData(val info: Seq[(String, String)]) {
+  @com.fasterxml.jackson.annotation.JsonIgnore
+  @org.apache.spark.util.kvstore.KVIndex
+  def id: String = "auron_tpu_build_info"
+}
+
+/** kvstore row: per-execution conversion summary. */
+class AuronTpuExecutionUIData(
+    @org.apache.spark.util.kvstore.KVIndex val executionId: Long,
+    val description: String,
+    val nativeSegments: Int,
+    val hostFallbacks: Int,
+    val fallbackReason: Option[String])

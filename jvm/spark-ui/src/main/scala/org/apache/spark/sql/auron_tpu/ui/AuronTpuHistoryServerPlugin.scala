@@ -1,0 +1,27 @@
+/*
+ * History-server replay support (reference
+ * auron-spark-ui/.../AuronSQLHistoryServerPlugin.scala): re-creates the
+ * listener so replayed event logs rebuild the auron-tpu status rows, and
+ * re-attaches the tab on the rebuilt UI.
+ */
+package org.apache.spark.sql.auron_tpu.ui
+
+import org.apache.spark.SparkConf
+import org.apache.spark.scheduler.SparkListener
+import org.apache.spark.status.{AppHistoryServerPlugin, ElementTrackingStore}
+import org.apache.spark.ui.SparkUI
+
+class AuronTpuHistoryServerPlugin extends AppHistoryServerPlugin {
+
+  override def createListeners(
+      conf: SparkConf,
+      store: ElementTrackingStore): Seq[SparkListener] =
+    Seq(new AuronTpuSQLAppStatusListener(conf, store))
+
+  override def setupUI(ui: SparkUI): Unit = {
+    val store = new AuronTpuSQLAppStatusStore(ui.store.store)
+    if (store.executionCount() > 0 || store.buildInfo().nonEmpty) {
+      new AuronTpuSQLTab(store, ui)
+    }
+  }
+}

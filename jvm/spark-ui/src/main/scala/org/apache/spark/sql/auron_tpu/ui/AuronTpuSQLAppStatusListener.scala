@@ -1,0 +1,74 @@
+/*
+ * Listener writing auron-tpu events into the app status store (reference
+ * auron-spark-ui/.../AuronSQLAppStatusListener.scala:29-50): live UI and
+ * history server replay consume the same rows.
+ */
+package org.apache.spark.sql.auron_tpu.ui
+
+import org.apache.spark.{SparkConf, SparkContext}
+import org.apache.spark.internal.Logging
+import org.apache.spark.scheduler.{SparkListener, SparkListenerEvent}
+import org.apache.spark.status.ElementTrackingStore
+
+class AuronTpuSQLAppStatusListener(conf: SparkConf, kvstore: ElementTrackingStore)
+    extends SparkListener
+    with Logging {
+
+  private def onBuildInfo(event: AuronTpuBuildInfoEvent): Unit =
+    kvstore.write(new AuronTpuBuildInfoUIData(event.info.toSeq))
+
+  private def onConversion(event: AuronTpuConversionEvent): Unit = {
+    // AQE re-plans per query stage -> one event per stage; MERGE them
+    // into the execution's row (a late all-host stage must not erase an
+    // earlier native one)
+    val prev =
+      try Some(kvstore.read(classOf[AuronTpuExecutionUIData], event.executionId))
+      catch { case _: java.util.NoSuchElementException => None }
+    val merged = prev match {
+      case Some(p) => new AuronTpuExecutionUIData(
+        event.executionId, p.description,
+        p.nativeSegments + event.nativeSegments,
+        p.hostFallbacks + event.hostFallbacks,
+        event.fallbackReason.orElse(p.fallbackReason))
+      case None => new AuronTpuExecutionUIData(
+        event.executionId, event.description, event.nativeSegments,
+        event.hostFallbacks, event.fallbackReason)
+    }
+    kvstore.write(merged)
+  }
+
+  override def onOtherEvent(event: SparkListenerEvent): Unit = event match {
+    case e: AuronTpuBuildInfoEvent => onBuildInfo(e)
+    case e: AuronTpuConversionEvent => onConversion(e)
+    case _ => // ignore
+  }
+}
+
+object AuronTpuSQLAppStatusListener {
+  def register(sc: SparkContext): Unit = {
+    val kvstore = sc.statusStore.store.asInstanceOf[ElementTrackingStore]
+    val listener = new AuronTpuSQLAppStatusListener(sc.conf, kvstore)
+    // bound retention like the stock SQL listener: evict oldest rows past
+    // spark.sql.ui.retainedExecutions (ElementTrackingStore only evicts
+    // classes that register a trigger)
+    val retained = sc.conf.getInt("spark.sql.ui.retainedExecutions", 1000)
+    kvstore.addTrigger(classOf[AuronTpuExecutionUIData], retained) { count =>
+      val toDelete = (count - retained).toInt
+      if (toDelete > 0) {
+        // natural-index order = ascending executionId (oldest first)
+        val it = kvstore.view(classOf[AuronTpuExecutionUIData])
+          .closeableIterator()
+        try {
+          var n = 0
+          while (n < toDelete && it.hasNext) {
+            kvstore.delete(classOf[AuronTpuExecutionUIData],
+              it.next().executionId)
+            n += 1
+          }
+        } finally it.close()
+      }
+    }
+    sc.listenerBus.addToStatusQueue(listener)
+    AuronTpuSQLTab.attachIfLiveUI(sc, new AuronTpuSQLAppStatusStore(kvstore))
+  }
+}

@@ -1,0 +1,28 @@
+/*
+ * Read side of the auron-tpu status rows (reference
+ * auron-spark-ui/.../AuronSQLAppStatusStore.scala): the tab and the
+ * history server render from here, never from live listener state.
+ */
+package org.apache.spark.sql.auron_tpu.ui
+
+import scala.jdk.CollectionConverters._
+
+import org.apache.spark.util.kvstore.KVStore
+
+class AuronTpuSQLAppStatusStore(store: KVStore) {
+
+  def buildInfo(): Seq[(String, String)] = {
+    val it = store.view(classOf[AuronTpuBuildInfoUIData]).closeableIterator()
+    try {
+      if (it.hasNext) it.next().info else Seq.empty
+    } finally it.close()
+  }
+
+  def executions(): Seq[AuronTpuExecutionUIData] = {
+    val it = store.view(classOf[AuronTpuExecutionUIData]).closeableIterator()
+    try it.asScala.toSeq finally it.close()
+  }
+
+  def executionCount(): Long =
+    store.count(classOf[AuronTpuExecutionUIData])
+}

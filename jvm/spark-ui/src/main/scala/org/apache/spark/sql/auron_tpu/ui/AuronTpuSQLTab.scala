@@ -1,0 +1,66 @@
+/*
+ * "Auron TPU" web UI tab (reference auron-spark-ui/.../AuronSQLTab.scala +
+ * AuronAllExecutionsPage.scala): engine build info and per-query native
+ * conversion outcomes. Per-operator native metrics appear on the stock SQL
+ * tab through the SQLMetrics NativeSegmentExec declares.
+ */
+package org.apache.spark.sql.auron_tpu.ui
+
+import javax.servlet.http.HttpServletRequest
+
+import scala.xml.Node
+
+import org.apache.spark.SparkContext
+import org.apache.spark.ui.{SparkUI, SparkUITab, UIUtils, WebUIPage}
+
+class AuronTpuSQLTab(store: AuronTpuSQLAppStatusStore, ui: SparkUI)
+    extends SparkUITab(ui, "auron_tpu") {
+  override val name: String = "Auron TPU"
+  attachPage(new AuronTpuAllExecutionsPage(this, store))
+  ui.attachTab(this)
+}
+
+object AuronTpuSQLTab {
+  def attachIfLiveUI(sc: SparkContext, store: AuronTpuSQLAppStatusStore): Unit =
+    sc.ui.foreach(ui => new AuronTpuSQLTab(store, ui))
+}
+
+class AuronTpuAllExecutionsPage(
+    parent: AuronTpuSQLTab,
+    store: AuronTpuSQLAppStatusStore)
+  extends WebUIPage("") {
+
+  override def render(request: HttpServletRequest): Seq[Node] = {
+    val build = store.buildInfo()
+    val execs = store.executions()
+    val content =
+      <div>
+        <h4>Engine build</h4>
+        <table class="table table-striped">
+          <tbody>
+            {build.map { case (k, v) => <tr><td>{k}</td><td>{v}</td></tr> }}
+          </tbody>
+        </table>
+        <h4>Native conversion outcomes ({execs.size})</h4>
+        <table class="table table-striped">
+          <thead>
+            <tr><th>Execution</th><th>Description</th>
+              <th>Native segments</th><th>Host fallbacks</th>
+              <th>Fallback reason</th></tr>
+          </thead>
+          <tbody>
+            {execs.map { e =>
+              <tr>
+                <td>{e.executionId}</td>
+                <td>{e.description}</td>
+                <td>{e.nativeSegments}</td>
+                <td>{e.hostFallbacks}</td>
+                <td>{e.fallbackReason.getOrElse("")}</td>
+              </tr>
+            }}
+          </tbody>
+        </table>
+      </div>
+    UIUtils.headerSparkPage(request, "Auron TPU", Seq(content), parent)
+  }
+}

@@ -535,3 +535,37 @@ def test_abi_symbols_cross_checked():
     exported = jvm_lint.exported_abi_symbols()
     if exported is not None:
         assert set(bound) <= exported
+
+
+def test_metric_rollup_twins_agree_on_names():
+    """The SQLMetric set NativeMetrics.scala declares must name REAL engine
+    metrics (names drift silently otherwise), and MetricNode.flat_totals
+    must roll up the snapshot shape the JVM twin parses."""
+    import re
+
+    from auron_tpu.exec.metrics import MetricNode
+
+    # engine-side rollup over a synthetic tree
+    root = MetricNode("root")
+    root.add("output_rows", 5)
+    c = root.child(0)
+    c.add("output_rows", 7)
+    c.add("spill_time", 100)
+    c.child(0).add("spill_time", 50)
+    flat = MetricNode.flat_totals(root.snapshot())
+    assert flat == {"output_rows": 12, "spill_time": 150}
+
+    # every metric the Scala side declares exists somewhere in the engine
+    scala = open(
+        "jvm/spark-extension/src/main/scala/org/apache/spark/sql/"
+        "auron_tpu/NativeMetrics.scala").read()
+    declared = re.findall(r'"([a-z_]+)"\s*->\s*SQLMetrics', scala)
+    assert len(declared) >= 10
+    import subprocess
+
+    for name in declared:
+        r = subprocess.run(
+            ["grep", "-rlE",
+             f'(add|timer|set)\\("{name}"', "auron_tpu/"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, f"Scala declares unknown engine metric {name!r}"
