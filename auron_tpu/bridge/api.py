@@ -64,6 +64,14 @@ def get_resource(key: str) -> Any:
 def remove_resource(key: str) -> None:
     with _lock:
         _resources.pop(key, None)
+        # engine-built clients cached against the resource (e.g. the kafka
+        # wire client under "<rid>.client") die with it
+        client = _resources.pop(f"{key}.client", None)
+    if client is not None and hasattr(client, "close"):
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001 — removal must not raise
+            pass
     # broadcast-build locks are keyed by resource id; evict with the
     # resource so executors don't accumulate one lock per broadcast
     from auron_tpu.exec.joins.bhj import evict_build_lock

@@ -391,6 +391,27 @@ class _Converter:
         # build reuse comes from hash_join.cached_build_id
         return ch[0]
 
+    def _c_KafkaSourceExec(self, n, ch):
+        """Streaming table source (Flink front-end; jvm/flink-extension
+        AuronTpuKafkaTableFactory serializes this node). The source
+        resource is a JSON client config the task runtime materializes
+        into a real KafkaWireSource (exec/streaming.py)."""
+        return B.kafka_scan(
+            n.schema,
+            n.args["topic"],
+            n.args["source_resource_id"],
+            startup_mode=n.args.get("startup_mode", "earliest"),
+            start_offsets={
+                int(k): int(v)
+                for k, v in (n.args.get("start_offsets") or {}).items()
+            },
+            data_format=n.args.get("format", "json"),
+            on_error=n.args.get("on_error", "skip"),
+            max_batch_records=int(n.args.get("max_batch_records", 0)),
+            pb_field_ids=[int(x) for x in n.args.get("pb_field_ids") or []] or None,
+            zigzag_cols=[int(x) for x in n.args.get("zigzag_cols") or []] or None,
+        )
+
     def _c_DataWritingCommandExec(self, n, ch):
         fmt = n.args.get("format", "parquet")
         partition_by = n.args.get("partition_by") or []

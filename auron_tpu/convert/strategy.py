@@ -26,7 +26,7 @@ _OP_KEYS = [
     "local_limit", "global_limit", "take_ordered_and_project", "aggr",
     "expand", "window", "window_group_limit", "generate",
     "local_table_scan", "data_writing", "broadcast_exchange",
-    "shuffle_exchange",
+    "shuffle_exchange", "kafka_scan",
 ]
 ENABLE_FLAGS = {
     k: bool_conf(f"convert.enable.{k}", True, "convert",
@@ -59,6 +59,8 @@ OP_FLAG = {
     "DataWritingCommandExec": "data_writing",
     "BroadcastExchangeExec": "broadcast_exchange",
     "ShuffleExchangeExec": "shuffle_exchange",
+    # streaming front-end (Flink table source; jvm/flink-extension)
+    "KafkaSourceExec": "kafka_scan",
 }
 
 _AGG_OPS = {"HashAggregateExec", "ObjectHashAggregateExec", "SortAggregateExec"}
@@ -142,18 +144,27 @@ def _remove_inefficient_converts(root: HostNode, tags: ConvertTags) -> None:
                 tags.never(node, reason)
                 finished = False
 
+        def induced_boundary(e: HostNode) -> bool:
+            """True when converting e would CREATE a row->columnar
+            boundary. A FlinkStreamInput child is a DECLARED stream
+            boundary (jvm/flink-extension Calc shadow) — the conversion
+            cost exists either way, so the rule must not demote."""
+            return (
+                bool(e.children)
+                and not tags.ok(e.children[0])
+                and e.children[0].op != "FlinkStreamInput"
+            )
+
         for e in root.walk_down():
             # NonNative -> NativeFilter / NativeAgg: converting would force
             # a row->columnar conversion of a large input
             if tags.ok(e) and e.op == "FilterExec":
                 dont_convert(
-                    e, e.children and not tags.ok(e.children[0]),
-                    f"{e.op}, children is not native.",
+                    e, induced_boundary(e), f"{e.op}, children is not native.",
                 )
             if tags.ok(e) and e.op in _AGG_OPS:
                 dont_convert(
-                    e, e.children and not tags.ok(e.children[0]),
-                    f"{e.op}, children is not native.",
+                    e, induced_boundary(e), f"{e.op}, children is not native.",
                 )
             # Agg -> NativeShuffle: next stage likely reads non-natively
             if tags.ok(e) and e.op == "ShuffleExchangeExec":

@@ -351,8 +351,11 @@ class KafkaWireSource:
     earliest/latest/offsets startup, offsets() checkpoint surface.
 
     partitions=None assigns ALL partitions of the topic (single-reader);
-    a split-assigned runtime passes an explicit subset, exactly like the
-    reference source's split assignment."""
+    an explicit subset assigns those (an EMPTY list is a valid zero-split
+    assignment: poll() drains immediately); assign_mod=(index, parallelism)
+    assigns the discovered partitions where pid % parallelism == index —
+    the deterministic round-robin split a parallel runtime uses
+    (KafkaTopicPartitionAssigner analog)."""
 
     def __init__(
         self,
@@ -365,6 +368,7 @@ class KafkaWireSource:
         fetch_max_bytes: int = 4 << 20,
         timeout_s: float = 30.0,
         offset_reset: str = "earliest",
+        assign_mod: tuple[int, int] | None = None,
     ):
         if startup_mode not in ("earliest", "latest", "offsets"):
             raise ValueError(f"unknown startup_mode {startup_mode!r}")
@@ -380,7 +384,7 @@ class KafkaWireSource:
         self.offset_reset = offset_reset
         self._conns: dict[tuple[str, int], KafkaConnection] = {}
         boot = self._conn((host, int(port_s)))
-        self._parts = self._discover(boot, partitions)
+        self._parts = self._discover(boot, partitions, assign_mod)
         self._init_offsets(startup_mode, start_offsets or {})
         self._rr = 0  # round-robin cursor over assigned partitions
 
@@ -393,7 +397,12 @@ class KafkaWireSource:
             )
         return self._conns[addr]
 
-    def _discover(self, boot: KafkaConnection, wanted: list[int] | None):
+    def _discover(
+        self,
+        boot: KafkaConnection,
+        wanted: list[int] | None,
+        assign_mod: tuple[int, int] | None = None,
+    ):
         body = struct.pack(">i", 1) + enc_str(self.topic)
         c = boot.request(API_METADATA, 1, body)
         brokers = {}
@@ -422,12 +431,17 @@ class KafkaWireSource:
                     continue
                 if wanted is not None and pid not in wanted:
                     continue
+                if assign_mod is not None and pid % assign_mod[1] != assign_mod[0]:
+                    continue
                 if perr:
                     raise RuntimeError(f"partition {pid} metadata error {perr}")
                 parts[pid] = _PartitionState(leader=brokers[leader])
             if err:
                 raise RuntimeError(f"topic {name} metadata error {err}")
-        if not parts:
+        if not parts and wanted is None and assign_mod is None:
+            # an explicit empty/mod assignment is a valid zero-split reader
+            # (parallelism > partition count); only ALL-partitions discovery
+            # of a partitionless topic is an error
             raise RuntimeError(f"topic {self.topic}: no assignable partitions")
         return parts
 

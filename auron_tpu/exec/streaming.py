@@ -345,13 +345,14 @@ class KafkaScanExec(ExecOperator):
         raise ValueError(f"unsupported streaming format {self.data_format!r}")
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        de = self._make_deserializer()  # validate format BEFORE connecting
         provider = ctx.resources[self.source_resource_id]
-        source = (
-            provider(self.topic, self.startup_mode, dict(self.start_offsets))
-            if callable(provider)
-            else provider
-        )
-        de = self._make_deserializer()
+        if isinstance(provider, (bytes, bytearray)):
+            source = self._client_from_config(bytes(provider), partition, ctx)
+        elif callable(provider):
+            source = provider(self.topic, self.startup_mode, dict(self.start_offsets))
+        else:
+            source = provider
         try:
             while (payloads := source.poll(self.max_batch_records)) is not None:
                 ctx.check_cancelled()
@@ -361,7 +362,62 @@ class KafkaScanExec(ExecOperator):
                     yield Batch.from_arrow(rb)
         finally:
             # an ABORTED stream is exactly when resume offsets matter:
-            # surface checkpoint state + error counts on every exit path
+            # surface checkpoint state + error counts on every exit path.
+            # Offsets also ride the metric tree so C-ABI hosts (which can
+            # only read finalize JSON) can checkpoint them.
             if de.errors:
                 ctx.metrics.add("deserialize_errors", de.errors)
-            ctx.resources[f"{self.source_resource_id}.offsets"] = source.offsets()
+            offsets = source.offsets()
+            ctx.resources[f"{self.source_resource_id}.offsets"] = offsets
+            for pid, off in offsets.items():
+                ctx.metrics.set(f"kafka_offset_p{pid}", int(off))
+            # engine-built clients are CACHED against the resource (the
+            # cache entry dies with remove_resource); caller-provided
+            # sources keep their caller's lifecycle
+
+    def _client_from_config(
+        self, config: bytes, partition: int, ctx: ExecutionContext
+    ):
+        """Host-registered client config (auron_put_resource_bytes from the
+        Flink front-end) -> a real wire client, CACHED in the resource map
+        so successive micro-batch tasks reuse the TCP connections and the
+        client's own position (bridge/api.remove_resource closes it).
+
+        Config keys: bootstrap (required); start_offsets {pid: next}
+        (overrides the plan's startup for restores); partition_assignment
+        {task_index: [pids]} (missing index = zero-split) or assign_mod
+        [index, parallelism] (deterministic round-robin split);
+        offset_reset."""
+        import json as _json
+
+        from auron_tpu.exec.kafka_wire import KafkaWireSource
+
+        # cache in the executor-shared store (the live bridge resource map;
+        # ctx.resources is a per-task snapshot) — successive tasks reuse it
+        store = ctx.shared if ctx.shared is not None else ctx.resources
+        cache_key = f"{self.source_resource_id}.client"
+        cached = store.get(cache_key)
+        if cached is not None:
+            return cached  # continue from the client's own position
+        cfg = _json.loads(config)
+        assigned = cfg.get("partition_assignment")
+        cfg_offsets = cfg.get("start_offsets")
+        if cfg_offsets:
+            mode = "offsets"
+            offsets = {int(k): int(v) for k, v in cfg_offsets.items()}
+        else:
+            mode = self.startup_mode
+            offsets = dict(self.start_offsets)
+        source = KafkaWireSource(
+            cfg["bootstrap"],
+            self.topic,
+            mode,
+            offsets,
+            partitions=(
+                list(assigned.get(str(partition), [])) if assigned else None
+            ),
+            assign_mod=(tuple(cfg["assign_mod"]) if cfg.get("assign_mod") else None),
+            offset_reset=cfg.get("offset_reset", "earliest"),
+        )
+        store[cache_key] = source
+        return source

@@ -1,0 +1,99 @@
+/*
+ * Streaming Calc runtime operator (reference
+ * auron-flink-runtime/.../FlinkAuronCalcOperator.java:31-80, condensed):
+ * micro-batches input rows, ships each batch to the engine as an Arrow
+ * IPC FFI resource, runs the converted Calc task through the C ABI
+ * (NativeBridge, shared with the Spark shim) and emits the engine's
+ * output rows. Stateless between batches — checkpointing passes through
+ * (the engine-side Calc keeps no state; SURVEY §5).
+ */
+package org.apache.auron_tpu.flink;
+
+import java.util.ArrayList;
+import java.util.List;
+
+import org.apache.flink.streaming.api.operators.AbstractStreamOperator;
+import org.apache.flink.streaming.api.operators.OneInputStreamOperator;
+import org.apache.flink.streaming.api.watermark.Watermark;
+import org.apache.flink.streaming.runtime.streamrecord.StreamRecord;
+import org.apache.flink.table.data.RowData;
+import org.apache.flink.table.types.logical.RowType;
+
+import org.apache.auron_tpu.NativeBridge;
+
+public class AuronTpuCalcOperator extends AbstractStreamOperator<RowData>
+        implements OneInputStreamOperator<RowData, RowData> {
+
+    /** Rows per native invocation: amortizes the C-ABI round trip without
+     * holding a stream batch long enough to matter for latency. */
+    static final int FLUSH_ROWS = 8192;
+
+    private final String taskJson;
+    private final RowType inputType;
+    private final RowType outputType;
+
+    private transient List<RowData> pending;
+    private transient byte[] taskProto;  // conversion result, bound in open()
+    private transient String resourceKey;
+    private transient FlinkArrowBridge arrow;
+
+    public AuronTpuCalcOperator(String taskJson, RowType inputType, RowType outputType) {
+        this.taskJson = taskJson;
+        this.inputType = inputType;
+        this.outputType = outputType;
+    }
+
+    @Override
+    public void open() throws Exception {
+        super.open();
+        pending = new ArrayList<>(FLUSH_ROWS);
+        int subtask = getRuntimeContext().getIndexOfThisSubtask();
+        // engine conversion once per operator instance: hostplan JSON ->
+        // TaskDefinition-ready proto (the same auron_convert_plan service
+        // the Spark shim calls); the response names the FFI input resource
+        String resp = NativeBridge.convertPlan(taskJson);
+        taskProto = TaskProtoCodec.fromResponse(resp, subtask);
+        resourceKey = TaskProtoCodec.inputResourceId(resp) + "." + subtask;
+        arrow = new FlinkArrowBridge(inputType, outputType);
+    }
+
+    @Override
+    public void processElement(StreamRecord<RowData> element) throws Exception {
+        pending.add(element.getValue());
+        if (pending.size() >= FLUSH_ROWS) {
+            flush();
+        }
+    }
+
+    @Override
+    public void processWatermark(Watermark mark) throws Exception {
+        flush(); // watermarks must not overtake their rows
+        super.processWatermark(mark);
+    }
+
+    @Override
+    public void finish() throws Exception {
+        flush();
+        super.finish();
+    }
+
+    private void flush() throws Exception {
+        if (pending.isEmpty()) {
+            return;
+        }
+        NativeBridge.putResource(resourceKey, arrow.encode(pending));
+        pending.clear();
+        long handle = NativeBridge.callNative(taskProto);
+        try {
+            byte[] ipc;
+            while ((ipc = NativeBridge.nextBatch(handle)) != null) {
+                for (RowData row : arrow.decode(ipc)) {
+                    output.collect(new StreamRecord<>(row));
+                }
+            }
+        } finally {
+            NativeBridge.finalizeNative(handle);
+            NativeBridge.removeResource(resourceKey);
+        }
+    }
+}

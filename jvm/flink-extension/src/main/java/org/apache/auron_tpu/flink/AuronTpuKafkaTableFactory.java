@@ -1,0 +1,117 @@
+/*
+ * Flink dynamic table source for the engine's native Kafka scan
+ * (reference auron-flink-runtime/connector/kafka/
+ * AuronKafkaDynamicTableFactory.java + AuronKafkaDynamicTableSource.java,
+ * condensed): 'connector' = 'auron-tpu-kafka' binds a table to the
+ * engine-side kafka_scan plan node, whose task consumes the broker with
+ * the engine's own wire client (auron_tpu/exec/kafka_wire.py) and
+ * deserializes records natively (json/protobuf).
+ */
+package org.apache.auron_tpu.flink;
+
+import java.util.HashSet;
+import java.util.Set;
+
+import org.apache.flink.configuration.ConfigOption;
+import org.apache.flink.configuration.ConfigOptions;
+import org.apache.flink.configuration.ReadableConfig;
+import org.apache.flink.table.connector.ChangelogMode;
+import org.apache.flink.table.connector.source.DynamicTableSource;
+import org.apache.flink.table.connector.source.ScanTableSource;
+import org.apache.flink.table.connector.source.SourceFunctionProvider;
+import org.apache.flink.table.factories.DynamicTableSourceFactory;
+import org.apache.flink.table.factories.FactoryUtil;
+import org.apache.flink.table.types.logical.RowType;
+
+public class AuronTpuKafkaTableFactory implements DynamicTableSourceFactory {
+
+    public static final ConfigOption<String> TOPIC =
+        ConfigOptions.key("topic").stringType().noDefaultValue();
+    public static final ConfigOption<String> BOOTSTRAP =
+        ConfigOptions.key("properties.bootstrap.servers").stringType().noDefaultValue();
+    public static final ConfigOption<String> FORMAT =
+        ConfigOptions.key("value.format").stringType().defaultValue("json");
+    public static final ConfigOption<String> STARTUP_MODE =
+        ConfigOptions.key("scan.startup.mode").stringType().defaultValue("earliest");
+    public static final ConfigOption<String> ON_ERROR =
+        ConfigOptions.key("value.on-error").stringType().defaultValue("skip");
+
+    @Override
+    public String factoryIdentifier() {
+        return "auron-tpu-kafka";
+    }
+
+    @Override
+    public Set<ConfigOption<?>> requiredOptions() {
+        Set<ConfigOption<?>> s = new HashSet<>();
+        s.add(TOPIC);
+        s.add(BOOTSTRAP);
+        return s;
+    }
+
+    @Override
+    public Set<ConfigOption<?>> optionalOptions() {
+        Set<ConfigOption<?>> s = new HashSet<>();
+        s.add(FORMAT);
+        s.add(STARTUP_MODE);
+        s.add(ON_ERROR);
+        return s;
+    }
+
+    @Override
+    public DynamicTableSource createDynamicTableSource(Context context) {
+        FactoryUtil.TableFactoryHelper helper =
+            FactoryUtil.createTableFactoryHelper(this, context);
+        helper.validate();
+        ReadableConfig opts = helper.getOptions();
+        RowType rowType = (RowType) context.getCatalogTable()
+            .getResolvedSchema().toPhysicalRowDataType().getLogicalType();
+        return new AuronTpuKafkaTableSource(
+            opts.get(TOPIC), opts.get(BOOTSTRAP), opts.get(FORMAT),
+            opts.get(STARTUP_MODE), opts.get(ON_ERROR), rowType);
+    }
+
+    /** ScanTableSource wrapping the engine-driven source function. */
+    public static class AuronTpuKafkaTableSource implements ScanTableSource {
+        private final String topic;
+        private final String bootstrap;
+        private final String format;
+        private final String startupMode;
+        private final String onError;
+        private final RowType rowType;
+
+        AuronTpuKafkaTableSource(String topic, String bootstrap, String format,
+                String startupMode, String onError, RowType rowType) {
+            this.topic = topic;
+            this.bootstrap = bootstrap;
+            this.format = format;
+            this.startupMode = startupMode;
+            this.onError = onError;
+            this.rowType = rowType;
+        }
+
+        @Override
+        public ChangelogMode getChangelogMode() {
+            return ChangelogMode.insertOnly();
+        }
+
+        @Override
+        public ScanRuntimeProvider getScanRuntimeProvider(ScanContext ctx) {
+            return SourceFunctionProvider.of(
+                new AuronTpuKafkaSourceFunction(
+                    topic, bootstrap, format, startupMode, onError, rowType),
+                false);
+        }
+
+        @Override
+        public DynamicTableSource copy() {
+            return new AuronTpuKafkaTableSource(
+                topic, bootstrap, format, startupMode, onError, rowType);
+        }
+
+        @Override
+        public String asSummaryString() {
+            return "auron-tpu-kafka[" + topic + "]";
+        }
+    }
+}

@@ -1,0 +1,87 @@
+/*
+ * RowData <-> Arrow IPC stream for the C-ABI boundary (the role of the
+ * reference's auron-flink-runtime/arrow/ package — FlinkArrowWriter/
+ * FlinkArrowReader + per-type writers/vectors — condensed onto Flink's
+ * own arrow runtime utilities instead of hand-written per-type classes).
+ */
+package org.apache.auron_tpu.flink;
+
+import java.io.ByteArrayInputStream;
+import java.io.ByteArrayOutputStream;
+import java.util.ArrayList;
+import java.util.List;
+
+import org.apache.arrow.memory.RootAllocator;
+import org.apache.arrow.vector.VectorSchemaRoot;
+import org.apache.arrow.vector.ipc.ArrowStreamReader;
+import org.apache.arrow.vector.ipc.ArrowStreamWriter;
+import org.apache.flink.table.data.RowData;
+import org.apache.flink.table.runtime.arrow.ArrowReader;
+import org.apache.flink.table.runtime.arrow.ArrowUtils;
+import org.apache.flink.table.runtime.arrow.ArrowWriter;
+import org.apache.flink.table.types.logical.RowType;
+
+public final class FlinkArrowBridge implements AutoCloseable {
+
+    private final RowType inputType;
+    private final RowType outputType;
+    private final RootAllocator allocator = new RootAllocator(Long.MAX_VALUE);
+
+    public FlinkArrowBridge(RowType inputType, RowType outputType) {
+        this.inputType = inputType;
+        this.outputType = outputType;
+    }
+
+    /** Buffered rows -> one Arrow IPC stream (engine FFI input form). */
+    public byte[] encode(List<RowData> rows) throws Exception {
+        try (VectorSchemaRoot root = VectorSchemaRoot.create(
+                ArrowUtils.toArrowSchema(inputType), allocator)) {
+            ArrowWriter<RowData> writer = ArrowUtils.createRowDataArrowWriter(root, inputType);
+            for (RowData r : rows) {
+                writer.write(r);
+            }
+            writer.finish();
+            ByteArrayOutputStream bytes = new ByteArrayOutputStream();
+            try (ArrowStreamWriter ipc = new ArrowStreamWriter(root, null, bytes)) {
+                ipc.start();
+                ipc.writeBatch();
+                ipc.end();
+            }
+            return bytes.toByteArray();
+        }
+    }
+
+    /** Engine IPC output -> materialized RowData list (all batches).
+     * ArrowReader.read returns a view over the vectors, which die with
+     * the reader: copy each row into a GenericRowData via FieldGetters. */
+    public List<RowData> decode(byte[] ipc) throws Exception {
+        int n = outputType.getFieldCount();
+        RowData.FieldGetter[] getters = new RowData.FieldGetter[n];
+        for (int i = 0; i < n; i++) {
+            getters[i] = RowData.createFieldGetter(outputType.getTypeAt(i), i);
+        }
+        List<RowData> out = new ArrayList<>();
+        try (ArrowStreamReader reader =
+                new ArrowStreamReader(new ByteArrayInputStream(ipc), allocator)) {
+            while (reader.loadNextBatch()) {
+                VectorSchemaRoot root = reader.getVectorSchemaRoot();
+                ArrowReader rowReader = ArrowUtils.createArrowReader(root, outputType);
+                for (int i = 0; i < root.getRowCount(); i++) {
+                    RowData view = rowReader.read(i);
+                    org.apache.flink.table.data.GenericRowData copy =
+                        new org.apache.flink.table.data.GenericRowData(n);
+                    for (int f = 0; f < n; f++) {
+                        copy.setField(f, getters[f].getFieldOrNull(view));
+                    }
+                    out.add(copy);
+                }
+            }
+        }
+        return out;
+    }
+
+    @Override
+    public void close() {
+        allocator.close();
+    }
+}

@@ -1,0 +1,172 @@
+/*
+ * Calc (projection + condition) -> engine hostplan JSON (the converter
+ * layer of the reference's auron-flink-planner/converter/* package,
+ * condensed). The node/expression encoding is the SAME wire contract the
+ * Spark shim's HostPlanSerializer produces (auron_tpu/convert/hostplan.py
+ * reads it): one conversion service serves both front-ends. The input
+ * stream appears as an unknown "FlinkStreamInput" node, which the engine
+ * tags unconvertible — it becomes the segment's FFI boundary and the
+ * response names the resource id the runtime operator feeds.
+ */
+package org.apache.auron_tpu.flink;
+
+import java.util.List;
+
+import org.apache.calcite.rex.RexCall;
+import org.apache.calcite.rex.RexInputRef;
+import org.apache.calcite.rex.RexLiteral;
+import org.apache.calcite.rex.RexNode;
+import org.apache.flink.table.types.logical.LogicalType;
+import org.apache.flink.table.types.logical.RowType;
+
+public final class FlinkCalcConverter {
+
+    /** Conversion bail: carries the unsupported node class for the
+     * once-per-class WARN in the shadow. */
+    public static final class Unsupported extends RuntimeException {
+        public final String nodeClass;
+
+        public Unsupported(String nodeClass, String msg) {
+            super(msg);
+            this.nodeClass = nodeClass;
+        }
+    }
+
+    private FlinkCalcConverter() {}
+
+    /** Serialize the Calc fragment as hostplan JSON the engine converts:
+     * ProjectExec -> (FilterExec ->) FlinkStreamInput. */
+    public static String convert(
+            List<RexNode> projection,
+            RexNode condition,
+            RowType inputType,
+            RowType outputType) {
+        String input = "{\"op\":\"FlinkStreamInput\",\"schema\":"
+            + schema(inputType) + ",\"args\":{},\"children\":[]}";
+        String child = input;
+        if (condition != null) {
+            child = "{\"op\":\"FilterExec\",\"schema\":" + schema(inputType)
+                + ",\"args\":{\"predicates\":[" + expr(condition)
+                + "]},\"children\":[" + input + "]}";
+        }
+        StringBuilder projections = new StringBuilder();
+        for (int i = 0; i < projection.size(); i++) {
+            if (i > 0) projections.append(',');
+            projections.append(expr(projection.get(i)));
+        }
+        return "{\"op\":\"ProjectExec\",\"schema\":" + schema(outputType)
+            + ",\"args\":{\"projections\":[" + projections
+            + "]},\"children\":[" + child + "]}";
+    }
+
+    static String expr(RexNode node) {
+        if (node instanceof RexInputRef) {
+            RexInputRef ref = (RexInputRef) node;
+            return "{\"kind\":\"attr\",\"index\":" + ref.getIndex() + "}";
+        }
+        if (node instanceof RexLiteral) {
+            RexLiteral lit = (RexLiteral) node;
+            Object v = lit.getValue3();
+            String type = typeName(lit.getType().getSqlTypeName().getName());
+            String value = v == null ? "null"
+                : (v instanceof Number || v instanceof Boolean)
+                    ? v.toString() : quote(v.toString());
+            return "{\"kind\":\"lit\",\"type\":" + quote(type)
+                + ",\"value\":" + value + "}";
+        }
+        if (node instanceof RexCall) {
+            RexCall call = (RexCall) node;
+            return call(opName(call.getOperator().getName()), call.getOperands());
+        }
+        throw new Unsupported(node.getClass().getName(), node.toString());
+    }
+
+    private static String call(String name, List<RexNode> operands) {
+        StringBuilder args = new StringBuilder();
+        for (int i = 0; i < operands.size(); i++) {
+            if (i > 0) args.append(',');
+            args.append(expr(operands.get(i)));
+        }
+        String inner = "{\"kind\":\"call\",\"name\":" + quote(
+                name.startsWith("not:") ? name.substring(4) : name)
+            + ",\"children\":[" + args + "]}";
+        if (name.startsWith("not:")) {
+            return "{\"kind\":\"call\",\"name\":\"not\",\"children\":["
+                + inner + "]}";
+        }
+        return inner;
+    }
+
+    /** Calcite operator -> engine expression name (convert/exprs.py
+     * _BINOPS + function registry names; "not:" prefix wraps in NOT). */
+    private static String opName(String calcite) {
+        switch (calcite) {
+            case "+": return "add";
+            case "-": return "subtract";
+            case "*": return "multiply";
+            case "/": return "divide";
+            case "MOD": return "remainder";
+            case "=": return "equalto";
+            case "<>": return "not:equalto";
+            case "<": return "lessthan";
+            case "<=": return "lessthanorequal";
+            case ">": return "greaterthan";
+            case ">=": return "greaterthanorequal";
+            case "AND": return "and";
+            case "OR": return "or";
+            case "NOT": return "not";
+            case "IS NULL": return "isnull";
+            case "IS NOT NULL": return "isnotnull";
+            case "CAST": return "cast";
+            case "UPPER": return "upper";
+            case "LOWER": return "lower";
+            case "ABS": return "abs";
+            case "COALESCE": return "coalesce";
+            case "CONCAT": return "concat";
+            default:
+                throw new Unsupported("RexCall:" + calcite, calcite);
+        }
+    }
+
+    static String schema(RowType row) {
+        StringBuilder b = new StringBuilder("[");
+        for (int i = 0; i < row.getFieldCount(); i++) {
+            if (i > 0) b.append(',');
+            LogicalType t = row.getTypeAt(i);
+            b.append('[').append(quote(row.getFieldNames().get(i)))
+                .append(',').append(quote(typeName(t.getTypeRoot().name())))
+                .append(',').append(t.isNullable()).append(']');
+        }
+        return b.append(']').toString();
+    }
+
+    /** Flink/Calcite type name -> engine hostplan type name. */
+    static String typeName(String root) {
+        switch (root) {
+            case "BOOLEAN": return "boolean";
+            case "TINYINT": return "tinyint";
+            case "SMALLINT": return "smallint";
+            case "INTEGER": case "INT": return "int";
+            case "BIGINT": return "long";
+            case "FLOAT": case "REAL": return "float";
+            case "DOUBLE": return "double";
+            case "CHAR": case "VARCHAR": return "string";
+            case "DATE": return "date";
+            case "TIMESTAMP": case "TIMESTAMP_WITHOUT_TIME_ZONE":
+                return "timestamp";
+            default:
+                throw new Unsupported("type:" + root, root);
+        }
+    }
+
+    static String quote(String s) {
+        StringBuilder b = new StringBuilder("\"");
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            if (c == '"' || c == '\\') b.append('\\').append(c);
+            else if (c < ' ') b.append(String.format("\\u%04x", (int) c));
+            else b.append(c);
+        }
+        return b.append('"').toString();
+    }
+}

@@ -1,0 +1,74 @@
+/*
+ * Conversion response -> per-subtask TaskDefinition bytes (the Flink twin
+ * of the Spark shim's TaskDefs varint assembly; proto/plan.proto:514-519).
+ * The response carries the segment's TaskDefinition-ready plan base64'd:
+ * {"converted": true, "root": {"kind": "segment",
+ *   "segment": {"plan_b64": "..."}}}.
+ */
+package org.apache.auron_tpu.flink;
+
+import java.io.ByteArrayOutputStream;
+import java.util.Base64;
+import java.util.regex.Matcher;
+import java.util.regex.Pattern;
+
+public final class TaskProtoCodec {
+
+    private TaskProtoCodec() {}
+
+    private static final Pattern PLAN_B64 =
+        Pattern.compile("\"plan_b64\"\\s*:\\s*\"([A-Za-z0-9+/=]+)\"");
+    private static final Pattern CONVERTED =
+        Pattern.compile("\"converted\"\\s*:\\s*true");
+    private static final Pattern RESOURCE_ID =
+        Pattern.compile("\"resource_id\"\\s*:\\s*\"([^\"]+)\"");
+
+    /** The segment's first FFI input resource id (the runtime operator
+     * registers "<rid>.<subtask>" per micro-batch). */
+    public static String inputResourceId(String responseJson) {
+        Matcher m = RESOURCE_ID.matcher(responseJson);
+        if (!m.find()) {
+            throw new IllegalStateException(
+                "conversion response names no FFI input: " + trim(responseJson));
+        }
+        return m.group(1);
+    }
+
+    /** Extract the (single-stage) segment plan and stamp the subtask id. */
+    public static byte[] fromResponse(String responseJson, int partitionId) {
+        if (!CONVERTED.matcher(responseJson).find()) {
+            throw new IllegalStateException(
+                "engine did not convert the calc fragment: " + trim(responseJson));
+        }
+        Matcher m = PLAN_B64.matcher(responseJson);
+        if (!m.find()) {
+            throw new IllegalStateException(
+                "conversion response carries no plan_b64: " + trim(responseJson));
+        }
+        byte[] plan = Base64.getDecoder().decode(m.group(1));
+        return assemble(plan, partitionId);
+    }
+
+    /** TaskDefinition{plan=1, partition_id=3} via manual varint framing. */
+    public static byte[] assemble(byte[] planProto, int partitionId) {
+        ByteArrayOutputStream out = new ByteArrayOutputStream();
+        writeVarint(out, (1 << 3) | 2); // field 1 (plan), length-delimited
+        writeVarint(out, planProto.length);
+        out.write(planProto, 0, planProto.length);
+        writeVarint(out, (3 << 3)); // field 3 (partition_id), varint
+        writeVarint(out, partitionId);
+        return out.toByteArray();
+    }
+
+    private static void writeVarint(ByteArrayOutputStream out, int v) {
+        while ((v & ~0x7F) != 0) {
+            out.write((v & 0x7F) | 0x80);
+            v >>>= 7;
+        }
+        out.write(v);
+    }
+
+    private static String trim(String s) {
+        return s.length() > 200 ? s.substring(0, 200) + "..." : s;
+    }
+}

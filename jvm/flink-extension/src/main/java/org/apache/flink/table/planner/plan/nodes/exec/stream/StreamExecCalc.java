@@ -1,0 +1,109 @@
+/*
+ * Shadow of Flink's stock StreamExecCalc (reference
+ * auron-flink-planner/.../StreamExecCalc.java:52 mechanism): Java resolves
+ * one class per fully-qualified name, so with the auron-tpu flink jar
+ * classpath-ordered ahead of flink-table-planner, the planner constructs
+ * THIS class for every Calc ExecNode. Translation attempts the engine
+ * conversion; any failure falls back to the stock translation (or throws
+ * when spark-style strict mode is configured).
+ */
+package org.apache.flink.table.planner.plan.nodes.exec.stream;
+
+import java.util.Collections;
+import java.util.List;
+import java.util.concurrent.ConcurrentHashMap;
+import java.util.concurrent.atomic.AtomicBoolean;
+
+import javax.annotation.Nullable;
+
+import org.apache.calcite.rex.RexNode;
+import org.apache.flink.api.dag.Transformation;
+import org.apache.flink.configuration.ReadableConfig;
+import org.apache.flink.streaming.api.operators.SimpleOperatorFactory;
+import org.apache.flink.table.data.RowData;
+import org.apache.flink.table.planner.delegation.PlannerBase;
+import org.apache.flink.table.planner.plan.nodes.exec.ExecNodeConfig;
+import org.apache.flink.table.planner.plan.nodes.exec.ExecNodeContext;
+import org.apache.flink.table.planner.plan.nodes.exec.InputProperty;
+import org.apache.flink.table.planner.plan.nodes.exec.common.CommonExecCalc;
+import org.apache.flink.table.planner.plan.nodes.exec.utils.ExecNodeUtil;
+import org.apache.flink.table.runtime.operators.TableStreamOperator;
+import org.apache.flink.table.runtime.typeutils.InternalTypeInfo;
+import org.apache.flink.table.types.logical.RowType;
+import org.slf4j.Logger;
+import org.slf4j.LoggerFactory;
+
+import org.apache.auron_tpu.flink.AuronTpuCalcOperator;
+import org.apache.auron_tpu.flink.FlinkCalcConverter;
+
+public class StreamExecCalc extends CommonExecCalc {
+
+    private static final Logger LOG = LoggerFactory.getLogger(StreamExecCalc.class);
+    private static final AtomicBoolean ACTIVATION_LOGGED = new AtomicBoolean();
+    /** once-per-RexNode-class fallback WARNs (grep surface for coverage). */
+    private static final ConcurrentHashMap.KeySetView<String, Boolean> WARNED =
+        ConcurrentHashMap.newKeySet();
+
+    public StreamExecCalc(
+            ReadableConfig tableConfig,
+            List<RexNode> projection,
+            @Nullable RexNode condition,
+            InputProperty inputProperty,
+            RowType outputType,
+            String description) {
+        super(
+            ExecNodeContext.newNodeId(),
+            ExecNodeContext.newContext(StreamExecCalc.class),
+            ExecNodeContext.newPersistedConfig(StreamExecCalc.class, tableConfig),
+            projection,
+            condition,
+            TableStreamOperator.class,
+            true,
+            Collections.singletonList(inputProperty),
+            outputType,
+            description);
+    }
+
+    @Override
+    @SuppressWarnings("unchecked")
+    protected Transformation<RowData> translateToPlanInternal(
+            PlannerBase planner, ExecNodeConfig config) {
+        if (ACTIVATION_LOGGED.compareAndSet(false, true)) {
+            LOG.info("auron-tpu StreamExecCalc shadow active");
+        }
+        boolean failBack = config.getConfiguration()
+            .getString("auron_tpu.fail.back.enabled", "true")
+            .equals("true");
+        try {
+            RowType inputType = (RowType) getInputEdges().get(0).getOutputType();
+            String taskJson = FlinkCalcConverter.convert(
+                projection, condition, inputType, (RowType) getOutputType());
+            Transformation<RowData> input = (Transformation<RowData>)
+                getInputEdges().get(0).translateToPlan(planner);
+            return ExecNodeUtil.createOneInputTransformation(
+                input,
+                createTransformationMeta("auron-tpu-calc", "AuronTpuCalc", "Calc", config),
+                SimpleOperatorFactory.of(new AuronTpuCalcOperator(
+                    taskJson, inputType, (RowType) getOutputType())),
+                InternalTypeInfo.of(getOutputType()),
+                input.getParallelism(),
+                false);
+        } catch (FlinkCalcConverter.Unsupported e) {
+            if (WARNED.add(e.nodeClass)) {
+                LOG.warn("auron-tpu calc fallback: unsupported {} ({})",
+                    e.nodeClass, e.getMessage());
+            }
+            if (!failBack) {
+                throw new IllegalStateException(
+                    "auron_tpu.fail.back.enabled=false and calc conversion failed", e);
+            }
+            return super.translateToPlanInternal(planner, config);
+        } catch (Throwable t) {
+            if (!failBack) {
+                throw new IllegalStateException("auron-tpu calc translation failed", t);
+            }
+            LOG.warn("auron-tpu calc fallback: {}", t.toString());
+            return super.translateToPlanInternal(planner, config);
+        }
+    }
+}
