@@ -325,18 +325,11 @@ class KafkaConnection:
         return c
 
     def _read_frame(self) -> bytes:
-        hdr = self._read_exact(4)
-        (n,) = struct.unpack(">i", hdr)
-        return self._read_exact(n)
+        from auron_tpu.utils.netio import read_exact
 
-    def _read_exact(self, n: int) -> bytes:
-        out = io.BytesIO()
-        while out.tell() < n:
-            chunk = self.sock.recv(n - out.tell())
-            if not chunk:
-                raise ConnectionError("broker closed connection")
-            out.write(chunk)
-        return out.getvalue()
+        hdr = read_exact(self.sock, 4)
+        (n,) = struct.unpack(">i", hdr)
+        return read_exact(self.sock, n)
 
 
 @dataclass

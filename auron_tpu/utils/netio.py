@@ -1,0 +1,20 @@
+"""Shared socket framing helpers (kafka_wire + rss_net clients/servers)."""
+
+from __future__ import annotations
+
+import io
+import socket
+
+
+def read_exact(sock: socket.socket, n: int, eof_ok: bool = False) -> bytes | None:
+    """Read exactly n bytes. On EOF: None when eof_ok (clean close between
+    frames), else ConnectionError (truncated frame)."""
+    buf = io.BytesIO()
+    while buf.tell() < n:
+        chunk = sock.recv(n - buf.tell())
+        if not chunk:
+            if eof_ok and buf.tell() == 0:
+                return None
+            raise ConnectionError(f"connection closed mid-frame ({buf.tell()}/{n})")
+        buf.write(chunk)
+    return buf.getvalue()
