@@ -79,6 +79,14 @@ def remove_resource(key: str) -> None:
     evict_build_lock(key)
 
 
+def install_udf_callback(fn_ptr: int) -> None:
+    """C-ABI entry (auron_register_udf_callback): install the host's UDF
+    evaluator; __hive:<token> expressions route through it."""
+    from auron_tpu.bridge import udf
+
+    udf.install_c_callback(int(fn_ptr))
+
+
 # ---- task entry points ----
 
 

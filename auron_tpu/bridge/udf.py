@@ -26,6 +26,10 @@ def register_udf(name: str, fn: Callable) -> None:
 
 
 def lookup_udf(name: str) -> Callable:
+    if name.startswith("__hive:"):
+        # Hive UDF glue: evaluation routes through the host's C-ABI
+        # callback with the plan-embedded serialized function
+        return hive_blob_udf(name[len("__hive:"):])
     if name not in _UDFS:
         raise KeyError(f"host UDF '{name}' is not registered with the bridge")
     return _UDFS[name]
@@ -121,3 +125,83 @@ def lookup_udtf(name: str) -> tuple[Callable, "object"]:
     if name not in _UDTFS:
         raise KeyError(f"host UDTF '{name}' is not registered with the bridge")
     return _UDTFS[name]
+
+
+# ---------------------------------------------------------------------------
+# C-ABI host callback (Hive UDF glue — auron_register_udf_callback)
+# ---------------------------------------------------------------------------
+
+_C_EVAL = None  # ctypes-wrapped host evaluator; process-wide like the C ABI
+
+
+def install_c_callback(fn_ptr: int) -> None:
+    """Called by auron_register_udf_callback (native/auron_bridge.cpp) with
+    the host's evaluator function pointer. __hive:<blob> HostUDFs then
+    marshal their argument columns as one Arrow IPC stream, call the host
+    with the plan-embedded serialized function, and decode the single
+    result column (the SparkUDFWrapper/HiveUDFUtil channel of the
+    reference, C-ABI-shaped). The blob travels IN the plan, so any
+    executor evaluates without a driver-local registry."""
+    import ctypes
+
+    global _C_EVAL
+    proto = ctypes.CFUNCTYPE(
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,  # udf blob
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,  # args ipc
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out ipc
+        ctypes.POINTER(ctypes.c_size_t),
+    )
+    _C_EVAL = proto(fn_ptr)
+
+
+def host_callback_installed() -> bool:
+    return _C_EVAL is not None
+
+
+def _eval_via_c(blob: bytes, args: list[pa.Array], n: int) -> pa.Array:
+    import ctypes
+    import io
+
+    cols = [a if isinstance(a, pa.Array) else pa.array(a) for a in args]
+    tbl = pa.table(
+        {f"a{i}": c for i, c in enumerate(cols)}
+        or {"__empty": pa.nulls(n)}
+    )
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    payload = sink.getvalue()
+    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+    bbuf = (ctypes.c_uint8 * max(len(blob), 1)).from_buffer_copy(blob or b"\x00")
+    out_ptr = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t(0)
+    rc = _C_EVAL(bbuf, len(blob), buf, len(payload),
+                 ctypes.byref(out_ptr), ctypes.byref(out_len))
+    if rc != 0:
+        raise RuntimeError(f"host UDF callback failed (rc={rc})")
+    data = ctypes.string_at(out_ptr, out_len.value)
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        result = r.read_all()
+    if result.num_columns != 1 or result.num_rows != n:
+        raise RuntimeError(
+            f"host UDF: expected 1 column x {n} rows, got "
+            f"{result.num_columns} x {result.num_rows}"
+        )
+    return result.column(0).combine_chunks()
+
+
+def hive_blob_udf(blob_b64: str):
+    """The callable lookup_udf returns for __hive:<b64 blob> names."""
+    import base64
+
+    blob = base64.b64decode(blob_b64)
+
+    def fn(args: list[pa.Array], n: int) -> pa.Array:
+        if _C_EVAL is None:
+            raise RuntimeError(
+                "no host UDF callback installed (auron_register_udf_callback)"
+            )
+        return _eval_via_c(blob, args, n)
+
+    return fn

@@ -144,6 +144,17 @@ def _convert_expr(e: dict, conf: Configuration, udf_registry: dict | None = None
     if name == "scalarsubquery":
         return ir.ScalarSubquery(e["resource_id"], parse_type(e["type"]))
 
+    if name == "__hive_udf__":
+        # Hive UDF (HiveUdfGlue.scala): the host serializer embedded the
+        # serialized function (base64) in the plan, so ANY executor can
+        # evaluate it through the C-ABI callback (bridge/udf.py
+        # hive_blob_udf). Gated by the same udf fallback flag as
+        # registered host UDFs.
+        if not conf.get(UDF_FALLBACK_ENABLE):
+            raise UnsupportedExpr("hive UDF with udf.fallback.enable off")
+        out_t = parse_type(e.get("type", "string"))
+        return ir.HostUDF(f"__hive:{e['udf_blob']}", tuple(subs()), out_t)
+
     fn = _FN_RENAME.get(name, name)
     if registry.lookup(fn) is not None:
         return ir.ScalarFunc(fn, tuple(subs()))

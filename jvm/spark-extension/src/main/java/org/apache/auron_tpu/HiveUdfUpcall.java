@@ -1,0 +1,100 @@
+/*
+ * FFM upcall implementing the engine's host-UDF evaluation callback
+ * (native/auron_bridge.h auron_udf_eval_fn): the engine ships the
+ * plan-embedded serialized expression + Arrow argument columns; this
+ * deserializes (memoized per blob — HiveUdfGlue.scala), evaluates per
+ * row, and returns one Arrow result column. Works on any executor: the
+ * function travels in the plan, not a driver registry. Registered once
+ * per JVM at extension install.
+ */
+package org.apache.auron_tpu;
+
+import java.io.ByteArrayInputStream;
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.lang.invoke.MethodHandles;
+import java.util.concurrent.atomic.AtomicBoolean;
+
+import org.apache.arrow.memory.RootAllocator;
+import org.apache.arrow.vector.ipc.ArrowStreamReader;
+import org.slf4j.Logger;
+import org.slf4j.LoggerFactory;
+
+public final class HiveUdfUpcall {
+
+    private static final Logger LOG = LoggerFactory.getLogger(HiveUdfUpcall.class);
+    private static final AtomicBoolean REGISTERED = new AtomicBoolean();
+    /** The upcall stub itself lives for the process. */
+    private static final Arena STUB_ARENA = Arena.ofShared();
+    /** Result buffers: per-thread confined arena, closed and re-created on
+     * the thread's NEXT call — exactly the header's lifetime contract,
+     * with no accumulation across calls. */
+    private static final ThreadLocal<Arena> RESULT_ARENA = new ThreadLocal<>();
+
+    private HiveUdfUpcall() {}
+
+    /** Install the upcall via auron_register_udf_callback; idempotent. */
+    public static void registerOnce() {
+        if (!REGISTERED.compareAndSet(false, true)) {
+            return;
+        }
+        try {
+            Linker linker = Linker.nativeLinker();
+            MethodHandle target = MethodHandles.lookup().findStatic(
+                HiveUdfUpcall.class, "evaluate",
+                java.lang.invoke.MethodType.methodType(int.class,
+                    MemorySegment.class, long.class,
+                    MemorySegment.class, long.class,
+                    MemorySegment.class, MemorySegment.class));
+            FunctionDescriptor desc = FunctionDescriptor.of(
+                ValueLayout.JAVA_INT,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+                ValueLayout.ADDRESS, ValueLayout.ADDRESS);
+            MemorySegment stub = linker.upcallStub(target, desc, STUB_ARENA);
+            NativeBridge.registerUdfCallback(stub);
+        } catch (Throwable t) {
+            REGISTERED.set(false);
+            throw new RuntimeException("hive udf upcall registration failed", t);
+        }
+    }
+
+    /** The auron_udf_eval_fn implementation. */
+    static int evaluate(MemorySegment blobSeg, long blobLen,
+                        MemorySegment argsIpc, long argsLen,
+                        MemorySegment outIpc, MemorySegment outLen) {
+        try (RootAllocator allocator = new RootAllocator(Long.MAX_VALUE)) {
+            byte[] blob = blobSeg.reinterpret(blobLen)
+                .toArray(ValueLayout.JAVA_BYTE);
+            byte[] payload = argsIpc.reinterpret(argsLen)
+                .toArray(ValueLayout.JAVA_BYTE);
+            byte[] result;
+            try (ArrowStreamReader reader = new ArrowStreamReader(
+                    new ByteArrayInputStream(payload), allocator)) {
+                result = org.apache.spark.sql.auron_tpu.HiveUdfArrowEval
+                    .evalToIpc(blob, reader);
+            }
+            Arena prev = RESULT_ARENA.get();
+            if (prev != null) {
+                prev.close(); // previous call's buffer, now past its lifetime
+            }
+            Arena arena = Arena.ofConfined();
+            RESULT_ARENA.set(arena);
+            MemorySegment buf = arena.allocate(result.length);
+            MemorySegment.copy(result, 0, buf, ValueLayout.JAVA_BYTE, 0,
+                result.length);
+            outIpc.reinterpret(ValueLayout.ADDRESS.byteSize())
+                .set(ValueLayout.ADDRESS, 0, buf);
+            outLen.reinterpret(ValueLayout.JAVA_LONG.byteSize())
+                .set(ValueLayout.JAVA_LONG, 0, (long) result.length);
+            return 0;
+        } catch (Throwable t) {
+            LOG.warn("hive udf evaluation failed", t);
+            return -1;
+        }
+    }
+}

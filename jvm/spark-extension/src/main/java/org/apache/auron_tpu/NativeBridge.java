@@ -52,6 +52,9 @@ public final class NativeBridge {
             ValueLayout.JAVA_LONG, ValueLayout.ADDRESS, ValueLayout.ADDRESS));
     private static final MethodHandle LAST_ERROR = handle("auron_last_error",
         FunctionDescriptor.of(ValueLayout.ADDRESS));
+    private static final MethodHandle REGISTER_UDF_CALLBACK =
+        handle("auron_register_udf_callback",
+            FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS));
 
     static {
         Runtime.getRuntime().addShutdownHook(new Thread(NativeBridge::onExit));
@@ -115,6 +118,17 @@ public final class NativeBridge {
     /** Opaque bytes (file lists, conf blobs) -> engine resource. */
     public static void putResourceBytes(String key, byte[] payload) {
         putResource(key, payload, PUT_RESOURCE_BYTES);
+    }
+
+    /** Install the process-wide host UDF evaluator (an FFM upcall stub —
+     * HiveUdfUpcall.registerOnce builds and owns it). */
+    public static void registerUdfCallback(MemorySegment upcallStub) {
+        try {
+            int rc = (int) REGISTER_UDF_CALLBACK.invokeExact(upcallStub);
+            if (rc != 0) throw new RuntimeException(lastError());
+        } catch (Throwable t) {
+            throw wrap(t);
+        }
     }
 
     private static void putResource(String key, byte[] payload,

@@ -31,7 +31,19 @@ object ConvertToNativeRule extends Rule[SparkPlan] {
   // and AT MOST ONCE, disabling conversion (never failing queries) when
   // the library is absent — the reference's checkNativeLib behavior
   private lazy val engineAvailable: Boolean =
-    try NativeBridge.probe() catch { case _: Throwable => false }
+    try {
+      val ok = NativeBridge.probe()
+      if (ok) {
+        // host UDF evaluator (Hive glue): optional — a registration
+        // failure loses only __hive_udf__ coverage, never all conversion
+        try org.apache.auron_tpu.HiveUdfUpcall.registerOnce()
+        catch { case t: Throwable =>
+          org.slf4j.LoggerFactory.getLogger(getClass)
+            .warn("hive udf upcall unavailable: {}", t.toString)
+        }
+      }
+      ok
+    } catch { case _: Throwable => false }
 
   override def apply(plan: SparkPlan): SparkPlan = {
     if (!conf.getConfString("spark.auron_tpu.enabled", "true").toBoolean

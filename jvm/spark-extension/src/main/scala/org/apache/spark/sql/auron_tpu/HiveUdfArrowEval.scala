@@ -1,0 +1,57 @@
+/*
+ * Arrow-level Hive UDF evaluation for the C-ABI callback
+ * (HiveUdfUpcall.java): argument columns in, one result column out. Rows
+ * materialize through Spark's Arrow column vectors; the registered
+ * (rebound) expression evaluates per row; the result encodes through
+ * Spark's ArrowWriter with the expression's result type.
+ */
+package org.apache.spark.sql.auron_tpu
+
+import java.io.ByteArrayOutputStream
+
+import org.apache.arrow.vector.VectorSchemaRoot
+import org.apache.arrow.vector.ipc.{ArrowStreamReader, ArrowStreamWriter}
+import org.apache.spark.sql.catalyst.InternalRow
+import org.apache.spark.sql.catalyst.expressions.GenericInternalRow
+import org.apache.spark.sql.execution.arrow.ArrowWriter
+import org.apache.spark.sql.types.{StructField, StructType}
+import org.apache.spark.sql.util.ArrowUtils
+
+object HiveUdfArrowEval {
+
+  /** Evaluate the blob's expression over every batch of the args stream;
+   * returns an Arrow IPC stream with ONE column named "r". */
+  def evalToIpc(blob: Array[Byte], reader: ArrowStreamReader): Array[Byte] = {
+    val expr = HiveUdfBlob.deserialize(blob)
+    val outType = StructType(Seq(StructField("r", expr.dataType, nullable = true)))
+    val allocator = reader.getVectorSchemaRoot.getFieldVectors.get(0) match {
+      case v => v.getAllocator
+    }
+    // session timezone (SQLConf.get works on executors; timestamps fail
+    // to encode with a null zone)
+    val tz = org.apache.spark.sql.internal.SQLConf.get.sessionLocalTimeZone
+    val outSchema = ArrowUtils.toArrowSchema(outType, tz, true, false)
+    val outRoot = VectorSchemaRoot.create(outSchema, allocator)
+    val bytes = new ByteArrayOutputStream()
+    val writer = new ArrowStreamWriter(outRoot, null, bytes)
+    try {
+      val arrowWriter = ArrowWriter.create(outRoot)
+      writer.start()
+      while (reader.loadNextBatch()) {
+        val root = reader.getVectorSchemaRoot
+        val rows = ArrowUtils.fromArrowRecordBatch(root)
+        rows.foreach { argRow: InternalRow =>
+          val value = expr.eval(argRow)
+          arrowWriter.write(new GenericInternalRow(Array[Any](value)))
+        }
+      }
+      arrowWriter.finish()
+      writer.writeBatch()
+      writer.end()
+      bytes.toByteArray
+    } finally {
+      writer.close()
+      outRoot.close()
+    }
+  }
+}

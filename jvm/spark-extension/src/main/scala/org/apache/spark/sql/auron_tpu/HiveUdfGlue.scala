@@ -1,0 +1,63 @@
+/*
+ * Hive UDF glue (reference spark-extension/.../hive/auron/HiveUDFUtil.scala
+ * + the SparkUDFWrapper callback channel): Hive UDF expressions cannot run
+ * on the engine, but they CAN stay inside native segments — the serializer
+ * issues a token binding the live JVM expression, and the engine evaluates
+ * it through the C-ABI callback (auron_register_udf_callback) with Arrow
+ * argument columns.
+ */
+package org.apache.spark.sql.auron_tpu
+
+import java.util.concurrent.ConcurrentHashMap
+
+import org.apache.spark.sql.catalyst.expressions.{BoundReference, Expression}
+
+/** Detection (HiveUDFUtil analog): the Hive expression classes live in the
+ * optional spark-hive jar, so matching is by class name, not type. */
+object HiveUdfDetect {
+  private val HIVE_UDF_CLASSES = Set(
+    "org.apache.spark.sql.hive.HiveSimpleUDF",
+    "org.apache.spark.sql.hive.HiveGenericUDF")
+
+  def isHiveUDF(e: Expression): Boolean =
+    HIVE_UDF_CLASSES.contains(e.getClass.getName)
+
+  def functionClassName(e: Expression): String = e.getClass.getName
+}
+
+/** Blob codec: the serializer ships the expression REBOUND onto its
+ * argument positions (a0..aN as the callback delivers them) as
+ * java-serialized bytes INSIDE the plan — executors deserialize locally,
+ * so evaluation works on any cluster topology (the reference serializes
+ * its UDF wrapper into the native plan the same way). Deserialization is
+ * memoized per distinct blob (bounded by the application's distinct
+ * Hive-UDF expressions; entries die with the executor). */
+object HiveUdfBlob {
+  private val cache = new ConcurrentHashMap[java.math.BigInteger, Expression]()
+
+  /** Rebind children to positional BoundReferences and serialize. */
+  def serialize(e: Expression): Array[Byte] = {
+    val rebound = e.withNewChildren(
+      e.children.zipWithIndex.map { case (c, i) =>
+        BoundReference(i, c.dataType, c.nullable)
+      })
+    val bytes = new java.io.ByteArrayOutputStream()
+    val out = new java.io.ObjectOutputStream(bytes)
+    out.writeObject(rebound)
+    out.close()
+    bytes.toByteArray
+  }
+
+  def serializeBase64(e: Expression): String =
+    java.util.Base64.getEncoder.encodeToString(serialize(e))
+
+  def deserialize(blob: Array[Byte]): Expression = {
+    val digest = new java.math.BigInteger(1,
+      java.security.MessageDigest.getInstance("SHA-256").digest(blob))
+    cache.computeIfAbsent(digest, _ => {
+      val in = new java.io.ObjectInputStream(
+        new java.io.ByteArrayInputStream(blob))
+      try in.readObject().asInstanceOf[Expression] finally in.close()
+    })
+  }
+}

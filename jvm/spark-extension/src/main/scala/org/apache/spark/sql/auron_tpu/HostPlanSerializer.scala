@@ -273,6 +273,14 @@ object HostPlanSerializer {
       ("children" -> List(expr(c.child, input))) ~
       ("to" -> typeName(c.dataType)) ~
       ("from" -> typeName(c.child.dataType))
+    case h if HiveUdfDetect.isHiveUDF(h) =>
+      // Hive UDFs stay inside native segments: the serialized function
+      // rides IN the plan and the engine calls back through the C ABI
+      // on whichever executor runs the task (HiveUdfGlue.scala)
+      ("kind" -> "call") ~ ("name" -> "__hive_udf__") ~
+      ("udf_blob" -> HiveUdfBlob.serializeBase64(h)) ~
+      ("type" -> typeName(h.dataType)) ~
+      ("children" -> h.children.map(expr(_, input)))
     case b: BinaryExpression =>
       ("kind" -> "call") ~ ("name" -> b.getClass.getSimpleName.toLowerCase) ~
       ("children" -> List(expr(b.left, input), expr(b.right, input)))

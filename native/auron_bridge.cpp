@@ -311,6 +311,25 @@ int auron_convert_plan(const uint8_t* host_plan_json, size_t len,
   return rc;
 }
 
+int auron_register_udf_callback(auron_udf_eval_fn fn) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  /* hand the raw pointer to the engine; bridge/udf.py wraps it with a
+   * ctypes prototype and routes __hive:<token> HostUDFs through it */
+  PyObject* res = PyObject_CallMethod(
+      g_api, "install_udf_callback", "K",
+      static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(fn)));
+  if (res != nullptr) {
+    rc = 0;
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
 const char* auron_last_error(void) { return tl_error.c_str(); }
 
 } /* extern "C" */

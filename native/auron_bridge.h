@@ -66,6 +66,22 @@ int auron_remove_resource(const char* key);
 int auron_convert_plan(const uint8_t* host_plan_json, size_t len,
                        const uint8_t** response_json, size_t* response_len);
 
+/* Host UDF evaluation callback (the reference's JVM-callback UDF wrapper
+ * channel, SparkUDFWrapperContext/HiveUDFUtil): the host registers ONE
+ * process-wide evaluator; the engine calls it for every host-wrapped
+ * expression (e.g. Hive UDFs). udf_blob is the host-serialized function
+ * (the serializer embedded it in the plan, so tasks evaluate it on ANY
+ * executor — no driver-local registry); args_ipc is an Arrow IPC stream
+ * with the argument columns (a0..aN, batch-length rows, padding rows
+ * included — the engine keeps the selection mask); the callback returns
+ * 0 and an IPC stream with ONE result column, or nonzero on failure.
+ * The result buffer is HOST-owned and must stay valid until the next
+ * call from the same engine thread. */
+typedef int (*auron_udf_eval_fn)(const uint8_t* udf_blob, size_t blob_len,
+                                 const uint8_t* args_ipc, size_t args_len,
+                                 const uint8_t** out_ipc, size_t* out_len);
+int auron_register_udf_callback(auron_udf_eval_fn fn);
+
 /* Last error message for the calling thread (UTF-8, engine-owned). */
 const char* auron_last_error(void);
 
