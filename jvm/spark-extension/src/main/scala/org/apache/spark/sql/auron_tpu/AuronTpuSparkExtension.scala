@@ -86,7 +86,7 @@ object UiEvents {
   def postBuildInfoOnce(plan: SparkPlan): Unit =
     try {
       if (!uiModulePresent) return
-      val sc = plan.session.sparkContext
+      val sc = VersionShims.sessionOf(plan).sparkContext
       if (!registeredApps.add(sc.applicationId)) return // per-context, not per-JVM
       org.apache.spark.sql.auron_tpu.ui.AuronTpuSQLAppStatusListener.register(sc)
       sc.listenerBus.post(
@@ -100,7 +100,7 @@ object UiEvents {
       plan: SparkPlan, spliced: SparkPlan, error: Option[String]): Unit =
     try {
       if (!uiModulePresent) return
-      val sc = plan.session.sparkContext
+      val sc = VersionShims.sessionOf(plan).sparkContext
       // outside SQLExecution there is no execution to attribute to — skip
       // rather than collapsing every such plan onto one sentinel row
       val executionId = Option(

@@ -30,7 +30,7 @@ object HiveUdfArrowEval {
     // session timezone (SQLConf.get works on executors; timestamps fail
     // to encode with a null zone)
     val tz = org.apache.spark.sql.internal.SQLConf.get.sessionLocalTimeZone
-    val outSchema = ArrowUtils.toArrowSchema(outType, tz, true, false)
+    val outSchema = VersionShims.toArrowSchema(outType, tz)
     val outRoot = VectorSchemaRoot.create(outSchema, allocator)
     val bytes = new ByteArrayOutputStream()
     val writer = new ArrowStreamWriter(outRoot, null, bytes)

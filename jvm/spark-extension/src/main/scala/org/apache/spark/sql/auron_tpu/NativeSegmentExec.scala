@@ -72,7 +72,7 @@ case class NativeSegmentExec(
   override def children: Seq[SparkPlan] = ffiInputs.map(_.child)
 
   override lazy val metrics =
-    NativeMetrics.createSegmentMetrics(session.sparkContext)
+    NativeMetrics.createSegmentMetrics(VersionShims.sessionOf(this).sparkContext)
 
   override protected def doExecute(): RDD[InternalRow] = {
     val out = output
@@ -111,7 +111,7 @@ case class NativeStagedSegmentExec(
   override def children: Seq[SparkPlan] = ffiInputs.map(_.child)
 
   override lazy val metrics =
-    NativeMetrics.createSegmentMetrics(session.sparkContext)
+    NativeMetrics.createSegmentMetrics(VersionShims.sessionOf(this).sparkContext)
 
   private def inputsOf(s: StageDesc): Seq[FfiInput] =
     s.ffiInputIds.flatMap(id => ffiInputs.find(_.resourceId == id))
@@ -138,7 +138,7 @@ case class NativeStagedSegmentExec(
       s.taskPartitions.getOrElse {
         val kids = inputsOf(s)
         if (kids.nonEmpty) kids.head.child.execute().getNumPartitions
-        else 1.max(conf.numShufflePartitions)
+        else 1.max(VersionShims.defaultShufflePartitions(conf))
       }
     }
   }
@@ -260,10 +260,10 @@ object NativeTaskRun {
       pinnedPartitions: Option[Int],
       conf: org.apache.spark.sql.internal.SQLConf)(
       f: (Int, Seq[Iterator[InternalRow]]) => Iterator[InternalRow]): RDD[InternalRow] = {
-    val sc = plan.session.sparkContext
+    val sc = VersionShims.sessionOf(plan).sparkContext
     inputs.map(_.child.execute()) match {
       case Seq() =>
-        val n = pinnedPartitions.getOrElse(1.max(conf.numShufflePartitions))
+        val n = pinnedPartitions.getOrElse(1.max(VersionShims.defaultShufflePartitions(conf)))
         sc.parallelize(0 until n, n).mapPartitionsWithIndex {
           (pid, _) => f(pid, Nil)
         }
@@ -365,7 +365,7 @@ object ArrowIpcExport {
 
   def encode(rows: Iterator[InternalRow], schema: StructType): Array[Byte] = {
     val allocator = new RootAllocator(Long.MaxValue)
-    val arrowSchema = ArrowUtils.toArrowSchema(schema, null, true, false)
+    val arrowSchema = VersionShims.toArrowSchema(schema, null)
     val root = VectorSchemaRoot.create(arrowSchema, allocator)
     val bytes = new java.io.ByteArrayOutputStream()
     val writer = new ArrowStreamWriter(root, null, bytes)

@@ -1,0 +1,59 @@
+/*
+ * Spark-version compatibility layer (the reference's @sparkver shim
+ * mechanism, the spark-extension-shims-spark modules, condensed into one
+ * reflective object). The wire contracts this shim speaks (hostplan
+ * JSON, C ABI, Arrow IPC) are version-stable by design; what drifts
+ * across Spark 3.2-3.5 is a handful of JVM API signatures. Each divergent
+ * call routes through here: the primary path targets 3.4/3.5 and the
+ * reflective fallbacks cover the older signatures, so ONE jar serves the
+ * supported range (the reference instead compiles per-version shims).
+ */
+package org.apache.spark.sql.auron_tpu
+
+import org.apache.spark.sql.SparkSession
+import org.apache.spark.sql.execution.SparkPlan
+import org.apache.spark.sql.types.StructType
+
+object VersionShims {
+
+  lazy val sparkVersion: (Int, Int) = {
+    val parts = org.apache.spark.SPARK_VERSION.split("\\.")
+    (parts(0).toInt, parts(1).toInt)
+  }
+
+  def atLeast(major: Int, minor: Int): Boolean = {
+    val (maj, min) = sparkVersion
+    maj > major || (maj == major && min >= minor)
+  }
+
+  /** SparkPlan.session appeared in 3.2; older versions expose sqlContext. */
+  def sessionOf(plan: SparkPlan): SparkSession =
+    try plan.session
+    catch {
+      case _: NoSuchMethodError =>
+        classOf[SparkPlan].getMethod("sqlContext").invoke(plan)
+          .asInstanceOf[org.apache.spark.sql.SQLContext].sparkSession
+    }
+
+  /** ArrowUtils.toArrowSchema gained parameters across 3.x:
+   * 3.2/3.3: (schema, timeZoneId); 3.4+: (schema, timeZoneId,
+   * errorOnDuplicatedFieldNames); 3.5: + largeVarTypes. */
+  def toArrowSchema(schema: StructType, timeZoneId: String):
+      org.apache.arrow.vector.types.pojo.Schema = {
+    val cls = org.apache.spark.sql.util.ArrowUtils.getClass
+    val inst = org.apache.spark.sql.util.ArrowUtils
+    val methods = cls.getMethods.filter(_.getName == "toArrowSchema")
+    val m = methods.minBy(_.getParameterCount)
+    m.getParameterCount match {
+      case 2 => m.invoke(inst, schema, timeZoneId)
+      case 3 => m.invoke(inst, schema, timeZoneId, java.lang.Boolean.TRUE)
+      case _ => m.invoke(inst, schema, timeZoneId, java.lang.Boolean.TRUE,
+        java.lang.Boolean.FALSE)
+    }
+  }.asInstanceOf[org.apache.arrow.vector.types.pojo.Schema]
+
+  /** numShufflePartitions config accessor (stable since 3.0; kept here so
+   * a future rename lands in one place). */
+  def defaultShufflePartitions(conf: org.apache.spark.sql.internal.SQLConf): Int =
+    conf.numShufflePartitions
+}

@@ -1,0 +1,239 @@
+"""Real-metadata Iceberg resolution: table dir -> descriptor -> native scan.
+
+The table on disk is built to the PUBLIC Iceberg spec shapes
+(metadata/v*.metadata.json, Avro manifest list, Avro manifests over
+parquet data files) using utils/avro.py's writer — the same
+both-directions approach as the kafka mini-broker. The resolver must
+walk snapshot -> manifest list -> manifests -> data files, map partition
+values through the spec, and the existing provider must prune + scan.
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu.convert.iceberg import resolve_iceberg_scan
+from auron_tpu.utils import avro
+
+
+MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file",
+    "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+    ],
+}
+
+MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry",
+    "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "data_file",
+            "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102",
+                    "fields": [{"name": "year", "type": ["null", "long"]}],
+                }},
+                {"name": "record_count", "type": "long"},
+            ],
+        }},
+    ],
+}
+
+
+def _build_table(root, codec="deflate"):
+    """Partitioned iceberg-shaped table: year=2023 and year=2024 files,
+    plus one DELETED entry and one delete-content file (both skipped)."""
+    data_dir = os.path.join(root, "data")
+    meta_dir = os.path.join(root, "metadata")
+    os.makedirs(data_dir)
+    os.makedirs(meta_dir)
+    frames = {}
+    rng = np.random.default_rng(4)
+    for year in (2023, 2024):
+        df = pd.DataFrame({
+            "id": rng.integers(0, 1000, 500).astype(np.int64),
+            "amount": rng.standard_normal(500),
+            "year": np.full(500, year, dtype=np.int64),
+        })
+        path = os.path.join(data_dir, f"year={year}", "part-0.parquet")
+        os.makedirs(os.path.dirname(path))
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+        frames[year] = df
+
+    def entry(status, content, path, year, count):
+        return {"status": status, "data_file": {
+            "content": content, "file_path": path, "file_format": "PARQUET",
+            "partition": {"year": year}, "record_count": count}}
+
+    m1 = os.path.join(meta_dir, "m1.avro")
+    avro.write_container(m1, MANIFEST_SCHEMA, [
+        entry(1, 0, os.path.join(data_dir, "year=2023", "part-0.parquet"), 2023, 500),
+        entry(2, 0, os.path.join(data_dir, "gone.parquet"), 2023, 10),  # DELETED
+    ], codec=codec)
+    m2 = os.path.join(meta_dir, "m2.avro")
+    avro.write_container(m2, MANIFEST_SCHEMA, [
+        entry(1, 0, os.path.join(data_dir, "year=2024", "part-0.parquet"), 2024, 500),
+        entry(1, 1, os.path.join(data_dir, "del.parquet"), 2024, 5),  # delete file
+    ], codec=codec)
+    mlist = os.path.join(meta_dir, "snap-77.avro")
+    avro.write_container(mlist, MANIFEST_LIST_SCHEMA, [
+        {"manifest_path": m1, "manifest_length": os.path.getsize(m1),
+         "partition_spec_id": 0},
+        {"manifest_path": m2, "manifest_length": os.path.getsize(m2),
+         "partition_spec_id": 0},
+    ], codec=codec)
+
+    metadata = {
+        "format-version": 2,
+        "table-uuid": "0000-test",
+        "location": root,
+        "current-schema-id": 0,
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "id", "required": True, "type": "long"},
+            {"id": 2, "name": "amount", "required": False, "type": "double"},
+            {"id": 3, "name": "year", "required": True, "type": "long"},
+        ]}],
+        "partition-specs": [{"spec-id": 0, "fields": [
+            {"name": "year", "transform": "identity",
+             "source-id": 3, "field-id": 1000},
+        ]}],
+        "current-snapshot-id": 77,
+        "snapshots": [{"snapshot-id": 77, "manifest-list": mlist}],
+    }
+    with open(os.path.join(meta_dir, "v3.metadata.json"), "w") as f:
+        json.dump(metadata, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write("3")
+    return frames
+
+
+def test_avro_codec_roundtrip(tmp_path):
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "s", "type": "string"},
+        {"name": "n", "type": ["null", "long"]},
+        {"name": "xs", "type": {"type": "array", "items": "int"}},
+        {"name": "m", "type": {"type": "map", "values": "double"}},
+        {"name": "e", "type": {"type": "enum", "name": "E",
+                               "symbols": ["A", "B"]}},
+        {"name": "fx", "type": {"type": "fixed", "name": "F", "size": 3}},
+    ]}
+    records = [
+        {"s": "hello", "n": None, "xs": [1, -2, 3], "m": {"a": 1.5}, "e": "B",
+         "fx": b"abc"},
+        {"s": "", "n": -(2**40), "xs": [], "m": {}, "e": "A", "fx": b"\x00\x01\x02"},
+    ]
+    for codec in ("null", "deflate"):
+        p = str(tmp_path / f"t_{codec}.avro")
+        avro.write_container(p, schema, records, codec=codec)
+        got_schema, got = avro.read_container(p)
+        assert got == records
+        assert got_schema["name"] == "r"
+
+
+def test_resolve_real_metadata_and_scan(tmp_path):
+    frames = _build_table(str(tmp_path))
+    desc = resolve_iceberg_scan(str(tmp_path))
+    assert desc["op"] == "IcebergScanExec"
+    assert [s[0] for s in desc["schema"]] == ["id", "amount", "year"]
+    files = desc["args"]["files"]
+    # deleted entry and delete-content file are gone
+    assert sorted(f["partition"]["year"] for f in files) == [2023, 2024]
+    assert all(f["record_count"] == 500 for f in files)
+
+    # descriptor -> conversion service -> native scan with partition pruning
+    from auron_tpu.bridge import api
+    from auron_tpu.convert.service import convert_host_plan_json
+
+    host = dict(desc)
+    host["args"] = dict(desc["args"])
+    host["args"]["filters"] = [
+        {"kind": "call", "name": "equalto", "children": [
+            {"kind": "attr", "index": 2, "name": "year"},
+            {"kind": "lit", "type": "long", "value": 2024}]},
+    ]
+    host["children"] = []
+    resp = json.loads(convert_host_plan_json(json.dumps(host)))
+    assert resp["converted"] is True, resp.get("error")
+
+    import base64
+
+    from auron_tpu.proto import plan_pb2 as pb
+
+    node = pb.PhysicalPlanNode()
+    node.ParseFromString(base64.b64decode(resp["root"]["plan_b64"]))
+    h = api.call_native(pb.TaskDefinition(plan=node).SerializeToString())
+    got = []
+    while (rb := api.next_batch(h)) is not None:
+        got.append(rb.to_pandas())
+    api.finalize_native(h)
+    out = pd.concat(got).reset_index(drop=True)
+    want = frames[2024][frames[2024].year == 2024].reset_index(drop=True)
+    assert len(out) == len(want)
+    assert out["amount"].sum() == pytest.approx(want["amount"].sum())
+    assert (out["year"] == 2024).all()
+
+
+def test_snapshot_time_travel(tmp_path):
+    _build_table(str(tmp_path))
+    # unknown snapshot -> empty scan (no files), not an error
+    desc = resolve_iceberg_scan(str(tmp_path), snapshot_id=12345)
+    assert desc["args"]["files"] == []
+
+
+def test_catalog_style_metadata_names(tmp_path):
+    frames = _build_table(str(tmp_path))
+    meta = str(tmp_path / "metadata")
+    os.remove(os.path.join(meta, "version-hint.text"))
+    # catalog naming: 00001-uuid < 00004-uuid must win over listdir order
+    os.rename(os.path.join(meta, "v3.metadata.json"),
+              os.path.join(meta, "00004-aaaa.metadata.json"))
+    with open(os.path.join(meta, "00001-zzzz.metadata.json"), "w") as f:
+        json.dump({"format-version": 2, "current-schema-id": 0,
+                   "schemas": [{"schema-id": 0, "fields": []}],
+                   "snapshots": [], "current-snapshot-id": None}, f)
+    desc = resolve_iceberg_scan(str(tmp_path))
+    assert len(desc["args"]["files"]) == 2  # resolved 00004, not 00001
+
+
+def test_nested_column_degrades_not_raises(tmp_path):
+    _build_table(str(tmp_path))
+    meta_path = os.path.join(str(tmp_path), "metadata", "v3.metadata.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["schemas"][0]["fields"].append({
+        "id": 9, "name": "nested", "required": False,
+        "type": {"type": "struct", "fields": []}})
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    desc = resolve_iceberg_scan(str(tmp_path))  # must not raise
+    assert desc["schema"][-1][0] == "nested"
+    # the unparseable type tag degrades the NODE engine-side with a reason
+    from auron_tpu.convert.hostplan import HostNode
+
+    node = HostNode.from_json({"op": "IcebergScanExec",
+                               "schema": desc["schema"], "args": desc["args"],
+                               "children": []})
+    assert node.schema_error is not None
+
+
+def test_non_parquet_data_file_rejected(tmp_path):
+    _build_table(str(tmp_path))
+    meta_dir = os.path.join(str(tmp_path), "metadata")
+    avro.write_container(os.path.join(meta_dir, "m1.avro"), MANIFEST_SCHEMA, [
+        {"status": 1, "data_file": {
+            "content": 0, "file_path": "/x/f.orc", "file_format": "ORC",
+            "partition": {"year": 2023}, "record_count": 1}},
+    ])
+    with pytest.raises(ValueError, match="parquet only"):
+        resolve_iceberg_scan(str(tmp_path))
