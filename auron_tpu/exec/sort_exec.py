@@ -122,8 +122,11 @@ class SortExec(ExecOperator):
                 order = hostsort.order_by_words((live, *ops))
                 sorted_ops = (None, *(o[order] for o in ops), order)
             else:
-                sorted_ops = lax.sort(
-                    tuple([live, *ops, iota]), num_keys=len(ops) + 1
+                from auron_tpu.ops import bitonic, sortkeys
+
+                sorted_ops = bitonic.ordered_sort(
+                    tuple([live, *ops, iota]),
+                    word_narrow=sortkeys.narrow_flags(len(self.specs)),
                 )
                 order = sorted_ops[-1]
         dev = big.device

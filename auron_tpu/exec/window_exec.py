@@ -140,7 +140,18 @@ class WindowExec(ExecOperator):
         live = jnp.where(big.device.sel, jnp.uint64(0), jnp.uint64(1))
         iota = jnp.arange(cap, dtype=jnp.int32)
         ops = [live, *pwords, *owords, iota]
-        sorted_ops = lax.sort(tuple(ops), num_keys=len(ops) - 1)
+        from auron_tpu.ops import bitonic, sortkeys
+
+        # pwords = one equality word per partition column + a null-bits
+        # word (key_words contract; its hi half is zero for <= 32 cols)
+        p_narrow = (
+            ((False,) * (len(pwords) - 1) + (len(pvals) <= 32,))
+            if pwords else ()
+        )
+        sorted_ops = bitonic.ordered_sort(
+            tuple(ops),
+            word_narrow=p_narrow + sortkeys.narrow_flags(len(owords) // 2),
+        )
         order = sorted_ops[-1]
         sel_sorted = sorted_ops[0] == 0
         n_pw = len(pwords)

@@ -224,6 +224,33 @@ def bitonic_sort(
     return tuple(result)
 
 
+def ordered_sort(
+    operands: tuple,
+    word_narrow: tuple | None = None,
+    impl: str | None = None,
+) -> tuple:
+    """ORDER-BY path dispatch: drop-in for
+    ``lax.sort(operands, num_keys=len(operands)-1)`` over
+    ``(live, *order_words, iota)`` operands (exec/sort_exec.py,
+    exec/window_exec.py — both eager, so this owns the impl resolution).
+    word_narrow marks order words with statically-zero hi halves (the 0/1
+    null-placement words sortkeys emits — sortkeys.narrow_flags); the
+    liveness key always rides narrow, the iota payload is the stability
+    tiebreak."""
+    n_words = len(operands) - 2
+    if word_narrow is None:
+        word_narrow = (False,) * n_words
+    assert len(word_narrow) == n_words, (len(word_narrow), n_words)
+    if impl is None:
+        impl = sort_impl_for(
+            n_words, operands[0].shape[0], n_narrow_words=sum(word_narrow)
+        )
+    if impl in ("jnp", "pallas"):
+        narrow = (True, *word_narrow, False)
+        return bitonic_sort(operands, impl=impl, narrow=narrow)
+    return lax.sort(operands, num_keys=len(operands) - 1)
+
+
 def sort_impl_for(n_words: int, cap: int, n_narrow_words: int = 1) -> str:
     """Trace-time choice of the cluster-sort implementation for a
     (dead_key, *words, iota) operand tuple: 'lax' | 'jnp' | 'pallas'.

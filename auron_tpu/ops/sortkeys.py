@@ -137,3 +137,11 @@ def sort_operands(
         ops.append(null_word)
         ops.append(w)
     return ops
+
+
+def narrow_flags(n_keys: int) -> tuple[bool, ...]:
+    """Per-operand narrow markers for sort_operands' output: the 0/1
+    null-placement words have statically-zero hi halves (bitonic network
+    single-plane ride); the direction-adjusted value words use all 64
+    bits (descending inverts)."""
+    return (True, False) * n_keys

@@ -181,6 +181,59 @@ def test_agg_end_to_end_with_bitonic(impl):
     pdt.assert_frame_equal(got, want, check_dtype=False)
 
 
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+def test_order_by_and_window_with_bitonic(impl):
+    """The ORDER BY and window paths produce identical results with the
+    network forced (exec/sort_exec.py + exec/window_exec.py wiring)."""
+    _skip_unless_pallas(impl)
+    import pandas as pd
+    import pyarrow as pa
+
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.exec.sort_exec import SortExec
+    from auron_tpu.exec.window_exec import WindowExec, WindowFunc
+    from auron_tpu.exprs.ir import col
+    from auron_tpu.ops.sortkeys import SortSpec
+    from auron_tpu.utils.config import (
+        DEVICE_SORT_IMPL,
+        HOST_SORT_MODE,
+        Configuration,
+        conf_scope,
+    )
+
+    rng = np.random.default_rng(31)
+    df = pd.DataFrame({
+        "g": rng.integers(0, 9, 4000).astype(np.int64),
+        "v": rng.standard_normal(4000),
+    })
+    df.loc[df.index % 11 == 0, "v"] = np.nan
+    scan = MemoryScanExec.single([Batch.from_arrow(
+        pa.RecordBatch.from_pandas(df.iloc[i:i+1000], preserve_index=False))
+        for i in range(0, len(df), 1000)])
+
+    conf = Configuration().set(HOST_SORT_MODE, "off").set(DEVICE_SORT_IMPL, impl)
+    ref_conf = Configuration().set(HOST_SORT_MODE, "off").set(DEVICE_SORT_IMPL, "lax")
+
+    def run_sort(c):
+        op = SortExec(scan, [col(1), col(0)],
+                      [SortSpec(asc=False, nulls_first=False), SortSpec()])
+        with conf_scope(c):
+            return op.collect(0, ExecutionContext(conf=c)).to_pandas()
+
+    pd.testing.assert_frame_equal(run_sort(conf), run_sort(ref_conf))
+
+    def run_window(c):
+        op = WindowExec(scan, [col(0)], [(col(1), SortSpec())],
+                        [(WindowFunc("row_number"), "rn")])
+        with conf_scope(c):
+            out = op.collect(0, ExecutionContext(conf=c)).to_pandas()
+        return out.sort_values(["g", "rn"]).reset_index(drop=True)
+
+    pd.testing.assert_frame_equal(run_window(conf), run_window(ref_conf))
+
+
 def test_sort_impl_for_gates():
     from auron_tpu.utils.config import DEVICE_SORT_IMPL, Configuration, conf_scope
 
