@@ -75,8 +75,18 @@ class MultiMapBlockProvider:
     and the local TPC-DS harness."""
 
     def __init__(self, pairs: list[tuple[str, str]]):
+        self.pairs = pairs  # kept for AQE introspection (skew splitting)
         self.providers = [LocalFileBlockProvider(d, i) for d, i in pairs]
 
     def __call__(self, partition: int) -> Iterator[pa.RecordBatch]:
         for p in self.providers:
+            yield from p(partition)
+
+    def read_slice(
+        self, partition: int, map_lo: int, map_hi: int
+    ) -> Iterator[pa.RecordBatch]:
+        """One partition's blocks from map outputs [map_lo, map_hi) —
+        the skew-split unit (a slice of the skewed side joins the full
+        other side)."""
+        for p in self.providers[map_lo:map_hi]:
             yield from p(partition)

@@ -84,6 +84,37 @@ class ExchangeStats:
         return self.rows.sum(axis=0)
 
 
+class SkewSplitProvider:
+    """AQE skew-join split consumer (Spark OptimizeSkewedJoin analog): the
+    stage widens to one task per (partition, slice) pair; the SPLIT side
+    reads a map-range slice of its skewed partition, the other side
+    re-reads the full partition per slice. tasks[i] = (pid, map_lo,
+    map_hi) with map_hi=None meaning all maps."""
+
+    def __init__(self, inner, tasks: list[tuple[int, int, int | None]]):
+        self.inner = inner
+        self.tasks = tasks
+
+    def __call__(self, task: int):
+        pid, lo, hi = self.tasks[task]
+        if hi is None:
+            yield from self.inner(pid)
+        else:
+            yield from self.inner.read_slice(pid, lo, hi)
+
+
+#: join types whose semantics survive splitting a given side: every row of
+#: the split side lands in exactly one slice, and the OTHER side must not
+#: produce unmatched-row output (it would duplicate per slice)
+_SPLITTABLE_SIDES = {
+    pb.JOIN_INNER: ("left", "right"),
+    pb.JOIN_LEFT: ("left",),
+    pb.JOIN_LEFT_SEMI: ("left",),
+    pb.JOIN_LEFT_ANTI: ("left",),
+    pb.JOIN_RIGHT: ("right",),
+}
+
+
 class CoalescedBlockProvider:
     """AQE post-shuffle coalescing consumer: reduce task p reads every
     original partition of its group (Spark CoalesceShufflePartitions —
@@ -163,6 +194,8 @@ class MeshQueryDriver:
 
             resolved = self._rewrite(prune_columns(plan), resources)
             n_reduce = self._maybe_coalesce_inputs(resolved, resources)
+            if n_reduce == self.n_parts and not self.spmd:
+                n_reduce = self._maybe_split_skew(resolved, resources)
             self._reduce_parts = n_reduce if n_reduce != self.n_parts else None
             outs: list[list[Batch]] = [
                 [] for _ in range(self._reduce_parts or self.n_parts)
@@ -217,6 +250,9 @@ class MeshQueryDriver:
         the same partition grouping is then applied to all of them, which
         preserves hash co-partitioning across the stage's inputs (a
         multi-shuffle join stays aligned). Returns the stage width."""
+        if not self.conf.get(EXCHANGE_COALESCE_ENABLE):
+            # candidates may exist for skew splitting alone
+            return self.n_parts
         leaves = self._collect_sources(plan)
         ex_ids = [
             rid
@@ -246,6 +282,90 @@ class MeshQueryDriver:
             if ex in by_id:
                 by_id[ex].coalesced_groups = groups
         return len(groups)
+
+    def _maybe_split_skew(self, plan: pb.PhysicalPlanNode, resources: dict) -> int:
+        """AQE skew-join splitting over a two-exchange SMJ stage: a reduce
+        partition much larger than the median splits into map-range slices
+        of the SKEWED side, each joined against the full other side; the
+        stage widens to one task per slice. Applies only when the split
+        side's join semantics allow it (_SPLITTABLE_SIDES) and both stage
+        leaves are just-resolved file exchanges."""
+        from auron_tpu.utils.config import (
+            EXCHANGE_SKEW_ENABLE,
+            EXCHANGE_SKEW_FACTOR,
+            EXCHANGE_SKEW_MIN_BYTES,
+        )
+
+        if not self.conf.get(EXCHANGE_SKEW_ENABLE):
+            return self.n_parts
+        smj = _find_single_smj(plan)
+        if smj is None:
+            return self.n_parts
+        sides = {}
+        for side in ("left", "right"):
+            leaves = self._collect_sources(getattr(smj, side))
+            if (
+                len(leaves) != 1
+                or leaves[0][0] != "ipc_reader"
+                or leaves[0][1] not in self._coalesce_candidates
+            ):
+                return self.n_parts
+            sides[side] = leaves[0][1]
+        if sides["left"] == sides["right"]:
+            return self.n_parts  # self-join on one exchange: slices collide
+        # the WHOLE stage must read only these two exchanges: widening the
+        # task range would mis-index any other source (broadcast dims etc.)
+        all_leaves = self._collect_sources(plan)
+        if {rid for _, rid in all_leaves} != set(sides.values()) or len(
+            all_leaves
+        ) != 2:
+            return self.n_parts
+
+        sizes = {
+            s: self._coalesce_candidates[ex][1] for s, ex in sides.items()
+        }
+        factor = self.conf.get(EXCHANGE_SKEW_FACTOR)
+        min_bytes = self.conf.get(EXCHANGE_SKEW_MIN_BYTES)
+        total = sizes["left"] + sizes["right"]
+        median = float(np.median(total)) if total.size else 0.0
+        threshold = max(median * factor, float(min_bytes))
+        allowed = _SPLITTABLE_SIDES.get(smj.join_type, ())
+
+        tasks: dict[str, list[tuple[int, int, int | None]]] = {
+            "left": [], "right": []
+        }
+        split_any = False
+        for pid in range(self.n_parts):
+            split_side = None
+            if total[pid] > threshold:
+                # split the larger side when its semantics allow it
+                order = sorted(
+                    ("left", "right"), key=lambda s: -int(sizes[s][pid])
+                )
+                split_side = next((s for s in order if s in allowed), None)
+            if split_side is None:
+                for s in ("left", "right"):
+                    tasks[s].append((pid, 0, None))
+                continue
+            provider = self._coalesce_candidates[sides[split_side]][0]
+            per_map = _per_map_partition_bytes(provider, pid)
+            target = max(median, float(min_bytes) / 2, 1.0)
+            groups = _group_maps_by_bytes(per_map, target)
+            other = "left" if split_side == "right" else "right"
+            for lo, hi in groups:
+                tasks[split_side].append((pid, lo, hi))
+                tasks[other].append((pid, 0, None))  # full re-read per slice
+            split_any = split_any or len(groups) > 1
+
+        if not split_any:
+            return self.n_parts
+        by_id = {s.exchange_id: s for s in self.stats}
+        for side, ex in sides.items():
+            provider, _ = self._coalesce_candidates.pop(ex)
+            resources[ex] = SkewSplitProvider(provider, tasks[side])
+            if ex in by_id:
+                by_id[ex].coalesced_groups = tasks[side]
+        return len(tasks["left"])
 
     def _cleanup_tmp(self) -> None:
         import shutil
@@ -290,9 +410,12 @@ class MeshQueryDriver:
         self._exchange_seq += 1
 
         # ---- map stage: run the child sub-plan per shard (AQE may have
-        # coalesced this stage's shuffle inputs, shrinking its width);
+        # coalesced this stage's shuffle inputs, shrinking its width, or
+        # skew-split a hot SMJ partition, widening it);
         # SPMD: only this process's shards run here, peers run theirs
         n_src = self._maybe_coalesce_inputs(child, resources)
+        if n_src == self.n_parts and not self.spmd:
+            n_src = self._maybe_split_skew(child, resources)
         op = plan_from_proto(child)
         schema = op.schema
         shard_batches: list[Batch] = []
@@ -530,11 +653,16 @@ class MeshQueryDriver:
         finally:
             resources.pop(src_id, None)
         provider = MultiMapBlockProvider(pairs)
-        # ---- AQE: statistics-driven post-shuffle coalescing candidate.
-        # The grouping decision is made PER CONSUMING STAGE
+        # ---- AQE: statistics-driven candidate for post-shuffle coalescing
+        # AND skew-join splitting (both consume the same per-partition
+        # sizes). The grouping decision is made PER CONSUMING STAGE
         # (_maybe_coalesce_inputs): every shuffle feeding a stage gets the
         # same groups, so hash co-partitioning across inputs is preserved.
-        if self.conf.get(EXCHANGE_COALESCE_ENABLE):
+        from auron_tpu.utils.config import EXCHANGE_SKEW_ENABLE
+
+        if self.conf.get(EXCHANGE_COALESCE_ENABLE) or self.conf.get(
+            EXCHANGE_SKEW_ENABLE
+        ):
             from auron_tpu.parallel.broadcast import map_output_stats
 
             sizes = map_output_stats([i for _, i in pairs])
@@ -545,6 +673,109 @@ class MeshQueryDriver:
                 schema=schema_to_proto(schema), resource_id=ex_id
             )
         )
+
+
+def _partition_scoped(which: str, inner) -> bool:
+    """Nodes whose output depends on seeing a WHOLE partition: splitting a
+    partition into slices changes their result (regrouping aggs, windows,
+    per-partition limits/top-k)."""
+    if which == "hash_agg" and inner.mode != pb.AGG_PARTIAL:
+        return True
+    if which in ("window", "window_group_limit", "limit"):
+        return True
+    if which == "sort" and inner.has_fetch:
+        return True  # per-partition top-k
+    return False
+
+
+#: nodes allowed BETWEEN the SMJ and its exchange leaf on a split side —
+#: strictly per-row (or whole-input sorts feeding the merge join)
+_SLICE_SAFE_BELOW = {"sort", "project", "filter", "ipc_reader", "rename_columns"}
+
+
+def _find_single_smj(plan: pb.PhysicalPlanNode):
+    """The stage's sort_merge_join node, when the stage is skew-splittable:
+    exactly one SMJ; no partition-scoped node above it (its result would
+    change when a partition runs as several slices); the SMJ's subtrees
+    contain only slice-safe nodes down to their leaves."""
+    found: list = []
+    blocked: list = []
+
+    def rec(node, above_scoped: bool):
+        which = node.WhichOneof("plan")
+        inner = getattr(node, which)
+        if which == "sort_merge_join":
+            found.append(inner)
+            if above_scoped:
+                blocked.append("partition-scoped ancestor")
+            for side in ("left", "right"):
+                if not _slice_safe(getattr(inner, side)):
+                    blocked.append(f"{side} subtree not slice-safe")
+            return  # subtrees validated by _slice_safe
+        if _partition_scoped(which, inner):
+            above_scoped = True
+        if which == "union":
+            for c in inner.children:
+                rec(c, above_scoped)
+            return
+        for f in ("child", "left", "right"):
+            try:
+                present = inner.HasField(f)
+            except ValueError:
+                continue
+            if present:
+                rec(getattr(inner, f), above_scoped)
+
+    def _slice_safe(node) -> bool:
+        which = node.WhichOneof("plan")
+        inner = getattr(node, which)
+        if which not in _SLICE_SAFE_BELOW:
+            return False
+        if which == "sort" and inner.has_fetch:
+            return False
+        if which == "ipc_reader":
+            return True
+        return _slice_safe(inner.child)
+
+    rec(plan, False)
+    if len(found) != 1 or blocked:
+        return None
+    return found[0]
+
+
+def _per_map_partition_bytes(provider, pid: int) -> list[int]:
+    """Bytes each map output contributes to one reduce partition (from the
+    shuffle index files — the split planner's balance input)."""
+    from auron_tpu.exec.shuffle.format import read_index
+
+    out = []
+    for _, index_file in provider.pairs:
+        offsets = read_index(index_file)
+        out.append(int(offsets[pid + 1] - offsets[pid]))
+    return out
+
+
+def _group_maps_by_bytes(per_map: list[int], target: float) -> list[tuple[int, int]]:
+    """Contiguous map ranges each totalling ~target bytes (>=1 map per
+    range; ranges cover [0, n_maps)). A small tail folds into the last
+    range — every extra slice re-reads the other side."""
+    groups: list[tuple[int, int]] = []
+    lo = 0
+    acc = 0.0
+    for m, b in enumerate(per_map):
+        acc += b
+        if acc >= target and m + 1 > lo:
+            groups.append((lo, m + 1))
+            lo = m + 1
+            acc = 0.0
+    if lo < len(per_map):
+        if groups and acc < target / 2:
+            groups[-1] = (groups[-1][0], len(per_map))
+        else:
+            groups.append((lo, len(per_map)))
+    if not groups:
+        groups.append((0, len(per_map)))
+    return groups
 
 
 def _spmd_shard_rows(mesh, n_parts: int, local_arr) -> jax.Array:

@@ -323,3 +323,101 @@ def test_aqe_coalesces_intermediate_stage(mesh):
     want = df.groupby("k").agg(s2=("v", "sum")).reset_index()
     got = out.sort_values("k").reset_index(drop=True)
     assert got["s2"].astype(np.int64).tolist() == want["s2"].astype(np.int64).tolist()
+
+
+# ---------------------------------------------------------------------------
+# AQE skew-join splitting (Spark OptimizeSkewedJoin analog)
+# ---------------------------------------------------------------------------
+
+
+def _skew_join_plan(l_schema, r_schema):
+    """fact JOIN dim over two planned exchanges + sorts (q72 shape)."""
+    from auron_tpu.ops.sortkeys import SortSpec
+
+    lex = B.mesh_exchange(
+        B.memory_scan(l_schema, "skew_l"), B.hash_partitioning([col(0)], N_DEV),
+        "skew_ex_l")
+    rex = B.mesh_exchange(
+        B.memory_scan(r_schema, "skew_r"), B.hash_partitioning([col(0)], N_DEV),
+        "skew_ex_r")
+    lsort = B.sort(lex, [(col(0), SortSpec())])
+    rsort = B.sort(rex, [(col(0), SortSpec())])
+    j = B.sort_merge_join(lsort, rsort, [col(0)], [col(0)], "inner")
+    p = B.hash_agg(j, [(col(0), "k")],
+                   [("count_star", None, "c"), ("sum", col(3), "w")], "partial")
+    # the regrouping agg sits BEYOND another exchange: the join stage is
+    # skew-splittable, the final agg keeps one group per partition
+    ex2 = B.mesh_exchange(p, B.hash_partitioning([col(0)], N_DEV), "skew_ex2")
+    return B.hash_agg(ex2, [(col(0), "k")],
+                      [("count_star", None, "c"), ("sum", col(1), "w")], "final")
+
+
+def _skew_data(hot_frac=0.7, n=30000):
+    rng = np.random.default_rng(12)
+    keys = rng.integers(0, 60, n)
+    keys[: int(n * hot_frac)] = 7  # one hot key -> one hot partition
+    fact = pd.DataFrame({
+        "k": keys.astype(np.int64),
+        "v": rng.integers(0, 5, n).astype(np.int64),
+    })
+    dim = pd.DataFrame({
+        "k2": np.arange(60, dtype=np.int64),
+        "w": rng.integers(1, 10, 60).astype(np.int64),
+    })
+    return fact, dim
+
+
+def _run_skew(mesh, fact, dim, extra=None):
+    from auron_tpu.utils.config import (
+        EXCHANGE_COALESCE_TARGET_BYTES,
+        EXCHANGE_SKEW_FACTOR,
+        EXCHANGE_SKEW_MIN_BYTES,
+    )
+
+    l_schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(fact.iloc[:1], preserve_index=False).schema)
+    r_schema = T.Schema.from_arrow(
+        pa.RecordBatch.from_pandas(dim.iloc[:1], preserve_index=False).schema)
+    conf = (Configuration().set(EXCHANGE_MODE, "file")
+            .set(EXCHANGE_COALESCE_TARGET_BYTES, 1)  # keep full width
+            .set(EXCHANGE_SKEW_FACTOR, 2.0)
+            .set(EXCHANGE_SKEW_MIN_BYTES, 1))
+    for k, v in (extra or {}).items():
+        conf.set(k, v)
+    driver = MeshQueryDriver(mesh, conf=conf)
+    resources = {"skew_l": _partitioned(fact, N_DEV),
+                 "skew_r": _partitioned(dim, N_DEV)}
+    out = driver.collect(_skew_join_plan(l_schema, r_schema), resources)
+    return out.sort_values("k").reset_index(drop=True), driver
+
+
+def test_skew_join_splits_hot_partition(mesh):
+    fact, dim = _skew_data()
+    got, driver = _run_skew(mesh, fact, dim)
+    # the JOIN stage widened: the downstream exchange saw more map tasks
+    # than mesh partitions, and the split sides recorded their task maps
+    ex2 = next(s for s in driver.stats if s.exchange_id == "skew_ex2")
+    assert ex2.rows.shape[0] > N_DEV
+    exl = next(s for s in driver.stats if s.exchange_id == "skew_ex_l")
+    assert exl.coalesced_groups is not None and len(exl.coalesced_groups) > N_DEV
+    want = (fact.merge(dim, left_on="k", right_on="k2")
+            .groupby("k").agg(c=("v", "size"), w=("w", "sum")).reset_index()
+            .sort_values("k").reset_index(drop=True))
+    got = got.astype({"k": np.int64, "c": np.int64, "w": np.int64})
+    pd.testing.assert_frame_equal(
+        got, want.astype({"k": np.int64, "c": np.int64, "w": np.int64}))
+
+
+def test_skew_join_disabled_keeps_width(mesh):
+    from auron_tpu.utils.config import EXCHANGE_SKEW_ENABLE
+
+    fact, dim = _skew_data()
+    got, driver = _run_skew(mesh, fact, dim, extra={EXCHANGE_SKEW_ENABLE: False})
+    ex2 = next(s for s in driver.stats if s.exchange_id == "skew_ex2")
+    assert ex2.rows.shape[0] == N_DEV  # untouched width
+    want = (fact.merge(dim, left_on="k", right_on="k2")
+            .groupby("k").agg(c=("v", "size"), w=("w", "sum")).reset_index()
+            .sort_values("k").reset_index(drop=True))
+    got = got.astype({"k": np.int64, "c": np.int64, "w": np.int64})
+    pd.testing.assert_frame_equal(
+        got, want.astype({"k": np.int64, "c": np.int64, "w": np.int64}))
