@@ -79,6 +79,8 @@ class ExchangeStats:
     rows: np.ndarray  # [P_src, P_dst] routed row counts
     est_bytes_per_shard: int  # payload of the hottest receiving shard
     coalesced_groups: list | None = None  # AQE partition grouping, if applied
+    #: AQE skew-split task table, if applied: [(pid, map_lo, map_hi|None)]
+    skew_tasks: list | None = None
 
     def partition_sizes(self) -> np.ndarray:
         return self.rows.sum(axis=0)
@@ -143,7 +145,10 @@ class MeshQueryDriver:
         self._exchange_seq = 0
         self._tmp_dirs: list[str] = []
         self._reduce_parts: int | None = None  # AQE-coalesced stage width
-        #: pending per-exchange AQE candidates: ex_id -> (provider, sizes)
+        #: pending per-exchange AQE candidates:
+        #: ex_id -> (provider, per-partition totals, per-(map,partition)
+        #: byte matrix) — coalescing consumes the totals, skew splitting
+        #: the matrix
         self._coalesce_candidates: dict[str, tuple] = {}
         #: SPMD multi-host mode: every process runs this SAME driver over
         #: the global mesh (parallel/multihost.py), executing only the
@@ -268,7 +273,7 @@ class MeshQueryDriver:
 
         combined = None
         for ex in ex_ids:
-            _, sizes = self._coalesce_candidates[ex]
+            _, sizes, _ = self._coalesce_candidates[ex]
             combined = sizes if combined is None else combined + sizes
         groups = plan_coalesced_partitions(
             combined, self.conf.get(EXCHANGE_COALESCE_TARGET_BYTES)
@@ -277,7 +282,7 @@ class MeshQueryDriver:
             return self.n_parts
         by_id = {s.exchange_id: s for s in self.stats}
         for ex in ex_ids:
-            provider, _ = self._coalesce_candidates.pop(ex)
+            provider, _, _ = self._coalesce_candidates.pop(ex)
             resources[ex] = CoalescedBlockProvider(provider, groups)
             if ex in by_id:
                 by_id[ex].coalesced_groups = groups
@@ -347,8 +352,7 @@ class MeshQueryDriver:
                 for s in ("left", "right"):
                     tasks[s].append((pid, 0, None))
                 continue
-            provider = self._coalesce_candidates[sides[split_side]][0]
-            per_map = _per_map_partition_bytes(provider, pid)
+            per_map = self._coalesce_candidates[sides[split_side]][2][:, pid]
             target = max(median, float(min_bytes) / 2, 1.0)
             groups = _group_maps_by_bytes(per_map, target)
             other = "left" if split_side == "right" else "right"
@@ -361,10 +365,10 @@ class MeshQueryDriver:
             return self.n_parts
         by_id = {s.exchange_id: s for s in self.stats}
         for side, ex in sides.items():
-            provider, _ = self._coalesce_candidates.pop(ex)
+            provider, _, _ = self._coalesce_candidates.pop(ex)
             resources[ex] = SkewSplitProvider(provider, tasks[side])
             if ex in by_id:
-                by_id[ex].coalesced_groups = tasks[side]
+                by_id[ex].skew_tasks = tasks[side]
         return len(tasks["left"])
 
     def _cleanup_tmp(self) -> None:
@@ -663,10 +667,17 @@ class MeshQueryDriver:
         if self.conf.get(EXCHANGE_COALESCE_ENABLE) or self.conf.get(
             EXCHANGE_SKEW_ENABLE
         ):
-            from auron_tpu.parallel.broadcast import map_output_stats
+            from auron_tpu.exec.shuffle.format import read_index
 
-            sizes = map_output_stats([i for _, i in pairs])
-            self._coalesce_candidates[ex_id] = (provider, sizes)
+            # per-(map, partition) byte matrix: coalescing consumes the
+            # per-partition totals, skew splitting the per-map breakdown
+            per_map = np.stack([
+                np.diff(np.asarray(read_index(i), dtype=np.int64))
+                for _, i in pairs
+            ]) if pairs else np.zeros((0, self.n_parts), np.int64)
+            self._coalesce_candidates[ex_id] = (
+                provider, per_map.sum(axis=0), per_map
+            )
         resources[ex_id] = provider
         return pb.PhysicalPlanNode(
             ipc_reader=pb.IpcReaderNode(
@@ -743,18 +754,6 @@ def _find_single_smj(plan: pb.PhysicalPlanNode):
     return found[0]
 
 
-def _per_map_partition_bytes(provider, pid: int) -> list[int]:
-    """Bytes each map output contributes to one reduce partition (from the
-    shuffle index files — the split planner's balance input)."""
-    from auron_tpu.exec.shuffle.format import read_index
-
-    out = []
-    for _, index_file in provider.pairs:
-        offsets = read_index(index_file)
-        out.append(int(offsets[pid + 1] - offsets[pid]))
-    return out
-
-
 def _group_maps_by_bytes(per_map: list[int], target: float) -> list[tuple[int, int]]:
     """Contiguous map ranges each totalling ~target bytes (>=1 map per
     range; ranges cover [0, n_maps)). A small tail folds into the last
@@ -764,7 +763,7 @@ def _group_maps_by_bytes(per_map: list[int], target: float) -> list[tuple[int, i
     acc = 0.0
     for m, b in enumerate(per_map):
         acc += b
-        if acc >= target and m + 1 > lo:
+        if acc >= target:
             groups.append((lo, m + 1))
             lo = m + 1
             acc = 0.0

@@ -399,7 +399,7 @@ def test_skew_join_splits_hot_partition(mesh):
     ex2 = next(s for s in driver.stats if s.exchange_id == "skew_ex2")
     assert ex2.rows.shape[0] > N_DEV
     exl = next(s for s in driver.stats if s.exchange_id == "skew_ex_l")
-    assert exl.coalesced_groups is not None and len(exl.coalesced_groups) > N_DEV
+    assert exl.skew_tasks is not None and len(exl.skew_tasks) > N_DEV
     want = (fact.merge(dim, left_on="k", right_on="k2")
             .groupby("k").agg(c=("v", "size"), w=("w", "sum")).reset_index()
             .sort_values("k").reset_index(drop=True))
