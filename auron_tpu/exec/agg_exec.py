@@ -451,9 +451,20 @@ class HashAggExec(ExecOperator):
                     zip(self.aggs, self._agg_input_types), agg_cols
                 )
             )
+            flags = self._sort_flags(sel)
+            # host-sort order computes EAGERLY and enters the jit as data:
+            # no pure_callback may live inside the compiled program
+            # (concurrent callback-bearing XLA:CPU programs wedge). The
+            # canonical words ride along so the jit doesn't recompute them.
+            if flags[0] and self.n_keys:
+                words = S.key_words(keys)
+                order = S.host_order(words, sel)
+                words = tuple(words)
+            else:
+                words, order = None, None
             out_v, out_m, group_valid = _reduce_arrays_jit(
-                sel, key_v, key_m, agg_v, agg_m, agg_aux,
-                cfg=self._reduce_cfg + self._sort_flags(sel), raw=raw,
+                sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words,
+                cfg=self._reduce_cfg + flags, raw=raw,
             )
             out_vals = []
             dict_map = self._output_dicts(keys, agg_cols)
@@ -484,10 +495,20 @@ class HashAggExec(ExecOperator):
         agg_cols: list[list[ColumnVal]],
         raw: bool,
     ) -> Batch:
+        flags = self._sort_flags(sel)
+        # same invariant as the jit path: segment_by_keys is itself jitted,
+        # so the host-sort order must enter it as data (never a callback
+        # inside a compiled program — pump threads run concurrently)
+        if flags[0] and self.n_keys:
+            words = S.key_words(keys)
+            order = S.host_order(words, sel)
+            words = tuple(words)
+        else:
+            words, order = None, None
         out_vals, group_valid = _reduce_columns(
             sel, keys, agg_cols, raw,
-            self._reduce_cfg + self._sort_flags(sel),
-            collect_cb=self._host_agg_cb
+            self._reduce_cfg + flags,
+            collect_cb=self._host_agg_cb, order=order, words=words,
         )
         out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
         return Batch(self.inter_schema, out.device, out.dicts)
@@ -953,7 +974,8 @@ def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
     return _agg_aux(a, None, cols)
 
 
-def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None):
+def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None,
+                    order=None, words=None):
     """Segment + reduce already-evaluated columns.
 
     cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...), host_sort,
@@ -974,10 +996,11 @@ def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None
             sel_sorted=sel,
         )
     else:
-        words = S.key_words(keys)
+        if words is None:
+            words = S.key_words(keys)
         seg = S.segment_by_keys(
-            words, sel, host_sort=host_sort, device_impl=device_impl,
-            n_key_cols=n_keys,
+            list(words), sel, order, host_sort=host_sort,
+            device_impl=device_impl, n_key_cols=n_keys,
         )
     order = seg.order
 
@@ -1178,7 +1201,7 @@ def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid, aux=None):
     return out
 
 
-def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, cfg, raw):
+def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words, cfg, raw):
     n_keys, key_dtypes, agg_specs, _host_sort, _device_impl = cfg
     keys = [
         ColumnVal(v, m, dt, None) for (v, m, dt) in zip(key_v, key_m, key_dtypes)
@@ -1188,7 +1211,8 @@ def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, cfg, raw):
         for vs, ms in zip(agg_v, agg_m)
     ]
     out_vals, group_valid = _reduce_columns(
-        sel, keys, agg_cols, raw, cfg, agg_aux=agg_aux
+        sel, keys, agg_cols, raw, cfg, agg_aux=agg_aux, order=order,
+        words=words,
     )
     return (
         tuple(cv.values for cv in out_vals),

@@ -154,20 +154,12 @@ class MemManager:
             if spillable and new_used > consumer_min:
                 pass  # self-spill below (outside the wait path)
             else:
-                # below min share (or unspillable): wait for the pool.
-                # Under the CPU exec gate, siblings cannot compute while
-                # this thread blocks — waiting could only time out, so
-                # skip straight to the outcome (runtime/task.py gate).
-                from auron_tpu.runtime.task import cpu_gate_serialized
-
+                # below min share (or unspillable): wait for the pool
                 self.num_waits += 1
-                if cpu_gate_serialized():
-                    ok = False
-                else:
-                    ok = self._released.wait_for(
-                        lambda: self._pool_state()[0] <= self._pool_state()[1],
-                        timeout=self._wait_timeout,
-                    )
+                ok = self._released.wait_for(
+                    lambda: self._pool_state()[0] <= self._pool_state()[1],
+                    timeout=self._wait_timeout,
+                )
                 if ok or not spillable:
                     return
         # self-spill without holding the manager lock (consumer locks are

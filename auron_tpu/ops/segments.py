@@ -89,6 +89,7 @@ class Segmentation(NamedTuple):
 def segment_by_keys(
     words: list[jnp.ndarray],
     sel: jnp.ndarray,
+    order: jnp.ndarray | None = None,
     *,
     host_sort: bool,
     device_impl: str = "lax",
@@ -99,14 +100,22 @@ def segment_by_keys(
     shapes, not config — a default resolved inside the trace would bake a
     stale choice into already-compiled programs). device_impl picks the
     on-device sort when host_sort is False: 'lax' | 'jnp' | 'pallas'
-    (ops/bitonic.py network paths)."""
+    (ops/bitonic.py network paths).
+
+    With host_sort, EVERY caller must precompute ``order`` eagerly
+    (host_order) and pass it as data: this function is itself jitted, so
+    an order=None host_sort call compiles the pure_callback into an
+    XLA:CPU program — and concurrent callback-bearing programs wedge the
+    intra-op pool (runtime/task.py invariant). The in-trace callback is
+    kept only as a single-threaded-context fallback."""
     from auron_tpu.ops import hostsort
 
     cap = sel.shape[0]
     dead_first_key = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
     iota = jnp.arange(cap, dtype=jnp.int32)
     if host_sort:
-        order = hostsort.order_by_words((dead_first_key, *words))
+        if order is None:
+            order = hostsort.order_by_words((dead_first_key, *words))
         sel_sorted = sel[order]
         sorted_words = tuple(w[order] for w in words)
     else:
@@ -140,6 +149,18 @@ def segment_by_keys(
         jnp.arange(cap, dtype=jnp.int32), seg_ids, num_segments=cap + 1
     )[:cap]
     return Segmentation(order, seg_ids, boundary, group_of_slot, num_groups, sel_sorted)
+
+
+def host_order(words: list[jnp.ndarray], sel: jnp.ndarray) -> jnp.ndarray:
+    """EAGER host lexsort order for segment_by_keys(host_sort=True):
+    identical tie semantics to the in-trace callback (dead rows last,
+    stable). Call OUTSIDE jit; pass the result as ``order``."""
+    import numpy as np
+
+    dead_first = np.asarray(jax.device_get(jnp.where(sel, jnp.uint64(0), jnp.uint64(1))))
+    host_words = [np.asarray(jax.device_get(w)) for w in words]
+    operands = [dead_first, *host_words]
+    return jnp.asarray(np.lexsort(tuple(reversed(operands))).astype(np.int32))
 
 
 # ---------------------------------------------------------------------------

@@ -27,28 +27,6 @@ from auron_tpu.utils.config import TOKIO_EQUIV_PREFETCH_DEPTH, Configuration, co
 
 _END = object()
 
-# one compute step at a time across CPU-backend pumps (see _pump). RLock:
-# an operator that drives a nested plan inline stays on one thread.
-_CPU_GATE = threading.RLock()
-_gate_state = threading.local()
-
-
-def _cpu_exec_gate() -> threading.RLock | None:
-    import jax
-
-    try:
-        return _CPU_GATE if jax.default_backend() == "cpu" else None
-    except Exception:
-        return _CPU_GATE
-
-
-def cpu_gate_serialized() -> bool:
-    """True on a thread currently holding the CPU exec gate — a memory
-    wait there can never be satisfied by a sibling's progress (siblings
-    are parked on the gate), so memmgr.update_mem_used skips its
-    condition-wait instead of timing out."""
-    return getattr(_gate_state, "held", False)
-
 
 class TaskRuntime:
     def __init__(
@@ -87,29 +65,14 @@ class TaskRuntime:
         set_task_context(self.ctx.stage_id, self.ctx.partition_id)
         try:
             with conf_scope(self.ctx.conf):
-                it = self.plan.execute(self.ctx.partition_id, self.ctx)
-                gate = _cpu_exec_gate()
-                while True:
-                    # XLA:CPU wedges when CONCURRENT computations carrying
-                    # host callbacks (hostsort's pure_callback lexsort)
-                    # exhaust the intra-op pool: each parks a pool thread
-                    # awaiting a callback continuation that needs a pool
-                    # thread (reproduced; see tests/test_runtime.py
-                    # concurrent-hostsort test). On the CPU backend, one
-                    # pump computes at a time; queue handoff still
-                    # overlaps producers/consumers. Real accelerators
-                    # serialize on the device — no gate.
-                    if gate is None:
-                        batch = next(it, _END)
-                    else:
-                        with gate:
-                            _gate_state.held = True
-                            try:
-                                batch = next(it, _END)
-                            finally:
-                                _gate_state.held = False
-                    if batch is _END:
-                        break
+                # INVARIANT: no compiled program launched from a pump may
+                # carry a host callback (pure_callback) — concurrent
+                # callback-bearing XLA:CPU computations wedge the intra-op
+                # pool (reproduced; tests/test_runtime.py concurrent-
+                # hostsort test). Host sorts therefore compute their order
+                # EAGERLY and pass it into the jit as data
+                # (ops/segments.py host_order).
+                for batch in self.plan.execute(self.ctx.partition_id, self.ctx):
                     self._queue.put(batch)
         except TaskCancelled:
             pass

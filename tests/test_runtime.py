@@ -252,11 +252,13 @@ def test_hive_partitioned_parquet_sink(tmp_path):
 
 
 def test_concurrent_hostsort_tasks_no_wedge():
-    """Regression: two task pumps whose programs carry hostsort
+    """Regression: two task pumps whose programs carried hostsort
     pure_callbacks wedged XLA:CPU (each in-flight computation parked an
     intra-op thread waiting for a callback continuation that itself
-    needed a pool thread). The CPU exec gate in TaskRuntime._pump
-    serializes compute steps; this must finish, not hang."""
+    needed a pool thread). The fix: host-sort orders compute EAGERLY and
+    enter the jitted programs as data (ops/segments.py host_order) — no
+    compiled program launched from a pump may carry a callback. This
+    must finish, not hang."""
     import threading
 
     import numpy as np
