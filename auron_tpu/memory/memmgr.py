@@ -50,6 +50,26 @@ from auron_tpu.utils.config import (
 _MIN_TRIGGER_BYTES = 1 << 20
 
 
+def _auto_budget() -> int:
+    """Hardware-shaped default (conf 0 = auto): accelerators get an
+    HBM-sized 8GB; on the CPU backend device arrays live in host RAM, so
+    half the physical memory is the faithful analog of the reference's
+    executor-memory-derived budget."""
+    try:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            return 8 << 30
+    except Exception:
+        pass
+    try:
+        phys = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+        return phys // 2  # the documented behavior, no floor: a small
+        # host must spill, not OOM
+    except (ValueError, OSError):
+        return 8 << 30
+
+
 class MemConsumer(Protocol):
     name: str
 
@@ -65,7 +85,13 @@ class MemManager:
 
     def __init__(self, budget_bytes: int | None = None):
         conf = active_conf()
-        total = budget_bytes if budget_bytes is not None else conf.get(HBM_BUDGET_BYTES)
+        # 0 = auto applies to the CONF default only; an explicit
+        # budget_bytes=0 is an intentional always-spill manager
+        total = (
+            budget_bytes
+            if budget_bytes is not None
+            else (conf.get(HBM_BUDGET_BYTES) or _auto_budget())
+        )
         self.budget = int(total * conf.get(MEMORY_FRACTION))
         self._lock = threading.RLock()
         self._released = threading.Condition(self._lock)

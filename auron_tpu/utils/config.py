@@ -143,8 +143,12 @@ MEMORY_FRACTION = float_conf(
     "memory.fraction", 0.6, "memory", "fraction of HBM budget usable by consumers"
 )
 HBM_BUDGET_BYTES = int_conf(
-    "memory.hbm.budget.bytes", 8 << 30, "memory",
-    "total HBM bytes the memory manager may hand out (analog of native memory = overhead * fraction)",
+    "memory.hbm.budget.bytes", 0, "memory",
+    "total HBM bytes the memory manager may hand out (analog of native "
+    "memory = overhead * fraction, which the reference derives from the "
+    "executor's provisioned memory). 0 = auto: 8GB on accelerators "
+    "(HBM-sized), half of physical RAM on the CPU backend (device arrays "
+    "ARE host memory there)",
 )
 SPILL_COMPRESSION_CODEC = str_conf(
     "spill.compression.codec", "zstd", "memory", "codec for spill files and shuffle runs (zstd|lz4|none)"
