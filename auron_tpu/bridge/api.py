@@ -123,12 +123,31 @@ def next_batch_ipc(handle: int) -> bytes | None:
     return sink.getvalue()
 
 
+_metrics_sink = None
+
+
+def set_metrics_sink(fn) -> None:
+    """Install a callable receiving every finalized task's metric-tree
+    snapshot (the in-process analog of the reference pushing each task's
+    MetricNode tree into Spark's SQLMetric registry at finalize,
+    native-engine/auron/src/metrics.rs:7-35). Pass None to uninstall.
+    Used by perf_gate.py to build per-class operator-time breakdowns."""
+    global _metrics_sink
+    _metrics_sink = fn
+
+
 def finalize_native(handle: int) -> dict:
     with _lock:
         rt = _runtimes.pop(handle, None)
     if rt is None:
         return {}
-    return rt.finalize()
+    snap = rt.finalize()
+    if _metrics_sink is not None:
+        try:
+            _metrics_sink(snap)
+        except Exception:  # noqa: BLE001 — observability must not fail tasks
+            pass
+    return snap
 
 
 def finalize_native_json(handle: int) -> bytes:

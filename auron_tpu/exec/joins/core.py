@@ -20,6 +20,7 @@ Spark.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Iterator
 
 import jax
@@ -78,11 +79,15 @@ class PreparedBuild:
     # per-batch host sync. Dimension-table joins (the common BHJ shape) are
     # almost always in this regime.
     unique: bool = False
-    # dense direct-address table: lut[word - lut_base] = sorted row index
+    # dense direct-address table: lut[word - lut_base] = build row index
     # (or -1). Built when the single key is integer-like with a small value
     # range (surrogate-key dims); turns the probe into a single O(1) gather.
     lut: jnp.ndarray | None = None
-    lut_base: int = 0  # uint64 word base (int value of words.min())
+    lut_base: int = 0  # key-value base (signed int of words.min())
+    # existence-only table for duplicate-keyed builds probed by semi/anti
+    # (no pair enumeration needed): exists_lut[key - lut_base] per probe row
+    # replaces the binary search — and lets the build skip its sort.
+    exists_lut: jnp.ndarray | None = None
 
 
 def _key_columns(batch: Batch, key_exprs: list[ir.Expr]) -> list[ColumnVal]:
@@ -134,7 +139,92 @@ def unify_key_dicts(
     return out_b, out_p
 
 
-def prepare_build(batches: list[Batch], key_exprs: list[ir.Expr], schema: T.Schema) -> PreparedBuild:
+@partial(jax.jit, static_argnames=("device_sort",))
+def _prepare_build_jit(key_sel, row_sel, words, values, validity, order, *,
+                       device_sort: bool):
+    """Fused build-side preparation: cluster rows by key and compute the
+    uniqueness/key-range stats in ONE compiled program (the whole build was
+    previously ~40 eager primitives — each a separate unfused pass over a
+    capacity-sized buffer, which is what collapsed the join-heavy perf-gate
+    classes). ``order`` is the host lexsort permutation on CPU hosts
+    (ops/hostsort.py rationale) and None on accelerators, where the sort
+    runs in-program on device."""
+    cap = key_sel.shape[0]
+    if device_sort:
+        live_first = jnp.where(key_sel, jnp.uint64(0), jnp.uint64(1))
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        sorted_ops = lax.sort(
+            tuple([live_first, *words, iota]), num_keys=len(words) + 1
+        )
+        sorted_words = tuple(sorted_ops[1:-1])
+        order = sorted_ops[-1]
+    else:
+        sorted_words = tuple(w[order] for w in words)
+    values_s = tuple(v[order] for v in values)
+    validity_s = tuple(m[order] for m in validity)
+    row_sel_s = row_sel[order]  # null-keyed rows stay live (outer emits them)
+    n_live_dev = jnp.sum(key_sel)
+    live_sorted = jnp.arange(cap) < n_live_dev  # live rows are a prefix
+    dup = jnp.ones(cap, bool).at[0].set(False)
+    for w in sorted_words:
+        dup = dup & jnp.concatenate([jnp.zeros(1, bool), w[1:] == w[:-1]])
+    # adjacent ALL-columns-equal, both rows live, marks a duplicate key
+    has_dup = jnp.any(
+        dup & live_sorted & jnp.concatenate([jnp.zeros(1, bool), live_sorted[:-1]])
+    )
+    w0 = sorted_words[0]
+    kmin = w0[0]
+    kmax = w0[jnp.clip(n_live_dev - 1, 0, cap - 1)]
+    stats = jnp.stack([
+        n_live_dev.astype(jnp.uint64),
+        has_dup.astype(jnp.uint64),
+        kmin,
+        kmax,
+    ])
+    return row_sel_s, sorted_words, values_s, validity_s, stats
+
+
+
+@jax.jit
+def _key_range_jit(w0, sel):
+    """(n_live, kmin, kmax) of the live signed key values — the no-sort
+    pre-pass deciding whether a dense LUT can replace the sorted-array map."""
+    s = w0.view(jnp.int64)
+    n_live = jnp.sum(sel)
+    kmin = jnp.min(jnp.where(sel, s, jnp.iinfo(jnp.int64).max))
+    kmax = jnp.max(jnp.where(sel, s, jnp.iinfo(jnp.int64).min))
+    return jnp.stack([n_live, kmin, kmax])
+
+
+@partial(jax.jit, static_argnames=("size",))
+def _scatter_luts_jit(w0, sel, kmin, size: int):
+    """Dense tables straight from the unsorted build — no sort pass.
+    Returns (row_lut, exists, has_dup): row_lut maps key-kmin -> original
+    row index (valid only when !has_dup), exists marks occupied slots."""
+    cap = w0.shape[0]
+    idx = (w0.view(jnp.int64) - kmin).astype(jnp.int32)
+    slot = jnp.where(sel, idx, size)
+    counts = jnp.zeros(size, jnp.int32).at[slot].add(1, mode="drop")
+    row_lut = (
+        jnp.full(size, -1, jnp.int32)
+        .at[slot]
+        .set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    )
+    has_dup = jnp.any(counts > 1)
+    return row_lut, counts > 0, has_dup
+
+
+def prepare_build(
+    batches: list[Batch],
+    key_exprs: list[ir.Expr],
+    schema: T.Schema,
+    need_pairs: bool = True,
+) -> PreparedBuild:
+    """``need_pairs=False`` (semi/anti probes that only test existence)
+    licenses the duplicate-tolerant LUT fast path: with duplicates and no
+    pair enumeration the build can stay unsorted behind an existence table."""
+    from auron_tpu.ops import hostsort
+
     if batches:
         big = device_concat(batches)
     else:
@@ -143,66 +233,69 @@ def prepare_build(batches: list[Batch], key_exprs: list[ir.Expr], schema: T.Sche
     words, valid = _canon_words(vals)
     sel = big.device.sel & (valid if valid is not None else True)
     cap = big.capacity
-    live_first = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    sorted_ops = lax.sort(tuple([live_first, *words, iota]), num_keys=len(words) + 1)
-    order = sorted_ops[-1]
     dev = big.device
-    clustered = Batch(
-        big.schema,
-        DeviceBatch(
-            sel=big.device.sel[order],  # keep null-keyed rows live (outer emits them)
-            values=tuple(v[order] for v in dev.values),
-            validity=tuple(m[order] for m in dev.validity),
-        ),
-        big.dicts,
-    )
-    sorted_words = [w for w in sorted_ops[1:-1]]
-    # uniqueness + key-range stats ride the same transfer as the live count
-    live_sorted = jnp.arange(cap) < jnp.sum(sel)  # live rows are a prefix
-    dup = jnp.ones(cap, bool).at[0].set(False)
-    for w in sorted_words:
-        dup = dup & jnp.concatenate([jnp.zeros(1, bool), w[1:] == w[:-1]])
-    # adjacent ALL-columns-equal, both rows live, marks a duplicate key
-    has_dup = jnp.any(dup & live_sorted & jnp.concatenate([jnp.zeros(1, bool), live_sorted[:-1]]))
-    w0 = sorted_words[0]
-    kmin = w0[0]
-    n_live_dev = jnp.sum(sel)
-    kmax = w0[jnp.clip(n_live_dev - 1, 0, cap - 1)]
-    n_live, has_dup_h, kmin_h, kmax_h = (
-        int(x) for x in jax.device_get((n_live_dev, has_dup, kmin, kmax))
-    )
-    unique = n_live > 0 and not has_dup_h
-    lut = None
-    lut_base = 0
+
+    # ---- sort-free LUT path: single integer-like key, small value range
     if (
-        unique
-        and len(sorted_words) == 1
+        cap > 0
+        and len(words) == 1
         and vals[0].dtype.kind
         in (T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32, T.TypeKind.INT64,
             T.TypeKind.DATE32, T.TypeKind.TIMESTAMP)
         and not vals[0].dtype.is_dict_encoded
-        and 0 <= kmax_h - kmin_h < max(4 * cap, 1 << 16)
-        and kmax_h - kmin_h < (1 << 22)
-        and kmax_h < (1 << 63)  # negative int64 keys view as huge uint64s
     ):
-        size = int(kmax_h - kmin_h) + 1
-        idx = (w0[:cap].astype(jnp.int64) - jnp.int64(kmin_h)).astype(jnp.int32)
-        slot = jnp.where(live_sorted, idx, size)  # dead rows dropped
-        lut = (
-            jnp.full(size, -1, jnp.int32)
-            .at[slot]
-            .set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
-        )
-        lut_base = kmin_h
+        n_live, kmin_h, kmax_h = (int(x) for x in jax.device_get(_key_range_jit(words[0], sel)))
+        # pigeonhole pre-check: more live rows than distinct slots guarantees
+        # duplicates, so a pairs-producing build can never be unique — skip
+        # the scatter pass (and its sync) instead of building tables that the
+        # duplicates+pairs fallthrough would discard
+        cannot_be_unique = n_live > kmax_h - kmin_h + 1
+        if (
+            n_live > 0
+            and 0 <= kmax_h - kmin_h < min(max(4 * cap, 1 << 16), 1 << 22)
+            and not (need_pairs and cannot_be_unique)
+        ):
+            size = bucket_capacity(int(kmax_h - kmin_h) + 1)
+            row_lut, exists, has_dup_d = _scatter_luts_jit(
+                words[0], sel, jnp.int64(kmin_h), size=size
+            )
+            has_dup = bool(jax.device_get(has_dup_d))
+            if not has_dup:
+                return PreparedBuild(
+                    batch=big, words=[words[0]], n_live=n_live,
+                    matched=jnp.zeros(cap, bool), unique=True,
+                    lut=row_lut, lut_base=kmin_h,
+                )
+            if not need_pairs:
+                return PreparedBuild(
+                    batch=big, words=[words[0]], n_live=n_live,
+                    matched=jnp.zeros(cap, bool), unique=False,
+                    exists_lut=exists, lut_base=kmin_h,
+                )
+            # duplicates + pair output -> fall through to the sorted map
+    if hostsort.use_host_sort():
+        order = S.host_order(words, sel)
+        device_sort = False
+    else:
+        order, device_sort = None, True
+    row_sel_s, sorted_words, values_s, validity_s, stats = _prepare_build_jit(
+        sel, dev.sel, tuple(words), dev.values, dev.validity, order,
+        device_sort=device_sort,
+    )
+    clustered = Batch(
+        big.schema, DeviceBatch(row_sel_s, values_s, validity_s), big.dicts
+    )
+    sorted_words = list(sorted_words)
+    # uniqueness stats ride ONE transfer (integer-like keys took the LUT
+    # fast path above, so no dense table is built here)
+    n_live, has_dup_h, _, _ = (int(x) for x in jax.device_get(stats))
+    unique = n_live > 0 and not has_dup_h
     return PreparedBuild(
         batch=clustered,
         words=sorted_words,
         n_live=n_live,
         matched=jnp.zeros(cap, bool),
         unique=unique,
-        lut=lut,
-        lut_base=lut_base,
     )
 
 
@@ -213,7 +306,9 @@ def _probe_unique_ops(
     if lut is not None:
         w = probe_words[0]
         size = lut.shape[0]
-        idx = w.astype(jnp.int64) - lut_base
+        # view, not astype: words >= 2^63 are negative keys and must
+        # reinterpret bit-exactly, a value conversion would be UB-ish
+        idx = w.view(jnp.int64) - lut_base
         in_range = (idx >= 0) & (idx < size)
         bi = lut[jnp.clip(idx, 0, size - 1).astype(jnp.int32)]
         ok = ok_base & in_range & (bi >= 0)
@@ -343,6 +438,45 @@ def probe_ranges(build: PreparedBuild, probe_words, probe_valid, probe_sel):
     ok = probe_sel & (probe_valid if probe_valid is not None else True)
     counts = jnp.where(ok, hi - lo, 0).astype(jnp.int32)
     return lo, counts
+
+
+@jax.jit
+def _probe_exists_jit(exists_lut, base, pword, pvalid, psel):
+    """Existence probe against a duplicate-tolerant dense LUT: one gather
+    per probe batch, no binary search, no build sort."""
+    size = exists_lut.shape[0]
+    idx = pword.view(jnp.int64) - base
+    in_range = (idx >= 0) & (idx < size)
+    hit = exists_lut[jnp.clip(idx, 0, size - 1).astype(jnp.int32)]
+    ok = psel & (pvalid if pvalid is not None else True)
+    return ok & in_range & hit
+
+
+@partial(jax.jit, static_argnames=("need_build_delta",))
+def _probe_mark_jit(
+    build_words, n_live, build_matched, probe_words, probe_valid, probe_sel,
+    *, need_build_delta: bool,
+):
+    """Fused no-pairs probe (semi/anti/existence): binary-search ranges,
+    per-probe matched flags, and — when the build side owns the mark — the
+    range-covered build flags folded into ``matched``, all in one program
+    (per-batch eager dispatch was a measured q95-class sink)."""
+    lo = binsearch._search(build_words, probe_words, n_live, binsearch._lex_less)
+    hi = binsearch._search(build_words, probe_words, n_live, binsearch._lex_less_eq)
+    ok = probe_sel & (probe_valid if probe_valid is not None else True)
+    counts = jnp.where(ok, hi - lo, 0).astype(jnp.int32)
+    probe_matched = (counts > 0) & probe_sel
+    if not need_build_delta:
+        return probe_matched, build_matched
+    cap = build_words[0].shape[0]
+    hit = counts > 0
+    starts = jnp.where(hit, lo, cap)
+    stops = jnp.where(hit, lo + counts, cap)
+    diff = jnp.zeros(cap + 1, jnp.int32)
+    diff = diff.at[starts].add(1, mode="drop")
+    diff = diff.at[stops].add(-1, mode="drop")
+    covered = jnp.cumsum(diff[:cap]) > 0
+    return probe_matched, build_matched | covered
 
 
 def expand_pairs(

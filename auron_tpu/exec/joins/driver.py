@@ -101,7 +101,16 @@ class EquiJoinDriver:
     def prepare(self, build_batches: list[Batch]) -> PreparedBuild:
         schema = self.left_schema if self.build_side == "left" else self.right_schema
         keys = self.left_keys if self.build_side == "left" else self.right_keys
-        return core.prepare_build(build_batches, keys, schema)
+        # existence-only probes (probe-side semi/anti with no residual
+        # condition and no build-side marking) never enumerate pairs, so a
+        # duplicate-keyed build may skip its sort behind an existence LUT
+        need_pairs = (
+            self.wants_pairs
+            or self.condition is not None
+            or self.build_mark
+            or self.build_outer
+        )
+        return core.prepare_build(build_batches, keys, schema, need_pairs=need_pairs)
 
     def probe_batch(self, build: PreparedBuild, pb: Batch) -> Iterator[Batch]:
         """Probe one batch; updates build.matched in place."""
@@ -132,7 +141,6 @@ class EquiJoinDriver:
             return
 
         pwords, pvalid = _canon_words(pvals)
-        lo, counts = probe_ranges(build, pwords, pvalid, pb.device.sel)
 
         condition = None
         if self.condition is not None:
@@ -141,14 +149,25 @@ class EquiJoinDriver:
 
         need_pairs = self.wants_pairs or condition is not None
         if need_pairs:
+            lo, counts = probe_ranges(build, pwords, pvalid, pb.device.sel)
             chunks, probe_matched, build_delta = expand_pairs(
                 pb, build, lo, counts, condition, True
             )
+            build.matched = build.matched | build_delta
+        elif build.exists_lut is not None:
+            chunks = []
+            probe_matched = core._probe_exists_jit(
+                build.exists_lut, jnp.int64(build.lut_base),
+                pwords[0], pvalid, pb.device.sel,
+            )
         else:
             chunks = []
-            probe_matched = (counts > 0) & pb.device.sel
-            build_delta = self._mark_build_matched(build, lo, counts)
-        build.matched = build.matched | build_delta
+            # one fused program: search + probe flags + build-mark fold
+            probe_matched, build.matched = core._probe_mark_jit(
+                tuple(build.words), jnp.int32(build.n_live), build.matched,
+                tuple(pwords), pvalid, pb.device.sel,
+                need_build_delta=self.build_mark or self.build_outer,
+            )
         if orig_build is not build:
             orig_build.matched = build.matched
 
@@ -369,19 +388,6 @@ class EquiJoinDriver:
                 yield self._finish_batch(cols, bb.device.sel)
 
     # ------------------------------------------------------------------
-
-    def _mark_build_matched(self, build: PreparedBuild, lo, counts) -> jnp.ndarray:
-        """Without pair expansion, mark build rows in [lo, lo+count) ranges
-        as matched via a difference array (for build-side semi/anti)."""
-        cap = build.batch.capacity
-        hit = counts > 0
-        starts = jnp.where(hit, lo, cap)
-        stops = jnp.where(hit, lo + counts, cap)
-        diff = jnp.zeros(cap + 1, jnp.int32)
-        diff = diff.at[starts].add(1, mode="drop")
-        diff = diff.at[stops].add(-1, mode="drop")
-        covered = jnp.cumsum(diff[:cap]) > 0
-        return covered
 
     def _assemble_pairs_batch(self, probe_b, build_b, li, ri, ok) -> Batch:
         pv, pm, bv, bm = core.gather_pair_arrays(

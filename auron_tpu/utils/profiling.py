@@ -1,0 +1,77 @@
+"""Engine-level counters: XLA compiles and host syncs.
+
+The reference accounts where task time goes with ~20 named per-operator
+metrics (native-engine/auron/src/metrics.rs:7-35); on the XLA substrate the
+two engine-level costs that metric tree cannot see are (a) compilation of
+new program shapes and (b) device->host syncs (every ``device_get`` /
+``np.asarray`` of a live array blocks on the computation producing it).
+``EngineCounters`` taps both, best-effort: the jaxlib internals it wraps are
+version-dependent, so every hook degrades to "counter absent" rather than
+failing the run.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class EngineCounters:
+    """Process-wide compile/sync counters. install() is idempotent per
+    process; read the totals from .snapshot()."""
+
+    _installed: "EngineCounters | None" = None
+
+    def __init__(self) -> None:
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.syncs = 0
+        self.sync_s = 0.0
+
+    @classmethod
+    def install(cls) -> "EngineCounters":
+        if cls._installed is not None:
+            return cls._installed
+        self = cls()
+        try:
+            from jax._src import compiler as _jc
+
+            orig_compile = _jc.backend_compile_and_load
+
+            def counted_compile(*a, **kw):
+                t0 = time.perf_counter()
+                try:
+                    return orig_compile(*a, **kw)
+                finally:
+                    self.compiles += 1
+                    self.compile_s += time.perf_counter() - t0
+
+            _jc.backend_compile_and_load = counted_compile
+        except Exception:
+            pass
+        try:
+            from jax._src import array as _ja
+
+            orig_value = _ja.ArrayImpl._value
+
+            @property
+            def counted_value(arr):
+                t0 = time.perf_counter()
+                try:
+                    return orig_value.fget(arr)
+                finally:
+                    self.syncs += 1
+                    self.sync_s += time.perf_counter() - t0
+
+            _ja.ArrayImpl._value = counted_value
+        except Exception:
+            pass
+        cls._installed = self
+        return self
+
+    def snapshot(self) -> dict:
+        return {
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 3),
+            "host_syncs": self.syncs,
+            "host_sync_s": round(self.sync_s, 3),
+        }

@@ -10,14 +10,25 @@ Each class runs in its OWN subprocess with a timeout: a wedged query
 gets a SIGUSR1 stack dump (forensics on stderr) and a kill, and the gate
 moves on — one stall can't eat the remaining classes or the summary.
 
+This is a gate, not a log (the reference's result-check AND plan-check are
+both hard gates, dev/auron-it QueryResultComparator.scala:39-110): a class
+FAILS when rows mismatch, when it exceeds the wall-clock budget, or when
+its speedup vs the single-thread pandas oracle is below the per-class
+minimum — and the process exits nonzero when any class fails.
+
 Per class, one JSON line:
     {"class": ..., "sf": N, "ok": bool, "engine_s": N, "oracle_s": N,
      "speedup": N, "backend": ..., "error": str|null}
-and a final summary line {"metric": "perf_gate", ...}.
+plus a "breakdown" line with the per-operator metric rollup (the metric
+tree every task hands back at finalize — metrics.rs:7-35 analog) and the
+engine-level compile/host-sync counters; the full tree is also written to
+PERF_BREAKDOWN_SF{N}.json next to this script.
 
 Env: PERF_GATE_SF (default 100), PERF_GATE_CLASSES (comma list, default
 the heavy subset), BENCH_PARTS (default 2), PERF_GATE_CLASS_TIMEOUT
-(seconds per class, default 2700).
+(seconds per class, default 2700), PERF_GATE_BUDGET_S (wall-clock budget
+per class, default 900 — a correct-but-slow class fails), and
+PERF_GATE_MIN_SPEEDUP (default 0.5; q3/q18/q93/q14 default 1.0).
 
 Run on the TPU backend when the tunnel is up; CPU runs are still a valid
 correctness gate at scale.
@@ -33,19 +44,78 @@ import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, ROOT)
 
 HEAVY = ["q3", "q18", "q72", "q95", "q65", "q5", "q93", "q14"]
 CLASS_TIMEOUT_S = int(os.environ.get("PERF_GATE_CLASS_TIMEOUT", "2700"))
+BUDGET_S = float(os.environ.get("PERF_GATE_BUDGET_S", "900"))
+# agg/scan-dominated classes must BEAT one pandas thread; the join/shuffle
+# classes (where the oracle skips the exchange entirely) must reach half.
+# An explicit PERF_GATE_MIN_SPEEDUP overrides BOTH tiers.
+_ENV_MIN_SPEEDUP = os.environ.get("PERF_GATE_MIN_SPEEDUP")
+DEFAULT_MIN_SPEEDUP = float(_ENV_MIN_SPEEDUP or "0.5")
+MIN_SPEEDUP = (
+    {}
+    if _ENV_MIN_SPEEDUP
+    else {"q3": 1.0, "q18": 1.0, "q93": 1.0, "q14": 1.0}
+)
+
+
+def _pick_backend_env(env: dict) -> None:
+    """Child backend selection: use the TPU only when the round's probe
+    daemon (.tpu_probe/status.json) reports a live chip; otherwise force
+    CPU AND drop PYTHONPATH — the axon sitecustomize hook hijacks backend
+    init even under JAX_PLATFORMS=cpu and wedges for 900s (probe.log)."""
+    live = False
+    try:
+        with open(os.path.join(ROOT, ".tpu_probe", "status.json")) as f:
+            st = json.load(f)
+        live = bool(st.get("ok")) and time.time() - st.get("ts", 0) < 900
+    except Exception:
+        pass
+    if not live:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PYTHONPATH", None)
 
 
 def run_one(name: str, ws: str) -> None:
     """Child mode: generate data, run ONE class + oracle, print its record."""
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
+    from auron_tpu.utils.profiling import EngineCounters
+
+    counters = EngineCounters.install()
+
     import jax
 
+    from auron_tpu.bridge import api
+    from auron_tpu.exec.metrics import MetricNode
     from auron_tpu.models import tpcds
+
+    # per-operator rollup across every task of the class
+    op_totals: dict[str, dict[str, int]] = {}
+    flat_totals: dict[str, int] = {}
+    trees: list[dict] = []
+
+    def sink(snap: dict) -> None:
+        trees.append(snap)
+        for k, v in MetricNode.flat_totals(snap).items():
+            flat_totals[k] = flat_totals.get(k, 0) + int(v)
+
+        def rec(node: dict) -> None:
+            name_ = node.get("name") or "<node>"
+            # strip the per-instance ".N" child suffixes down to the op name
+            op = name_.split(".")[0]
+            tot = op_totals.setdefault(op, {})
+            for k, v in node.get("values", {}).items():
+                tot[k] = tot.get(k, 0) + int(v)
+            for c in node.get("children", ()):
+                rec(c)
+
+        rec(snap)
+
+    api.set_metrics_sink(sink)
 
     sf = float(os.environ.get("PERF_GATE_SF", "100"))
     n_parts = int(os.environ.get("BENCH_PARTS", "2"))
@@ -95,6 +165,18 @@ def run_one(name: str, ws: str) -> None:
         "speedup": round(orc / eng, 3) if eng else None,
         "backend": backend, "error": err,
     }), flush=True)
+    # second line: where the time went (op rollup sorted by compute time)
+    ranked = sorted(
+        op_totals.items(),
+        key=lambda kv: -sum(v for m, v in kv[1].items()
+                            if m.endswith("_time") or m.endswith("_nanos")),
+    )
+    print(json.dumps({
+        "breakdown": name, "sf": sf, "tasks": len(trees),
+        "counters": counters.snapshot(),
+        "flat": {k: flat_totals[k] for k in sorted(flat_totals)},
+        "ops": {k: v for k, v in ranked},
+    }), flush=True)
 
 
 def main() -> None:
@@ -104,10 +186,12 @@ def main() -> None:
              if n.strip() in HEAVY]
     ws = tempfile.mkdtemp(prefix="auron_perf_gate_")
     results = []
+    breakdowns = {}
     for name in names:
         env = dict(os.environ)
         env["PERF_GATE_CHILD"] = name
         env["PERF_GATE_WS"] = ws
+        _pick_backend_env(env)
         rec = None
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
@@ -129,20 +213,47 @@ def main() -> None:
             )
         if rec is None:
             lines = [ln for ln in out.splitlines() if ln.startswith("{")]
-            if proc.returncode == 0 and lines:
-                rec = json.loads(lines[-1])
+            recs = []
+            for ln in lines:
+                try:
+                    recs.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass  # child killed mid-print; keep what parsed
+            main_recs = [r for r in recs if "class" in r]
+            brk = [r for r in recs if "breakdown" in r]
+            if brk:
+                breakdowns[name] = brk[-1]
+            if proc.returncode == 0 and main_recs:
+                rec = main_recs[-1]
             else:
                 rec = {"class": name, "sf": sf, "ok": False, "engine_s": None,
                        "oracle_s": None, "speedup": None, "backend": None,
                        "error": f"child rc={proc.returncode}: {err_txt[-300:]}"}
+        # ---- the teeth: wall budget + minimum speedup are hard failures
+        if rec["ok"]:
+            floor = MIN_SPEEDUP.get(name, DEFAULT_MIN_SPEEDUP)
+            if rec["engine_s"] is not None and rec["engine_s"] > BUDGET_S:
+                rec["ok"] = False
+                rec["error"] = (
+                    f"wall budget exceeded: {rec['engine_s']:.1f}s > {BUDGET_S:.0f}s"
+                )
+            elif rec["speedup"] is not None and rec["speedup"] < floor:
+                rec["ok"] = False
+                rec["error"] = f"speedup {rec['speedup']} < required {floor}"
         shutil.rmtree(os.path.join(ws, name), ignore_errors=True)
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
+    out_path = os.path.join(ROOT, f"PERF_BREAKDOWN_SF{int(sf)}.json")
+    with open(out_path, "w") as f:
+        json.dump(breakdowns, f, indent=1)
+    passed = sum(bool(r["ok"]) for r in results)
     print(json.dumps({
         "metric": "perf_gate", "sf": sf, "classes": len(results),
-        "passed": sum(bool(r["ok"]) for r in results),
+        "passed": passed,
     }))
+    if passed < len(results):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

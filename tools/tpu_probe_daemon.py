@@ -1,0 +1,76 @@
+"""Round-long TPU probe daemon.
+
+The axon tunnel has wedged `jax.devices()` for four straight rounds
+(.tpu_probe/FORENSICS.md). This daemon probes in a fresh subprocess
+(never in-process — a wedged PJRT init is unkillable from Python) every
+~17 minutes with a hard timeout, appends to .tpu_probe/probe.log, and
+writes .tpu_probe/status.json that bench.py reads (15-min freshness
+window). On the first live probe it exits, leaving ok=true for bench.
+
+Usage: nohup python tools/tpu_probe_daemon.py >> .tpu_probe/daemon.out 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIR = os.path.join(ROOT, ".tpu_probe")
+TIMEOUT_S = int(os.environ.get("TPU_PROBE_TIMEOUT", "900"))
+INTERVAL_S = int(os.environ.get("TPU_PROBE_INTERVAL", "1020"))
+
+PROBE_SRC = (
+    "import jax, json; ds = jax.devices(); "
+    "print(json.dumps({'n': len(ds), 'kind': ds[0].device_kind, "
+    "'platform': ds[0].platform}))"
+)
+
+
+def log(msg: str) -> None:
+    stamp = time.strftime("[%H:%M:%S]")
+    with open(os.path.join(DIR, "probe.log"), "a") as f:
+        f.write(f"{stamp} {msg}\n")
+
+
+def main() -> None:
+    os.makedirs(DIR, exist_ok=True)
+    attempt = 0
+    # continue the numbered trail across restarts
+    try:
+        with open(os.path.join(DIR, "status.json")) as f:
+            attempt = int(json.load(f).get("attempt", 0))
+    except Exception:
+        pass
+    while True:
+        attempt += 1
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "axon"
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", PROBE_SRC],
+                env=env, capture_output=True, text=True, timeout=TIMEOUT_S,
+            )
+            ok = out.returncode == 0 and out.stdout.strip().startswith("{")
+            detail = out.stdout.strip() if ok else (out.stderr or "")[-200:]
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"timeout {TIMEOUT_S}s"
+        log(f"attempt {attempt}: " + ("LIVE " + detail if ok
+                                      else f"TIMEOUT after {int(time.time() - t0)}s"
+                                      if detail.startswith("timeout")
+                                      else "FAIL " + detail))
+        with open(os.path.join(DIR, "status.json"), "w") as f:
+            json.dump({"ok": ok, "detail": detail, "attempt": attempt,
+                       "ts": time.time()}, f)
+        if ok:
+            log("TPU live — daemon exiting; bench.py will use it")
+            return
+        time.sleep(max(0, INTERVAL_S - (time.time() - t0)))
+
+
+if __name__ == "__main__":
+    main()
