@@ -317,6 +317,19 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
     return Batch.from_arrow(rb)
 
 
+@jax.jit
+def device_take(dev: DeviceBatch, order: jnp.ndarray) -> DeviceBatch:
+    """Permute every column of a DeviceBatch by an index array in ONE fused
+    program — the shared kernel behind sorted-run finalization, shuffle pid
+    clustering and join-build clustering (keep ONE definition so gather
+    semantics—clamping, index dtype, shardings—can't drift apart)."""
+    return DeviceBatch(
+        sel=dev.sel[order],
+        values=tuple(v[order] for v in dev.values),
+        validity=tuple(m[order] for m in dev.validity),
+    )
+
+
 @partial(jax.jit, static_argnames=("pad",))
 def _device_concat_jit(sels, cols, masks, remaps, pad: int):
     """Fused multi-batch concatenation: every column of every input lands

@@ -828,6 +828,8 @@ class _AggTableConsumer:
         self.state: Batch | None = None
         self.staged: list[Batch] = []
         self.staged_rows = 0
+        self._staged_bytes = 0
+        self._state_bytes = 0
         self.parked: list = []  # DiskSpill objects
         # tasks run concurrently; MemManager.acquire may spill this consumer
         # from ANOTHER task's thread. Lock order is manager -> consumer (the
@@ -835,9 +837,12 @@ class _AggTableConsumer:
         self._lock = threading.RLock()
 
     def add(self, inter: Batch, groups: int) -> None:
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
         with self._lock:
             self.staged.append(inter)
             self.staged_rows += groups
+            self._staged_bytes += batch_nbytes(inter)
 
     def adjust_staged(self, delta: int) -> None:
         """Correct the staged-rows estimate once an exact group count settles
@@ -846,20 +851,24 @@ class _AggTableConsumer:
             self.staged_rows = max(0, self.staged_rows + delta)
 
     def compact(self) -> None:
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
         with self._lock:
             self.state = self.exec._merge(
                 [self.state] if self.state is not None else [], self.staged
             )
-            self.staged, self.staged_rows = [], 0
+            self.staged, self.staged_rows, self._staged_bytes = [], 0, 0
+            self._state_bytes = (
+                batch_nbytes(self.state) if self.state is not None else 0
+            )
 
     def mem_used(self) -> int:
-        from auron_tpu.exec.sort_exec import batch_nbytes
-
+        # incremental accounting: the manager polls every consumer's
+        # mem_used on EVERY acquire, so an O(len(staged)) scan here turns
+        # the whole pipeline quadratic in staged-batch count (measured as
+        # the q72-class superlinear blowup: 124k batch_nbytes calls at SF=2)
         with self._lock:
-            total = sum(batch_nbytes(b) for b in self.staged)
-            if self.state is not None:
-                total += batch_nbytes(self.state)
-            return total
+            return self._staged_bytes + self._state_bytes
 
     def spill(self) -> int:
         """Park the merged state as a compressed run (host-RAM tier first,
@@ -878,6 +887,7 @@ class _AggTableConsumer:
                     self.parked.append(ds)
             self.ctx.metrics.add("spilled_aggs", 1)
             self.state = None
+            self._state_bytes = 0
             return freed
 
     def drain(self):
@@ -890,6 +900,7 @@ class _AggTableConsumer:
         with self._lock:
             staged, state, parked = self.staged, self.state, self.parked
             self.staged, self.staged_rows, self.state, self.parked = [], 0, None, []
+            self._staged_bytes = self._state_bytes = 0
         yield from staged
         if state is not None:
             yield state
@@ -906,6 +917,7 @@ class _AggTableConsumer:
                 parts.append(self.state)
             parked, self.parked = self.parked, []
             self.staged, self.staged_rows, self.state = [], 0, None
+            self._staged_bytes = self._state_bytes = 0
         for ds in parked:
             for rb in ds.read_tables():
                 parts.append(Batch.from_arrow(rb))

@@ -160,9 +160,11 @@ def _prepare_build_jit(key_sel, row_sel, words, values, validity, order, *,
         order = sorted_ops[-1]
     else:
         sorted_words = tuple(w[order] for w in words)
-    values_s = tuple(v[order] for v in values)
-    validity_s = tuple(m[order] for m in validity)
-    row_sel_s = row_sel[order]  # null-keyed rows stay live (outer emits them)
+    from auron_tpu.columnar.batch import device_take
+
+    # null-keyed rows stay live (outer emits them): permute row_sel, not key_sel
+    taken = device_take(DeviceBatch(row_sel, values, validity), order)
+    row_sel_s, values_s, validity_s = taken.sel, taken.values, taken.validity
     n_live_dev = jnp.sum(key_sel)
     live_sorted = jnp.arange(cap) < n_live_dev  # live rows are a prefix
     dup = jnp.ones(cap, bool).at[0].set(False)
@@ -183,6 +185,40 @@ def _prepare_build_jit(key_sel, row_sel, words, values, validity, order, *,
     ])
     return row_sel_s, sorted_words, values_s, validity_s, stats
 
+
+
+@jax.jit
+def _presorted_stats_jit(sel, words):
+    """(already_clustered, stats) in one tiny program: True when key-live
+    rows form a prefix AND their word tuples are lexicographically
+    non-decreasing (unsigned — the binary-search comparator's order).
+    SMJ build sides straight from SortExec hit this; stats match
+    _prepare_build_jit's layout so the caller is branch-transparent."""
+    cap = sel.shape[0]
+    n_live = jnp.sum(sel)
+    prefix_ok = jnp.all(sel == (jnp.arange(cap) < n_live))
+    in_prefix = jnp.arange(1, cap) < n_live  # positions 1..cap-1 with prev live
+    # lexicographic non-decreasing: at the first differing word, prev <= cur
+    lt = jnp.zeros(cap - 1, bool)   # prev < cur at an earlier word
+    eq = jnp.ones(cap - 1, bool)    # all earlier words equal
+    all_eq = jnp.ones(cap - 1, bool)
+    for w in words:
+        a, b = w[:-1], w[1:]
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+        all_eq = all_eq & (a == b)
+    nondec = jnp.all(jnp.where(in_prefix, lt | eq, True))
+    has_dup = jnp.any(in_prefix & all_eq)
+    w0 = words[0]
+    kmin = w0[0]
+    kmax = w0[jnp.clip(n_live - 1, 0, cap - 1)]
+    stats = jnp.stack([
+        n_live.astype(jnp.uint64),
+        has_dup.astype(jnp.uint64),
+        kmin,
+        kmax,
+    ])
+    return prefix_ok & nondec, stats
 
 
 @jax.jit
@@ -273,18 +309,27 @@ def prepare_build(
                     exists_lut=exists, lut_base=kmin_h,
                 )
             # duplicates + pair output -> fall through to the sorted map
-    if hostsort.use_host_sort():
-        order = S.host_order(words, sel)
-        device_sort = False
+    # presorted pre-check: SMJ build sides arrive straight from SortExec,
+    # already clustered with live rows in a prefix — detecting that on
+    # device (one tiny sync) skips the whole sort + all-column permute
+    sorted_flag, stats0 = jax.device_get(_presorted_stats_jit(sel, tuple(words)))
+    if bool(sorted_flag):
+        clustered = big
+        stats = stats0
+        sorted_words = list(words)
     else:
-        order, device_sort = None, True
-    row_sel_s, sorted_words, values_s, validity_s, stats = _prepare_build_jit(
-        sel, dev.sel, tuple(words), dev.values, dev.validity, order,
-        device_sort=device_sort,
-    )
-    clustered = Batch(
-        big.schema, DeviceBatch(row_sel_s, values_s, validity_s), big.dicts
-    )
+        if hostsort.use_host_sort():
+            order = S.host_order(words, sel)
+            device_sort = False
+        else:
+            order, device_sort = None, True
+        row_sel_s, sorted_words, values_s, validity_s, stats = _prepare_build_jit(
+            sel, dev.sel, tuple(words), dev.values, dev.validity, order,
+            device_sort=device_sort,
+        )
+        clustered = Batch(
+            big.schema, DeviceBatch(row_sel_s, values_s, validity_s), big.dicts
+        )
     sorted_words = list(sorted_words)
     # uniqueness stats ride ONE transfer (integer-like keys took the LUT
     # fast path above, so no dense table is built here)

@@ -204,15 +204,6 @@ def _cluster_by_pid(dev: DeviceBatch, pids: jnp.ndarray, n_out: int):
     return out, counts
 
 
-@jax.jit
-def _gather_by_order(dev: DeviceBatch, order: jnp.ndarray) -> DeviceBatch:
-    """One fused gather of every column by a host-computed order (CPU-host
-    pid clustering — same lax.sort-vs-host fork as ops/hostsort.py)."""
-    return DeviceBatch(
-        sel=dev.sel[order],
-        values=tuple(v[order] for v in dev.values),
-        validity=tuple(m[order] for m in dev.validity),
-    )
 
 
 class RssShuffleWriterExec(ExecOperator):
@@ -294,7 +285,9 @@ def partition_batch(
         sort_pid = np.where(sel_np, pids_np.astype(np.int32), n_out)
         order = jnp.asarray(np.argsort(sort_pid, kind="stable").astype(np.int32))
         counts_np = np.bincount(sort_pid, minlength=n_out + 1)[:n_out]
-        clustered_dev = _gather_by_order(b.device, order)
+        from auron_tpu.columnar.batch import device_take
+
+        clustered_dev = device_take(b.device, order)
     else:
         clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
         counts_np = np.asarray(jax.device_get(counts))[:n_out]

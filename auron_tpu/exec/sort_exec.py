@@ -49,7 +49,7 @@ class SortExec(ExecOperator):
         sort_exprs: list[ir.Expr],
         specs: list[SortSpec],
         fetch: int | None = None,
-        spill_threshold_rows: int = 1 << 21,
+        spill_threshold_rows: int = 1 << 23,
     ):
         super().__init__([child], child.schema)
         self.sort_exprs = sort_exprs
@@ -132,14 +132,10 @@ class SortExec(ExecOperator):
         dev = big.device
         n = big.num_rows()
         new_cap = bucket_capacity(max(n, 1))
-        idx = order[:new_cap]
-        out = DeviceBatch(
-            sel=dev.sel[idx],
-            values=tuple(v[idx] for v in dev.values),
-            validity=tuple(m[idx] for m in dev.validity),
+        out, key_words = _gather_run(
+            dev, order, tuple(sorted_ops[1:-1]), new_cap=new_cap
         )
         sorted_batch = Batch(self.schema, out, big.dicts)
-        key_words = tuple(o[:new_cap] for o in sorted_ops[1:-1])
         return _SortedRun(sorted_batch, key_words)
 
     def _emit(self, sorted_batch: Batch, ctx: ExecutionContext) -> Iterator[Batch]:
@@ -157,19 +153,45 @@ class SortExec(ExecOperator):
             yield sorted_batch
             return
         dev = sorted_batch.device
-        total_cap = sorted_batch.capacity
         for start in range(0, n, chunk):
-            stop = min(start + chunk, total_cap)
-            sl = slice(start, stop)
-            vals = tuple(v[sl] for v in dev.values)
-            mask = tuple(m[sl] for m in dev.validity)
-            sel = dev.sel[sl]
-            if stop - start < chunk:  # tail pad to the bucket shape
-                pad = chunk - (stop - start)
-                sel = jnp.pad(sel, (0, pad))
-                vals = tuple(jnp.pad(v, (0, pad)) for v in vals)
-                mask = tuple(jnp.pad(m, (0, pad)) for m in mask)
-            yield Batch(self.schema, DeviceBatch(sel, vals, mask), sorted_batch.dicts)
+            # one fused dynamic-slice program per chunk (bounds-clamped, so
+            # the tail reads the zero-padded capacity region — those slots
+            # carry sel=0 and are dead by construction)
+            yield Batch(
+                self.schema,
+                _slice_chunk(dev, jnp.int32(start), chunk=chunk),
+                sorted_batch.dicts,
+            )
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("new_cap",))
+def _gather_run(dev: DeviceBatch, order, sorted_words, *, new_cap: int):
+    """Fused run finalization: permute every column to sorted order and
+    trim to the live-prefix bucket in ONE program."""
+    from auron_tpu.columnar.batch import device_take
+
+    out = device_take(dev, order[:new_cap])
+    return out, tuple(o[:new_cap] for o in sorted_words)
+
+
+@_partial(jax.jit, static_argnames=("chunk",))
+def _slice_chunk(dev: DeviceBatch, start, *, chunk: int) -> DeviceBatch:
+    """One fused dynamic-slice of every column. Capacities and chunks are
+    both power-of-two buckets, so start+chunk never exceeds capacity and
+    the clamp in dynamic_slice never rewinds (no duplicate rows)."""
+    from jax import lax
+
+    def sl(a):
+        return lax.dynamic_slice_in_dim(a, start, chunk)
+
+    return DeviceBatch(
+        sel=sl(dev.sel),
+        values=tuple(sl(v) for v in dev.values),
+        validity=tuple(sl(m) for m in dev.validity),
+    )
 
 
 def batch_nbytes(b: Batch) -> int:

@@ -403,7 +403,15 @@ def run_q72_class(
                             ("avg", col(2), "p_avg")], "final")
         frames = []
         for p in range(n_reduce):
-            h = api.call_native(B.task(agg_f, stage_id=2, partition_id=p).SerializeToString())
+            # this host knows nothing above the join needs row order (the
+            # result is re-sorted for comparison), so it asserts full
+            # SMJ-input-sort elision — the Spark extension sets the same
+            # flag when the parent's requiredChildOrdering is empty
+            h = api.call_native(
+                B.task(agg_f, stage_id=2, partition_id=p,
+                       conf={"auron.smj.elide.sorts": "full"})
+                .SerializeToString()
+            )
             while (rb := api.next_batch(h)) is not None:
                 frames.append(rb.to_pandas())
             api.finalize_native(h)

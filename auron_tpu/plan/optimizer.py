@@ -326,3 +326,69 @@ _HANDLERS = {
 }
 for _p in _PASSTHROUGH:
     _HANDLERS[_p] = _prune_passthrough
+
+
+# ---------------------------------------------------------------------------
+# Sort elision under sort-merge join
+# ---------------------------------------------------------------------------
+
+# the only operator in the IR whose OUTPUT depends on its input's row order
+# (head-N). Sort/TakeOrdered/Window establish their own order internally.
+_ORDER_SENSITIVE = ("limit",)
+
+
+def elide_smj_input_sorts(
+    plan: "pb.PhysicalPlanNode", mode: str = "build"
+) -> "pb.PhysicalPlanNode":
+    """Drop SortExec children feeding a sort-merge join.
+
+    The host engine plans Sort->SMJ because ITS merge-join streams two
+    ordered cursors; this engine's SMJ clusters the build side itself
+    (joins/core.prepare_build) and probes with order-independent binary
+    searches, so explicit input sorts are pure overhead — at perf-gate
+    scale each one is a full materialized lexsort of a fact partition.
+
+    ``mode`` controls how aggressive the rewrite is:
+
+    - "build" (default): elide only the BUILD-side (right) sort. The join's
+      output order follows the probe side, so this NEVER changes the output
+      ordering — safe even when the host relied on the SMJ's output
+      ordering to satisfy a downstream requirement invisible in this task
+      plan (Spark's EnsureRequirements plants no sort above a join whose
+      outputOrdering already satisfies the parent).
+    - "full": elide both sides. Only the host can know no ancestor outside
+      the converted section needs the order; it asserts that by setting
+      ``auron.smj.elide.sorts=full`` in the task conf.
+    - "off": no rewrite.
+
+    Either way a fetch-carrying sort (TakeOrdered — changes the row SET) is
+    never touched, and the rewrite is skipped under an order-sensitive
+    ancestor inside the plan (head-N limit).
+    """
+    if mode == "off":
+        return plan
+    new = pb.PhysicalPlanNode()
+    new.CopyFrom(plan)
+    _elide(new, order_sensitive=False, full=(mode == "full"))
+    return new
+
+
+def _elide(node: "pb.PhysicalPlanNode", order_sensitive: bool, full: bool) -> None:
+    from auron_tpu.plan.protowalk import child_nodes
+
+    which = node.WhichOneof("plan")
+    sensitive = order_sensitive or which in _ORDER_SENSITIVE
+    if which == "sort_merge_join" and not sensitive:
+        j = node.sort_merge_join
+        sides = ("left", "right") if full else ("right",)
+        for side in sides:
+            child = getattr(j, side)
+            if (
+                child.WhichOneof("plan") == "sort"
+                and not child.sort.has_fetch
+            ):
+                grand = pb.PhysicalPlanNode()
+                grand.CopyFrom(child.sort.child)
+                getattr(j, side).CopyFrom(grand)
+    for c in child_nodes(node):
+        _elide(c, sensitive, full)

@@ -519,9 +519,12 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
 
 def task_from_proto(task: pb.TaskDefinition):
     """Returns (root exec, stage_id, partition_id, Configuration)."""
+    from auron_tpu.plan.optimizer import elide_smj_input_sorts
+
     _resolve_shuffle_templates(task)
-    plan = plan_from_proto(task.plan)
     conf = Configuration(dict(task.conf))
+    mode = dict(task.conf).get("auron.smj.elide.sorts", "build")
+    plan = plan_from_proto(elide_smj_input_sorts(task.plan, mode=mode))
     return plan, task.stage_id, task.partition_id, conf
 
 

@@ -137,7 +137,12 @@ def generate_doc() -> str:
 # ---------------------------------------------------------------------------
 
 BATCH_SIZE = int_conf(
-    "batch.size", 8192, "exec", "target rows per columnar device batch"
+    "batch.size", 131072, "exec",
+    "target rows per columnar device batch. Much larger than the "
+    "reference's 8192 (conf.rs BATCH_SIZE) on purpose: one fused XLA "
+    "program per batch amortizes dispatch over rows, and accelerator "
+    "lanes want long arrays — per-batch host overhead is the engine's "
+    "per-row cost floor",
 )
 MEMORY_FRACTION = float_conf(
     "memory.fraction", 0.6, "memory", "fraction of HBM budget usable by consumers"

@@ -71,7 +71,10 @@ def _pick_backend_env(env: dict) -> None:
     try:
         with open(os.path.join(ROOT, ".tpu_probe", "status.json")) as f:
             st = json.load(f)
-        live = bool(st.get("ok")) and time.time() - st.get("ts", 0) < 900
+        # the daemon EXITS after its first success, so ok=true only goes
+        # stale on the scale of a round — a 900s window would flip a live
+        # chip back to forced-CPU mid-gate. 6h covers a round.
+        live = bool(st.get("ok")) and time.time() - st.get("ts", 0) < 6 * 3600
     except Exception:
         pass
     if not live:
@@ -93,12 +96,17 @@ def run_one(name: str, ws: str) -> None:
     from auron_tpu.exec.metrics import MetricNode
     from auron_tpu.models import tpcds
 
-    # per-operator rollup across every task of the class
+    import threading
+
+    # per-operator rollup across every task of the class; tasks finalize
+    # from concurrent pump threads, so the read-modify-write is locked
     op_totals: dict[str, dict[str, int]] = {}
     flat_totals: dict[str, int] = {}
     trees: list[dict] = []
+    sink_lock = threading.Lock()
 
     def sink(snap: dict) -> None:
+      with sink_lock:
         trees.append(snap)
         for k, v in MetricNode.flat_totals(snap).items():
             flat_totals[k] = flat_totals.get(k, 0) + int(v)

@@ -105,6 +105,11 @@ def test_emit_chunks_multiple_batches():
     scan = MemoryScanExec.single([b])
     s = SortExec(scan, [col(0)], [SortSpec()])
     ctx = ExecutionContext()
+    # chunked emission is the behavior under test: force a batch size
+    # smaller than the input regardless of the engine default
+    from auron_tpu.utils.config import BATCH_SIZE
+
+    ctx.conf.set(BATCH_SIZE, 4096)
     out = list(s.execute(0, ctx))
     assert len(out) > 1
     allv = []
