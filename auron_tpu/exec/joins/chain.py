@@ -167,7 +167,10 @@ def try_fused_chain(top, partition: int, ctx) -> Iterator[Batch] | None:
     for ex, _ in links:
         b = ex._build(partition, ctx)
         builds.append(b)
-        if not b.unique:
+        # packed builds carry a single synthetic word the fused probe's raw
+        # per-column canonicalization knows nothing about — fall back to the
+        # per-operator path, whose probe_batch packs with the build's spec
+        if not b.unique or b.pack is not None:
             keys = []
             for (ex2, _), b2 in zip(links, builds):
                 k = ("fusion_build_memo", id(ex2), partition)

@@ -88,6 +88,9 @@ class PreparedBuild:
     # (no pair enumeration needed): exists_lut[key - lut_base] per probe row
     # replaces the binary search — and lets the build skip its sort.
     exists_lut: jnp.ndarray | None = None
+    # multi-integer-key packing: when set, ``words`` is ONE packed uint64
+    # word and probes must pack their key words with the same spec
+    pack: "PackSpec | None" = None
 
 
 def _key_columns(batch: Batch, key_exprs: list[ir.Expr]) -> list[ColumnVal]:
@@ -222,6 +225,93 @@ def _presorted_stats_jit(sel, words):
 
 
 @jax.jit
+def _key_minmax_jit(words, sel):
+    """Per-key signed (min, max) over live rows — one tiny program feeding
+    the multi-key packing decision."""
+    mins, maxs = [], []
+    imax = jnp.iinfo(jnp.int64).max
+    imin = jnp.iinfo(jnp.int64).min
+    for w in words:
+        s = w.view(jnp.int64)
+        mins.append(jnp.min(jnp.where(sel, s, imax)))
+        maxs.append(jnp.max(jnp.where(sel, s, imin)))
+    return jnp.stack(mins), jnp.stack(maxs)
+
+
+@dataclass(frozen=True)
+class PackSpec:
+    """Multi-key -> single-word packing parameters (build-side ranges)."""
+
+    mins: tuple  # signed per-key minimum
+    maxs: tuple  # signed per-key maximum
+    shifts: tuple  # left-shift per key (leading key highest)
+
+
+_PACKABLE_KINDS = (
+    T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32, T.TypeKind.INT64,
+    T.TypeKind.DATE32, T.TypeKind.TIMESTAMP, T.TypeKind.BOOL,
+)
+
+
+def _maybe_pack(vals, words, sel) -> PackSpec | None:
+    """Decide multi-integer-key packing from build-side ranges (one sync).
+    Packing halves every downstream word-tuple pass: the build sort, the
+    presorted check, and each of the probe's ~2*log2(n) binary-search
+    gathers."""
+    if len(words) < 2:
+        return None
+    for cv in vals:
+        if cv.dtype.kind not in _PACKABLE_KINDS or cv.dtype.is_dict_encoded:
+            return None
+    mins, maxs = (x.tolist() for x in jax.device_get(_key_minmax_jit(tuple(words), sel)))
+    if any(mn > mx for mn, mx in zip(mins, maxs)):  # no live rows
+        return None
+    bits = [max(int(mx - mn).bit_length(), 1) for mn, mx in zip(mins, maxs)]
+    if sum(bits) > 63:
+        return None
+    shifts = []
+    acc = 0
+    for b in reversed(bits):  # last key sits in the low bits
+        shifts.append(acc)
+        acc += b
+    shifts = tuple(reversed(shifts))
+    return PackSpec(mins=tuple(mins), maxs=tuple(maxs), shifts=shifts)
+
+
+@jax.jit
+def _pack_probe_words_jit(words, valid, mins, maxs, shifts):
+    """Apply a build-side PackSpec to probe words in one program: rows
+    whose key falls outside the build's per-key range can never match —
+    masked invalid (their clamped packed word may alias a real build
+    key). mins/maxs/shifts arrive as DYNAMIC scalars (one compile per
+    word count, not per data-dependent key range)."""
+    in_range = None
+    acc = jnp.zeros(words[0].shape, jnp.uint64)
+    for i, w in enumerate(words):
+        s = w.view(jnp.int64)
+        ok = (s >= mins[i]) & (s <= maxs[i])
+        in_range = ok if in_range is None else (in_range & ok)
+        off = jnp.clip(s - mins[i], 0, None).astype(jnp.uint64)
+        acc = acc | (off << shifts[i])
+    new_valid = in_range if valid is None else (valid & in_range)
+    return acc, new_valid
+
+
+def _pack_probe_jit(words, valid, spec: PackSpec):
+    return _pack_probe_words_jit(
+        tuple(words), valid,
+        jnp.asarray(spec.mins, jnp.int64),
+        jnp.asarray(spec.maxs, jnp.int64),
+        jnp.asarray(spec.shifts, jnp.uint64),
+    )
+
+
+def pack_probe_words(spec: PackSpec, words, valid):
+    packed, new_valid = _pack_probe_jit(tuple(words), valid, spec)
+    return [packed], new_valid
+
+
+@jax.jit
 def _key_range_jit(w0, sel):
     """(n_live, kmin, kmax) of the live signed key values — the no-sort
     pre-pass deciding whether a dense LUT can replace the sorted-array map."""
@@ -271,6 +361,12 @@ def prepare_build(
     cap = big.capacity
     dev = big.device
 
+    # ---- multi-integer-key packing: one word for every downstream pass
+    pack = _maybe_pack(vals, words, sel) if cap > 0 else None
+    if pack is not None:
+        packed, _ = _pack_probe_jit(tuple(words), None, pack)
+        words = [packed]
+
     # ---- sort-free LUT path: single integer-like key, small value range
     if (
         cap > 0
@@ -300,13 +396,13 @@ def prepare_build(
                 return PreparedBuild(
                     batch=big, words=[words[0]], n_live=n_live,
                     matched=jnp.zeros(cap, bool), unique=True,
-                    lut=row_lut, lut_base=kmin_h,
+                    lut=row_lut, lut_base=kmin_h, pack=pack,
                 )
             if not need_pairs:
                 return PreparedBuild(
                     batch=big, words=[words[0]], n_live=n_live,
                     matched=jnp.zeros(cap, bool), unique=False,
-                    exists_lut=exists, lut_base=kmin_h,
+                    exists_lut=exists, lut_base=kmin_h, pack=pack,
                 )
             # duplicates + pair output -> fall through to the sorted map
     # presorted pre-check: SMJ build sides arrive straight from SortExec,
@@ -341,6 +437,7 @@ def prepare_build(
         n_live=n_live,
         matched=jnp.zeros(cap, bool),
         unique=unique,
+        pack=pack,
     )
 
 

@@ -116,6 +116,19 @@ class EquiJoinDriver:
         """Probe one batch; updates build.matched in place."""
         probe_keys = self.left_keys if self.probe_is_left else self.right_keys
         pvals = _key_columns(pb, probe_keys)
+        if build.pack is not None:
+            # the build packed its multi-integer keys into one word; pack
+            # the probe keys with the SAME spec and substitute a single
+            # synthetic int64 key column — every downstream path (unique
+            # LUT, exists LUT, binary search) then runs single-word.
+            # Bit-exact: canonical(int64 view of packed) == packed.
+            w0, v0 = core._canon_words(pvals)
+            packed, pvalid2 = core._pack_probe_jit(tuple(w0), v0, build.pack)
+            pvals = [ColumnVal(
+                packed.view(jnp.int64),
+                pvalid2 if pvalid2 is not None else jnp.ones(packed.shape, bool),
+                T.INT64,
+            )]
         has_dict_keys = any(v.dtype.is_dict_encoded for v in pvals)
         orig_build = build  # matched-flag updates must land on the caller's object
         if has_dict_keys:

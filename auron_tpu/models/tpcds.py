@@ -269,10 +269,7 @@ def run_q3_class(
         part = B.hash_partitioning([col(0), col(1)], n_reduce)
         pairs = []
         handles = []
-        from auron_tpu.plan.optimizer import prune_columns
-
-        # prune once — per-partition writers differ only in file paths
-        partial = prune_columns(partial)
+        # column pruning now runs on every task in task_from_proto
         for p in range(n_map):
             data_f = os.path.join(work, f"map{p}.data")
             index_f = os.path.join(work, f"map{p}.index")
@@ -368,21 +365,28 @@ def run_q72_class(
     api.put_resource("q72_r", right_parts)
     try:
         # ---- map stages: shuffle both inputs by (item_sk, date_sk)
-        pairs = {"l": [], "r": []}
-        for side, res in (("l", "q72_l"), ("r", "q72_r")):
+        def map_task(side: str, res: str, p: int):
             scan = B.memory_scan(fact_schema, res)
             # partition on item_sk alone: a subset of the join keys keeps the
             # join co-partitioned AND aligns the downstream GROUP BY item
             part = B.hash_partitioning([col(1)], n_reduce)
-            for p in range(n_map):
-                d = os.path.join(work, f"{side}{p}.data")
-                i = os.path.join(work, f"{side}{p}.index")
-                w = B.shuffle_writer(scan, part, d, i)
-                h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
-                while api.next_batch(h) is not None:
-                    pass
-                api.finalize_native(h)
-                pairs[side].append((d, i))
+            d = os.path.join(work, f"{side}{p}.data")
+            i = os.path.join(work, f"{side}{p}.index")
+            w = B.shuffle_writer(scan, part, d, i)
+            h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+            while api.next_batch(h) is not None:
+                pass
+            api.finalize_native(h)
+            return side, (d, i)
+
+        results = run_tasks_parallel([
+            (lambda s=side, r=res, q=p: map_task(s, r, q))
+            for side, res in (("l", "q72_l"), ("r", "q72_r"))
+            for p in range(n_map)
+        ])
+        pairs = {"l": [], "r": []}
+        for side, di in results:
+            pairs[side].append(di)
 
         # ---- reduce: read -> sort -> SMJ -> partial+final agg (co-partitioned)
         api.put_resource("q72_lb", MultiMapBlockProvider(pairs["l"]))
@@ -401,8 +405,7 @@ def run_q72_class(
         agg_f = B.hash_agg(agg_p, [(col(0), "item")],
                            [("count_star", None, "cnt"), ("sum", col(1), "qty"),
                             ("avg", col(2), "p_avg")], "final")
-        frames = []
-        for p in range(n_reduce):
+        def reduce_task(p: int):
             # this host knows nothing above the join needs row order (the
             # result is re-sorted for comparison), so it asserts full
             # SMJ-input-sort elision — the Spark extension sets the same
@@ -412,9 +415,18 @@ def run_q72_class(
                        conf={"auron.smj.elide.sorts": "full"})
                 .SerializeToString()
             )
+            out = []
             while (rb := api.next_batch(h)) is not None:
-                frames.append(rb.to_pandas())
+                out.append(rb.to_pandas())
             api.finalize_native(h)
+            return out
+
+        frames = [
+            f for fs in run_tasks_parallel(
+                [(lambda q=p: reduce_task(q)) for p in range(n_reduce)]
+            )
+            for f in fs
+        ]
         if not frames:
             return pd.DataFrame({"item": [], "cnt": [], "qty": [], "p_avg": []})
         return (
@@ -461,8 +473,8 @@ def run_q95_class(
         # map: shuffle fact by customer
         scan = B.memory_scan(fact_schema, "q95_fact")
         part = B.hash_partitioning([col(2)], n_reduce)  # ss_customer_sk
-        pairs = []
-        for p in range(n_map):
+
+        def map_task(p: int):
             d = os.path.join(work, f"f{p}.data")
             i = os.path.join(work, f"f{p}.index")
             w = B.shuffle_writer(scan, part, d, i)
@@ -470,7 +482,9 @@ def run_q95_class(
             while api.next_batch(h) is not None:
                 pass
             api.finalize_native(h)
-            pairs.append((d, i))
+            return d, i
+
+        pairs = run_tasks_parallel([(lambda q=p: map_task(q)) for p in range(n_map)])
         api.put_resource("q95_blocks", MultiMapBlockProvider(pairs))
 
         # reduce: sales rows for cat-1 items (semi), minus customers with
@@ -494,12 +508,20 @@ def run_q95_class(
                            [("count_star", None, "cnt")], "partial")
         agg_f = B.hash_agg(agg_p, [(col(2), "customer")],
                            [("count_star", None, "cnt")], "final")
-        frames = []
-        for p in range(n_reduce):
+        def reduce_task(p: int):
             h = api.call_native(B.task(agg_f, stage_id=2, partition_id=p).SerializeToString())
+            out = []
             while (rb := api.next_batch(h)) is not None:
-                frames.append(rb.to_pandas())
+                out.append(rb.to_pandas())
             api.finalize_native(h)
+            return out
+
+        frames = [
+            f for fs in run_tasks_parallel(
+                [(lambda q=p: reduce_task(q)) for p in range(n_reduce)]
+            )
+            for f in fs
+        ]
         if not frames:
             return pd.DataFrame({"customer": [], "cnt": []})
         return pd.concat(frames).sort_values("customer").reset_index(drop=True)
@@ -654,9 +676,7 @@ def run_q6_class(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
                            [("count_star", None, "cnt")], "partial")
         agg_f = B.hash_agg(agg_p, [(col(0), "d_year")],
                            [("count_star", None, "cnt")], "final")
-        from auron_tpu.plan.optimizer import prune_columns
-
-        agg_f = prune_columns(agg_f)
+        # column pruning now runs on every task in task_from_proto
         frames = []
         for p in range(n_partitions):
             h = api.call_native(B.task(agg_f, partition_id=p).SerializeToString())
@@ -724,9 +744,7 @@ def run_q18_class(
                 ("sum", col(3), "p_sum"), ("count_star", None, "cnt")]
         partial = B.hash_agg(proj, [(col(0), "cat"), (col(1), "d_year")],
                              aggs, "partial")
-        from auron_tpu.plan.optimizer import prune_columns
-
-        partial = prune_columns(partial)
+        # column pruning now runs on every task in task_from_proto
         part = B.hash_partitioning([col(0), col(1)], n_reduce)
         pairs = []
         handles = []
@@ -892,12 +910,26 @@ def _drain_task(plan, stage_id=0, partition_id=0) -> list[pd.DataFrame]:
     return frames
 
 
+def run_tasks_parallel(fns: list) -> list:
+    """Run per-partition task closures concurrently (the host engine runs
+    executor tasks in parallel — Spark's task slots; the reference gets
+    this from the JVM scheduler for free). XLA releases the GIL, so
+    thread-level parallelism is real for the compiled portions. Returns
+    results in input order; the first exception propagates."""
+    import concurrent.futures as cf
+
+    if len(fns) <= 1:
+        return [fn() for fn in fns]
+    with cf.ThreadPoolExecutor(max_workers=min(len(fns), os.cpu_count() or 2)) as ex:
+        return list(ex.map(lambda f: f(), fns))
+
+
 def _shuffle_stage(plan, out_schema, key_cols, n_map, n_reduce, work, rid, stage_id=1):
     """Run `plan` as n_map map tasks hash-shuffled into files; returns the
     reduce-side ipc_reader node (the manual analog of one mesh_exchange)."""
     part = B.hash_partitioning([col(c) for c in key_cols], n_reduce)
-    pairs = []
-    for p in range(n_map):
+
+    def map_task(p: int):
         d = os.path.join(work, f"{rid}_m{p}.data")
         i = os.path.join(work, f"{rid}_m{p}.index")
         w = B.shuffle_writer(plan, part, d, i)
@@ -907,7 +939,11 @@ def _shuffle_stage(plan, out_schema, key_cols, n_map, n_reduce, work, rid, stage
         while api.next_batch(h) is not None:
             pass
         api.finalize_native(h)
-        pairs.append((d, i))
+        return d, i
+
+    pairs = run_tasks_parallel(
+        [(lambda p=p: map_task(p)) for p in range(n_map)]
+    )
     api.put_resource(rid, MultiMapBlockProvider(pairs))
     return B.ipc_reader(out_schema, rid)
 
@@ -942,8 +978,11 @@ def run_q14_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
         f2 = B.hash_agg(read2, [(col(0), "y")], [("count_star", None, "d_items")],
                         "final")
         frames = []
-        for p in range(n_reduce):
-            frames.extend(_drain_task(f2, stage_id=3, partition_id=p))
+        for fs in run_tasks_parallel(
+            [(lambda q=p: _drain_task(f2, stage_id=3, partition_id=q))
+             for p in range(n_reduce)]
+        ):
+            frames.extend(fs)
         out = pd.concat(frames) if frames else pd.DataFrame({"y": [], "d_items": []})
         return out.sort_values("y").reset_index(drop=True)
     finally:
@@ -1060,8 +1099,10 @@ def run_q48_class(data: TpcdsData, n_map=2) -> pd.DataFrame:
                        [("sum", col(1), "cheap_s"), ("sum", col(2), "all_s")],
                        "final")
         frames = []
-        for p_i in range(n_map):
-            frames.extend(_drain_task(f, partition_id=p_i))
+        for fs in run_tasks_parallel(
+            [(lambda q=p_i: _drain_task(f, partition_id=q)) for p_i in range(n_map)]
+        ):
+            frames.extend(fs)
         out = pd.concat(frames)
         out = (out.groupby("y").agg(cheap_s=("cheap_s", "sum"),
                                     all_s=("all_s", "sum")).reset_index())
@@ -1244,8 +1285,11 @@ def run_q16_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
         p = B.hash_agg(anti, [], [("count_star", None, "c")], "partial")
         f = B.hash_agg(p, [], [("count_star", None, "c")], "final")
         frames = []
-        for pi in range(n_reduce):
-            frames.extend(_drain_task(f, stage_id=2, partition_id=pi))
+        for fs in run_tasks_parallel(
+            [(lambda q=pi: _drain_task(f, stage_id=2, partition_id=q))
+             for pi in range(n_reduce)]
+        ):
+            frames.extend(fs)
         out = pd.concat(frames)
         return pd.DataFrame({"c": [np.int64(out["c"].sum())]})
     finally:
@@ -1283,8 +1327,11 @@ def run_q65_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
                         build_side="right")
         flt = B.filter_(j, [BinaryOp("gt", col(3), BinaryOp("mul", col(1), lit(2.0)))])
         frames = []
-        for pi in range(n_reduce):
-            frames.extend(_drain_task(flt, stage_id=3, partition_id=pi))
+        for fs in run_tasks_parallel(
+            [(lambda q=pi: _drain_task(flt, stage_id=3, partition_id=q))
+             for pi in range(n_reduce)]
+        ):
+            frames.extend(fs)
         cols = ["i", "a", "i2", "m"]
         out = (pd.concat(frames) if frames else
                pd.DataFrame(columns=cols))
@@ -1324,8 +1371,11 @@ def run_q5_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Data
         f = B.hash_agg(p, [(col(1), "i")],
                        [("count_star", None, "c"), ("sum", col(4), "s")], "final")
         frames = []
-        for pi in range(n_reduce):
-            frames.extend(_drain_task(f, stage_id=3, partition_id=pi))
+        for fs in run_tasks_parallel(
+            [(lambda q=pi: _drain_task(f, stage_id=3, partition_id=q))
+             for pi in range(n_reduce)]
+        ):
+            frames.extend(fs)
         out = pd.concat(frames)
         return out.sort_values("i").reset_index(drop=True)
     finally:

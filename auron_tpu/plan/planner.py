@@ -519,12 +519,15 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
 
 def task_from_proto(task: pb.TaskDefinition):
     """Returns (root exec, stage_id, partition_id, Configuration)."""
-    from auron_tpu.plan.optimizer import elide_smj_input_sorts
+    from auron_tpu.plan.optimizer import elide_smj_input_sorts, prune_columns
 
     _resolve_shuffle_templates(task)
     conf = Configuration(dict(task.conf))
     mode = dict(task.conf).get("auron.smj.elide.sorts", "build")
-    plan = plan_from_proto(elide_smj_input_sorts(task.plan, mode=mode))
+    # column pruning runs on EVERY task (idempotent): join pair-gather
+    # bytes scale with emitted column count, the dominant join cost
+    proto = prune_columns(elide_smj_input_sorts(task.plan, mode=mode))
+    plan = plan_from_proto(proto)
     return plan, task.stage_id, task.partition_id, conf
 
 
