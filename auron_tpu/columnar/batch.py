@@ -445,21 +445,20 @@ def prefix_slice(batch: Batch, new_capacity: int) -> Batch:
     )
 
 
-def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.ndarray]]:
-    """Build a unified dictionary for column `col` across batches.
+def merge_vocab(
+    entry_lists: Sequence[list], dtype: T.DataType
+) -> tuple[pa.Array, list[np.ndarray]]:
+    """Merge per-source dictionary entry lists into ONE vocabulary.
 
-    Returns (unified_dict, per-batch code remap tables). The remap table
-    ``r`` satisfies: new_code = r[old_code]. Device-side remapping is then a
-    single gather.
-    """
-    dtype = batches[0].schema[col].dtype
+    Returns (unified_dict, per-source remap tables): new_code =
+    remaps[src][old_code]. The single shared merge used by in-process
+    unification (unify_dict) AND the SPMD cross-process exchange
+    (mesh_driver._unify_dicts_global) — dict-type handling must never
+    diverge between the two."""
     vocab: dict = {}
     values: list = []
     remaps: list[np.ndarray] = []
-    for b in batches:
-        d = b.dicts[col]
-        assert d is not None
-        pylist = d.to_pylist()
+    for pylist in entry_lists:
         r = np.empty(len(pylist), dtype=np.int32)
         for i, s in enumerate(pylist):
             k = _vocab_key(s)
@@ -478,3 +477,18 @@ def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.nd
         value_type = pa.string()
     unified = pa.array(values, type=value_type) if values else _empty_dict(dtype)
     return unified, remaps
+
+
+def unify_dict(batches: Sequence[Batch], col: int) -> tuple[pa.Array, list[np.ndarray]]:
+    """Build a unified dictionary for column `col` across batches.
+
+    Returns (unified_dict, per-batch code remap tables). The remap table
+    ``r`` satisfies: new_code = r[old_code]. Device-side remapping is then a
+    single gather.
+    """
+    entry_lists = []
+    for b in batches:
+        d = b.dicts[col]
+        assert d is not None
+        entry_lists.append(d.to_pylist())
+    return merge_vocab(entry_lists, batches[0].schema[col].dtype)

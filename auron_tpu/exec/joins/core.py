@@ -306,10 +306,6 @@ def _pack_probe_jit(words, valid, spec: PackSpec):
     )
 
 
-def pack_probe_words(spec: PackSpec, words, valid):
-    packed, new_valid = _pack_probe_jit(tuple(words), valid, spec)
-    return [packed], new_valid
-
 
 @jax.jit
 def _key_range_jit(w0, sel):

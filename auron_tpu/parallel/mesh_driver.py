@@ -145,6 +145,7 @@ class MeshQueryDriver:
         self._exchange_seq = 0
         self._tmp_dirs: list[str] = []
         self._reduce_parts: int | None = None  # AQE-coalesced stage width
+        self._workdir_shared: bool | None = None  # SPMD probe, cached
         #: pending per-exchange AQE candidates:
         #: ex_id -> (provider, per-partition totals, per-(map,partition)
         #: byte matrix) — coalescing consumes the totals, skew splitting
@@ -453,25 +454,32 @@ class MeshQueryDriver:
             # ICI all_to_all is square (P src = P dst); a coalesced map
             # stage routes through the file transport
             mode = "file"
-        if self.spmd:
-            # cross-process exchanges ride the global-mesh collective; the
-            # file transport would need shared storage + path exchange
-            if self.conf.get(EXCHANGE_MODE) == "file":
-                raise NotImplementedError(
-                    "exchange.mode=file is not supported in SPMD mode"
+        if self.spmd and mode == "file":
+            # the file transport needs every process to see every map
+            # output: probe work_dir shared-ness ONCE (token write +
+            # barrier + everyone-sees-it allgather)
+            if self._workdir_is_shared():
+                pass  # durable cross-process transport below
+            elif self.conf.get(EXCHANGE_MODE) == "file":
+                raise RuntimeError(
+                    "exchange.mode=file in SPMD mode requires a SHARED "
+                    "auron.work_dir (capability probe failed: peers cannot "
+                    "see this process's files). Point work_dir at shared "
+                    "storage or use exchange.mode=mesh."
                 )
-            if mode == "file":
-                # auto routed to file (payload over exchange.mesh.max.bytes):
-                # stay on the collective but say so — the budget exists to
-                # protect device residency
+            else:
+                # auto routed to file (payload over exchange.mesh.max.bytes)
+                # but no shared storage: stay on the collective and say so —
+                # the budget exists to protect device residency
                 import logging
 
                 logging.getLogger("auron_tpu").warning(
                     "SPMD exchange %s: est %d bytes/shard exceeds "
-                    "exchange.mesh.max.bytes; riding all_to_all anyway",
+                    "exchange.mesh.max.bytes and work_dir is not shared; "
+                    "riding all_to_all anyway",
                     ex_id, est_shard_bytes,
                 )
-            mode = "mesh"
+                mode = "mesh"
         self.stats.append(ExchangeStats(ex_id, mode, counts, est_shard_bytes))
 
         if mode == "file":
@@ -533,6 +541,64 @@ class MeshQueryDriver:
             full[int(proc_rows[0])] = proc_rows[1:-1]
         return full, int(rows[:, -1].max())
 
+    def _unify_dicts_global(
+        self, schema: T.Schema, batches: list[Batch], dict_cols: list[int]
+    ) -> dict:
+        """SPMD cross-process dictionary unification (closes the planner
+        gap where any string group-by key failed in SPMD mode).
+
+        Every process first unifies its LOCAL shards per column, then all
+        processes exchange their local vocabularies over TWO host-level
+        allgathers (payload lengths, then padded pickled payloads — the
+        same multihost channel the counts barrier uses) and build the SAME
+        global vocabulary in process-rank order. Codes then remap to
+        global ids with one device gather per shard. Two barriers per
+        exchange regardless of column count."""
+        import pickle
+
+        import pyarrow as pa
+        from jax.experimental import multihost_utils
+
+        local_vocab: dict[int, list] = {}
+        local_remaps: dict[int, list[np.ndarray]] = {}
+        for ci in dict_cols:
+            unified, remaps = unify_dict(batches, ci)
+            local_vocab[ci] = unified.to_pylist()
+            local_remaps[ci] = remaps
+        blob = pickle.dumps(local_vocab, protocol=4)
+        lengths = multihost_utils.process_allgather(
+            np.array([len(blob)], dtype=np.int64)
+        ).reshape(-1)
+        buf = np.zeros(int(lengths.max()), dtype=np.uint8)
+        buf[: len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+        gathered = multihost_utils.process_allgather(buf)
+        gathered = np.asarray(gathered).reshape(len(lengths), -1)
+        per_proc = [
+            pickle.loads(bytes(gathered[p, : int(lengths[p])].tobytes()))
+            for p in range(len(lengths))
+        ]
+        from auron_tpu.columnar.batch import merge_vocab
+
+        out: dict[int, tuple] = {}
+        my_rank = jax.process_index()
+        for ci in dict_cols:
+            # the SAME merge as in-process unification, fed per-process
+            # entry lists in rank order -> identical vocab on every process
+            unified, proc_remaps = merge_vocab(
+                [pv.get(ci, []) for pv in per_proc], schema[ci].dtype
+            )
+            my_global = proc_remaps[my_rank]
+            # compose: local batch codes -> local unified -> global
+            local_to_global = [
+                jnp.asarray(
+                    my_global[np.clip(r, 0, max(len(my_global) - 1, 0))]
+                    .astype(np.int32)
+                )
+                for r in local_remaps[ci]
+            ]
+            out[ci] = (unified, local_to_global)
+        return out
+
     # ---- ICI transport ------------------------------------------------
 
     def _mesh_exchange(
@@ -549,14 +615,19 @@ class MeshQueryDriver:
         # unify dictionaries so codes are meaningful across shards
         dicts: list = [None] * ncols
         remapped: dict[int, list[jnp.ndarray]] = {}
-        for ci, f in enumerate(schema):
-            if f.dtype.is_dict_encoded:
-                if self.spmd:
-                    # dictionary unification needs every shard's host
-                    # dictionary; cross-process merge is not wired yet
-                    raise NotImplementedError(
-                        "SPMD mesh exchange over dict-encoded columns"
-                    )
+        dict_cols = [ci for ci, f in enumerate(schema) if f.dtype.is_dict_encoded]
+        if dict_cols and self.spmd:
+            global_dicts = self._unify_dicts_global(schema, batches, dict_cols)
+            for ci, (unified, local_to_global) in global_dicts.items():
+                dicts[ci] = unified
+                remapped[ci] = [
+                    local_to_global[bi][
+                        jnp.clip(b.col_values(ci), 0, local_to_global[bi].shape[0] - 1)
+                    ]
+                    for bi, b in enumerate(batches)
+                ]
+        else:
+            for ci in dict_cols:
                 unified, remaps = unify_dict(batches, ci)
                 dicts[ci] = unified
                 remapped[ci] = [
@@ -620,6 +691,35 @@ class MeshQueryDriver:
 
     # ---- durable file transport ---------------------------------------
 
+    def _workdir_is_shared(self) -> bool:
+        """SPMD capability probe (once per driver): process 0 writes a
+        token under work_dir, a cross-process barrier lands, every process
+        checks visibility, and an allgather ANDs the answers — file
+        transport is offered only when ALL processes see the token."""
+        if self._workdir_shared is not None:
+            return self._workdir_shared
+        from jax.experimental import multihost_utils
+
+        # EVERY process must walk the same collective sequence even when
+        # its own work_dir is unset — an early local return would leave
+        # peers blocked in the barrier (silent distributed wedge)
+        token = (
+            os.path.join(self.work_dir, ".auron_shared_probe")
+            if self.work_dir
+            else None
+        )
+        if token and jax.process_index() == 0:
+            os.makedirs(self.work_dir, exist_ok=True)
+            with open(token, "w") as f:
+                f.write("probe")
+        multihost_utils.sync_global_devices("auron_workdir_probe")
+        saw = np.array(
+            [1 if token and os.path.exists(token) else 0], dtype=np.int64
+        )
+        all_saw = multihost_utils.process_allgather(saw)
+        self._workdir_shared = bool(np.asarray(all_saw).min() == 1)
+        return self._workdir_shared
+
     def _file_exchange(
         self,
         spec: pb.MeshExchangeNode,
@@ -639,23 +739,38 @@ class MeshQueryDriver:
             work = tempfile.mkdtemp(prefix="auron_exchange_")
             self._tmp_dirs.append(work)  # removed after the residual run
         part = partitioning_from_proto(spec.partitioning)
-        pairs = []
         src_id = ex_id + "__src"
         resources[src_id] = [[b] for b in batches]
+        # SPMD: this process writes only its LOCAL shards' map outputs
+        # (named by GLOBAL shard id onto the probed-shared work_dir), then
+        # a barrier makes every peer's files visible before any read
+        map_ids = list(self.local_parts) if self.spmd else list(range(len(batches)))
         try:
-            for p in range(len(batches)):
+            for local_i, p in enumerate(map_ids):
                 data_f = os.path.join(work, f"{ex_id}_map{p}.data")
                 index_f = os.path.join(work, f"{ex_id}_map{p}.index")
                 w = ShuffleWriterExec(
                     ResourceScanExec(schema, src_id), part, data_f, index_f
                 )
-                ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
+                ctx = ExecutionContext(partition_id=local_i,
+                                       conf=self.conf.copy(),
                                        resources=resources)
-                for _ in w.execute(p, ctx):
+                for _ in w.execute(local_i, ctx):
                     pass
-                pairs.append((data_f, index_f))
         finally:
             resources.pop(src_id, None)
+        if self.spmd:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"auron_file_exchange_{ex_id}")
+            all_map_ids = range(self.n_parts)
+        else:
+            all_map_ids = range(len(batches))
+        pairs = [
+            (os.path.join(work, f"{ex_id}_map{p}.data"),
+             os.path.join(work, f"{ex_id}_map{p}.index"))
+            for p in all_map_ids
+        ]
         provider = MultiMapBlockProvider(pairs)
         # ---- AQE: statistics-driven candidate for post-shuffle coalescing
         # AND skew-join splitting (both consume the same per-partition
@@ -664,8 +779,13 @@ class MeshQueryDriver:
         # same groups, so hash co-partitioning across inputs is preserved.
         from auron_tpu.utils.config import EXCHANGE_SKEW_ENABLE
 
-        if self.conf.get(EXCHANGE_COALESCE_ENABLE) or self.conf.get(
-            EXCHANGE_SKEW_ENABLE
+        # SPMD: coalescing/skew-splitting would resize the reduce stage,
+        # but every process owns a FIXED set of global partition ids —
+        # regrouping needs a globally coordinated decision (not wired);
+        # partition ownership stays 1:1 with mesh devices
+        if not self.spmd and (
+            self.conf.get(EXCHANGE_COALESCE_ENABLE)
+            or self.conf.get(EXCHANGE_SKEW_ENABLE)
         ):
             from auron_tpu.exec.shuffle.format import read_index
 
