@@ -26,6 +26,7 @@ count->long (never null).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Iterator
 
 import threading
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
+from jax import lax
 
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import (
@@ -242,6 +244,30 @@ class HashAggExec(ExecOperator):
 
     # ------------------------------------------------------------------
 
+    def _dense_eligible(self) -> bool:
+        """Single small-range integer group key + simple aggregates can run
+        as a DENSE direct-address table (one fused scatter-reduce per
+        batch, no sort — the TPU-idiomatic analog of the reference's
+        integer-keyed agg hash map, agg/agg_hash_map.rs). Range discovery
+        and mid-stream fallback live in _DenseAggState.update and the
+        dense block of _execute."""
+        if self.n_keys != 1 or self._has_host_aggs:
+            return False
+        kt = self.inter_schema[0].dtype
+        if kt.is_dict_encoded or kt.kind not in (
+            T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32,
+            T.TypeKind.INT64, T.TypeKind.DATE32, T.TypeKind.TIMESTAMP,
+        ):
+            return False
+        for (a, _), in_t in zip(self.aggs, self._agg_input_types):
+            if a.func not in ("sum", "avg", "count", "count_star", "min", "max"):
+                return False
+            if a.func in ("sum", "avg") and is_wide_sum(in_t):
+                return False
+            if in_t is not None and in_t.is_dict_encoded:
+                return False
+        return True
+
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         conf = ctx.conf
         skipping_enabled = (
@@ -266,10 +292,39 @@ class HashAggExec(ExecOperator):
         # heuristic tolerates the one-batch lag
         pending_g = None
         pending_proxy = 0
+        # dense direct-address accumulator (no sort, one fused scatter-
+        # reduce per batch); drains into the generic table when the key
+        # range outgrows the dense limit
+        dense = _DenseAggState(self, ctx) if self._dense_eligible() else None
+        if dense is not None:
+            # fixed-footprint table (<= LIMIT slots x field widths): an
+            # UNSPILLABLE consumer so its bytes shrink the pool others
+            # fair-share (same citizenship as resident join builds)
+            mm.register(dense, spillable=False)
+
+        def drain_dense_into_table():
+            sb, g = dense.state_batch_and_count()
+            if sb is not None:
+                mm.acquire(table, batch_nbytes(sb))
+                table.add(sb, g)
 
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
+                if dense is not None:
+                    with ctx.metrics.timer("elapsed_compute"):
+                        if dense.update(b):
+                            continue
+                    # key range outgrew the dense limit: drain and hand
+                    # THIS batch (and the rest) to the sort-segmentation path
+                    if dense.base is not None:
+                        # rows already folded in: the skip heuristic's
+                        # row/group counters never saw them — keep it off
+                        skipping_enabled = False
+                    drain_dense_into_table()
+                    mm.unregister(dense)
+                    dense.release(mm)
+                    dense = None
                 if self.mode == PARTIAL:
                     # sync the live count FIRST: sparse batches (post-filter/
                     # join output still at input capacity) are compacted
@@ -292,6 +347,12 @@ class HashAggExec(ExecOperator):
                         # its exact group count, so low-cardinality aggs don't
                         # cross the merge threshold on inflated estimates
                         table.adjust_staged(gp - pending_proxy)
+                        # groups live in a valid prefix: shrink the staged
+                        # intermediate to its group bucket so the eventual
+                        # merge concat scales with GROUPS, not input
+                        # capacity (low-cardinality aggs were paying a
+                        # full-capacity concat per staged batch)
+                        table.shrink_last(bucket_capacity(max(gp, 1)))
                         pending_g = None
                     if n == 0:
                         continue
@@ -316,6 +377,10 @@ class HashAggExec(ExecOperator):
                     )
                     if n == 0:
                         continue
+                    # groups live in a valid prefix and g is exact here:
+                    # stage at the group bucket so merge concat scales
+                    # with groups, not the input capacity
+                    inter = prefix_slice(inter, bucket_capacity(max(g, 1)))
                 seen_rows += n
                 if self.mode != PARTIAL:
                     seen_groups += g
@@ -336,11 +401,20 @@ class HashAggExec(ExecOperator):
                     continue
                 mm.acquire(table, batch_nbytes(inter))
                 table.add(inter, g)
-                if table.staged_rows >= merge_threshold:
+                # geometric amortization: compacting re-reduces the WHOLE
+                # state, so only do it once the staged rows rival the state
+                # size — otherwise high-cardinality aggs go quadratic in
+                # merge work (measured as the q5-class merge_time blowup)
+                if table.staged_rows >= max(merge_threshold, table.state_capacity()):
                     with ctx.metrics.timer("merge_time"):
                         table.compact()
                     ctx.metrics.add("num_merges", 1)
         finally:
+            if dense is not None:
+                drain_dense_into_table()
+                mm.unregister(dense)
+                dense.release(mm)
+                dense = None
             mm.unregister(table)
 
         if skipping:
@@ -357,6 +431,28 @@ class HashAggExec(ExecOperator):
             yield state
 
     # ------------------------------------------------------------------
+
+    def _intermediate_groups(self, b: Batch, ofs: int | None = None):
+        """Per-agg groups of intermediate-field ColumnVals starting at
+        column ``ofs`` (defaults to n_keys) — THE offset walk over
+        intermediate_fields, shared by the merge path, _to_intermediate's
+        merge branch and the dense accumulator so column alignment against
+        inter_schema can never diverge between them."""
+        ofs = self.n_keys if ofs is None else ofs
+        groups: list[list[ColumnVal]] = []
+        for (a, name), in_t in zip(self.aggs, self._agg_input_types):
+            k = len(intermediate_fields(a, in_t if in_t is not None else T.INT64, name))
+            groups.append([
+                ColumnVal(
+                    b.col_values(ofs + j),
+                    b.col_validity(ofs + j),
+                    self.inter_schema[ofs + j].dtype,
+                    b.dicts[ofs + j],
+                )
+                for j in range(k)
+            ])
+            ofs += k
+        return groups
 
     def _to_intermediate(self, b: Batch, ctx: ExecutionContext) -> Batch:
         """Group one batch and reduce it to intermediate form."""
@@ -381,19 +477,9 @@ class HashAggExec(ExecOperator):
                 ColumnVal(b.col_values(i), b.col_validity(i), self.inter_schema[i].dtype, b.dicts[i])
                 for i in range(self.n_keys)
             ]
-            cols: list[list[ColumnVal]] = []
-            ofs = self.n_keys
-            for (a, name), in_t in zip(self.aggs, self._agg_input_types):
-                k = len(intermediate_fields(a, in_t if in_t is not None else T.INT64, name))
-                grp = []
-                for j in range(k):
-                    f = self.inter_schema[ofs + j]
-                    grp.append(
-                        ColumnVal(b.col_values(ofs + j), b.col_validity(ofs + j), f.dtype, b.dicts[ofs + j])
-                    )
-                cols.append(grp)
-                ofs += k
-            return self._group_reduce(b.device.sel, keys, cols, raw=False)
+            return self._group_reduce(
+                b.device.sel, keys, self._intermediate_groups(b), raw=False
+            )
 
     def _merge(self, state: list[Batch], staged: list[Batch]) -> Batch | None:
         parts = [s for s in state + staged if s is not None]
@@ -406,23 +492,9 @@ class HashAggExec(ExecOperator):
             ColumnVal(big.col_values(i), big.col_validity(i), self.inter_schema[i].dtype, big.dicts[i])
             for i in range(self.n_keys)
         ]
-        cols: list[list[ColumnVal]] = []
-        ofs = self.n_keys
-        for (a, name), in_t in zip(self.aggs, self._agg_input_types):
-            k = len(intermediate_fields(a, in_t if in_t is not None else T.INT64, name))
-            cols.append(
-                [
-                    ColumnVal(
-                        big.col_values(ofs + j),
-                        big.col_validity(ofs + j),
-                        self.inter_schema[ofs + j].dtype,
-                        big.dicts[ofs + j],
-                    )
-                    for j in range(k)
-                ]
-            )
-            ofs += k
-        merged = self._group_reduce(big.device.sel, keys, cols, raw=False)
+        merged = self._group_reduce(
+            big.device.sel, keys, self._intermediate_groups(big), raw=False
+        )
         # shrink back to a compact capacity bucket (host sync on group count)
         g = merged.num_rows()
         return prefix_slice(merged, bucket_capacity(max(g, 1)))
@@ -844,11 +916,34 @@ class _AggTableConsumer:
             self.staged_rows += groups
             self._staged_bytes += batch_nbytes(inter)
 
+    def state_capacity(self) -> int:
+        """Locked snapshot (a cross-thread spill may null state between
+        a bare None-check and a .capacity read)."""
+        with self._lock:
+            return self.state.capacity if self.state is not None else 0
+
     def adjust_staged(self, delta: int) -> None:
         """Correct the staged-rows estimate once an exact group count settles
         (clamped: a concurrent compact() may already have reset it)."""
         with self._lock:
             self.staged_rows = max(0, self.staged_rows + delta)
+
+    def shrink_last(self, new_cap: int) -> None:
+        """Slice the most recently staged intermediate down to its exact
+        group bucket (groups occupy a valid prefix). No-op if a concurrent
+        compact/spill already consumed it."""
+        from auron_tpu.columnar.batch import prefix_slice
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
+        with self._lock:
+            if not self.staged:
+                return
+            old = self.staged[-1]
+            if new_cap >= old.capacity:
+                return
+            shrunk = prefix_slice(old, new_cap)
+            self.staged[-1] = shrunk
+            self._staged_bytes += batch_nbytes(shrunk) - batch_nbytes(old)
 
     def compact(self) -> None:
         from auron_tpu.exec.sort_exec import batch_nbytes
@@ -1236,3 +1331,322 @@ def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words, 
 import jax as _jax  # noqa: E402
 
 _reduce_arrays_jit = _jax.jit(_reduce_arrays_impl, static_argnames=("cfg", "raw"))
+
+
+# ---------------------------------------------------------------------------
+# Dense direct-address aggregation (integer keys, small range)
+# ---------------------------------------------------------------------------
+
+
+def _seg_sum(vals, ids, nseg):
+    return jax.ops.segment_sum(vals, ids, num_segments=nseg)
+
+
+def _seg_any(flags, ids, nseg):
+    # `> 0`, NOT astype(bool): segment_max fills segments that received no
+    # element with the dtype minimum (a nonzero int), which astype(bool)
+    # would turn into True — every empty slot would look occupied
+    return jax.ops.segment_max(flags.astype(jnp.int32), ids, num_segments=nseg) > 0
+
+
+@partial(jax.jit, static_argnames=("cfg", "size"), donate_argnums=(0, 1, 2))
+def _dense_update_jit(
+    state_vals, state_valids, present, base, key_v, key_m, sel, agg_ins,
+    *, cfg, size: int,
+):
+    """ONE fused scatter-reduce folding a batch into the dense table.
+
+    Slot 0 is the NULL-key group; real keys land at ``key - base + 1``;
+    dead rows route to segment ``size`` (dropped). No sort, no
+    segmentation — the whole per-batch aggregation is segment_* scatters
+    at O(rows + size), the dense analog of the reference's integer-keyed
+    agg hash map (agg/agg_hash_map.rs)."""
+    raw, funcs = cfg
+    nseg = size + 1
+    idx = jnp.where(
+        sel,
+        jnp.where(
+            key_m,
+            jnp.clip(key_v.astype(jnp.int64) - base + 1, 0, size - 1).astype(jnp.int32),
+            0,
+        ),
+        size,
+    )
+    new_present = present | _seg_any(sel, idx, nseg)[:size]
+    out_vals = []
+    out_valids = []
+    fi = 0
+    for (func, _), ins in zip(funcs, agg_ins):
+        if func in ("count", "count_star"):
+            if not raw:
+                # merge: SUM the intermediate #count field
+                v, _ = ins[0]
+                contrib = _seg_sum(jnp.where(sel, v, 0).astype(jnp.int64), idx, nseg)[:size]
+            elif func == "count_star":
+                contrib = _seg_sum(
+                    jnp.where(sel, jnp.int64(1), jnp.int64(0)), idx, nseg
+                )[:size]
+            else:
+                _, m = ins[0]
+                contrib = _seg_sum((m & sel).astype(jnp.int64), idx, nseg)[:size]
+            out_vals.append(state_vals[fi] + contrib)
+            out_valids.append(None)
+            fi += 1
+            continue
+        if func in ("sum", "avg"):
+            v, m = ins[0]
+            ok = m & sel
+            s = _seg_sum(jnp.where(ok, v, jnp.zeros_like(v)), idx, nseg)[:size]
+            sv = _seg_any(ok, idx, nseg)[:size]
+            out_vals.append(state_vals[fi] + s)
+            out_valids.append(state_valids[fi] | sv)
+            fi += 1
+            if func == "avg":
+                if raw:
+                    c = _seg_sum(ok.astype(jnp.int64), idx, nseg)[:size]
+                else:
+                    cv, _ = ins[1]
+                    c = _seg_sum(jnp.where(sel, cv, 0).astype(jnp.int64), idx, nseg)[:size]
+                out_vals.append(state_vals[fi] + c)
+                out_valids.append(None)
+                fi += 1
+            continue
+        if func in ("min", "max"):
+            v, m = ins[0]
+            ok = m & sel
+            if func == "min":
+                ident = S._max_identity(v.dtype)
+                contrib = jax.ops.segment_min(
+                    jnp.where(ok, v, jnp.asarray(ident, v.dtype)), idx,
+                    num_segments=nseg,
+                )[:size]
+                both = jnp.minimum(state_vals[fi], contrib)
+            else:
+                ident = S._min_identity(v.dtype)
+                contrib = jax.ops.segment_max(
+                    jnp.where(ok, v, jnp.asarray(ident, v.dtype)), idx,
+                    num_segments=nseg,
+                )[:size]
+                both = jnp.maximum(state_vals[fi], contrib)
+            cv_valid = _seg_any(ok, idx, nseg)[:size]
+            old_valid = state_valids[fi]
+            merged = jnp.where(
+                old_valid & cv_valid, both,
+                jnp.where(cv_valid, contrib, state_vals[fi]),
+            )
+            out_vals.append(merged)
+            out_valids.append(old_valid | cv_valid)
+            fi += 1
+            continue
+        raise AssertionError(func)
+    return tuple(out_vals), tuple(out_valids), new_present
+
+
+@jax.jit
+def _dense_key_range_jit(key_v, key_m, sel):
+    """(n_live, kmin, kmax) over live valid-key rows — one tiny program."""
+    ok = sel & key_m
+    s = key_v.astype(jnp.int64)
+    n = jnp.sum(sel)
+    imax = jnp.iinfo(jnp.int64).max
+    imin = jnp.iinfo(jnp.int64).min
+    kmin = jnp.min(jnp.where(ok, s, imax))
+    kmax = jnp.max(jnp.where(ok, s, imin))
+    return jnp.stack([n, kmin, kmax])
+
+
+@partial(jax.jit, static_argnames=("new_size",))
+def _dense_regrow_jit(vals, valids, present, offset, new_size: int):
+    """Move the table into a larger range: slot 0 (NULL group) stays at 0,
+    real slots shift by ``offset``."""
+
+    def grow(a, fill):
+        out = jnp.full(new_size, fill, a.dtype)
+        out = out.at[0].set(a[0])  # null slot
+        # real slots 1..n shift to 1+offset..
+        n = a.shape[0] - 1
+        return lax.dynamic_update_slice(out, a[1:], (1 + offset,)) if n else out
+
+    new_vals = tuple(grow(a, jnp.zeros((), a.dtype)) for a in vals)
+    new_valids = tuple(
+        (grow(m, False) if m is not None else None) for m in valids
+    )
+    new_present = grow(present, False)
+    return new_vals, new_valids, new_present
+
+
+class _DenseAggState:
+    """Dense table accumulator for HashAggExec (single int key)."""
+
+    LIMIT = 1 << 21  # max real slots
+
+    def __init__(self, exec_: "HashAggExec", ctx: ExecutionContext):
+        self.name = f"dense-agg-{id(exec_):x}"
+        self.exec = exec_
+        self.ctx = ctx
+        self.base: int | None = None  # key value of slot 1
+        self.has_real = False  # any valid (non-null) key folded in yet
+        self.size = 0  # slots incl. null slot 0
+        self.vals: tuple | None = None
+        self.valids: tuple | None = None
+        self.present: jnp.ndarray | None = None
+        self._cfg = (
+            exec_.mode == PARTIAL,
+            tuple(
+                (a.func, str(t)) for (a, _), t in
+                zip(exec_.aggs, exec_._agg_input_types)
+            ),
+        )
+
+    # -- input extraction ------------------------------------------------
+
+    def _key_and_inputs(self, b: Batch):
+        ex = self.exec
+        if ex.mode == PARTIAL:
+            ev = Evaluator(ex.children[0].schema)
+            key = ev.evaluate(b, [ex.groupings[0][0]])[0]
+            per_agg = []
+            for (a, _), in_t in zip(ex.aggs, ex._agg_input_types):
+                if a.expr is None:
+                    per_agg.append(())
+                    continue
+                cv = ev.evaluate(b, [a.expr])[0]
+                if a.func in ("sum", "avg"):
+                    cv = ev._cast(cv, sum_type(in_t))
+                per_agg.append(((cv.values, cv.validity),))
+            return key, tuple(per_agg)
+        key = ColumnVal(
+            b.col_values(0), b.col_validity(0), ex.inter_schema[0].dtype, b.dicts[0]
+        )
+        per_agg = tuple(
+            tuple((cv.values, cv.validity) for cv in grp)
+            for grp in ex._intermediate_groups(b, ofs=1)
+        )
+        return key, per_agg
+
+    def _alloc(self, size: int) -> None:
+        ex = self.exec
+        vals, valids = [], []
+        for (a, _), in_t in zip(ex.aggs, ex._agg_input_types):
+            fields = intermediate_fields(a, in_t if in_t is not None else T.INT64, "x")
+            for f in fields:
+                dt = f.dtype.physical_dtype()
+                if a.func == "min" and f.name.endswith("#min"):
+                    fill = S._max_identity(dt)
+                elif a.func == "max" and f.name.endswith("#max"):
+                    fill = S._min_identity(dt)
+                else:
+                    fill = 0
+                vals.append(jnp.full(size, fill, dt))
+                valids.append(
+                    jnp.zeros(size, bool) if f.nullable else None
+                )
+        self.vals = tuple(vals)
+        self.valids = tuple(valids)
+        self.present = jnp.zeros(size, bool)
+        self.size = size
+
+    def update(self, b: Batch) -> bool:
+        """Fold one batch in; False = key range exceeds the dense limit
+        (caller drains and falls back). Table footprint is bounded by
+        LIMIT slots x field widths (<= ~100MB worst case), accounted by
+        the generic table consumer once drained."""
+        key, per_agg = self._key_and_inputs(b)
+        n, kmin, kmax = (
+            int(x) for x in
+            jax.device_get(_dense_key_range_jit(key.values, key.validity, b.device.sel))
+        )
+        if n == 0:
+            return True
+        null_only = kmin > kmax
+        if null_only:
+            # only null-keyed rows: any anchoring works; keep a tiny table
+            kmin = kmax = self.base if self.base is not None else 0
+        if self.base is None:
+            rng = kmax - kmin + 1
+            if rng > self.LIMIT:
+                return False
+            self._alloc(bucket_capacity(rng + 1))
+            self.base = kmin
+        elif not self.has_real and not null_only:
+            # only the NULL slot holds data so far: re-anchor freely to the
+            # first real keys (a leading null-only batch must not pin the
+            # range at an arbitrary base)
+            rng = kmax - kmin + 1
+            if rng > self.LIMIT:
+                return False
+            want = bucket_capacity(rng + 1)
+            if want > self.size:
+                self.vals, self.valids, self.present = _dense_regrow_jit(
+                    self.vals, self.valids, self.present,
+                    jnp.int32(0), new_size=want,
+                )
+                self.size = want
+            self.base = kmin
+        elif kmin < self.base or kmax - self.base + 2 > self.size:
+            new_base = min(self.base, kmin)
+            new_end = max(self.base + self.size - 1, kmax + 1)
+            rng = new_end - new_base + 1
+            if rng > self.LIMIT:
+                return False
+            new_size = bucket_capacity(rng + 1)
+            offset = self.base - new_base
+            self.vals, self.valids, self.present = _dense_regrow_jit(
+                self.vals, self.valids, self.present,
+                jnp.int32(offset), new_size=new_size,
+            )
+            self.base = new_base
+            self.size = new_size
+        self.vals, self.valids, self.present = _dense_update_jit(
+            self.vals, self.valids, self.present,
+            jnp.int64(self.base), key.values, key.validity, b.device.sel,
+            per_agg, cfg=self._cfg, size=self.size,
+        )
+        self.has_real = self.has_real or not null_only
+        return True
+
+    def state_batch_and_count(self) -> tuple[Batch | None, int]:
+        """Materialize the table as a (sparse-sel) intermediate batch."""
+        if self.base is None or self.present is None:
+            return None, 0
+        ex = self.exec
+        g = int(jax.device_get(jnp.sum(self.present)))
+        if g == 0:
+            return None, 0
+        key_f = ex.inter_schema[0]
+        phys = key_f.dtype.physical_dtype()
+        keys = (jnp.arange(self.size, dtype=jnp.int64) + (self.base - 1)).astype(phys)
+        key_valid = self.present & (jnp.arange(self.size) > 0)
+        cols = [ColumnVal(keys, key_valid, key_f.dtype, None)]
+        for fi, f in enumerate(ex.inter_schema.fields[1:]):
+            m = self.valids[fi]
+            cols.append(ColumnVal(
+                self.vals[fi],
+                (m & self.present) if m is not None else self.present,
+                f.dtype,
+                None,
+            ))
+        out = batch_from_columns(cols, ex.inter_schema.names, self.present)
+        sb = Batch(ex.inter_schema, out.device, out.dicts)
+        from auron_tpu.columnar.batch import compact_batch
+
+        # compact to the GROUP bucket: a sparse range-sized batch (2 groups
+        # in a 2^21-slot table) must not flow downstream at range capacity
+        return compact_batch(sb, bucket_capacity(g)), g
+
+    def mem_used(self) -> int:
+        if self.vals is None:
+            return 0
+        total = self.size  # present bools
+        for v in self.vals:
+            total += v.size * v.dtype.itemsize
+        for m in self.valids:
+            if m is not None:
+                total += m.size
+        return total
+
+    def spill(self) -> int:
+        return 0  # unspillable (fixed footprint); drained at stream end
+
+    def release(self, mm) -> None:
+        self.vals = self.valids = self.present = None

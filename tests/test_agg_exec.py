@@ -194,7 +194,9 @@ def test_partial_skipping_still_correct():
 
     n = 4000
     rng = np.random.default_rng(1)
-    k = rng.permutation(n)  # all distinct -> ratio 1.0
+    # all distinct -> ratio 1.0; spread over a huge range so the dense
+    # direct-address path (which makes skipping moot) stays ineligible
+    k = rng.permutation(n) * 1_000_003
     v = rng.integers(0, 100, n)
     df = pd.DataFrame({"k": k, "v": v})
     batches = [

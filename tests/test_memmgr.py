@@ -137,13 +137,16 @@ def test_join_build_under_tiny_budget_stays_exact():
     from auron_tpu.exprs.ir import col
 
     rng = np.random.default_rng(3)
+    # keys spread over a huge range: keeps the dense direct-address agg
+    # (which needs no spills) ineligible — the GENERIC spill machinery
+    # under pressure is what this test exercises
     fact = pd.DataFrame({
-        "k": rng.integers(0, 50, 5000).astype(np.int64),
+        "k": (rng.integers(0, 50, 5000) * 1_000_003).astype(np.int64),
         "v": rng.integers(-100, 100, 5000).astype(np.int64),
     })
     dim = pd.DataFrame({
-        "k2": np.arange(50, dtype=np.int64),
-        "g": (np.arange(50, dtype=np.int64) % 7),
+        "k2": (np.arange(50) * 1_000_003).astype(np.int64),
+        "g": ((np.arange(50) % 7) * 1_000_003).astype(np.int64),
     })
 
     def mk(df, chunk):

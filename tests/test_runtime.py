@@ -128,7 +128,10 @@ def test_agg_spill_under_pressure():
     try:
         rng = np.random.default_rng(31)
         n = 20_000
-        df = pd.DataFrame({"k": rng.integers(0, 3000, n), "v": rng.normal(size=n)})
+        # wide key range keeps the dense direct-address agg (no spills
+        # needed) ineligible; this test exercises the generic spill path
+        df = pd.DataFrame({"k": rng.integers(0, 3000, n) * 1_000_003,
+                           "v": rng.normal(size=n)})
         batches = [
             Batch.from_arrow(
                 pa.RecordBatch.from_pandas(df.iloc[i : i + 2000], preserve_index=False)
