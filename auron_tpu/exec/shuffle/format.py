@@ -63,13 +63,50 @@ def decode_blocks(data: bytes) -> Iterator[pa.RecordBatch]:
             yield from r
 
 
-def write_index(path: str, offsets: list[int]) -> None:
+# trailer magic binding a (data, index) pair to ONE writer attempt: two
+# concurrent task attempts commit via separate atomic os.replace calls per
+# file, and although attempts over the same input normally produce
+# identical bytes, nondeterministic memory-pressure spills can change the
+# block segmentation — a mixed pair must fail LOUDLY at read time (task
+# retry), never decode with the wrong offsets
+PAIR_MAGIC = 0x41_55_52_4F_4E_50_41_52  # "AURONPAR"
+
+
+def write_index(path: str, offsets: list[int], pair_tag: int | None = None) -> None:
     with open(path, "wb") as f:
         for o in offsets:
             f.write(struct.pack("<Q", o))
+        if pair_tag is not None:
+            f.write(struct.pack("<QQ", PAIR_MAGIC, pair_tag))
+
+
+def data_trailer(pair_tag: int) -> bytes:
+    """16-byte trailer appended AFTER the last offset position of a data
+    file (readers slice by offsets, so it is invisible to block decode)."""
+    return struct.pack("<QQ", PAIR_MAGIC, pair_tag)
 
 
 def read_index(path: str) -> list[int]:
+    offsets, _ = read_index_tagged(path)
+    return offsets
+
+
+def read_index_tagged(path: str) -> tuple[list[int], int | None]:
     with open(path, "rb") as f:
         raw = f.read()
-    return [struct.unpack_from("<Q", raw, i)[0] for i in range(0, len(raw), 8)]
+    words = [struct.unpack_from("<Q", raw, i)[0] for i in range(0, len(raw), 8)]
+    if len(words) >= 3 and words[-2] == PAIR_MAGIC:
+        return words[:-2], words[-1]
+    return words, None
+
+
+def read_data_tag(path: str, last_offset: int) -> int | None:
+    """The pair tag from a data file's trailer (None for untagged files)."""
+    with open(path, "rb") as f:
+        f.seek(last_offset)
+        tail = f.read(16)
+    if len(tail) == 16:
+        magic, tag = struct.unpack("<QQ", tail)
+        if magic == PAIR_MAGIC:
+            return tag
+    return None

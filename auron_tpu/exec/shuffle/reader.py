@@ -59,7 +59,21 @@ class LocalFileBlockProvider:
         self.index_file = index_file
 
     def __call__(self, partition: int) -> Iterator[pa.RecordBatch]:
-        offsets = read_index(self.index_file)
+        from auron_tpu.exec.shuffle.format import read_data_tag, read_index_tagged
+
+        offsets, pair_tag = read_index_tagged(self.index_file)
+        if pair_tag is not None:
+            # pair-integrity check: concurrent task attempts commit data
+            # and index with separate atomic replaces; a mixed pair (rare
+            # interleaving) must fail LOUDLY here so the task retries,
+            # never decode blocks with the wrong offsets
+            dtag = read_data_tag(self.data_file, offsets[-1])
+            if dtag != pair_tag:
+                raise RuntimeError(
+                    f"shuffle pair mismatch: {self.data_file} tag={dtag} vs "
+                    f"{self.index_file} tag={pair_tag} (concurrent attempt "
+                    "commit interleaving); retry the task"
+                )
         start, stop = offsets[partition], offsets[partition + 1]
         if start == stop:
             return

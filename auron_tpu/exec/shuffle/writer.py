@@ -68,12 +68,45 @@ class ShuffleWriterExec(ExecOperator):
 
             offsets = [0]
             with ctx.metrics.timer("write_time"):
-                with open(self.data_file, "wb") as f:
-                    for pid in range(n_out):
-                        for blk in staging.blocks_of(pid):
-                            f.write(blk)
-                        offsets.append(f.tell())
-                write_index(self.index_file, offsets)
+                # task-attempt isolation: a speculative duplicate or a
+                # zombie attempt surviving an executor-loss retry may run
+                # CONCURRENTLY with this one against the same deterministic
+                # output paths (the staged-segment scheduler commits
+                # whatever bytes land there). Each attempt writes its own
+                # temp files and commits with atomic os.replace — attempts
+                # are deterministic over the same input partition, so
+                # whichever attempt's pair lands last is byte-identical.
+                import os as _os
+                import uuid as _uuid
+
+                from auron_tpu.exec.shuffle.format import data_trailer
+
+                attempt = _uuid.uuid4()
+                suffix = f".attempt-{attempt.hex[:8]}"
+                pair_tag = attempt.int & ((1 << 64) - 1)
+                tmp_data = self.data_file + suffix
+                tmp_index = self.index_file + suffix
+                committed = False
+                try:
+                    with open(tmp_data, "wb") as f:
+                        for pid in range(n_out):
+                            for blk in staging.blocks_of(pid):
+                                f.write(blk)
+                            offsets.append(f.tell())
+                        # pair tag past the last offset: invisible to
+                        # offset-sliced reads, checked by the reader
+                        f.write(data_trailer(pair_tag))
+                    write_index(tmp_index, offsets, pair_tag=pair_tag)
+                    _os.replace(tmp_data, self.data_file)
+                    _os.replace(tmp_index, self.index_file)
+                    committed = True
+                finally:
+                    if not committed:  # don't leak .attempt-* temps
+                        for p in (tmp_data, tmp_index):
+                            try:
+                                _os.unlink(p)
+                            except OSError:
+                                pass
         finally:
             mm.unregister(staging)
             staging.release()
@@ -103,6 +136,7 @@ class _ShuffleStaging:
         self.staged_bytes = [0] * n_out
         self.regions: list[list[bytes]] = [[] for _ in range(n_out)]
         self._region_bytes = 0
+        self._closed = False
         self._spill_files: list[tuple[str, list[list[tuple[int, int]]]]] = []
         # concurrent tasks: MemManager may spill this consumer from another
         # thread (lock order manager -> consumer, like agg/sort consumers)
@@ -134,6 +168,12 @@ class _ShuffleStaging:
         import tempfile
 
         with self._lock:
+            # a release()d staging must never spill again: the race window
+            # between the manager's victim snapshot and this call would
+            # otherwise write a fresh .shuffle.spill temp file AFTER the
+            # task already cleaned up — leaked file per race (ADVICE r4)
+            if self._closed:
+                return 0
             freed = self.mem_used()
             if freed == 0:
                 return 0
@@ -178,6 +218,7 @@ class _ShuffleStaging:
 
         with self._lock:
             files, self._spill_files = self._spill_files, []
+            self._closed = True
         for path, _ in files:
             try:
                 os.unlink(path)

@@ -224,11 +224,15 @@ class MemManager:
         for c in victims:
             with self._lock:
                 # re-check live pool state per victim: concurrent spills/
-                # releases may have already covered the shortfall
+                # releases may have already covered the shortfall — and
+                # membership: a victim that finished and unregistered in
+                # the meantime must not be spilled (its spill would write
+                # a temp file nothing ever unlinks, ADVICE r4)
                 needed = self.total_used() + additional - self.budget
+                gone = c is not consumer and c not in self._consumers
             if needed <= 0:
                 break
-            if c.mem_used() == 0:
+            if gone or c.mem_used() == 0:
                 continue
             if c.spill():
                 with self._lock:
@@ -286,7 +290,11 @@ class _HostLedger:
         self._resident: list["HostSpill"] = []
         self._bytes = 0
 
-    def admit(self, spill: "HostSpill", nbytes: int) -> None:
+    def admit(self, spill: "HostSpill", nbytes: int) -> list["HostSpill"]:
+        """Record bytes; returns the demotion victims WITHOUT demoting —
+        the caller runs them after releasing its own spill lock (admission
+        happens under the admitting spill's lock so it can never interleave
+        with a concurrent demotion of that same spill, ADVICE r4)."""
         budget = int(active_conf().get(HOST_SPILL_BUDGET_BYTES))
         to_demote: list[HostSpill] = []
         with self._lock:
@@ -296,8 +304,7 @@ class _HostLedger:
             while self._bytes > budget and self._resident:
                 victim = self._resident.pop(0)
                 to_demote.append(victim)
-        for v in to_demote:
-            v._demote()
+        return to_demote
 
     def forget(self, spill: "HostSpill", nbytes: int) -> None:
         with self._lock:
@@ -322,6 +329,7 @@ class HostSpill:
     def __init__(self, spill_dir: str | None = None):
         self._blocks: list[bytes] | None = []
         self._nbytes = 0
+        self._admitted = 0  # bytes this spill currently holds in the ledger
         self._disk: DiskSpill | None = None
         self._spill_dir = spill_dir
         self._lock = threading.Lock()
@@ -337,7 +345,15 @@ class HostSpill:
                 return
             self._blocks.append(blk)
             self._nbytes += len(blk)
-        _host_ledger.admit(self, len(blk))
+            self._admitted += len(blk)
+            # admission under OUR lock: a concurrent demotion of this spill
+            # must take this lock first, so it always sees these bytes and
+            # forgets exactly _admitted — the ledger can't drift (ADVICE r4:
+            # the post-release admit re-added bytes a demotion had already
+            # forgotten and re-inserted a demoted spill as resident)
+            victims = _host_ledger.admit(self, len(blk))
+        for v in victims:  # demote OUTSIDE our lock (lock order spill->ledger)
+            v._demote()
 
     def _demote(self) -> None:
         """Move resident blocks to disk (ledger pressure)."""
@@ -348,8 +364,8 @@ class HostSpill:
             with open(disk.path, "ab") as f:
                 for blk in self._blocks:
                     f.write(blk)
-            freed = self._nbytes
-            self._blocks, self._nbytes = [], 0
+            freed = self._admitted
+            self._blocks, self._nbytes, self._admitted = [], 0, 0
             self._disk = disk
         _host_ledger.forget(self, freed)
 
@@ -370,8 +386,9 @@ class HostSpill:
 
     def release(self) -> None:
         with self._lock:
-            disk, freed = self._disk, self._nbytes
+            disk, freed = self._disk, self._admitted
             self._blocks, self._nbytes, self._disk = None, 0, None
+            self._admitted = 0
         if disk is not None:
             disk.release()
         if freed:

@@ -84,7 +84,21 @@ public class AuronTpuKafkaSourceFunction
     public void run(SourceContext<RowData> sourceContext) throws Exception {
         int subtask = getRuntimeContext().getIndexOfThisSubtask();
         int parallelism = getRuntimeContext().getNumberOfParallelSubtasks();
-        resourceId = "flink_kafka_" + topic + "_" + subtask;
+        // operator-unique id: two sources over the SAME topic in one
+        // TaskManager (two jobs, or one job referencing the table twice)
+        // must not collide on the engine resource — a shared id would make
+        // the second putResourceBytes overwrite the first's config, both
+        // would share one cached wire client (wrong offsets/assignment),
+        // and either close() would tear down the other's live client
+        // getOperatorUniqueID lives on StreamingRuntimeContext only
+        // (FLINK-8926); the plain RuntimeContext interface lacks it
+        org.apache.flink.api.common.functions.RuntimeContext rc = getRuntimeContext();
+        String opId =
+            (rc instanceof org.apache.flink.streaming.api.operators.StreamingRuntimeContext)
+                ? ((org.apache.flink.streaming.api.operators.StreamingRuntimeContext) rc)
+                    .getOperatorUniqueID()
+                : java.util.UUID.randomUUID().toString();
+        resourceId = "flink_kafka_" + topic + "_" + opId + "_" + subtask;
         arrow = new FlinkArrowBridge(rowType, rowType);
         // the engine builds (and CACHES against this resource) a real wire
         // client from this config: deterministic mod-split over the

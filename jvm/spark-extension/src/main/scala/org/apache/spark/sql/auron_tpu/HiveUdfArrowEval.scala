@@ -9,13 +9,14 @@ package org.apache.spark.sql.auron_tpu
 
 import java.io.ByteArrayOutputStream
 
+import scala.collection.JavaConverters._
+
 import org.apache.arrow.vector.VectorSchemaRoot
 import org.apache.arrow.vector.ipc.{ArrowStreamReader, ArrowStreamWriter}
-import org.apache.spark.sql.catalyst.InternalRow
 import org.apache.spark.sql.catalyst.expressions.GenericInternalRow
 import org.apache.spark.sql.execution.arrow.ArrowWriter
 import org.apache.spark.sql.types.{StructField, StructType}
-import org.apache.spark.sql.util.ArrowUtils
+import org.apache.spark.sql.vectorized.{ArrowColumnVector, ColumnarBatch, ColumnVector}
 
 object HiveUdfArrowEval {
 
@@ -39,8 +40,18 @@ object HiveUdfArrowEval {
       writer.start()
       while (reader.loadNextBatch()) {
         val root = reader.getVectorSchemaRoot
-        val rows = ArrowUtils.fromArrowRecordBatch(root)
-        rows.foreach { argRow: InternalRow =>
+        // Spark has no ArrowUtils row-iterator helper: wrap the loaded
+        // vectors in ArrowColumnVectors inside a ColumnarBatch and walk
+        // rowIterator() (the reference's ColumnarHelper pattern —
+        // spark-extension/.../columnar/ColumnarHelper.scala)
+        val cols: Array[ColumnVector] = root.getFieldVectors.asScala
+          .map(v => new ArrowColumnVector(v): ColumnVector)
+          .toArray
+        // NOT closed here: closing the ColumnarBatch would close the
+        // ArrowColumnVectors and with them the reader-owned ValueVectors
+        // mid-stream; the reader's own close() releases them once
+        val batch = new ColumnarBatch(cols, root.getRowCount)
+        batch.rowIterator().asScala.foreach { argRow =>
           val value = expr.eval(argRow)
           arrowWriter.write(new GenericInternalRow(Array[Any](value)))
         }
