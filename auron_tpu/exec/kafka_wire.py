@@ -390,6 +390,25 @@ class KafkaWireSource:
             )
         return self._conns[addr]
 
+    def _leader_request(
+        self, addr: tuple[str, int], api: int, ver: int, body: bytes
+    ) -> Cursor:
+        """One request with reconnect-once on a broken connection (broker
+        restart / idle-connection reaping / partial frame under
+        congestion). Safe for the read APIs this source issues — metadata,
+        list_offsets, fetch are all idempotent; offsets only advance after
+        a DECODED response, so a retried fetch can't skip records."""
+        for attempt in (0, 1):
+            try:
+                return self._conn(addr).request(api, ver, body)
+            except (ConnectionError, OSError):
+                stale = self._conns.pop(addr, None)
+                if stale is not None:
+                    stale.close()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
     def _discover(
         self,
         boot: KafkaConnection,
@@ -455,7 +474,7 @@ class KafkaWireSource:
             + struct.pack(">i", 1)  # one partition
             + struct.pack(">iq", pid, ts)
         )
-        c = self._conn(st.leader).request(API_LIST_OFFSETS, 1, body)
+        c = self._leader_request(st.leader, API_LIST_OFFSETS, 1, body)
         for _ in range(c.i32()):
             c.string()  # topic
             for _ in range(c.i32()):
@@ -513,7 +532,7 @@ class KafkaWireSource:
             + struct.pack(">i", 1)  # one partition
             + struct.pack(">iqi", pid, st.next_offset, self.fetch_max_bytes)
         )
-        c = self._conn(st.leader).request(API_FETCH, 4, body)
+        c = self._leader_request(st.leader, API_FETCH, 4, body)
         c.i32()  # throttle
         records: list[tuple[int, bytes | None]] = []
         hwm = st.next_offset

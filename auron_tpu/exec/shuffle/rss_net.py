@@ -90,8 +90,15 @@ class RssNetServer:
     (connections are long-lived: one per executor client)."""
 
     def __init__(self, service: LocalRssService | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 fault_hook=None):
         self.service = service or LocalRssService()
+        #: fault injection seam for network-hardening tests: called as
+        #: fault_hook(op_code) before each reply; may return one of
+        #: "drop_before" (close with no reply), "partial_reply" (send a
+        #: truncated header then close), "delay:<seconds>" — or None for
+        #: normal service. Production servers leave it None.
+        self.fault_hook = fault_hook
         self.srv = socket.socket()
         self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.srv.bind((host, port))
@@ -133,11 +140,17 @@ class RssNetServer:
                 if n > _MAX_FRAME:
                     return
                 frame = read_exact(conn, n)
+                op = frame[0] if frame else -1
                 try:
                     reply = self._dispatch(_Cursor(frame))
                 except Exception as e:  # noqa: BLE001 — relay to client
                     msg = f"{type(e).__name__}: {e}".encode()[:1000]
                     reply = b"\x01" + msg
+                if self.fault_hook is not None:
+                    from auron_tpu.utils.netio import apply_fault
+
+                    if apply_fault(conn, self.fault_hook(op), len(reply)):
+                        return
                 conn.sendall(struct.pack(">I", len(reply)) + reply)
         except (ConnectionError, OSError):
             return

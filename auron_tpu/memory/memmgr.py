@@ -301,9 +301,15 @@ class _HostLedger:
             self._bytes += nbytes
             if spill not in self._resident:
                 self._resident.append(spill)
-            while self._bytes > budget and self._resident:
+            # pick only enough victims to clear the shortfall: their bytes
+            # leave the ledger later (each victim's forget), so track a
+            # running remainder here instead of re-reading self._bytes —
+            # otherwise ONE pressure event demotes every resident spill
+            remaining = self._bytes
+            while remaining > budget and self._resident:
                 victim = self._resident.pop(0)
                 to_demote.append(victim)
+                remaining -= victim._admitted
         return to_demote
 
     def forget(self, spill: "HostSpill", nbytes: int) -> None:

@@ -18,3 +18,25 @@ def read_exact(sock: socket.socket, n: int, eof_ok: bool = False) -> bytes | Non
             raise ConnectionError(f"connection closed mid-frame ({buf.tell()}/{n})")
         buf.write(chunk)
     return buf.getvalue()
+
+
+def apply_fault(conn: socket.socket, action: str | None, reply_len: int) -> bool:
+    """Shared fault-injection interpreter for in-process protocol servers
+    (rss_net server + the kafka mini broker test seam). Returns True when
+    the fault consumed the reply (connection closed); the caller then
+    stops serving this connection. Actions: "drop_before" (close, no
+    reply), "partial_reply" (half a length header then close),
+    "delay:<seconds>" (stall, then send normally)."""
+    import struct
+    import time
+
+    if action == "drop_before":
+        conn.close()
+        return True
+    if action == "partial_reply":
+        conn.sendall(struct.pack(">I", reply_len)[:2])
+        conn.close()
+        return True
+    if action and action.startswith("delay:"):
+        time.sleep(float(action.split(":", 1)[1]))
+    return False

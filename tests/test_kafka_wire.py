@@ -11,6 +11,7 @@ import json
 import socket
 import struct
 import threading
+import time
 
 import pytest
 
@@ -24,9 +25,13 @@ from auron_tpu.exec import kafka_wire as KW
 
 
 class MiniKafkaBroker:
-    def __init__(self, topic: str, n_partitions: int = 2, codec: int = KW.CODEC_NONE):
+    def __init__(self, topic: str, n_partitions: int = 2, codec: int = KW.CODEC_NONE,
+                 fault_hook=None):
         self.topic = topic
         self.codec = codec
+        # fault injection seam: fault_hook(api_key) -> None | "drop_before"
+        # | "partial_reply" (truncated header then close) | "delay:<s>"
+        self.fault_hook = fault_hook
         self.logs: list[list[bytes]] = [[] for _ in range(n_partitions)]
         self.starts = [0] * n_partitions  # log-start offsets (retention)
         self.fetch_chunk = 100  # records per batch in a fetch response
@@ -87,6 +92,11 @@ class MiniKafkaBroker:
                 else:
                     return
                 resp = struct.pack(">i", corr) + body
+                if self.fault_hook is not None:
+                    from auron_tpu.utils.netio import apply_fault
+
+                    if apply_fault(conn, self.fault_hook(api), len(resp)):
+                        return
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
         except (ConnectionError, OSError):
             return
@@ -354,3 +364,92 @@ def test_kafka_scan_exec_with_wire_source(broker):
         got += list(zip(df["k"].tolist(), df["v"].tolist()))
     assert sorted(got) == sorted((r["k"], r["v"]) for r in rows)
     assert ctx.resources["kafka_src.offsets"] == {0: 30, 1: 27}
+
+
+# ---------------------------------------------------------------------------
+# network fault injection (VERDICT r4 #10: loopback-to-LAN hardening)
+# ---------------------------------------------------------------------------
+
+
+def test_poll_survives_broker_connection_drop():
+    """Broker drops the connection before a fetch reply (restart /
+    idle-reaping): the source reconnects once and resumes from its
+    next_offset — no duplicates, no gaps."""
+    topic = "faulty"
+    faults = {"n": 0}
+
+    def hook(api):
+        if api == KW.API_FETCH and faults["n"] == 0:
+            faults["n"] += 1
+            return "drop_before"
+        return None
+
+    br = MiniKafkaBroker(topic, n_partitions=1, fault_hook=hook)
+    try:
+        br.produce(0, [f"m{i}".encode() for i in range(10)])
+        src = KW.KafkaWireSource(
+            f"127.0.0.1:{br.port}", topic, startup_mode="earliest")
+        got = []
+        while True:
+            recs = src.poll(100)
+            if not recs:
+                break
+            got.extend(recs)
+        assert got == [f"m{i}".encode() for i in range(10)]
+        assert faults["n"] == 1  # the drop DID happen mid-stream
+        src.close()
+    finally:
+        br.close()
+
+
+def test_poll_survives_partial_frame():
+    """A truncated reply header (congestion) fails read_exact cleanly and
+    the reconnect retry delivers the full stream."""
+    topic = "halfframe"
+    faults = {"n": 0}
+
+    def hook(api):
+        if api == KW.API_FETCH and faults["n"] == 0:
+            faults["n"] += 1
+            return "partial_reply"
+        return None
+
+    br = MiniKafkaBroker(topic, n_partitions=2, fault_hook=hook)
+    try:
+        br.produce(0, [b"a0", b"a1"])
+        br.produce(1, [b"b0"])
+        src = KW.KafkaWireSource(
+            f"127.0.0.1:{br.port}", topic, startup_mode="earliest")
+        got = []
+        while True:
+            recs = src.poll(100)
+            if not recs:
+                break
+            got.extend(recs)
+        assert sorted(got) == [b"a0", b"a1", b"b0"]
+        assert faults["n"] == 1
+        src.close()
+    finally:
+        br.close()
+
+
+def test_persistent_broker_outage_is_loud():
+    """When EVERY retry meets a dead connection the error must propagate
+    (reconnect is once, not forever — a dead broker can't spin the task)."""
+    topic = "deadbroker"
+
+    def hook(api):
+        if api == KW.API_FETCH:
+            return "drop_before"
+        return None
+
+    br = MiniKafkaBroker(topic, n_partitions=1, fault_hook=hook)
+    try:
+        br.produce(0, [b"x"])
+        src = KW.KafkaWireSource(
+            f"127.0.0.1:{br.port}", topic, startup_mode="earliest")
+        with pytest.raises((ConnectionError, OSError)):
+            src.poll(10)
+        src.close()
+    finally:
+        br.close()

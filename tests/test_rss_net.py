@@ -156,3 +156,110 @@ def test_fetch_pages_through_reply_budget(server, monkeypatch):
     w.flush()
     assert client.fetch("page", 2) == blocks
     client.close()
+
+
+# ---------------------------------------------------------------------------
+# network fault injection (VERDICT r4 #10: loopback-to-LAN hardening)
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_survives_connection_drop():
+    """Server kills the connection before replying to the FIRST fetch; the
+    client's reconnect-once path must transparently retry."""
+    faults = {"n": 0}
+
+    def hook(op):
+        if op == RN.OP_FETCH and faults["n"] == 0:
+            faults["n"] += 1
+            return "drop_before"
+        return None
+
+    srv = RN.RssNetServer(fault_hook=hook)
+    try:
+        cl = RN.RssNetClient(srv.addr)
+        att = cl.new_attempt("s1", 0)
+        cl.push("s1", 0, att, 0, b"hello")
+        cl.commit("s1", 0, att)
+        got = cl.fetch("s1", 0)
+        assert got == [b"hello"]
+        assert faults["n"] == 1  # the fault DID fire
+        cl.close()
+    finally:
+        srv.close()
+
+
+def test_fetch_survives_partial_frame():
+    """Server sends half a length header then closes (congestion-truncated
+    reply): read_exact must fail cleanly and the retry must succeed."""
+    faults = {"n": 0}
+
+    def hook(op):
+        if op == RN.OP_FETCH and faults["n"] == 0:
+            faults["n"] += 1
+            return "partial_reply"
+        return None
+
+    srv = RN.RssNetServer(fault_hook=hook)
+    try:
+        cl = RN.RssNetClient(srv.addr)
+        att = cl.new_attempt("s2", 0)
+        cl.push("s2", 0, att, 1, b"blockA")
+        cl.commit("s2", 0, att)
+        assert cl.fetch("s2", 1) == [b"blockA"]
+        assert faults["n"] == 1
+        cl.close()
+    finally:
+        srv.close()
+
+
+def test_push_drop_is_loud_and_reattempt_is_clean():
+    """A dropped PUSH raises (non-idempotent, never silently retried); the
+    writer opens a NEW attempt whose committed data wins, and the broken
+    attempt's partial pushes are invisible (attempt isolation)."""
+    faults = {"n": 0}
+
+    def hook(op):
+        if op == RN.OP_PUSH and faults["n"] == 0:
+            faults["n"] += 1
+            return "drop_before"
+        return None
+
+    srv = RN.RssNetServer(fault_hook=hook)
+    try:
+        cl = RN.RssNetClient(srv.addr)
+        a1 = cl.new_attempt("s3", 0)
+        import pytest as _pytest
+
+        with _pytest.raises((ConnectionError, OSError)):
+            cl.push("s3", 0, a1, 0, b"broken")
+        # fresh attempt over the same (reconnected) client
+        a2 = cl.new_attempt("s3", 0)
+        cl.push("s3", 0, a2, 0, b"good")
+        cl.commit("s3", 0, a2)
+        assert cl.fetch("s3", 0) == [b"good"]
+        cl.close()
+    finally:
+        srv.close()
+
+
+def test_slow_server_times_out_cleanly():
+    """A stalled reply must surface as a timeout error, not a hang."""
+
+    def hook(op):
+        if op == RN.OP_FETCH:
+            return "delay:5"
+        return None
+
+    srv = RN.RssNetServer(fault_hook=hook)
+    try:
+        cl = RN.RssNetClient(srv.addr, timeout_s=0.5)
+        att = cl.new_attempt("s4", 0)
+        cl.push("s4", 0, att, 0, b"x")
+        cl.commit("s4", 0, att)
+        import pytest as _pytest
+
+        with _pytest.raises((TimeoutError, OSError)):
+            cl.fetch("s4", 0)
+        cl.close()
+    finally:
+        srv.close()
