@@ -146,7 +146,7 @@ def test_gate_runs_all_classes():
     """The single-command differential gate (QueryRunner analog): every
     query class executes and matches its oracle."""
     res = tpcds.run_gate(sf=0.02, verbose=False)
-    assert len(res) >= 9
+    assert len(res) >= 40  # VERDICT r4 #6: the widened differential surface
     failures = [(n, e) for n, ok, e, _ in res if not ok]
     assert not failures, failures
 
