@@ -90,45 +90,48 @@ def _split_planes(operands: tuple, narrow: tuple) -> list[jnp.ndarray]:
     return planes
 
 
-def _network(x: jnp.ndarray, P: int) -> jnp.ndarray:
-    """The bitonic merge network over stacked planes x: (NP, R, 128).
+def _substage(x: jnp.ndarray, flat: jnp.ndarray, R: int, k: int, j: int) -> jnp.ndarray:
+    """ONE compare-exchange substage of the bitonic network over stacked
+    planes x: (NP, R, 128). want_max[i] = bit_j(i) != bit_k(i); partner by
+    two static rolls + select; lexicographic uint32 compare chain across
+    planes (payload plane = last key -> never equal, the order is total).
+    THE single comparator core — the full network and the tiled path's
+    merge stage both run exactly this code."""
+    jbit = (flat & j) != 0
+    kbit = (flat & k) != 0
+    want_max = jbit != kbit
+    if j >= _LANES:
+        sh, ax = j // _LANES, 1
+    else:
+        sh, ax = j, 2
+    partner = jnp.where(
+        jbit[None], jnp.roll(x, sh, axis=ax), jnp.roll(x, -sh, axis=ax)
+    )
+    lt = jnp.zeros((R, _LANES), dtype=bool)
+    eq = jnp.ones((R, _LANES), dtype=bool)
+    for p in range(x.shape[0]):
+        a, b = x[p], partner[p]
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+    take_partner = lt == want_max
+    return jnp.where(take_partner[None], partner, x)
 
-    Fully unrolled (strides are static -> rolls are static shifts). For
-    substage (k, j): want_max[i] = bit_j(i) != bit_k(i); partner by two
-    rolls + select; lexicographic uint32 compare chain across planes.
-    """
+
+def _iota2d(P: int):
     R = P // _LANES
     rows = lax.broadcasted_iota(jnp.int32, (R, _LANES), 0)
     cols = lax.broadcasted_iota(jnp.int32, (R, _LANES), 1)
-    flat = rows * _LANES + cols
+    return R, rows * _LANES + cols
 
-    def substage(x, k, j):
-        jbit = (flat & j) != 0
-        kbit = (flat & k) != 0
-        want_max = jbit != kbit
-        if j >= _LANES:
-            sh, ax = j // _LANES, 1
-        else:
-            sh, ax = j, 2
-        partner = jnp.where(
-            jbit[None], jnp.roll(x, sh, axis=ax), jnp.roll(x, -sh, axis=ax)
-        )
-        # x < partner, lexicographic over planes (payload plane = last key
-        # -> never equal, the order is total)
-        lt = jnp.zeros((R, _LANES), dtype=bool)
-        eq = jnp.ones((R, _LANES), dtype=bool)
-        for p in range(x.shape[0]):
-            a, b = x[p], partner[p]
-            lt = lt | (eq & (a < b))
-            eq = eq & (a == b)
-        take_partner = lt == want_max
-        return jnp.where(take_partner[None], partner, x)
 
+def _network(x: jnp.ndarray, P: int) -> jnp.ndarray:
+    """The full bitonic sort network (fully unrolled; static strides)."""
+    R, flat = _iota2d(P)
     k = 2
     while k <= P:
         j = k // 2
         while j >= 1:
-            x = substage(x, k, j)
+            x = _substage(x, flat, R, k, j)
             j //= 2
         k *= 2
     return x
@@ -155,6 +158,104 @@ def _run_pallas(x: jnp.ndarray, P: int, interpret: bool) -> jnp.ndarray:
         out_specs=pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
         interpret=interpret,
     )(x)
+
+
+def _merge_network(x: jnp.ndarray, P: int) -> jnp.ndarray:
+    """The FINAL bitonic stage only (k = P): turns one bitonic sequence of
+    length P into sorted order — the compare-exchange kernel of the tiled
+    path. Literally _network's last stage (k = P makes every kbit 0, so
+    the shared comparator's want_max reduces to jbit)."""
+    R, flat = _iota2d(P)
+    j = P // 2
+    while j >= 1:
+        x = _substage(x, flat, R, P, j)
+        j //= 2
+    return x
+
+
+def _merge_kernel(x_ref, out_ref, *, P: int):
+    out_ref[:] = _merge_network(x_ref[:], P)
+
+
+@partial(jax.jit, static_argnames=("P", "interpret"))
+def _run_pallas_merge(x: jnp.ndarray, P: int, interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        partial(_merge_kernel, P=P),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+
+
+@partial(jax.jit, static_argnames=("B", "impl", "interpret"))
+def _merge_pairs(pairs: jnp.ndarray, B: int, impl: str, interpret: bool) -> jnp.ndarray:
+    """Merge-split over pairs (npairs, NP, 2*RB, 128), each pair
+    [block_a ++ reversed(block_b)] (a bitonic sequence); returns the
+    merged ascending pairs. impl="pallas" runs the VMEM-resident merge
+    kernel per pair (lax.map: one trace, sequential grid); "jnp" vmaps
+    the same network through XLA."""
+    if impl == "pallas":
+        return lax.map(lambda x: _run_pallas_merge(x, 2 * B, interpret), pairs)
+    return jax.vmap(lambda x: _merge_network(x, 2 * B))(pairs)
+
+
+def _reverse_block(x: jnp.ndarray) -> jnp.ndarray:
+    """Reverse element order of a (NP, RB, 128) block (rows and lanes)."""
+    return x[:, ::-1, ::-1]
+
+
+def _tiled_sort(stacked: jnp.ndarray, P: int, impl: str, interpret: bool,
+                block_rows: int) -> jnp.ndarray:
+    """Batcher bitonic network over SORTED BLOCKS with merge-split
+    compare-exchanges (the standard lift of a sorting network to sorted
+    runs, 0-1-principle correct): inputs larger than one VMEM block sort
+    block-by-block (each block a single-kernel network), then log^2(nb)
+    merge-split passes — every kernel invocation stays VMEM-sized, so the
+    Pallas path covers arbitrarily large inputs (VERDICT r4 #4; the
+    reference's analog is the rdx_sort + loser-tree merge pair)."""
+    NP = stacked.shape[0]
+    RB = block_rows // _LANES
+    nb = P // block_rows
+    x = stacked.reshape(NP, nb, RB, _LANES)
+
+    # ---- phase 1: sort each block independently (VMEM-resident network);
+    # lax.map traces the kernel ONCE and runs blocks sequentially — the
+    # per-block program (pallas or jnp) stays within the VMEM budget
+    def sort_block(blk):
+        if impl == "pallas":
+            return _run_pallas(blk, block_rows, interpret)
+        return _network(blk, block_rows)
+
+    x = jnp.moveaxis(lax.map(sort_block, jnp.moveaxis(x, 1, 0)), 0, 1)
+
+    # ---- phase 2: Batcher network over blocks; merge-split per exchange
+    k = 2
+    while k <= nb:
+        j = k // 2
+        while j >= 1:
+            lo_ids = [i for i in range(nb) if not i & j]
+            pairs = []
+            for i in lo_ids:
+                a, b = x[:, i], x[:, i ^ j]
+                pairs.append(jnp.concatenate([a, _reverse_block(b)], axis=1))
+            merged = _merge_pairs(jnp.stack(pairs), block_rows, impl, interpret)
+            new_blocks: list = [None] * nb
+            for pi, i in enumerate(lo_ids):
+                lo, hi = merged[pi, :, :RB, :], merged[pi, :, RB:, :]
+                # i has bit j clear: it takes the MIN half unless its
+                # k-region sorts descending (bit_k set) — the block-level
+                # image of the element network's want_max = bit_j != bit_k
+                desc = (i & k) != 0
+                new_blocks[i] = hi if desc else lo
+                new_blocks[i ^ j] = lo if desc else hi
+            x = jnp.stack(new_blocks, axis=1)
+            j //= 2
+        k *= 2
+    return x.reshape(NP, P // _LANES, _LANES)
 
 
 def bitonic_sort(
@@ -186,12 +287,20 @@ def bitonic_sort(
     stacked = jnp.stack(
         [jnp.concatenate([p, pad]).reshape(P // _LANES, _LANES) for p in planes]
     )
-    if impl == "pallas":
-        out = _run_pallas(stacked, P, interpret)
-    elif impl == "jnp":
-        out = _run_jnp(stacked, P)
-    else:
+    n_planes = stacked.shape[0]
+    single_block = n_planes * P * 4 * 3 <= _VMEM_GATE_BYTES
+    if impl not in ("pallas", "jnp"):
         raise ValueError(f"bitonic impl {impl!r} (use lax.sort for 'lax')")
+    if single_block:
+        out = _run_pallas(stacked, P, interpret) if impl == "pallas" else _run_jnp(stacked, P)
+    else:
+        # tiled: per-kernel working set = one block pair; covers inputs of
+        # any size (VERDICT r4 #4 — the 12MB gate no longer routes
+        # perf-gate-scale partitions off the kernel path)
+        block_rows = 8 * _LANES
+        while n_planes * (4 * block_rows) * 4 * 3 <= _VMEM_GATE_BYTES and block_rows < P // 2:
+            block_rows *= 2
+        out = _tiled_sort(stacked, P, impl, interpret, block_rows)
     flat = out.reshape(out.shape[0], P)[:, :cap]
     # recombine planes -> original operand dtypes (narrow: hi is zero;
     # signed: undo the sign bias applied in _split_planes)
@@ -270,11 +379,10 @@ def sort_impl_for(n_words: int, cap: int, n_narrow_words: int = 1) -> str:
     if backend not in ("tpu", "axon"):
         return "lax"
     P = max(_next_pow2(cap), 8 * _LANES)
-    # dead key rides narrow (1 plane) + words as hi/lo minus the narrow
-    # ones + the payload plane — mirror segment_by_keys' actual stacking
-    n_planes = 1 + 2 * n_words - min(n_narrow_words, n_words) + 1
     if P < _MIN_P:
         return "lax"
-    if n_planes * P * 4 * 3 <= _VMEM_GATE_BYTES:
-        return "pallas"
-    return "jnp"
+    # single-block AND tiled inputs both run the kernel now (the tiled
+    # network keeps every invocation — block sorts AND pair merges —
+    # VMEM-sized regardless of P). n_words/n_narrow_words stay in the
+    # signature for callers' static cfg keys; only P gates the choice.
+    return "pallas"
