@@ -29,6 +29,8 @@ package org.apache.spark.sql.auron_tpu
 
 import java.io.ByteArrayInputStream
 
+import scala.collection.JavaConverters._
+
 import org.apache.arrow.memory.RootAllocator
 import org.apache.arrow.vector.ipc.ArrowStreamReader
 import org.apache.spark.TaskContext
@@ -36,7 +38,7 @@ import org.apache.spark.rdd.RDD
 import org.apache.spark.sql.catalyst.InternalRow
 import org.apache.spark.sql.catalyst.expressions.{Attribute, UnsafeProjection}
 import org.apache.spark.sql.execution.SparkPlan
-import org.apache.spark.sql.util.ArrowUtils
+import org.apache.spark.sql.vectorized.{ArrowColumnVector, ColumnarBatch, ColumnVector}
 
 /** One FFI boundary: the engine reads resource "<resourceId>.<pid>". */
 case class FfiInput(resourceId: String, child: SparkPlan)
@@ -341,9 +343,18 @@ object NativeTaskRun {
             try {
               val builder = Seq.newBuilder[InternalRow]
               while (reader.loadNextBatch()) { // ALL batches in the stream
-                builder ++= ArrowUtils
-                  .fromArrowRecordBatch(reader.getVectorSchemaRoot)
-                  .map(r => proj(r).copy())
+                // Spark has no ArrowUtils row-iterator helper: wrap the
+                // loaded vectors in a ColumnarBatch and walk rowIterator()
+                // (HiveUdfArrowEval does the same; vectors stay owned by
+                // the reader, so the batch is NOT closed here)
+                val root = reader.getVectorSchemaRoot
+                val cols: Array[ColumnVector] = root.getFieldVectors.asScala
+                  .map(v => new ArrowColumnVector(v): ColumnVector)
+                  .toArray
+                val batch = new ColumnarBatch(cols, root.getRowCount)
+                batch.rowIterator().asScala.foreach { r =>
+                  builder += proj(r).copy()
+                }
               }
               current = builder.result().iterator
             } finally reader.close()

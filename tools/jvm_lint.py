@@ -83,6 +83,7 @@ def strip_and_check(
             while i < n and depth:
                 if src[i] == "\n":
                     line += 1
+                    out.append("\n")  # keep line numbers addressable
                 if scala and src[i] == "/" and at(i + 1) == "*":
                     depth += 1
                     i += 2
@@ -104,7 +105,9 @@ def strip_and_check(
                     break
                 if literals is not None:
                     literals.append(src[i + 3 : end])
-                line += src.count("\n", i, end)
+                nl = src.count("\n", i, end)
+                line += nl
+                out.append('""' + "\n" * nl)  # placeholder + line fidelity
                 i = end + 3
                 continue
             interp = scala and i > 0 and (src[i - 1].isalnum() or src[i - 1] == "_")
@@ -136,6 +139,7 @@ def strip_and_check(
                 i += 1
             if not closed:
                 errors.append(f"line {start_line}: unterminated string")
+            out.append('""')  # placeholder: a literal arg must stay an arg
             flush_lit()
             continue
         if c == "'":
@@ -294,11 +298,127 @@ REQUIRED_WIRE_KEYS = {
 }
 
 
+
+
+# ---------------------------------------------------------------------------
+# host-API signature check (VERDICT r4 #7: from lexical lint toward a gate
+# that catches a wrong zipPartitions arity / a nonexistent API — the rot
+# class ADVICE r4 found in HiveUdfArrowEval)
+# ---------------------------------------------------------------------------
+
+_SIG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "spark_api_signatures.json")
+
+
+def _call_arity(code: str, open_idx: int) -> int | None:
+    """Argument count of the call whose '(' sits at open_idx, by balanced
+    top-level comma counting over comment/string-stripped code. None when
+    the paren block is unbalanced (truncated file)."""
+    depth = 0
+    args = 0
+    saw_any = False
+    i = open_idx
+    while i < len(code):
+        ch = code[i]
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                return args + 1 if saw_any else 0
+        elif depth == 1:
+            if ch == ",":
+                args += 1
+            elif not ch.isspace():
+                saw_any = True
+        i += 1
+    return None
+
+
+_STRIP_CACHE: dict[str, str] = {}
+
+
+def _stripped(path: str) -> str:
+    if path not in _STRIP_CACHE:
+        with open(path) as f:
+            raw = f.read()
+        _STRIP_CACHE[path], _ = strip_and_check(raw, path.endswith(".scala"))
+    return _STRIP_CACHE[path]
+
+
+def check_api_signatures() -> list[str]:
+    import json as _json
+
+    with open(_SIG_PATH) as f:
+        db = _json.load(f)
+    findings: list[str] = []
+    for path in jvm_sources():
+        code = _stripped(path)
+        rel = os.path.relpath(path, ROOT)
+
+        # nonexistent APIs (qualified Name.method occurrences)
+        for bad in db.get("nonexistent", ()):
+            cls, meth = bad.rsplit(".", 1)
+            if re.search(rf"\b{cls}\s*\.\s*{meth}\b", code):
+                findings.append(
+                    f"{rel}: calls {bad}, which exists in NO supported "
+                    "host-engine version (spark_api_signatures.json)"
+                )
+
+        def line_of(idx: int) -> int:
+            return code.count("\n", 0, idx) + 1
+
+        # instance/receiver method calls: .name(
+        for name, spec in db.get("methods", {}).items():
+            for m in re.finditer(
+                rf"\.\s*{name}\s*(?:\[[^\]]*\])?\s*\(", code
+            ):
+                open_idx = code.index("(", m.start())
+                n = _call_arity(code, open_idx)
+                if n is None:
+                    continue
+                allowed = set(spec["arities"])
+                if "max_with_flag" in spec:
+                    allowed.add(spec["max_with_flag"])
+                if n not in allowed:
+                    findings.append(
+                        f"{rel}:{line_of(m.start())}: .{name}() called with "
+                        f"{n} args; host API allows {sorted(allowed)}"
+                    )
+
+        # constructors: new Name(
+        for name, spec in db.get("constructors", {}).items():
+            for m in re.finditer(
+                rf"\bnew\s+(?:[\w$]+\s*\.\s*)*{name}\s*(?:\[[^\]]*\])?\s*\(", code
+            ):
+                open_idx = code.index("(", m.start())
+                n = _call_arity(code, open_idx)
+                if n is not None and n not in set(spec["arities"]):
+                    findings.append(
+                        f"{rel}:{line_of(m.start())}: new {name}(...) with "
+                        f"{n} args; host API allows {spec['arities']}"
+                    )
+
+        # statics: Name.method(
+        for qual, spec in db.get("statics", {}).items():
+            cls, meth = qual.rsplit(".", 1)
+            for m in re.finditer(rf"\b{cls}\s*\.\s*{meth}\s*\(", code):
+                open_idx = code.index("(", m.start())
+                n = _call_arity(code, open_idx)
+                if n is not None and n not in set(spec["arities"]):
+                    findings.append(
+                        f"{rel}:{line_of(m.start())}: {qual}(...) with "
+                        f"{n} args; host API allows {spec['arities']}"
+                    )
+    return findings
+
+
 def run_all() -> list[str]:
     """Every finding across all checks (empty = clean)."""
     findings: list[str] = []
     for p in jvm_sources():
         findings += lint_file(p)
+    findings += check_api_signatures()
 
     bound = bound_abi_symbols()
     declared = declared_abi_symbols()
