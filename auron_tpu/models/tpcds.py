@@ -470,39 +470,35 @@ def run_q95_class(
     api.put_resource("q95_fact", fact_parts)
     api.put_resource("q95_item", [it] * max(n_map, n_reduce))
     try:
-        # map: shuffle fact by customer
+        # map stages mirror the host engine's REAL plan: the item semi
+        # joins are BROADCAST joins pushed BELOW the customer exchange
+        # (Catalyst always plans them there), so only the ~1/n_categories
+        # surviving rows — and for the anti branch only the customer key
+        # column — cross the shuffle, not the whole fact table
         scan = B.memory_scan(fact_schema, "q95_fact")
-        part = B.hash_partitioning([col(2)], n_reduce)  # ss_customer_sk
-
-        def map_task(p: int):
-            d = os.path.join(work, f"f{p}.data")
-            i = os.path.join(work, f"f{p}.index")
-            w = B.shuffle_writer(scan, part, d, i)
-            h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
-            while api.next_batch(h) is not None:
-                pass
-            api.finalize_native(h)
-            return d, i
-
-        pairs = run_tasks_parallel([(lambda q=p: map_task(q)) for p in range(n_map)])
-        api.put_resource("q95_blocks", MultiMapBlockProvider(pairs))
-
-        # reduce: sales rows for cat-1 items (semi), minus customers with
-        # cat-2 purchases (anti), grouped per customer
-        read = B.ipc_reader(fact_schema, "q95_blocks")
         cat1 = B.filter_(B.memory_scan(it_schema, "q95_item"),
                          [BinaryOp("eq", col(2), lit(1))])
-        cat2_sales = B.hash_join(
-            read,
-            B.filter_(B.memory_scan(it_schema, "q95_item"),
-                      [BinaryOp("eq", col(2), lit(2))]),
-            [col(1)], [col(0)], "left_semi", build_side="right",
-        )
-        # customers of cat2 purchases (projected to the key)
-        bad_customers = B.project(cat2_sales, [(col(2), "c")])
-        semi = B.hash_join(read, cat1, [col(1)], [col(0)], "left_semi",
-                           build_side="right")
-        anti = B.hash_join(semi, bad_customers, [col(2)], [col(0)], "left_anti",
+        cat2 = B.filter_(B.memory_scan(it_schema, "q95_item"),
+                         [BinaryOp("eq", col(2), lit(2))])
+        semi_map = B.hash_join(scan, cat1, [col(1)], [col(0)], "left_semi",
+                               build_side="right",
+                               cached_build_id="q95_cat1_build")
+        bad_map = B.project(
+            B.hash_join(scan, cat2, [col(1)], [col(0)], "left_semi",
+                        build_side="right",
+                        cached_build_id="q95_cat2_build"),
+            [(col(2), "c")])
+        # derived, not hardcoded: the shuffled key column is the fact's
+        # customer column, whatever dtype the generator gives it
+        bad_schema = T.Schema.of(T.Field("c", fact_schema[2].dtype, True))
+
+        read = _shuffle_stage(semi_map, fact_schema, [2], n_map, n_reduce,
+                              work, "q95_blocks", 1)
+        bad_customers = _shuffle_stage(bad_map, bad_schema, [0], n_map,
+                                       n_reduce, work, "q95_bad", 1)
+
+        # reduce: co-partitioned anti join + per-customer count
+        anti = B.hash_join(read, bad_customers, [col(2)], [col(0)], "left_anti",
                            build_side="right")
         agg_p = B.hash_agg(anti, [(col(2), "customer")],
                            [("count_star", None, "cnt")], "partial")
@@ -526,7 +522,8 @@ def run_q95_class(
             return pd.DataFrame({"customer": [], "cnt": []})
         return pd.concat(frames).sort_values("customer").reset_index(drop=True)
     finally:
-        for k in ("q95_fact", "q95_item", "q95_blocks"):
+        for k in ("q95_fact", "q95_item", "q95_blocks", "q95_bad",
+                  "q95_cat1_build", "q95_cat2_build"):
             api.remove_resource(k)
 
 
@@ -1369,14 +1366,22 @@ def run_q5_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Data
         scan = B.memory_scan(fact_schema, "q5_fact")
         cheap = B.filter_(scan, [BinaryOp("lteq", col(4), lit(50.0))])
         pricey = B.filter_(scan, [BinaryOp("gt", col(4), lit(50.0))])
-        read_a = _shuffle_stage(cheap, fact_schema, [1], n_map, n_reduce,
+        # the host engine's REAL plan puts the partial aggregate BELOW the
+        # exchange (Spark always does for sum/count group-bys): each map
+        # task ships ~|items| intermediate rows, not its raw fact rows
+        p_a = B.hash_agg(cheap, [(col(1), "i")],
+                         [("count_star", None, "c"), ("sum", col(4), "s")],
+                         "partial")
+        p_b = B.hash_agg(pricey, [(col(1), "i")],
+                         [("count_star", None, "c"), ("sum", col(4), "s")],
+                         "partial")
+        inter = _agg_inter_schema(p_a)
+        read_a = _shuffle_stage(p_a, inter, [0], n_map, n_reduce,
                                 work, "q5_exA", 1)
-        read_b = _shuffle_stage(pricey, fact_schema, [1], n_map, n_reduce,
+        read_b = _shuffle_stage(p_b, inter, [0], n_map, n_reduce,
                                 work, "q5_exB", 2)
         u = B.union([read_a, read_b])
-        p = B.hash_agg(u, [(col(1), "i")],
-                       [("count_star", None, "c"), ("sum", col(4), "s")], "partial")
-        f = B.hash_agg(p, [(col(1), "i")],
+        f = B.hash_agg(u, [(col(0), "i")],
                        [("count_star", None, "c"), ("sum", col(4), "s")], "final")
         frames = []
         for fs in run_tasks_parallel(
