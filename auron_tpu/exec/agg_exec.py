@@ -245,20 +245,21 @@ class HashAggExec(ExecOperator):
     # ------------------------------------------------------------------
 
     def _dense_eligible(self) -> bool:
-        """Single small-range integer group key + simple aggregates can run
+        """Up to three small-range integer group keys + simple aggregates run
         as a DENSE direct-address table (one fused scatter-reduce per
         batch, no sort — the TPU-idiomatic analog of the reference's
         integer-keyed agg hash map, agg/agg_hash_map.rs). Range discovery
         and mid-stream fallback live in _DenseAggState.update and the
         dense block of _execute."""
-        if self.n_keys != 1 or self._has_host_aggs:
+        if not (1 <= self.n_keys <= 3) or self._has_host_aggs:
             return False
-        kt = self.inter_schema[0].dtype
-        if kt.is_dict_encoded or kt.kind not in (
-            T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32,
-            T.TypeKind.INT64, T.TypeKind.DATE32, T.TypeKind.TIMESTAMP,
-        ):
-            return False
+        for i in range(self.n_keys):
+            kt = self.inter_schema[i].dtype
+            if kt.is_dict_encoded or kt.kind not in (
+                T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32,
+                T.TypeKind.INT64, T.TypeKind.DATE32, T.TypeKind.TIMESTAMP,
+            ):
+                return False
         for (a, _), in_t in zip(self.aggs, self._agg_input_types):
             if a.func not in ("sum", "avg", "count", "count_star", "min", "max"):
                 return False
@@ -313,12 +314,20 @@ class HashAggExec(ExecOperator):
                 ctx.check_cancelled()
                 if dense is not None:
                     with ctx.metrics.timer("elapsed_compute"):
-                        if dense.update(b):
-                            continue
-                    # key range outgrew the dense limit: drain and hand
-                    # THIS batch (and the rest) to the sort-segmentation path
-                    if dense.base is not None:
-                        # rows already folded in: the skip heuristic's
+                        r = dense.update(b)
+                        if r == "restart":
+                            # ranges outgrew the anchored table: drain the
+                            # accumulated groups into the generic consumer
+                            # and re-anchor on THIS batch's union ranges
+                            drain_dense_into_table()
+                            dense.reset()
+                            r = dense.update(b)
+                    if r is True:
+                        continue
+                    # the union range can never fit: permanent fallback to
+                    # the sort-segmentation path from THIS batch on
+                    if dense.bases is not None or table.staged:
+                        # rows already folded/drained: the skip heuristic's
                         # row/group counters never saw them — keep it off
                         skipping_enabled = False
                     drain_dense_into_table()
@@ -1361,17 +1370,22 @@ def _dense_update_jit(
     segmentation — the whole per-batch aggregation is segment_* scatters
     at O(rows + size), the dense analog of the reference's integer-keyed
     agg hash map (agg/agg_hash_map.rs)."""
-    raw, funcs = cfg
+    raw, funcs, dims = cfg
     nseg = size + 1
-    idx = jnp.where(
-        sel,
-        jnp.where(
-            key_m,
-            jnp.clip(key_v.astype(jnp.int64) - base + 1, 0, size - 1).astype(jnp.int32),
+    # packed multi-dimensional slot: per key, offset 0 is that key's NULL
+    # lane and 1..dim_i-1 its value lanes; slot = sum(off_i * stride_i).
+    # Partial-null combinations land in distinct slots by construction.
+    idx = jnp.zeros(sel.shape, jnp.int32)
+    stride = 1
+    for i, (v, m) in enumerate(zip(key_v, key_m)):
+        off = jnp.where(
+            m,
+            jnp.clip(v.astype(jnp.int64) - base[i] + 1, 1, dims[i] - 1),
             0,
-        ),
-        size,
-    )
+        ).astype(jnp.int32)
+        idx = idx + off * stride
+        stride *= dims[i]
+    idx = jnp.where(sel, jnp.clip(idx, 0, size - 1), size)
     new_present = present | _seg_any(sel, idx, nseg)[:size]
     out_vals = []
     out_valids = []
@@ -1443,54 +1457,51 @@ def _dense_update_jit(
 
 
 @jax.jit
-def _dense_key_range_jit(key_v, key_m, sel):
-    """(n_live, kmin, kmax) over live valid-key rows — one tiny program."""
-    ok = sel & key_m
-    s = key_v.astype(jnp.int64)
-    n = jnp.sum(sel)
+def _dense_key_range_jit(key_vs, key_ms, sel):
+    """[n_live, min0, max0, min1, max1, ...] over live valid-key rows per
+    key column — one tiny program."""
     imax = jnp.iinfo(jnp.int64).max
     imin = jnp.iinfo(jnp.int64).min
-    kmin = jnp.min(jnp.where(ok, s, imax))
-    kmax = jnp.max(jnp.where(ok, s, imin))
-    return jnp.stack([n, kmin, kmax])
+    parts = [jnp.sum(sel).astype(jnp.int64)]
+    for v, m in zip(key_vs, key_ms):
+        ok = sel & m
+        s = v.astype(jnp.int64)
+        parts.append(jnp.min(jnp.where(ok, s, imax)))
+        parts.append(jnp.max(jnp.where(ok, s, imin)))
+    return jnp.stack(parts)
 
 
-@partial(jax.jit, static_argnames=("new_size",))
-def _dense_regrow_jit(vals, valids, present, offset, new_size: int):
-    """Move the table into a larger range: slot 0 (NULL group) stays at 0,
-    real slots shift by ``offset``."""
-
-    def grow(a, fill):
-        out = jnp.full(new_size, fill, a.dtype)
-        out = out.at[0].set(a[0])  # null slot
-        # real slots 1..n shift to 1+offset..
-        n = a.shape[0] - 1
-        return lax.dynamic_update_slice(out, a[1:], (1 + offset,)) if n else out
-
-    new_vals = tuple(grow(a, jnp.zeros((), a.dtype)) for a in vals)
-    new_valids = tuple(
-        (grow(m, False) if m is not None else None) for m in valids
-    )
-    new_present = grow(present, False)
-    return new_vals, new_valids, new_present
+def _next_pow2_agg(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
 
 
 class _DenseAggState:
-    """Dense table accumulator for HashAggExec (single int key)."""
+    """Dense table accumulator for HashAggExec (1-3 packed integer keys).
 
-    LIMIT = 1 << 21  # max real slots
+    Multi-key grouping packs per-key offsets into ONE slot index
+    (dimension strides; offset 0 per key = that key's NULL lane), so a
+    (year, item) group-by runs the same single scatter-reduce as a
+    one-key agg. Range growth drains the table into the generic consumer
+    and RESTARTS with the union ranges (amortized: ranges stabilize
+    after the first batches)."""
+
+    LIMIT = 1 << 21  # max slots (product of per-key dims)
 
     def __init__(self, exec_: "HashAggExec", ctx: ExecutionContext):
         self.name = f"dense-agg-{id(exec_):x}"
         self.exec = exec_
         self.ctx = ctx
-        self.base: int | None = None  # key value of slot 1
-        self.has_real = False  # any valid (non-null) key folded in yet
-        self.size = 0  # slots incl. null slot 0
+        self.bases: list[int] | None = None  # per-key value of offset 1
+        self.dims: tuple[int, ...] | None = None  # per-key lane count
+        self.size = 0  # bucketed product of dims
         self.vals: tuple | None = None
         self.valids: tuple | None = None
         self.present: jnp.ndarray | None = None
-        self._cfg = (
+        self._hint: list | None = None  # (mn, mx) per key across resets
+        self._base_cfg = (
             exec_.mode == PARTIAL,
             tuple(
                 (a.func, str(t)) for (a, _), t in
@@ -1498,13 +1509,27 @@ class _DenseAggState:
             ),
         )
 
+    def reset(self) -> None:
+        """Forget the table (after a drain) so the next update re-anchors.
+        The covered value range survives as a HINT: the re-anchor pads the
+        UNION of old+new ranges, so a steadily drifting key pays
+        O(log(total_span)) restarts, not one per batch."""
+        if self.bases is not None and self.dims is not None:
+            self._hint = [
+                (b + 1, b + d - 1) for b, d in zip(self.bases, self.dims)
+            ]
+        self.bases = None
+        self.dims = None
+        self.size = 0
+        self.vals = self.valids = self.present = None
+
     # -- input extraction ------------------------------------------------
 
-    def _key_and_inputs(self, b: Batch):
+    def _keys_and_inputs(self, b: Batch):
         ex = self.exec
         if ex.mode == PARTIAL:
             ev = Evaluator(ex.children[0].schema)
-            key = ev.evaluate(b, [ex.groupings[0][0]])[0]
+            keys = ev.evaluate(b, [g for g, _ in ex.groupings])
             per_agg = []
             for (a, _), in_t in zip(ex.aggs, ex._agg_input_types):
                 if a.expr is None:
@@ -1514,15 +1539,17 @@ class _DenseAggState:
                 if a.func in ("sum", "avg"):
                     cv = ev._cast(cv, sum_type(in_t))
                 per_agg.append(((cv.values, cv.validity),))
-            return key, tuple(per_agg)
-        key = ColumnVal(
-            b.col_values(0), b.col_validity(0), ex.inter_schema[0].dtype, b.dicts[0]
-        )
+            return keys, tuple(per_agg)
+        keys = [
+            ColumnVal(b.col_values(i), b.col_validity(i),
+                      ex.inter_schema[i].dtype, b.dicts[i])
+            for i in range(ex.n_keys)
+        ]
         per_agg = tuple(
             tuple((cv.values, cv.validity) for cv in grp)
-            for grp in ex._intermediate_groups(b, ofs=1)
+            for grp in ex._intermediate_groups(b)
         )
-        return key, per_agg
+        return keys, per_agg
 
     def _alloc(self, size: int) -> None:
         ex = self.exec
@@ -1546,79 +1573,96 @@ class _DenseAggState:
         self.present = jnp.zeros(size, bool)
         self.size = size
 
-    def update(self, b: Batch) -> bool:
-        """Fold one batch in; False = key range exceeds the dense limit
-        (caller drains and falls back). Table footprint is bounded by
-        LIMIT slots x field widths (<= ~100MB worst case), accounted by
-        the generic table consumer once drained."""
-        key, per_agg = self._key_and_inputs(b)
-        n, kmin, kmax = (
-            int(x) for x in
-            jax.device_get(_dense_key_range_jit(key.values, key.validity, b.device.sel))
-        )
+    def update(self, b: Batch):
+        """Fold one batch in. Returns True (folded), "restart" (this
+        batch's key ranges fall outside the anchored table: drain + reset
+        + retry — cheap and amortized, ranges stabilize fast), or False
+        (the union range can never fit LIMIT: fall back for good). Table
+        footprint is bounded by LIMIT slots x field widths, accounted as
+        an unspillable consumer."""
+        keys, per_agg = self._keys_and_inputs(b)
+        stats = [
+            int(x) for x in jax.device_get(_dense_key_range_jit(
+                tuple(k.values for k in keys),
+                tuple(k.validity for k in keys),
+                b.device.sel,
+            ))
+        ]
+        n = stats[0]
         if n == 0:
             return True
-        null_only = kmin > kmax
-        if null_only:
-            # only null-keyed rows: any anchoring works; keep a tiny table
-            kmin = kmax = self.base if self.base is not None else 0
-        if self.base is None:
-            rng = kmax - kmin + 1
-            if rng > self.LIMIT:
+        mins = stats[1::2]
+        maxs = stats[2::2]
+        if self.bases is None:
+            spans = []
+            for i, (mn, mx) in enumerate(zip(mins, maxs)):
+                if mn > mx:  # this key all-null in the batch: 1 value lane
+                    mn = mx = 0
+                if self._hint is not None:  # union with the drained range
+                    mn = min(mn, self._hint[i][0])
+                    mx = max(mx, self._hint[i][1])
+                spans.append((mn, mx - mn + 1))
+            # headroom: pad each dim to a power of two ~2x the observed
+            # span and CENTER the span in it, so drifting key ranges
+            # (time-ordered date keys) stay in-table instead of paying a
+            # drain+restart per batch; pow-2 dims keep the static-dims jit
+            # cache bounded. Shed padding largest-first when the product
+            # would blow the LIMIT; exact spans are the floor.
+            pads = [max(_next_pow2_agg(2 * (s + 1)), 4) for _, s in spans]
+            exact = [s + 1 for _, s in spans]
+            def product(ds):
+                t = 1
+                for d in ds:
+                    t *= d
+                return t
+            while product(pads) > self.LIMIT and pads != exact:
+                i = max(range(len(pads)), key=lambda i: pads[i] / exact[i])
+                pads[i] = exact[i] if pads[i] // 2 < exact[i] else pads[i] // 2
+            if product(pads) > self.LIMIT:
                 return False
-            self._alloc(bucket_capacity(rng + 1))
-            self.base = kmin
-        elif not self.has_real and not null_only:
-            # only the NULL slot holds data so far: re-anchor freely to the
-            # first real keys (a leading null-only batch must not pin the
-            # range at an arbitrary base)
-            rng = kmax - kmin + 1
-            if rng > self.LIMIT:
-                return False
-            want = bucket_capacity(rng + 1)
-            if want > self.size:
-                self.vals, self.valids, self.present = _dense_regrow_jit(
-                    self.vals, self.valids, self.present,
-                    jnp.int32(0), new_size=want,
-                )
-                self.size = want
-            self.base = kmin
-        elif kmin < self.base or kmax - self.base + 2 > self.size:
-            new_base = min(self.base, kmin)
-            new_end = max(self.base + self.size - 1, kmax + 1)
-            rng = new_end - new_base + 1
-            if rng > self.LIMIT:
-                return False
-            new_size = bucket_capacity(rng + 1)
-            offset = self.base - new_base
-            self.vals, self.valids, self.present = _dense_regrow_jit(
-                self.vals, self.valids, self.present,
-                jnp.int32(offset), new_size=new_size,
-            )
-            self.base = new_base
-            self.size = new_size
+            bases = []
+            for (mn, s), d in zip(spans, pads):
+                slack = d - (s + 1)
+                bases.append(mn - slack // 2)  # center: headroom both ways
+            self.bases = bases
+            self.dims = tuple(pads)
+            self._alloc(bucket_capacity(product(pads)))
+        else:
+            for i, (mn, mx) in enumerate(zip(mins, maxs)):
+                if mn > mx:
+                    continue  # all-null for this key: always in range
+                if mn < self.bases[i] or mx - self.bases[i] + 2 > self.dims[i]:
+                    # outgrown: caller drains this table and retries fresh
+                    return "restart"
         self.vals, self.valids, self.present = _dense_update_jit(
             self.vals, self.valids, self.present,
-            jnp.int64(self.base), key.values, key.validity, b.device.sel,
-            per_agg, cfg=self._cfg, size=self.size,
+            jnp.asarray(self.bases, jnp.int64),
+            tuple(k.values for k in keys),
+            tuple(k.validity for k in keys),
+            b.device.sel,
+            per_agg, cfg=self._base_cfg + (self.dims,), size=self.size,
         )
-        self.has_real = self.has_real or not null_only
         return True
 
     def state_batch_and_count(self) -> tuple[Batch | None, int]:
         """Materialize the table as a (sparse-sel) intermediate batch."""
-        if self.base is None or self.present is None:
+        if self.bases is None or self.present is None:
             return None, 0
         ex = self.exec
         g = int(jax.device_get(jnp.sum(self.present)))
         if g == 0:
             return None, 0
-        key_f = ex.inter_schema[0]
-        phys = key_f.dtype.physical_dtype()
-        keys = (jnp.arange(self.size, dtype=jnp.int64) + (self.base - 1)).astype(phys)
-        key_valid = self.present & (jnp.arange(self.size) > 0)
-        cols = [ColumnVal(keys, key_valid, key_f.dtype, None)]
-        for fi, f in enumerate(ex.inter_schema.fields[1:]):
+        slot = jnp.arange(self.size, dtype=jnp.int64)
+        cols = []
+        stride = 1
+        for i in range(ex.n_keys):
+            key_f = ex.inter_schema[i]
+            phys = key_f.dtype.physical_dtype()
+            coord = (slot // stride) % self.dims[i]
+            vals = (coord - 1 + self.bases[i]).astype(phys)
+            cols.append(ColumnVal(vals, self.present & (coord > 0), key_f.dtype, None))
+            stride *= self.dims[i]
+        for fi, f in enumerate(ex.inter_schema.fields[ex.n_keys:]):
             m = self.valids[fi]
             cols.append(ColumnVal(
                 self.vals[fi],

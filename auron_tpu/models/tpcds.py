@@ -495,7 +495,7 @@ def run_q95_class(
         read = _shuffle_stage(semi_map, fact_schema, [2], n_map, n_reduce,
                               work, "q95_blocks", 1)
         bad_customers = _shuffle_stage(bad_map, bad_schema, [0], n_map,
-                                       n_reduce, work, "q95_bad", 1)
+                                       n_reduce, work, "q95_bad", 2)
 
         # reduce: co-partitioned anti join + per-customer count
         anti = B.hash_join(read, bad_customers, [col(2)], [col(0)], "left_anti",
