@@ -1515,8 +1515,13 @@ class _DenseAggState:
         UNION of old+new ranges, so a steadily drifting key pays
         O(log(total_span)) restarts, not one per batch."""
         if self.bases is not None and self.dims is not None:
+            # covered VALUES are [b, b+d-2] (offset = v - b + 1; offset 0
+            # is the NULL lane); a dims==1 key was never anchored to real
+            # values — no hint for it, or a bogus range would poison the
+            # union re-anchor
             self._hint = [
-                (b + 1, b + d - 1) for b, d in zip(self.bases, self.dims)
+                ((b, b + d - 2) if d > 1 else None)
+                for b, d in zip(self.bases, self.dims)
             ]
         self.bases = None
         self.dims = None
@@ -1596,11 +1601,18 @@ class _DenseAggState:
         if self.bases is None:
             spans = []
             for i, (mn, mx) in enumerate(zip(mins, maxs)):
-                if mn > mx:  # this key all-null in the batch: 1 value lane
-                    mn = mx = 0
-                if self._hint is not None:  # union with the drained range
-                    mn = min(mn, self._hint[i][0])
-                    mx = max(mx, self._hint[i][1])
+                hint = self._hint[i] if self._hint is not None else None
+                if mn > mx:  # all-null in this batch: anchor from the hint
+                    if hint is None:
+                        # never saw a real value: NULL lane only (dim 1);
+                        # the first real value later triggers a restart
+                        # that anchors on ITS range, not a fake 0-anchor
+                        spans.append((0, 0))
+                        continue
+                    mn, mx = hint
+                elif hint is not None:  # union with the drained range
+                    mn = min(mn, hint[0])
+                    mx = max(mx, hint[1])
                 spans.append((mn, mx - mn + 1))
             # headroom: pad each dim to a power of two ~2x the observed
             # span and CENTER the span in it, so drifting key ranges
@@ -1608,7 +1620,10 @@ class _DenseAggState:
             # drain+restart per batch; pow-2 dims keep the static-dims jit
             # cache bounded. Shed padding largest-first when the product
             # would blow the LIMIT; exact spans are the floor.
-            pads = [max(_next_pow2_agg(2 * (s + 1)), 4) for _, s in spans]
+            pads = [
+                (1 if s == 0 else max(_next_pow2_agg(2 * (s + 1)), 4))
+                for _, s in spans
+            ]
             exact = [s + 1 for _, s in spans]
             def product(ds):
                 t = 1
@@ -1631,7 +1646,11 @@ class _DenseAggState:
             for i, (mn, mx) in enumerate(zip(mins, maxs)):
                 if mn > mx:
                     continue  # all-null for this key: always in range
-                if mn < self.bases[i] or mx - self.bases[i] + 2 > self.dims[i]:
+                if (
+                    self.dims[i] == 1  # NULL-lane-only key saw a real value
+                    or mn < self.bases[i]
+                    or mx - self.bases[i] + 2 > self.dims[i]
+                ):
                     # outgrown: caller drains this table and retries fresh
                     return "restart"
         self.vals, self.valids, self.present = _dense_update_jit(
