@@ -929,6 +929,18 @@ def run_tasks_parallel(fns: list) -> list:
         return list(ex.map(lambda f: f(), fns))
 
 
+def _drain_partitions_parallel(plan, n_parts, stage_id=0) -> list[pd.DataFrame]:
+    """Drain every partition of `plan` concurrently (one engine task per
+    partition, like Spark's result-stage task slots); flat frame list."""
+    frames: list[pd.DataFrame] = []
+    for fs in run_tasks_parallel(
+        [(lambda q=p: _drain_task(plan, stage_id=stage_id, partition_id=q))
+         for p in range(n_parts)]
+    ):
+        frames.extend(fs)
+    return frames
+
+
 def _shuffle_stage(plan, out_schema, key_cols, n_map, n_reduce, work, rid, stage_id=1):
     """Run `plan` as n_map map tasks hash-shuffled into files; returns the
     reduce-side ipc_reader node (the manual analog of one mesh_exchange)."""
@@ -982,12 +994,7 @@ def run_q14_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
         read2 = _shuffle_stage(p2, inter2, [0], n_reduce, n_reduce, work, "q14_ex1", 2)
         f2 = B.hash_agg(read2, [(col(0), "y")], [("count_star", None, "d_items")],
                         "final")
-        frames = []
-        for fs in run_tasks_parallel(
-            [(lambda q=p: _drain_task(f2, stage_id=3, partition_id=q))
-             for p in range(n_reduce)]
-        ):
-            frames.extend(fs)
+        frames = _drain_partitions_parallel(f2, n_reduce, stage_id=3)
         out = pd.concat(frames) if frames else pd.DataFrame({"y": [], "d_items": []})
         return out.sort_values("y").reset_index(drop=True)
     finally:
@@ -1103,11 +1110,7 @@ def run_q48_class(data: TpcdsData, n_map=2) -> pd.DataFrame:
         f = B.hash_agg(p, [(col(0), "y")],
                        [("sum", col(1), "cheap_s"), ("sum", col(2), "all_s")],
                        "final")
-        frames = []
-        for fs in run_tasks_parallel(
-            [(lambda q=p_i: _drain_task(f, partition_id=q)) for p_i in range(n_map)]
-        ):
-            frames.extend(fs)
+        frames = _drain_partitions_parallel(f, n_map)
         out = pd.concat(frames)
         out = (out.groupby("y").agg(cheap_s=("cheap_s", "sum"),
                                     all_s=("all_s", "sum")).reset_index())
@@ -1289,12 +1292,7 @@ def run_q16_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
                            build_side="right")
         p = B.hash_agg(anti, [], [("count_star", None, "c")], "partial")
         f = B.hash_agg(p, [], [("count_star", None, "c")], "final")
-        frames = []
-        for fs in run_tasks_parallel(
-            [(lambda q=pi: _drain_task(f, stage_id=2, partition_id=q))
-             for pi in range(n_reduce)]
-        ):
-            frames.extend(fs)
+        frames = _drain_partitions_parallel(f, n_reduce, stage_id=2)
         out = pd.concat(frames)
         return pd.DataFrame({"c": [np.int64(out["c"].sum())]})
     finally:
@@ -1331,12 +1329,7 @@ def run_q65_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
         j = B.hash_join(fin_a, fin_b, [col(0)], [col(0)], "inner",
                         build_side="right")
         flt = B.filter_(j, [BinaryOp("gt", col(3), BinaryOp("mul", col(1), lit(2.0)))])
-        frames = []
-        for fs in run_tasks_parallel(
-            [(lambda q=pi: _drain_task(flt, stage_id=3, partition_id=q))
-             for pi in range(n_reduce)]
-        ):
-            frames.extend(fs)
+        frames = _drain_partitions_parallel(flt, n_reduce, stage_id=3)
         cols = ["i", "a", "i2", "m"]
         out = (pd.concat(frames) if frames else
                pd.DataFrame(columns=cols))
@@ -1383,12 +1376,7 @@ def run_q5_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Data
         u = B.union([read_a, read_b])
         f = B.hash_agg(u, [(col(0), "i")],
                        [("count_star", None, "c"), ("sum", col(4), "s")], "final")
-        frames = []
-        for fs in run_tasks_parallel(
-            [(lambda q=pi: _drain_task(f, stage_id=3, partition_id=q))
-             for pi in range(n_reduce)]
-        ):
-            frames.extend(fs)
+        frames = _drain_partitions_parallel(f, n_reduce, stage_id=3)
         out = pd.concat(frames)
         return out.sort_values("i").reset_index(drop=True)
     finally:
@@ -1592,9 +1580,7 @@ def run_q93_class(data: TpcdsData, n_map=2, n_reduce=3, work_dir=None) -> pd.Dat
         f = B.hash_agg(p, [(col(0), "k_null")],
                        [("count_star", None, "rows"), ("count", col(1), "matched"),
                         ("sum", col(2), "s")], "final")
-        frames = []
-        for part in range(n_reduce):
-            frames.extend(_drain_task(f, stage_id=2, partition_id=part))
+        frames = _drain_partitions_parallel(f, n_reduce, stage_id=2)
         out = pd.concat(frames)
         out = (out.groupby("k_null", dropna=False)
                .agg(rows=("rows", "sum"), matched=("matched", "sum"),
