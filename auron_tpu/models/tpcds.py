@@ -114,11 +114,9 @@ def to_batches(df: pd.DataFrame, n_partitions: int, batch_rows: int = 1 << 20) -
     for p in range(n_partitions):
         chunk = df.iloc[p * per : (p + 1) * per]
         bs = [
-            Batch.from_arrow(
-                pa.RecordBatch.from_pandas(chunk.iloc[i : i + batch_rows], preserve_index=False)
-            )
+            Batch.from_pandas(chunk.iloc[i : i + batch_rows])
             for i in range(0, len(chunk), batch_rows)
-        ] or [Batch.from_arrow(pa.RecordBatch.from_pandas(chunk, preserve_index=False))]
+        ] or [Batch.from_pandas(chunk)]
         parts.append(bs)
     return parts
 
@@ -134,7 +132,7 @@ def run_q1_class(data: TpcdsData, n_partitions: int = 4, year: int = 2000) -> pd
     fact_schema = _schema_of(data.store_sales)
     dd_schema = _schema_of(data.date_dim)
     fact_parts = to_batches(data.store_sales, n_partitions)
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
 
     api.put_resource("q1_fact", fact_parts)
     api.put_resource("q1_dd", [dd] * n_partitions)
@@ -209,8 +207,8 @@ def ingest_q3(data: TpcdsData, n_map: int, batch_rows: int | None = None) -> dic
         fact_parts = to_batches(data.store_sales, n_map)
     else:
         fact_parts = to_batches(data.store_sales, n_map, batch_rows=batch_rows)
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
+    it = [Batch.from_pandas(data.item)]
     for p in fact_parts:
         for b in p:
             jax.block_until_ready(b.device)
@@ -466,7 +464,7 @@ def run_q95_class(
     it_schema = _schema_of(data.item)
 
     fact_parts = to_batches(data.store_sales, n_map)
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    it = [Batch.from_pandas(data.item)]
     api.put_resource("q95_fact", fact_parts)
     api.put_resource("q95_item", [it] * max(n_map, n_reduce))
     try:
@@ -615,8 +613,8 @@ def run_q6_class(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
     dd_schema = _schema_of(data.date_dim)
     it_schema = _schema_of(data.item)
     fact_parts = to_batches(data.store_sales, n_partitions)
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
+    it = [Batch.from_pandas(data.item)]
 
     api.put_resource("q6_fact", fact_parts)
     api.put_resource("q6_dd", [dd] * n_partitions)
@@ -722,8 +720,8 @@ def run_q18_class(
     dd_schema = _schema_of(data.date_dim)
     it_schema = _schema_of(data.item)
     fact_parts = to_batches(data.store_sales, n_map)
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
+    it = [Batch.from_pandas(data.item)]
     api.put_resource("q18_fact", fact_parts)
     api.put_resource("q18_dd", [dd] * n_map)
     api.put_resource("q18_item", [it] * n_map)
@@ -803,7 +801,7 @@ def run_generate_class(data: TpcdsData) -> pd.DataFrame:
     from auron_tpu.exprs.ir import ScalarFunc
 
     it_schema = _schema_of(data.item)
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    it = [Batch.from_pandas(data.item)]
     api.put_resource("qg_item", [it])
     try:
         scan = B.memory_scan(it_schema, "qg_item")
@@ -849,8 +847,7 @@ def run_windowed2_class(data: TpcdsData) -> pd.DataFrame:
         ["ss_item_sk", "ss_sold_date_sk"]
     ).reset_index(drop=True)
     fact_schema = _schema_of(sample)
-    api.put_resource("qw2_fact", [[Batch.from_arrow(
-        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    api.put_resource("qw2_fact", [[Batch.from_pandas(sample)]])
     try:
         w = B.window(
             B.memory_scan(fact_schema, "qw2_fact"),
@@ -974,7 +971,7 @@ def run_q14_class(data: TpcdsData, n_map=2, n_reduce=2, work_dir=None) -> pd.Dat
     fact_schema = _schema_of(data.store_sales)
     dd_schema = _schema_of(data.date_dim)
     api.put_resource("q14_fact", to_batches(data.store_sales, n_map))
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
     api.put_resource("q14_dd", [dd] * max(n_map, n_reduce))
     try:
         scan = B.memory_scan(fact_schema, "q14_fact")
@@ -1018,8 +1015,7 @@ def run_q67_class(data: TpcdsData) -> pd.DataFrame:
 
     sample = data.store_sales.iloc[:3000]
     fact_schema = _schema_of(sample)
-    api.put_resource("q67_fact", [[Batch.from_arrow(
-        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    api.put_resource("q67_fact", [[Batch.from_pandas(sample)]])
     try:
         scan = B.memory_scan(fact_schema, "q67_fact")
         null_i64 = Literal(None, T.INT64)
@@ -1096,7 +1092,7 @@ def run_q48_class(data: TpcdsData, n_map=2) -> pd.DataFrame:
     fact_schema = _schema_of(data.store_sales)
     dd_schema = _schema_of(data.date_dim)
     api.put_resource("q48_fact", to_batches(data.store_sales, n_map))
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
     api.put_resource("q48_dd", [dd] * n_map)
     try:
         j = B.hash_join(B.memory_scan(fact_schema, "q48_fact"),
@@ -1170,7 +1166,7 @@ def run_q37_class(data: TpcdsData) -> pd.DataFrame:
     fact_schema = _schema_of(data.store_sales)
     it_schema = _schema_of(data.item)
     api.put_resource("q37_fact", to_batches(data.store_sales, 1))
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    it = [Batch.from_pandas(data.item)]
     api.put_resource("q37_item", [it])
     try:
         cats = In(col(2), tuple(Literal(v, T.INT32) for v in (1, 2, 3)))
@@ -1200,9 +1196,8 @@ def run_q51_class(data: TpcdsData) -> pd.DataFrame:
     sample = data.store_sales.iloc[:6000]
     fact_schema = _schema_of(sample)
     dd_schema = _schema_of(data.date_dim)
-    api.put_resource("q51_fact", [[Batch.from_arrow(
-        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    api.put_resource("q51_fact", [[Batch.from_pandas(sample)]])
+    dd = [Batch.from_pandas(data.date_dim)]
     api.put_resource("q51_dd", [dd])
     try:
         j = B.hash_join(B.memory_scan(fact_schema, "q51_fact"),
@@ -1239,7 +1234,7 @@ def run_q23_class(data: TpcdsData) -> pd.DataFrame:
     fact_schema = _schema_of(data.store_sales)
     it_schema = _schema_of(data.item)
     api.put_resource("q23_fact", to_batches(data.store_sales, 1))
-    it = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.item, preserve_index=False))]
+    it = [Batch.from_pandas(data.item)]
     api.put_resource("q23_item", [it])
     try:
         j = B.hash_join(B.memory_scan(fact_schema, "q23_fact"),
@@ -1438,7 +1433,7 @@ def run_q14b_class(data: TpcdsData) -> pd.DataFrame:
     fact_schema = _schema_of(data.store_sales)
     dd_schema = _schema_of(data.date_dim)
     api.put_resource("q14b_fact", to_batches(data.store_sales, 1))
-    dd = [Batch.from_arrow(pa.RecordBatch.from_pandas(data.date_dim, preserve_index=False))]
+    dd = [Batch.from_pandas(data.date_dim)]
     api.put_resource("q14b_dd", [dd])
     try:
         from auron_tpu.exprs.ir import Literal
@@ -1495,8 +1490,7 @@ def run_q67b_class(data: TpcdsData) -> pd.DataFrame:
 
     sample = data.store_sales.iloc[:2500]
     fact_schema = _schema_of(sample)
-    api.put_resource("q67b_fact", [[Batch.from_arrow(
-        pa.RecordBatch.from_pandas(sample, preserve_index=False))]])
+    api.put_resource("q67b_fact", [[Batch.from_pandas(sample)]])
     try:
         scan = B.memory_scan(fact_schema, "q67b_fact")
         null_i64 = Literal(None, T.INT64)
@@ -1557,7 +1551,7 @@ def run_q93_class(data: TpcdsData, n_map=2, n_reduce=3, work_dir=None) -> pd.Dat
         "c_customer_sk": np.arange(1, 5001, dtype=np.int64),
         "c_band": (np.arange(1, 5001, dtype=np.int64) % 5),
     })
-    cu = [Batch.from_arrow(pa.RecordBatch.from_pandas(cust, preserve_index=False))]
+    cu = [Batch.from_pandas(cust)]
     api.put_resource("q93_cust", [cu] * n_reduce)
     cu_schema = _schema_of(cust)
     try:

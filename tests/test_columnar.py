@@ -113,3 +113,24 @@ def test_large_batch_bucketing():
     assert b.capacity == 16384
     assert b.num_rows() == n
     assert b.to_arrow().column("x").to_pylist() == list(range(n))
+
+
+def test_from_pandas_edge_ingest():
+    """Direct pandas ingest: masked ints, NaN floats, object columns with a
+    leading null, and NaT timestamps must all round-trip with exact
+    validity (regression for the no-Arrow fast path)."""
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "s": pd.Series([None, "b", None, "d"], dtype=object),
+        "i": pd.Series([1, None, 3, None], dtype="Int64"),
+        "f": np.array([1.5, np.nan, 2.5, np.nan]),
+        "o": pd.Series([1.0, None, 3.0, np.nan], dtype=object),
+        "t": pd.to_datetime(["2020-01-01", None, "2021-06-05", None]),
+    })
+    out = Batch.from_pandas(df).to_arrow().to_pydict()
+    assert out["s"] == [None, "b", None, "d"]
+    assert out["i"] == [1, None, 3, None]
+    assert out["f"][0] == 1.5 and out["f"][1] is None and out["f"][3] is None
+    assert out["o"][0] == 1.0 and out["o"][1] is None and out["o"][3] is None
+    assert out["t"][1] is None and str(out["t"][2]).startswith("2021-06-05")
