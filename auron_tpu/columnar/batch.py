@@ -204,8 +204,15 @@ class Batch:
 
     # ---- materialization ----
 
-    def to_arrow(self, compact: bool = True) -> pa.RecordBatch:
-        """Pull to host as an Arrow RecordBatch (live rows only)."""
+    def to_arrow(self, compact: bool = True,
+                 preserve_dicts: bool = False) -> pa.RecordBatch:
+        """Pull to host as an Arrow RecordBatch (live rows only).
+
+        ``preserve_dicts=True`` keeps dict-encoded columns as Arrow
+        DictionaryArrays (codes + one dictionary) instead of materializing
+        values per row — the engine-to-engine interchange mode used by
+        shuffle/spill, where the reader re-ingests codes directly. The
+        default materializes, for external consumers (JVM sink, pandas)."""
         dev = jax.device_get(self.device)  # one transfer for the whole pytree
         sel = np.asarray(dev.sel)
         idx = np.nonzero(sel)[0] if compact else np.arange(self.capacity)
@@ -213,7 +220,13 @@ class Batch:
         for i, f in enumerate(self.schema):
             vals = np.asarray(dev.values[i])[idx]
             mask = np.asarray(dev.validity[i])[idx]
-            arrays.append(_device_to_arrow(vals, mask, f.dtype, self.dicts[i]))
+            arrays.append(_device_to_arrow(vals, mask, f.dtype, self.dicts[i],
+                                           preserve_dicts=preserve_dicts))
+        if preserve_dicts:
+            # array types may be dictionary<...> where the declared schema
+            # says the logical value type; let Arrow carry the actual types
+            return pa.RecordBatch.from_arrays(
+                arrays, names=[f.name for f in self.schema])
         return pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
 
     def to_pydict(self) -> dict:
@@ -377,11 +390,22 @@ def _decimal_from_unscaled(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType
 
 
 def _device_to_arrow(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType,
-                     d: pa.Array | None) -> pa.Array:
+                     d: pa.Array | None, preserve_dicts: bool = False) -> pa.Array:
     k = dtype.kind
     if dtype.is_dict_encoded:
         assert d is not None
         codes = np.where(mask, vals, 0).astype(np.int32)
+        if (preserve_dicts
+                and k not in (T.TypeKind.LIST, T.TypeKind.MAP,
+                              T.TypeKind.STRUCT)
+                and len(d) <= 4096):
+            # preserve only SMALL dictionaries (group-key-like columns):
+            # every downstream per-partition slice carries the whole
+            # dictionary, so a near-unique string column would blow up
+            # staged-bytes accounting and write the dict once per slice —
+            # materializing is cheaper there
+            idx = pa.array(codes, type=pa.int32(), mask=~mask)
+            return pa.DictionaryArray.from_arrays(idx, d)
         taken = d.take(pa.array(codes, type=pa.int32()))
         if k in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT):
             pl = taken.to_pylist()

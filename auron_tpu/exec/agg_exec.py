@@ -309,115 +309,150 @@ class HashAggExec(ExecOperator):
                 mm.acquire(table, batch_nbytes(sb))
                 table.add(sb, g)
 
-        try:
-            for b in self.child_stream(0, partition, ctx):
-                ctx.check_cancelled()
-                if dense is not None:
-                    with ctx.metrics.timer("elapsed_compute"):
-                        r = dense.update(b)
-                        if r == "restart":
-                            # ranges outgrew the anchored table: drain the
-                            # accumulated groups into the generic consumer
-                            # and re-anchor on THIS batch's union ranges
-                            drain_dense_into_table()
-                            dense.reset()
-                            r = dense.update(b)
-                    if r is True:
-                        continue
+        def process_generic(b):
+            # generic (sort-segmentation) path for ONE batch; yields
+            # pass-through output in partial-agg skipping mode
+            nonlocal pending_g, pending_proxy, seen_rows, seen_groups, skipping
+            if self.mode == PARTIAL:
+                # sync the live count FIRST: sparse batches (post-filter/
+                # join output still at input capacity) are compacted
+                # before the O(cap log cap) sort-segmentation — grouping
+                # cost follows live rows, not the capacity bucket.
+                # The previous batch's group count rides the same
+                # transfer (its reduce has completed by now), so steady
+                # state pays ONE host round-trip per batch.
+                if pending_g is None:
+                    n = int(jax.device_get(b.device.num_rows()))
+                else:
+                    n, gp = (
+                        int(x)
+                        for x in jax.device_get(
+                            (b.device.num_rows(), pending_g)
+                        )
+                    )
+                    seen_groups += gp
+                    # replace the previous batch's staged-rows proxy with
+                    # its exact group count, so low-cardinality aggs don't
+                    # cross the merge threshold on inflated estimates
+                    table.adjust_staged(gp - pending_proxy)
+                    # groups live in a valid prefix: shrink the staged
+                    # intermediate to its group bucket so the eventual
+                    # merge concat scales with GROUPS, not input
+                    # capacity (low-cardinality aggs were paying a
+                    # full-capacity concat per staged batch)
+                    table.shrink_last(bucket_capacity(max(gp, 1)))
+                    pending_g = None
+                if n == 0:
+                    return
+                if 4 * n <= b.capacity:
+                    from auron_tpu.columnar.batch import compact_batch
+
+                    b = compact_batch(b, bucket_capacity(n))
+                with ctx.metrics.timer("elapsed_compute"):
+                    inter = self._to_intermediate(b, ctx)
+                pending_g = inter.device.num_rows()
+                g = pending_proxy = min(n, inter.capacity)  # proxy; the
+                # exact count settles one batch later via pending_g
+            else:
+                # merge modes never compact: one combined transfer
+                with ctx.metrics.timer("elapsed_compute"):
+                    inter = self._to_intermediate(b, ctx)
+                n, g = (
+                    int(x)
+                    for x in jax.device_get(
+                        (b.device.num_rows(), inter.device.num_rows())
+                    )
+                )
+                if n == 0:
+                    return
+                # groups live in a valid prefix and g is exact here:
+                # stage at the group bucket so merge concat scales
+                # with groups, not the input capacity
+                inter = prefix_slice(inter, bucket_capacity(max(g, 1)))
+            seen_rows += n
+            if self.mode != PARTIAL:
+                seen_groups += g
+            if skipping:
+                yield inter
+                return
+            if (
+                skipping_enabled
+                and seen_rows >= skip_min_rows
+                and seen_groups >= skip_ratio * seen_rows
+                and not table.parked
+            ):
+                # high cardinality: stop accumulating, stream through
+                ctx.metrics.add("partial_agg_skipped", 1)
+                skipping = True
+                yield from table.drain()
+                yield inter
+                return
+            mm.acquire(table, batch_nbytes(inter))
+            table.add(inter, g)
+            # geometric amortization: compacting re-reduces the WHOLE
+            # state, so only do it once the staged rows rival the state
+            # size — otherwise high-cardinality aggs go quadratic in
+            # merge work (measured as the q5-class merge_time blowup)
+            if table.staged_rows >= max(merge_threshold, table.state_capacity()):
+                with ctx.metrics.timer("merge_time"):
+                    table.compact()
+                ctx.metrics.add("num_merges", 1)
+
+        def fold_dense(nb, defer: bool = True) -> list | None:
+            """Fold one batch through the dense table, driving the
+            drain/re-anchor protocol (the anchored fold is deferred: its
+            in-range flag is read when the NEXT batch arrives, so steady
+            state pays no per-batch blocking sync; defer=False resolves
+            synchronously — used at end of stream). Returns None when
+            folded, or — after a permanent fallback (dense set to None) —
+            the batches that must flow to the generic path instead."""
+            nonlocal dense, skipping_enabled
+            todo = [nb]
+            while todo:
+                cur = todo.pop(0)
+                r = dense.update(cur, defer=defer)
+                if r == "restart":
+                    # ranges outgrew the anchored table: drain the
+                    # accumulated groups into the generic consumer and
+                    # re-anchor on the failed batches' union ranges
+                    drain_dense_into_table()
+                    todo = dense.reset_with_retry() + [cur] + todo
+                elif r is False:
                     # the union range can never fit: permanent fallback to
-                    # the sort-segmentation path from THIS batch on
+                    # the sort-segmentation path from this batch on
                     if dense.bases is not None or table.staged:
                         # rows already folded/drained: the skip heuristic's
                         # row/group counters never saw them — keep it off
                         skipping_enabled = False
                     drain_dense_into_table()
+                    left = dense.take_retry() + [cur] + todo
                     mm.unregister(dense)
                     dense.release(mm)
                     dense = None
-                if self.mode == PARTIAL:
-                    # sync the live count FIRST: sparse batches (post-filter/
-                    # join output still at input capacity) are compacted
-                    # before the O(cap log cap) sort-segmentation — grouping
-                    # cost follows live rows, not the capacity bucket.
-                    # The previous batch's group count rides the same
-                    # transfer (its reduce has completed by now), so steady
-                    # state pays ONE host round-trip per batch.
-                    if pending_g is None:
-                        n = int(jax.device_get(b.device.num_rows()))
-                    else:
-                        n, gp = (
-                            int(x)
-                            for x in jax.device_get(
-                                (b.device.num_rows(), pending_g)
-                            )
-                        )
-                        seen_groups += gp
-                        # replace the previous batch's staged-rows proxy with
-                        # its exact group count, so low-cardinality aggs don't
-                        # cross the merge threshold on inflated estimates
-                        table.adjust_staged(gp - pending_proxy)
-                        # groups live in a valid prefix: shrink the staged
-                        # intermediate to its group bucket so the eventual
-                        # merge concat scales with GROUPS, not input
-                        # capacity (low-cardinality aggs were paying a
-                        # full-capacity concat per staged batch)
-                        table.shrink_last(bucket_capacity(max(gp, 1)))
-                        pending_g = None
-                    if n == 0:
-                        continue
-                    if 4 * n <= b.capacity:
-                        from auron_tpu.columnar.batch import compact_batch
+                    return left
+            return None
 
-                        b = compact_batch(b, bucket_capacity(n))
+        try:
+            for b in self.child_stream(0, partition, ctx):
+                ctx.check_cancelled()
+                if dense is not None:
                     with ctx.metrics.timer("elapsed_compute"):
-                        inter = self._to_intermediate(b, ctx)
-                    pending_g = inter.device.num_rows()
-                    g = pending_proxy = min(n, inter.capacity)  # proxy; the
-                    # exact count settles one batch later via pending_g
-                else:
-                    # merge modes never compact: one combined transfer
-                    with ctx.metrics.timer("elapsed_compute"):
-                        inter = self._to_intermediate(b, ctx)
-                    n, g = (
-                        int(x)
-                        for x in jax.device_get(
-                            (b.device.num_rows(), inter.device.num_rows())
-                        )
-                    )
-                    if n == 0:
+                        leftovers = fold_dense(b)
+                    if leftovers is None:
                         continue
-                    # groups live in a valid prefix and g is exact here:
-                    # stage at the group bucket so merge concat scales
-                    # with groups, not the input capacity
-                    inter = prefix_slice(inter, bucket_capacity(max(g, 1)))
-                seen_rows += n
-                if self.mode != PARTIAL:
-                    seen_groups += g
-                if skipping:
-                    yield inter
+                    for nb in leftovers:
+                        yield from process_generic(nb)
                     continue
-                if (
-                    skipping_enabled
-                    and seen_rows >= skip_min_rows
-                    and seen_groups >= skip_ratio * seen_rows
-                    and not table.parked
-                ):
-                    # high cardinality: stop accumulating, stream through
-                    ctx.metrics.add("partial_agg_skipped", 1)
-                    skipping = True
-                    yield from table.drain()
-                    yield inter
-                    continue
-                mm.acquire(table, batch_nbytes(inter))
-                table.add(inter, g)
-                # geometric amortization: compacting re-reduces the WHOLE
-                # state, so only do it once the staged rows rival the state
-                # size — otherwise high-cardinality aggs go quadratic in
-                # merge work (measured as the q5-class merge_time blowup)
-                if table.staged_rows >= max(merge_threshold, table.state_capacity()):
-                    with ctx.metrics.timer("merge_time"):
-                        table.compact()
-                    ctx.metrics.add("num_merges", 1)
+                yield from process_generic(b)
+            # end of stream: resolve the in-flight deferred dense fold via
+            # the same protocol, synchronously (there is no next batch to
+            # piggyback the flag read on)
+            if dense is not None:
+                for nb in dense.finish_pending():
+                    with ctx.metrics.timer("elapsed_compute"):
+                        leftovers = fold_dense(nb, defer=False)
+                    for gb in leftovers or ():
+                        yield from process_generic(gb)
         finally:
             if dense is not None:
                 drain_dense_into_table()
@@ -987,7 +1022,7 @@ class _AggTableConsumer:
                 self.compact()
                 if self.state is not None:
                     ds = make_spill()
-                    ds.write_table(self.state.to_arrow())
+                    ds.write_table(self.state.to_arrow(preserve_dicts=True))
                     self.parked.append(ds)
             self.ctx.metrics.add("spilled_aggs", 1)
             self.state = None
@@ -1360,7 +1395,7 @@ def _seg_any(flags, ids, nseg):
 
 @partial(jax.jit, static_argnames=("cfg", "size"), donate_argnums=(0, 1, 2))
 def _dense_update_jit(
-    state_vals, state_valids, present, base, key_v, key_m, sel, agg_ins,
+    state_vals, state_valids, present, base, hi, key_v, key_m, sel, agg_ins,
     *, cfg, size: int,
 ):
     """ONE fused scatter-reduce folding a batch into the dense table.
@@ -1372,6 +1407,31 @@ def _dense_update_jit(
     agg hash map (agg/agg_hash_map.rs)."""
     raw, funcs, dims = cfg
     nseg = size + 1
+    # in-table guard, fused with the fold: if ANY live key falls outside
+    # the anchored ranges every row routes to the drop segment (all-or-
+    # nothing no-op) and the returned flag tells the host to drain +
+    # re-anchor + retry this batch — the host never has to sync a
+    # range-check BEFORE issuing the fold, so the steady-state pipeline
+    # has no per-batch blocking round-trip.
+    imax = jnp.iinfo(jnp.int64).max
+    imin = jnp.iinfo(jnp.int64).min
+    okall = jnp.ones((), bool)
+    for i, (v, m) in enumerate(zip(key_v, key_m)):
+        okv = sel & m
+        anyval = jnp.any(okv)
+        if dims[i] == 1:
+            bad = anyval  # NULL-lane-only key saw a real value
+        else:
+            s = v.astype(jnp.int64)
+            mn = jnp.min(jnp.where(okv, s, imax))
+            mx = jnp.max(jnp.where(okv, s, imin))
+            # pure comparisons against host-computed bounds (hi = base +
+            # dims - 2 clamped to int64): device-side `mx - base + 2`
+            # would WRAP for sentinel keys near the int64 extremes and
+            # let an out-of-range row fold into a clamped slot
+            bad = anyval & ((mn < base[i]) | (mx > hi[i]))
+        okall = okall & ~bad
+    live = sel & okall
     # packed multi-dimensional slot: per key, offset 0 is that key's NULL
     # lane and 1..dim_i-1 its value lanes; slot = sum(off_i * stride_i).
     # Partial-null combinations land in distinct slots by construction.
@@ -1385,8 +1445,8 @@ def _dense_update_jit(
         ).astype(jnp.int32)
         idx = idx + off * stride
         stride *= dims[i]
-    idx = jnp.where(sel, jnp.clip(idx, 0, size - 1), size)
-    new_present = present | _seg_any(sel, idx, nseg)[:size]
+    idx = jnp.where(live, jnp.clip(idx, 0, size - 1), size)
+    new_present = present | _seg_any(live, idx, nseg)[:size]
     out_vals = []
     out_valids = []
     fi = 0
@@ -1453,7 +1513,7 @@ def _dense_update_jit(
             fi += 1
             continue
         raise AssertionError(func)
-    return tuple(out_vals), tuple(out_valids), new_present
+    return tuple(out_vals), tuple(out_valids), new_present, okall
 
 
 @jax.jit
@@ -1495,12 +1555,16 @@ class _DenseAggState:
         self.exec = exec_
         self.ctx = ctx
         self.bases: list[int] | None = None  # per-key value of offset 1
+        self._his: list[int] | None = None  # per-key covered-value max
+        self._bases_dev = self._his_dev = None  # device copies (per anchor)
         self.dims: tuple[int, ...] | None = None  # per-key lane count
         self.size = 0  # bucketed product of dims
         self.vals: tuple | None = None
         self.valids: tuple | None = None
         self.present: jnp.ndarray | None = None
         self._hint: list | None = None  # (mn, mx) per key across resets
+        self._pending: tuple | None = None  # (batch, ok-flag) fold in flight
+        self._retry: list = []  # batches whose deferred fold was a no-op
         self._base_cfg = (
             exec_.mode == PARTIAL,
             tuple(
@@ -1578,14 +1642,65 @@ class _DenseAggState:
         self.present = jnp.zeros(size, bool)
         self.size = size
 
-    def update(self, b: Batch):
-        """Fold one batch in. Returns True (folded), "restart" (this
-        batch's key ranges fall outside the anchored table: drain + reset
-        + retry — cheap and amortized, ranges stabilize fast), or False
-        (the union range can never fit LIMIT: fall back for good). Table
+    def take_retry(self) -> list:
+        """Batches whose deferred fold turned out to be a no-op (out of
+        range); they must be re-folded after drain+reset or routed to the
+        generic path."""
+        r, self._retry = self._retry, []
+        return r
+
+    def reset_with_retry(self) -> list:
+        r = self.take_retry()
+        self.reset()
+        return r
+
+    def finish_pending(self) -> list:
+        """Resolve the in-flight deferred fold; returns the batch(es) that
+        were NOT folded (empty when the fold landed)."""
+        if self._pending is None:
+            return []
+        pb, flag = self._pending
+        self._pending = None
+        if not bool(jax.device_get(flag)):
+            return [pb]
+        return []
+
+    def update(self, b: Batch, defer: bool = True):
+        """Fold one batch in. Returns True (folded, or fold in flight),
+        "restart" (key ranges fell outside the anchored table: the caller
+        drains + resets, then re-folds take_retry() + this batch), or
+        False (the union range can never fit LIMIT: fall back for good).
+
+        The anchored fold is ONE fused program that checks ranges and
+        conditionally folds (all-or-nothing), returning a flag; with
+        ``defer`` the flag is read when the NEXT batch arrives, so the
+        steady state has no blocking host round-trip per batch. Table
         footprint is bounded by LIMIT slots x field widths, accounted as
         an unspillable consumer."""
+        failed = self.finish_pending()
+        if failed:
+            self._retry = failed
+            return "restart"
         keys, per_agg = self._keys_and_inputs(b)
+        if self.bases is not None:
+            self.vals, self.valids, self.present, flag = _dense_update_jit(
+                self.vals, self.valids, self.present,
+                self._bases_dev, self._his_dev,
+                tuple(k.values for k in keys),
+                tuple(k.validity for k in keys),
+                b.device.sel,
+                per_agg, cfg=self._base_cfg + (self.dims,), size=self.size,
+            )
+            if defer:
+                self._pending = (b, flag)
+                return True
+            if not bool(jax.device_get(flag)):
+                # the fold was an all-or-nothing no-op; the CALLER re-folds
+                # this batch after drain+reset (it is NOT queued in _retry —
+                # every restart handler already re-submits the batch it
+                # passed in, and queuing it here would fold it twice)
+                return "restart"
+            return True
         stats = [
             int(x) for x in jax.device_get(_dense_key_range_jit(
                 tuple(k.values for k in keys),
@@ -1598,64 +1713,61 @@ class _DenseAggState:
             return True
         mins = stats[1::2]
         maxs = stats[2::2]
-        if self.bases is None:
-            spans = []
-            for i, (mn, mx) in enumerate(zip(mins, maxs)):
-                hint = self._hint[i] if self._hint is not None else None
-                if mn > mx:  # all-null in this batch: anchor from the hint
-                    if hint is None:
-                        # never saw a real value: NULL lane only (dim 1);
-                        # the first real value later triggers a restart
-                        # that anchors on ITS range, not a fake 0-anchor
-                        spans.append((0, 0))
-                        continue
-                    mn, mx = hint
-                elif hint is not None:  # union with the drained range
-                    mn = min(mn, hint[0])
-                    mx = max(mx, hint[1])
-                spans.append((mn, mx - mn + 1))
-            # headroom: pad each dim to a power of two ~2x the observed
-            # span and CENTER the span in it, so drifting key ranges
-            # (time-ordered date keys) stay in-table instead of paying a
-            # drain+restart per batch; pow-2 dims keep the static-dims jit
-            # cache bounded. Shed padding largest-first when the product
-            # would blow the LIMIT; exact spans are the floor.
-            pads = [
-                (1 if s == 0 else max(_next_pow2_agg(2 * (s + 1)), 4))
-                for _, s in spans
-            ]
-            exact = [s + 1 for _, s in spans]
-            def product(ds):
-                t = 1
-                for d in ds:
-                    t *= d
-                return t
-            while product(pads) > self.LIMIT and pads != exact:
-                i = max(range(len(pads)), key=lambda i: pads[i] / exact[i])
-                pads[i] = exact[i] if pads[i] // 2 < exact[i] else pads[i] // 2
-            if product(pads) > self.LIMIT:
-                return False
-            bases = []
-            for (mn, s), d in zip(spans, pads):
-                slack = d - (s + 1)
-                bases.append(mn - slack // 2)  # center: headroom both ways
-            self.bases = bases
-            self.dims = tuple(pads)
-            self._alloc(bucket_capacity(product(pads)))
-        else:
-            for i, (mn, mx) in enumerate(zip(mins, maxs)):
-                if mn > mx:
-                    continue  # all-null for this key: always in range
-                if (
-                    self.dims[i] == 1  # NULL-lane-only key saw a real value
-                    or mn < self.bases[i]
-                    or mx - self.bases[i] + 2 > self.dims[i]
-                ):
-                    # outgrown: caller drains this table and retries fresh
-                    return "restart"
-        self.vals, self.valids, self.present = _dense_update_jit(
+        spans = []
+        for i, (mn, mx) in enumerate(zip(mins, maxs)):
+            hint = self._hint[i] if self._hint is not None else None
+            if mn > mx:  # all-null in this batch: anchor from the hint
+                if hint is None:
+                    # never saw a real value: NULL lane only (dim 1);
+                    # the first real value later triggers a restart
+                    # that anchors on ITS range, not a fake 0-anchor
+                    spans.append((0, 0))
+                    continue
+                mn, mx = hint
+            elif hint is not None:  # union with the drained range
+                mn = min(mn, hint[0])
+                mx = max(mx, hint[1])
+            spans.append((mn, mx - mn + 1))
+        # headroom: pad each dim to a power of two ~2x the observed
+        # span and CENTER the span in it, so drifting key ranges
+        # (time-ordered date keys) stay in-table instead of paying a
+        # drain+restart per batch; pow-2 dims keep the static-dims jit
+        # cache bounded. Shed padding largest-first when the product
+        # would blow the LIMIT; exact spans are the floor.
+        pads = [
+            (1 if s == 0 else max(_next_pow2_agg(2 * (s + 1)), 4))
+            for _, s in spans
+        ]
+        exact = [s + 1 for _, s in spans]
+        def product(ds):
+            t = 1
+            for d in ds:
+                t *= d
+            return t
+        while product(pads) > self.LIMIT and pads != exact:
+            i = max(range(len(pads)), key=lambda i: pads[i] / exact[i])
+            pads[i] = exact[i] if pads[i] // 2 < exact[i] else pads[i] // 2
+        if product(pads) > self.LIMIT:
+            return False
+        bases = []
+        for (mn, s), d in zip(spans, pads):
+            slack = d - (s + 1)
+            # center: headroom both ways (clamped so the base stays int64
+            # even when anchoring right at the type minimum)
+            bases.append(max(mn - slack // 2, -(1 << 63)))
+        self.bases = bases
+        self.dims = tuple(pads)
+        # covered-value upper bounds for the fused guard, computed in
+        # overflow-free Python ints and clamped to int64 (see kernel note)
+        i64max = (1 << 63) - 1
+        self._his = [min(b + d - 2, i64max) for b, d in zip(bases, pads)]
+        # constant between re-anchors: upload once, reuse per batch
+        self._bases_dev = jnp.asarray(self.bases, jnp.int64)
+        self._his_dev = jnp.asarray(self._his, jnp.int64)
+        self._alloc(bucket_capacity(product(pads)))
+        self.vals, self.valids, self.present, _ = _dense_update_jit(
             self.vals, self.valids, self.present,
-            jnp.asarray(self.bases, jnp.int64),
+            self._bases_dev, self._his_dev,
             tuple(k.values for k in keys),
             tuple(k.validity for k in keys),
             b.device.sel,

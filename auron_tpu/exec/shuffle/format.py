@@ -110,3 +110,39 @@ def read_data_tag(path: str, last_offset: int) -> int | None:
         if magic == PAIR_MAGIC:
             return tag
     return None
+
+
+def align_dict_batches(batches: list) -> list:
+    """Reconcile dictionary-preserving blocks with materialized ones.
+
+    The engine preserves SMALL dictionaries across shuffle (codes + one
+    dictionary per block) but materializes large ones; a dictionary that
+    crosses the size cap mid-stream yields batches whose schemas disagree
+    on dictionary-ness for the same column. Decode the dictionary side of
+    any such column so the set can be merged into one table."""
+    if len(batches) <= 1:
+        return batches
+    first = batches[0].schema
+    if all(b.schema.equals(first) for b in batches[1:]):
+        return batches
+    n = len(first)
+    decode = [
+        i for i in range(n)
+        if len({pa.types.is_dictionary(b.schema.field(i).type)
+                for b in batches}) == 2
+    ]
+    if not decode:
+        return batches
+    out = []
+    for b in batches:
+        cols = list(b.columns)
+        changed = False
+        for i in decode:
+            if pa.types.is_dictionary(cols[i].type):
+                cols[i] = cols[i].cast(cols[i].type.value_type)
+                changed = True
+        out.append(
+            pa.RecordBatch.from_arrays(cols, names=list(first.names))
+            if changed else b
+        )
+    return out

@@ -16,7 +16,7 @@ import pyarrow as pa
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch
 from auron_tpu.exec.base import ExecOperator, ExecutionContext
-from auron_tpu.exec.shuffle.format import decode_blocks, read_index
+from auron_tpu.exec.shuffle.format import align_dict_batches, decode_blocks, read_index
 
 
 class IpcReaderExec(ExecOperator):
@@ -45,7 +45,12 @@ class IpcReaderExec(ExecOperator):
 
 
 def _combine(batches: list[pa.RecordBatch], schema: T.Schema) -> Batch:
-    tbl = pa.Table.from_batches(batches).combine_chunks()
+    tbl = pa.Table.from_batches(align_dict_batches(batches))
+    if any(pa.types.is_dictionary(f.type) for f in tbl.schema):
+        # dictionary-preserving blocks: each block carries its own dict;
+        # unify so combine_chunks can merge codes into one array
+        tbl = tbl.unify_dictionaries()
+    tbl = tbl.combine_chunks()
     rb = tbl.to_batches()[0] if tbl.num_rows else pa.RecordBatch.from_pylist([], schema=tbl.schema)
     return Batch.from_arrow(rb)
 

@@ -25,7 +25,7 @@ from jax import lax
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch, DeviceBatch
 from auron_tpu.exec.base import ExecOperator, ExecutionContext
-from auron_tpu.exec.shuffle.format import encode_block, write_index
+from auron_tpu.exec.shuffle.format import align_dict_batches, encode_block, write_index
 from auron_tpu.exec.shuffle.partitioning import Partitioning
 from auron_tpu.utils.config import SHUFFLE_COMPRESSION_TARGET_BUF_SIZE
 
@@ -154,7 +154,7 @@ class _ShuffleStaging:
         if not self.staged[pid]:
             return
         with self.ctx.metrics.timer("compress_time"):
-            blk = encode_block(pa.Table.from_batches(self.staged[pid]))
+            blk = encode_block(pa.Table.from_batches(align_dict_batches(self.staged[pid])))
         self.regions[pid].append(blk)
         self._region_bytes += len(blk)
         self.staged[pid], self.staged_bytes[pid] = [], 0
@@ -280,7 +280,7 @@ class RssShuffleWriterExec(ExecOperator):
         def flush(pid: int):
             if staged[pid]:
                 with ctx.metrics.timer("compress_time"):
-                    blk = encode_block(pa.Table.from_batches(staged[pid]))
+                    blk = encode_block(pa.Table.from_batches(align_dict_batches(staged[pid])))
                 with ctx.metrics.timer("push_time"):
                     push(pid, blk)
                 ctx.metrics.add("data_size", len(blk))
@@ -337,7 +337,7 @@ def partition_batch(
     # live rows sort to the front (dead rows got pid=n_out): pull only the
     # live prefix — sparse batches don't pay device->host bytes for padding
     clustered = prefix_slice(clustered, bucket_capacity(max(total_live, 1)))
-    rb = clustered.to_arrow(compact=False)  # one transfer; rows already clustered
+    rb = clustered.to_arrow(compact=False, preserve_dicts=True)  # one transfer; rows already clustered
     out = []
     start = 0
     for pid in range(n_out):

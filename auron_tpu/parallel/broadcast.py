@@ -29,7 +29,7 @@ def collect_ipc(op: ExecOperator, partitions: list[int] | None = None) -> list[b
     for p in parts:
         ctx = ExecutionContext(partition_id=p)
         for b in op.execute(p, ctx):
-            rb = b.to_arrow()
+            rb = b.to_arrow(preserve_dicts=True)
             if rb.num_rows:
                 blocks.append(encode_block(rb))
     return blocks

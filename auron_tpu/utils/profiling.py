@@ -26,6 +26,24 @@ class EngineCounters:
         self.compile_s = 0.0
         self.syncs = 0
         self.sync_s = 0.0
+        # per-call-site sync attribution (engine frame nearest the sync);
+        # cheap enough to keep always-on: one stack walk per *blocking* sync
+        self.sync_sites: dict[str, list] = {}
+
+    def _record_site(self, dt: float) -> None:
+        import sys as _sys
+
+        f = _sys._getframe(2)
+        site = "?"
+        while f is not None:
+            fn = f.f_code.co_filename
+            if "auron_tpu" in fn and "utils/profiling" not in fn:
+                site = f"{fn.rsplit('auron_tpu/', 1)[-1]}:{f.f_lineno}"
+                break
+            f = f.f_back
+        ent = self.sync_sites.setdefault(site, [0, 0.0])
+        ent[0] += 1
+        ent[1] += dt
 
     @classmethod
     def install(cls) -> "EngineCounters":
@@ -59,8 +77,11 @@ class EngineCounters:
                 try:
                     return orig_value.fget(arr)
                 finally:
+                    dt = time.perf_counter() - t0
                     self.syncs += 1
-                    self.sync_s += time.perf_counter() - t0
+                    self.sync_s += dt
+                    if dt > 0.001:
+                        self._record_site(dt)
 
             _ja.ArrayImpl._value = counted_value
         except Exception:
@@ -68,10 +89,20 @@ class EngineCounters:
         cls._installed = self
         return self
 
+    def reset(self) -> None:
+        """Zero all counters (e.g. after an untimed warmup run)."""
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.syncs = 0
+        self.sync_s = 0.0
+        self.sync_sites.clear()
+
     def snapshot(self) -> dict:
+        top = sorted(self.sync_sites.items(), key=lambda kv: -kv[1][1])[:10]
         return {
             "compiles": self.compiles,
             "compile_s": round(self.compile_s, 3),
             "host_syncs": self.syncs,
             "host_sync_s": round(self.sync_s, 3),
+            "sync_sites": {k: [v[0], round(v[1], 3)] for k, v in top},
         }

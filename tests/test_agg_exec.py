@@ -463,3 +463,63 @@ def test_udaf_accumulator_state_spills(tmp_path):
             assert g == pytest.approx(w, rel=1e-9)
     finally:
         MemManager.init()
+
+
+def test_dense_agg_deferred_restart_no_double_fold():
+    """Dense-table folds are deferred (flag read one batch late). A batch
+    whose keys outgrow the anchored range must fold EXACTLY once after the
+    drain+re-anchor — both mid-stream and when the growth lands on the
+    last batch (resolved at end of stream). Regression: the q88-class last
+    band was double-counted."""
+    def run(key_batches):
+        batches = [
+            Batch.from_pydict({"k": ks, "v": [1.0] * len(ks)})
+            for ks in key_batches
+        ]
+        agg = HashAggExec(
+            MemoryScanExec.single(batches),
+            [(col(0), "k")],
+            [(AggExpr("count_star", None), "c"),
+             (AggExpr("sum", col(1)), "s")],
+            "partial",
+        )
+        final = HashAggExec(
+            agg, [(col(0), "k")],
+            [(AggExpr("count_star", None), "c"), (AggExpr("sum", col(1)), "s")],
+            "final",
+        )
+        return (final.collect().to_pandas()
+                .sort_values("k").reset_index(drop=True))
+
+    # growth on the LAST batch: its restart resolves at end of stream
+    out = run([[0, 0, 1], [1, 1], [100000, 100000]])
+    assert out["k"].tolist() == [0, 1, 100000]
+    assert out["c"].tolist() == [2, 3, 2]
+    assert out["s"].tolist() == [2.0, 3.0, 2.0]
+    # growth mid-stream: restart then more in-range batches
+    out = run([[5, 5], [900000], [5, 6], [900001]])
+    assert out["k"].tolist() == [5, 6, 900000, 900001]
+    assert out["c"].tolist() == [3, 1, 1, 1]
+
+
+def test_dense_agg_sentinel_key_extremes():
+    """A key near the int64 extremes must trigger the dense table's
+    re-anchor (then permanent fallback), never fold into a clamped slot:
+    the fused guard compares against host-computed bounds instead of
+    doing device int64 arithmetic that wraps."""
+    big = (1 << 63) - 1
+    agg = HashAggExec(
+        MemoryScanExec.single([
+            Batch.from_pydict({"k": [0, 1, 2, 2]}),
+            Batch.from_pydict({"k": [big, 0]}),
+        ]),
+        [(col(0), "k")],
+        [(AggExpr("count_star", None), "c")],
+        "partial",
+    )
+    final = HashAggExec(
+        agg, [(col(0), "k")], [(AggExpr("count_star", None), "c")], "final")
+    out = (final.collect().to_pandas()
+           .sort_values("k").reset_index(drop=True))
+    assert out["k"].tolist() == [0, 1, 2, big]
+    assert out["c"].tolist() == [2, 1, 2, 1]

@@ -294,3 +294,18 @@ def test_rss_speculative_attempt_cannot_destroy_committed():
     spec.write(0, blk)
     spec.flush()
     assert len(svc.fetch("s2", 0)) == 1  # still exactly one committed copy
+
+
+def test_align_dict_batches_mixed_schema():
+    """Dictionary-preserving and materialized blocks for the same column
+    must merge (the preserve decision is per-batch dict size, so one
+    stream can produce both)."""
+    import pyarrow as pa
+
+    from auron_tpu.exec.shuffle.format import align_dict_batches
+
+    d = pa.RecordBatch.from_arrays(
+        [pa.array(["a", "b", "a"]).dictionary_encode()], names=["s"])
+    m = pa.RecordBatch.from_arrays([pa.array(["c", "a"])], names=["s"])
+    tbl = pa.Table.from_batches(align_dict_batches([d, m]))
+    assert tbl.column("s").to_pylist() == ["a", "b", "a", "c", "a"]
