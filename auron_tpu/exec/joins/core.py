@@ -91,6 +91,14 @@ class PreparedBuild:
     # multi-integer-key packing: when set, ``words`` is ONE packed uint64
     # word and probes must pack their key words with the same spec
     pack: "PackSpec | None" = None
+    # unique-run compression of a duplicate-keyed sorted build (CSR over
+    # the sorted rows): probes do ONE binary search over DISTINCT keys
+    # instead of two over all rows — the analog of the reference's one
+    # hash-map entry per distinct key (join/join_hash_map.rs)
+    uniq_words: list | None = None
+    run_starts: jnp.ndarray | None = None  # [cap+1]; run i is rows
+    # [run_starts[i], run_starts[i+1]) of the sorted build
+    n_uniq: "jnp.ndarray | int" = 0  # device scalar (never synced)
 
 
 def _key_columns(batch: Batch, key_exprs: list[ir.Expr]) -> list[ColumnVal]:
@@ -170,9 +178,8 @@ def _prepare_build_jit(key_sel, row_sel, words, values, validity, order, *,
     row_sel_s, values_s, validity_s = taken.sel, taken.values, taken.validity
     n_live_dev = jnp.sum(key_sel)
     live_sorted = jnp.arange(cap) < n_live_dev  # live rows are a prefix
-    dup = jnp.ones(cap, bool).at[0].set(False)
-    for w in sorted_words:
-        dup = dup & jnp.concatenate([jnp.zeros(1, bool), w[1:] == w[:-1]])
+    dup = jnp.concatenate(
+        [jnp.zeros(1, bool), _adjacent_all_eq(sorted_words)])
     # adjacent ALL-columns-equal, both rows live, marks a duplicate key
     has_dup = jnp.any(
         dup & live_sorted & jnp.concatenate([jnp.zeros(1, bool), live_sorted[:-1]])
@@ -204,12 +211,11 @@ def _presorted_stats_jit(sel, words):
     # lexicographic non-decreasing: at the first differing word, prev <= cur
     lt = jnp.zeros(cap - 1, bool)   # prev < cur at an earlier word
     eq = jnp.ones(cap - 1, bool)    # all earlier words equal
-    all_eq = jnp.ones(cap - 1, bool)
     for w in words:
         a, b = w[:-1], w[1:]
         lt = lt | (eq & (a < b))
         eq = eq & (a == b)
-        all_eq = all_eq & (a == b)
+    all_eq = _adjacent_all_eq(words)
     nondec = jnp.all(jnp.where(in_prefix, lt | eq, True))
     has_dup = jnp.any(in_prefix & all_eq)
     w0 = words[0]
@@ -427,6 +433,18 @@ def prepare_build(
     # fast path above, so no dense table is built here)
     n_live, has_dup_h, _, _ = (int(x) for x in jax.device_get(stats))
     unique = n_live > 0 and not has_dup_h
+    uniq_words = run_starts = None
+    n_uniq = 0
+    has_dict_key = any(v.dtype.is_dict_encoded for v in vals)
+    if not unique and n_live > 0 and not has_dict_key:
+        # dict-encoded keys re-key per probe batch (driver rebuilds the
+        # PreparedBuild on a joint vocabulary, dropping these fields), so
+        # compression would be dead work there
+        # n_uniq stays a DEVICE scalar: it only ever feeds traced probe
+        # programs, and syncing it here would block on the compression
+        uw, run_starts, n_uniq = _compress_runs_jit(
+            tuple(sorted_words), jnp.int32(n_live))
+        uniq_words = list(uw)
     return PreparedBuild(
         batch=clustered,
         words=sorted_words,
@@ -434,7 +452,85 @@ def prepare_build(
         matched=jnp.zeros(cap, bool),
         unique=unique,
         pack=pack,
+        uniq_words=uniq_words,
+        run_starts=run_starts,
+        n_uniq=n_uniq,
     )
+
+
+def _adjacent_all_eq(words):
+    """bool[cap-1]: rows (j, j+1) equal across ALL key words — the one
+    definition behind dup stats, presorted detection and run compression
+    (three hand-rolled copies of this scan had started to drift)."""
+    eq = None
+    for w in words:
+        e = w[:-1] == w[1:]
+        eq = e if eq is None else (eq & e)
+    return eq
+
+
+@jax.jit
+def _compress_runs_jit(sorted_words, n_live):
+    """Unique-run compression of a sorted duplicate-keyed build: compacted
+    distinct key words + run start offsets (CSR over the sorted rows).
+    One program at build time; every probe batch then searches the
+    distinct keys once instead of running lower+upper bounds over all
+    rows."""
+    cap = sorted_words[0].shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    live = pos < n_live
+    neq = jnp.concatenate(
+        [jnp.ones(1, bool), ~_adjacent_all_eq(sorted_words)])
+    head = live & neq
+    uid = jnp.cumsum(head.astype(jnp.int32)) - 1
+    n_uniq = jnp.where(n_live > 0, uid[jnp.maximum(n_live - 1, 0)] + 1, 0)
+    tgt = jnp.where(head, uid, cap + 1)  # cap+1: dropped by the scatters
+    starts = jnp.full(cap + 1, n_live, jnp.int32).at[tgt].set(pos, mode="drop")
+    uniq = tuple(
+        jnp.zeros(cap, w.dtype).at[tgt].set(w, mode="drop")
+        for w in sorted_words
+    )
+    return uniq, starts, n_uniq
+
+
+def _uniq_lookup(uniq_words, run_starts, n_uniq, probe_words):
+    """Traced CSR lookup shared by the pairs and mark probes: ONE binary
+    search over distinct keys -> (found, run_lo, run_hi) per probe row.
+    Keep the found/clip logic HERE only — a boundary tweak applied to one
+    probe flavor but not the other would silently diverge semi/anti
+    results from inner-join results for the same keys."""
+    u = binsearch._search(
+        list(uniq_words), list(probe_words), n_uniq, binsearch._lex_less
+    )
+    cap = uniq_words[0].shape[0]
+    ucl = jnp.clip(u, 0, cap - 1)
+    found = u < n_uniq
+    for uw, pw in zip(uniq_words, probe_words):
+        found = found & (uw[ucl] == pw)
+    lo = run_starts[ucl]
+    hi = run_starts[jnp.clip(u + 1, 0, run_starts.shape[0] - 1)]
+    return found, lo, hi
+
+
+def _covered_fold(build_matched, hit, lo, hi):
+    """Fold probe-hit build-row ranges into ``matched`` via one
+    diff/cumsum pass (shared by both no-pairs probe flavors)."""
+    bcap = build_matched.shape[0]
+    starts = jnp.where(hit, lo, bcap)
+    stops = jnp.where(hit, hi, bcap)
+    diff = jnp.zeros(bcap + 1, jnp.int32)
+    diff = diff.at[starts].add(1, mode="drop")
+    diff = diff.at[stops].add(-1, mode="drop")
+    return build_matched | (jnp.cumsum(diff[:bcap]) > 0)
+
+
+@jax.jit
+def _uniq_ranges_jit(uniq_words, run_starts, n_uniq, probe_words, ok):
+    """(lo, count) per probe row via the shared CSR lookup."""
+    found, lo, hi = _uniq_lookup(uniq_words, run_starts, n_uniq, probe_words)
+    hit = ok & found
+    counts = jnp.where(hit, hi - lo, 0).astype(jnp.int32)
+    return jnp.where(hit, lo, 0), counts
 
 
 def _probe_unique_ops(
@@ -571,9 +667,14 @@ def _unique_join_emit_jit(
 
 
 def probe_ranges(build: PreparedBuild, probe_words, probe_valid, probe_sel):
+    ok = probe_sel & (probe_valid if probe_valid is not None else True)
+    if build.uniq_words is not None:
+        return _uniq_ranges_jit(
+            tuple(build.uniq_words), build.run_starts,
+            build.n_uniq, tuple(probe_words), ok,
+        )
     lo = binsearch.lower_bound(build.words, probe_words, build.n_live)
     hi = binsearch.upper_bound(build.words, probe_words, build.n_live)
-    ok = probe_sel & (probe_valid if probe_valid is not None else True)
     counts = jnp.where(ok, hi - lo, 0).astype(jnp.int32)
     return lo, counts
 
@@ -588,6 +689,37 @@ def _probe_exists_jit(exists_lut, base, pword, pvalid, psel):
     hit = exists_lut[jnp.clip(idx, 0, size - 1).astype(jnp.int32)]
     ok = psel & (pvalid if pvalid is not None else True)
     return ok & in_range & hit
+
+
+def probe_mark(build: PreparedBuild, probe_words, probe_valid, probe_sel,
+               need_build_delta: bool):
+    """Fused no-pairs probe (semi/anti/existence) over whichever build
+    layout exists: the CSR unique-run compression when the build has
+    duplicates (one search over distinct keys), else the two-search path."""
+    if build.uniq_words is not None:
+        return _probe_mark_uniq_jit(
+            tuple(build.uniq_words), build.run_starts, build.n_uniq,
+            build.matched, tuple(probe_words), probe_valid, probe_sel,
+            need_build_delta=need_build_delta,
+        )
+    return _probe_mark_jit(
+        tuple(build.words), jnp.int32(build.n_live), build.matched,
+        tuple(probe_words), probe_valid, probe_sel,
+        need_build_delta=need_build_delta,
+    )
+
+
+@partial(jax.jit, static_argnames=("need_build_delta",))
+def _probe_mark_uniq_jit(
+    uniq_words, run_starts, n_uniq, build_matched, probe_words, probe_valid,
+    probe_sel, *, need_build_delta: bool,
+):
+    ok = probe_sel & (probe_valid if probe_valid is not None else True)
+    found, lo, hi = _uniq_lookup(uniq_words, run_starts, n_uniq, probe_words)
+    probe_matched = ok & found
+    if not need_build_delta:
+        return probe_matched, build_matched
+    return probe_matched, _covered_fold(build_matched, probe_matched, lo, hi)
 
 
 @partial(jax.jit, static_argnames=("need_build_delta",))

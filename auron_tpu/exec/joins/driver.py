@@ -176,9 +176,8 @@ class EquiJoinDriver:
         else:
             chunks = []
             # one fused program: search + probe flags + build-mark fold
-            probe_matched, build.matched = core._probe_mark_jit(
-                tuple(build.words), jnp.int32(build.n_live), build.matched,
-                tuple(pwords), pvalid, pb.device.sel,
+            probe_matched, build.matched = core.probe_mark(
+                build, pwords, pvalid, pb.device.sel,
                 need_build_delta=self.build_mark or self.build_outer,
             )
         if orig_build is not build:
