@@ -52,7 +52,11 @@ EXISTENCE = "existence"
 
 JOIN_TYPES = (INNER, LEFT, RIGHT, FULL, LEFT_SEMI, LEFT_ANTI, EXISTENCE)
 
-_EXPAND_CHUNK = 1 << 18  # pair slots per emitted chunk
+# pair slots per emitted chunk: large enough that per-chunk dispatch +
+# deferred-agg flag reads amortize (a q72-scale expansion emits hundreds
+# of millions of pairs; 256k chunks meant ~1300 chunk round-trips), small
+# enough that a chunk's gathered columns stay modest (~8 MB/column)
+_EXPAND_CHUNK = 1 << 20
 
 
 def join_output_schema(

@@ -64,6 +64,7 @@ class EquiJoinDriver:
         self.join_type = join_type
         self.build_side = build_side
         self.condition = condition
+        self._cond_reduced = None  # lazy (schema, expr, assemble) cache
         self.exists_col = exists_col
         full_schema = core.join_output_schema(
             left_schema, right_schema, join_type, exists_col
@@ -157,8 +158,10 @@ class EquiJoinDriver:
 
         condition = None
         if self.condition is not None:
-            comb = core.join_output_schema(self.left_schema, self.right_schema, INNER)
-            condition = (comb, self.condition, self._assemble_pairs_batch)
+            if self._cond_reduced is None:
+                # depends only on immutable driver state: compute once
+                self._cond_reduced = self._reduced_condition()
+            condition = self._cond_reduced
 
         need_pairs = self.wants_pairs or condition is not None
         if need_pairs:
@@ -400,6 +403,51 @@ class EquiJoinDriver:
                 yield self._finish_batch(cols, bb.device.sel)
 
     # ------------------------------------------------------------------
+
+    def _reduced_condition(self):
+        """(schema, expr, assemble) for residual-condition evaluation over
+        ONLY the columns the condition references: expansion chunks used
+        to assemble the FULL combined schema just to evaluate a 2-4 column
+        predicate, gathering every pair column twice (once here, once at
+        emit) — a measured q72-class sink."""
+        comb = core.join_output_schema(self.left_schema, self.right_schema, INNER)
+        refs = sorted({
+            c.index for c in ir.walk(self.condition)
+            if isinstance(c, ir.Column)
+        })
+        expr = ir.remap_columns(
+            self.condition, {old: new for new, old in enumerate(refs)})
+        sub_schema = T.Schema(tuple(comb.fields[r] for r in refs))
+        nl = len(self.left_schema)
+        side_col = [
+            ((r < nl) == self.probe_is_left, r if r < nl else r - nl)
+            for r in refs
+        ]
+        pcols = [c for onp, c in side_col if onp]
+        bcols = [c for onp, c in side_col if not onp]
+
+        def assemble(probe_b, build_b, li, ri, ok) -> Batch:
+            pv, pm, bv, bm = core.gather_pair_arrays(
+                tuple(probe_b.col_values(c) for c in pcols),
+                tuple(probe_b.col_validity(c) for c in pcols),
+                tuple(build_b.col_values(c) for c in bcols),
+                tuple(build_b.col_validity(c) for c in bcols),
+                li, ri, ok,
+            )
+            it_p, it_b = iter(zip(pv, pm)), iter(zip(bv, bm))
+            colvals = []
+            for (onp, c), r in zip(side_col, refs):
+                if onp:
+                    v, m = next(it_p)
+                    d = probe_b.dicts[c]
+                else:
+                    v, m = next(it_b)
+                    d = build_b.dicts[c]
+                colvals.append(ColumnVal(v, m, comb.fields[r].dtype, d))
+            out = batch_from_columns(colvals, [comb.names[r] for r in refs], ok)
+            return Batch(sub_schema, out.device, out.dicts)
+
+        return sub_schema, expr, assemble
 
     def _assemble_pairs_batch(self, probe_b, build_b, li, ri, ok) -> Batch:
         pv, pm, bv, bm = core.gather_pair_arrays(

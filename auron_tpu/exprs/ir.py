@@ -399,3 +399,42 @@ def lit(value: Any, dtype: T.DataType | None = None) -> Literal:
         else:
             raise TypeError(f"cannot infer literal type of {value!r}")
     return Literal(value, dtype)
+
+
+def walk(e: Expr):
+    """Pre-order traversal."""
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def remap_columns(e: Expr, mapping: dict) -> Expr:
+    """Rebuild an expression with Column indices remapped (all nodes are
+    frozen dataclasses). Used when an expression is re-bound to a reduced
+    schema containing only its referenced columns.
+
+    Containers are walked to ANY depth (Case.branches is a tuple of
+    (cond, value) tuples), so every Column that ``walk`` can reach is
+    also rewritten — the two traversals must never diverge."""
+    import dataclasses
+
+    def rebuild(v):
+        if isinstance(v, Column):
+            return Column(mapping[v.index], v.name)
+        if isinstance(v, Expr):
+            changes = {}
+            for f in dataclasses.fields(v):
+                old = getattr(v, f.name)
+                new = rebuild(old)
+                if new is not old:
+                    changes[f.name] = new
+            return dataclasses.replace(v, **changes) if changes else v
+        if isinstance(v, tuple):
+            new = tuple(rebuild(x) for x in v)
+            return v if all(a is b for a, b in zip(new, v)) else new
+        if isinstance(v, list):
+            new = [rebuild(x) for x in v]
+            return v if all(a is b for a, b in zip(new, v)) else new
+        return v
+
+    return rebuild(e)

@@ -273,3 +273,37 @@ def test_fused_chain_fallback_memo_cleared_on_completion():
         if isinstance(k, tuple) and k and str(k[0]).startswith("fusion_build_memo")
     ]
     assert leftovers == [], leftovers
+
+
+def test_condition_with_case_remaps_columns():
+    """Residual conditions evaluate over a reduced schema of only their
+    referenced columns; Columns nested inside Case.branches (tuple of
+    tuples) must be remapped too (regression: they kept combined-schema
+    indices and read the wrong column or crashed)."""
+    import jax.numpy as jnp
+
+    from auron_tpu import types as T
+    from auron_tpu.columnar import Batch
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.exec.joins.bhj import BroadcastHashJoinExec
+    from auron_tpu.exprs import ir
+
+    left = Batch.from_pydict({"k": [1, 1, 2], "a": [10, 20, 30]})
+    right = Batch.from_pydict({"k": [1, 1, 2], "b": [5, 25, 40]})
+    # CASE WHEN a > 15 THEN b < a ELSE b > a END  (refs a=col1, b=col3)
+    cond = ir.Case(
+        branches=(
+            (ir.BinaryOp("gt", ir.Column(1), ir.Literal(15, T.INT64)),
+             ir.BinaryOp("lt", ir.Column(3), ir.Column(1))),
+        ),
+        orelse=ir.BinaryOp("gt", ir.Column(3), ir.Column(1)),
+    )
+    j = BroadcastHashJoinExec(
+        MemoryScanExec.single([left]), MemoryScanExec.single([right]),
+        [ir.col(0)], [ir.col(0)], "inner", condition=cond,
+        build_side="right",
+    )
+    out = j.collect().to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+    rows = set(zip(out["a"], out["b"]))
+    # a=10 (else: b>a): (10,25); a=20 (then: b<a): (20,5); a=30: b=40 not <30
+    assert rows == {(10, 25), (20, 5)}
