@@ -216,18 +216,9 @@ class Batch:
         dev = jax.device_get(self.device)  # one transfer for the whole pytree
         sel = np.asarray(dev.sel)
         idx = np.nonzero(sel)[0] if compact else np.arange(self.capacity)
-        arrays = []
-        for i, f in enumerate(self.schema):
-            vals = np.asarray(dev.values[i])[idx]
-            mask = np.asarray(dev.validity[i])[idx]
-            arrays.append(_device_to_arrow(vals, mask, f.dtype, self.dicts[i],
-                                           preserve_dicts=preserve_dicts))
-        if preserve_dicts:
-            # array types may be dictionary<...> where the declared schema
-            # says the logical value type; let Arrow carry the actual types
-            return pa.RecordBatch.from_arrays(
-                arrays, names=[f.name for f in self.schema])
-        return pa.RecordBatch.from_arrays(arrays, schema=self.schema.to_arrow())
+        return host_rows_to_arrow(self.schema, self.dicts, dev.values,
+                                  dev.validity, idx,
+                                  preserve_dicts=preserve_dicts)
 
     def to_pydict(self) -> dict:
         return self.to_arrow().to_pydict()
@@ -265,6 +256,26 @@ def _vocab_key(v):
     if isinstance(v, dict):
         return tuple(sorted((k, _vocab_key(x)) for k, x in v.items()))
     return v
+
+
+def host_rows_to_arrow(schema: T.Schema, dicts, values, validity, idx,
+                       preserve_dicts: bool = False) -> pa.RecordBatch:
+    """Arrow RecordBatch from HOST-resident column arrays gathered at
+    ``idx`` — the shared tail of Batch.to_arrow and the shuffle writer's
+    host-clustering path (one conversion loop so preserve_dicts semantics
+    can't drift between them)."""
+    arrays = []
+    for i, f in enumerate(schema):
+        vals = np.asarray(values[i])[idx]
+        mask = np.asarray(validity[i])[idx]
+        arrays.append(_device_to_arrow(vals, mask, f.dtype, dicts[i],
+                                       preserve_dicts=preserve_dicts))
+    if preserve_dicts:
+        # array types may be dictionary<...> where the declared schema
+        # says the logical value type; let Arrow carry the actual types
+        return pa.RecordBatch.from_arrays(
+            arrays, names=[f.name for f in schema])
+    return pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
 
 
 def _seal_batch(schema, values, validity, dicts, n: int, cap: int) -> "Batch":

@@ -317,21 +317,32 @@ def partition_batch(
     pids = partitioning.partition_ids(b, ctx)
     n_out = partitioning.num_partitions
     if hostsort.use_host_sort():
-        # CPU host: stable integer argsort on host (numpy radix) beats
-        # XLA:CPU's comparator lax.sort by ~50x; the column gathers stay
-        # one fused device program. One sync (pids+sel together).
-        pids_np, sel_np = (
-            np.asarray(x) for x in jax.device_get((pids, b.device.sel))
-        )
-        sort_pid = np.where(sel_np, pids_np.astype(np.int32), n_out)
-        order = jnp.asarray(np.argsort(sort_pid, kind="stable").astype(np.int32))
-        counts_np = np.bincount(sort_pid, minlength=n_out + 1)[:n_out]
-        from auron_tpu.columnar.batch import device_take
+        # CPU host: the clustered rows are headed to HOST Arrow blocks
+        # anyway, so pull the WHOLE batch once and do everything — stable
+        # integer argsort (numpy radix), live-prefix slicing, per-column
+        # gathers — in numpy. The previous split (host argsort, device
+        # gather, second full transfer via to_arrow) paid two round trips
+        # and a capacity-sized gather program per batch; this is one
+        # transfer and live-row-count work. The device path below stays
+        # for accelerators, where the gather belongs on-device.
+        from auron_tpu.columnar.batch import host_rows_to_arrow
 
-        clustered_dev = device_take(b.device, order)
-    else:
-        clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
-        counts_np = np.asarray(jax.device_get(counts))[:n_out]
+        pids_np, dev = jax.device_get((pids, b.device))  # numpy leaves
+        sort_pid = np.where(dev.sel, pids_np.astype(np.int32), n_out)
+        counts_np = np.bincount(sort_pid, minlength=n_out + 1)[:n_out]
+        order_live = np.argsort(sort_pid, kind="stable")[: int(counts_np.sum())]
+        rb = host_rows_to_arrow(b.schema, b.dicts, dev.values, dev.validity,
+                                order_live, preserve_dicts=True)
+        out = []
+        start = 0
+        for pid in range(n_out):
+            c = int(counts_np[pid])
+            if c:
+                out.append((pid, rb.slice(start, c)))
+            start += c
+        return out
+    clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
+    counts_np = np.asarray(jax.device_get(counts))[:n_out]
     clustered = Batch(b.schema, clustered_dev, b.dicts)
     total_live = int(counts_np.sum())
     # live rows sort to the front (dead rows got pid=n_out): pull only the
