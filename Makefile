@@ -1,4 +1,16 @@
-.PHONY: proto test native jvm-compile bench
+.PHONY: proto test native jvm-compile bench lint
+
+# keep `make` (no target) regenerating the proto, as before the lint gate
+.DEFAULT_GOAL := proto
+
+# Both static gates, one uniform report schema (tools/auronlint/report.py):
+# auronlint = engine-invariant rules R1-R5 over auron_tpu/ (AST-based),
+# jvm_lint  = structural/ABI/wire-contract checks over jvm/.
+# Exit nonzero on any unsuppressed finding. Also gated in tier-1 via
+# tests/test_auronlint.py and tests/test_jvm_contract.py.
+lint:
+	JAX_PLATFORMS=cpu python -m tools.auronlint
+	python tools/jvm_lint.py
 
 proto:
 	protoc --python_out=. auron_tpu/proto/plan.proto

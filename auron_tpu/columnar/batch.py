@@ -189,7 +189,7 @@ class Batch:
 
     def num_rows(self) -> int:
         """Live row count — host sync."""
-        return int(jax.device_get(self.device.num_rows()))
+        return int(jax.device_get(self.device.num_rows()))  # auronlint: sync-point -- num_rows() IS the engine's count-read API
 
     def col_values(self, i: int) -> jnp.ndarray:
         return self.device.values[i]
@@ -213,7 +213,8 @@ class Batch:
         values per row — the engine-to-engine interchange mode used by
         shuffle/spill, where the reader re-ingests codes directly. The
         default materializes, for external consumers (JVM sink, pandas)."""
-        dev = jax.device_get(self.device)  # one transfer for the whole pytree
+        # auronlint: sync-point -- to_arrow materializes for external consumers; one transfer for the whole pytree
+        dev = jax.device_get(self.device)
         sel = np.asarray(dev.sel)
         idx = np.nonzero(sel)[0] if compact else np.arange(self.capacity)
         return host_rows_to_arrow(self.schema, self.dicts, dev.values,
@@ -398,6 +399,18 @@ def _decimal_from_unscaled(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType
     for v, m in zip(vals.tolist(), mask.tolist()):
         pydecs.append(pydec.Decimal(v).scaleb(-dtype.scale).quantize(q) if m else None)
     return pa.array(pydecs, type=pa.decimal128(dtype.precision, dtype.scale))
+
+
+def host_arrow_cols(cvs) -> list[pa.Array]:
+    """Materialize column values (ColumnVal-shaped: .values/.validity/
+    .dtype/.dict) as host arrow arrays for host-evaluation contracts
+    (UDF/UDTF fallbacks, dictionary-transforming functions) — ONE batched
+    device transfer for every column."""
+    moved = jax.device_get(tuple((cv.values, cv.validity) for cv in cvs))  # auronlint: sync-point -- host-evaluation contract; one batched transfer for all columns
+    return [
+        _device_to_arrow(np.asarray(v), np.asarray(m), cv.dtype, cv.dict)
+        for cv, (v, m) in zip(cvs, moved)
+    ]
 
 
 def _device_to_arrow(vals: np.ndarray, mask: np.ndarray, dtype: T.DataType,

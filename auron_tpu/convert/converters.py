@@ -167,8 +167,11 @@ def _range_partitioning_proto(fields, num: int, bound_rows: list) -> pb.Partitio
     ]
     import jax
 
-    words = [np.asarray(jax.device_get(w)) for w in sort_operands(keys, specs)]
-    sel = np.asarray(jax.device_get(sample.device.sel))
+    # auronlint: sync-point -- range-bound sampling at plan time (driver side, once per query); one batched transfer
+    words_d, sel_d = jax.device_get((tuple(sort_operands(keys, specs)),
+                                     sample.device.sel))
+    words = [np.asarray(w) for w in words_d]
+    sel = np.asarray(sel_d)
     live = np.nonzero(sel)[0]
     mat = np.stack([w[live] for w in words], axis=1).astype(np.uint64)
     part.range_words_per_bound = mat.shape[1]

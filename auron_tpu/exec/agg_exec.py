@@ -322,11 +322,11 @@ class HashAggExec(ExecOperator):
                 # transfer (its reduce has completed by now), so steady
                 # state pays ONE host round-trip per batch.
                 if pending_g is None:
-                    n = int(jax.device_get(b.device.num_rows()))
+                    n = int(jax.device_get(b.device.num_rows()))  # auronlint: sync-point -- first-batch live-count read (see comment above)
                 else:
                     n, gp = (
                         int(x)
-                        for x in jax.device_get(
+                        for x in jax.device_get(  # auronlint: sync-point -- steady state: ONE round-trip per batch (count + prior group count)
                             (b.device.num_rows(), pending_g)
                         )
                     )
@@ -359,7 +359,7 @@ class HashAggExec(ExecOperator):
                     inter = self._to_intermediate(b, ctx)
                 n, g = (
                     int(x)
-                    for x in jax.device_get(
+                    for x in jax.device_get(  # auronlint: sync-point -- merge modes: one combined transfer per batch
                         (b.device.num_rows(), inter.device.num_rows())
                     )
                 )
@@ -657,10 +657,10 @@ class HashAggExec(ExecOperator):
         cv = cols[0]
         sv = cv.values[order]
         sm = cv.validity[order] & seg.sel_sorted
-        ids_np = np.asarray(jax.device_get(seg.seg_ids))
-        sv_np = np.asarray(jax.device_get(sv))
-        sm_np = np.asarray(jax.device_get(sm))
-        n_groups = int(jax.device_get(seg.num_groups))
+        # auronlint: sync-point -- host UDAF accumulation is host work by contract; one batched transfer
+        ids_d, sv_d, sm_d, ng_d = jax.device_get((seg.seg_ids, sv, sm, seg.num_groups))
+        ids_np, sv_np, sm_np = np.asarray(ids_d), np.asarray(sv_d), np.asarray(sm_d)
+        n_groups = int(ng_d)
         n_slots = max(n_groups, 1)
         states: list = [None] * n_slots
         if raw:
@@ -709,10 +709,10 @@ class HashAggExec(ExecOperator):
         cv = cols[0]
         sv = cv.values[order]
         sm = cv.validity[order] & seg.sel_sorted
-        ids_np = np.asarray(jax.device_get(seg.seg_ids))
-        sv_np = np.asarray(jax.device_get(sv))
-        sm_np = np.asarray(jax.device_get(sm))
-        n_groups = int(jax.device_get(seg.num_groups))
+        # auronlint: sync-point -- collect_list/set materializes per-group python lists; one batched transfer
+        ids_d, sv_d, sm_d, ng_d = jax.device_get((seg.seg_ids, sv, sm, seg.num_groups))
+        ids_np, sv_np, sm_np = np.asarray(ids_d), np.asarray(sv_d), np.asarray(sm_d)
+        n_groups = int(ng_d)
 
         list_t = T.DataType(T.TypeKind.LIST, inner=(in_t,))
         if raw:
@@ -749,8 +749,9 @@ class HashAggExec(ExecOperator):
 
         spec = lookup_udaf(a.udaf)
         cap = int(state_cv.values.shape[0])
-        codes = np.asarray(jax.device_get(state_cv.values))
-        valid = np.asarray(jax.device_get(state_cv.validity))
+        # auronlint: sync-point -- UDAF state decode is host work by contract; one batched transfer
+        codes_d, valid_d = jax.device_get((state_cv.values, state_cv.validity))
+        codes, valid = np.asarray(codes_d), np.asarray(valid_d)
         entries = state_cv.dict.to_pylist()
         out_rows = []
         for i in range(cap):
@@ -843,8 +844,12 @@ class HashAggExec(ExecOperator):
 
         st = sum_type(in_t)
         k = _n_limbs(st.precision)
-        limbs = jax.device_get(tuple(c.values for c in cols[:k]))
-        valid = np.asarray(jax.device_get(cols[0].validity))
+        # auronlint: sync-point -- exact wide-decimal totals need python ints (host by design); one batched transfer incl. the avg count column
+        limbs, valid_d, cnt_d = jax.device_get((
+            tuple(c.values for c in cols[:k]), cols[0].validity,
+            cols[k].values if len(cols) > k else None,
+        ))
+        valid = np.asarray(valid_d)
         # exact totals: vectorized python-int accumulation over k arrays
         total = np.zeros(len(valid), dtype=object)
         base = 1
@@ -857,7 +862,7 @@ class HashAggExec(ExecOperator):
             ok = valid.copy()
         else:  # avg: exact HALF_UP division at the avg scale
             emit_t = avg_type(in_t)
-            cnt = np.asarray(jax.device_get(cols[k].values))
+            cnt = np.asarray(cnt_d)
             ok = valid & (cnt > 0)
             diff = emit_t.scale - st.scale
             num_shift = 10 ** max(diff, 0)  # pure-int shifts: a float
@@ -1661,7 +1666,7 @@ class _DenseAggState:
             return []
         pb, flag = self._pending
         self._pending = None
-        if not bool(jax.device_get(flag)):
+        if not bool(jax.device_get(flag)):  # auronlint: sync-point -- one-scalar fold-outcome read per flush
             return [pb]
         return []
 
@@ -1694,7 +1699,7 @@ class _DenseAggState:
             if defer:
                 self._pending = (b, flag)
                 return True
-            if not bool(jax.device_get(flag)):
+            if not bool(jax.device_get(flag)):  # auronlint: sync-point -- one-scalar fold-outcome read per fold
                 # the fold was an all-or-nothing no-op; the CALLER re-folds
                 # this batch after drain+reset (it is NOT queued in _retry —
                 # every restart handler already re-submits the batch it
@@ -1702,7 +1707,7 @@ class _DenseAggState:
                 return "restart"
             return True
         stats = [
-            int(x) for x in jax.device_get(_dense_key_range_jit(
+            int(x) for x in jax.device_get(_dense_key_range_jit(  # auronlint: sync-point -- dense-table eligibility stats, one fused read per batch
                 tuple(k.values for k in keys),
                 tuple(k.validity for k in keys),
                 b.device.sel,
@@ -1780,7 +1785,7 @@ class _DenseAggState:
         if self.bases is None or self.present is None:
             return None, 0
         ex = self.exec
-        g = int(jax.device_get(jnp.sum(self.present)))
+        g = int(jax.device_get(jnp.sum(self.present)))  # auronlint: sync-point -- group count read once at table emission (blocking boundary)
         if g == 0:
             return None, 0
         slot = jnp.arange(self.size, dtype=jnp.int64)

@@ -254,7 +254,7 @@ def _run_chain(
     def finish(state) -> Batch:
         pb, sel_out, bis = state
         if compact_mode:
-            sel_np = np.asarray(jax.device_get(sel_out))
+            sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point -- compaction index at the chain blocking boundary
             idx_np = np.flatnonzero(sel_np)
             n_live = int(idx_np.size)
             out_cap = bucket_capacity(max(n_live, 1))

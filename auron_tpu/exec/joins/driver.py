@@ -325,7 +325,7 @@ class EquiJoinDriver:
         # ONE transfer: the selection mask itself (it was going to sync for
         # the live count anyway; the mask is 1 byte/row and yields the
         # compaction index host-side via flatnonzero)
-        sel_np = np.asarray(jax.device_get(sel_out))
+        sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point -- compaction index at the join blocking boundary
         idx_np = np.flatnonzero(sel_np)
         n_live = int(idx_np.size)
         out_cap = bucket_capacity(max(n_live, 1))

@@ -251,8 +251,8 @@ class _SortedRun:
         self.key_words = key_words
 
     def to_host(self) -> "_HostRun":
-        dev = jax.device_get(self.batch.device)
-        words = jax.device_get(self.key_words)
+        # auronlint: sync-point -- spill tier: device->host is the operation itself; one batched transfer
+        dev, words = jax.device_get((self.batch.device, self.key_words))
         n = int(np.sum(np.asarray(dev.sel)))
         return _HostRun(
             sel=np.asarray(dev.sel),

@@ -211,7 +211,7 @@ class WindowExec(ExecOperator):
         out = batch_from_columns(cols, names, sel_sorted)
         whole = Batch(self.schema, out.device, out.dicts)
         # chunked emission like sort
-        n = int(jax.device_get(jnp.sum(sel_sorted)))
+        n = int(jax.device_get(jnp.sum(sel_sorted)))  # auronlint: sync-point -- live count for chunked emission, once per blocking window
         chunk = bucket_capacity(ctx.batch_size())
         if n <= chunk:
             yield whole
@@ -400,9 +400,12 @@ class WindowExec(ExecOperator):
             )
             return cum[jnp.clip(peer_end - 1, 0, cap - 1)] - base
 
-        limb_sums = jax.device_get(tuple(windowed(lr) for lr in limb_rows))
-        cnt = np.asarray(jax.device_get(windowed(valid.astype(jnp.int64))))
-        sel_h = np.asarray(jax.device_get(sel))
+        # auronlint: sync-point -- exact wide-decimal window sums need python ints (host by design); one batched transfer
+        limb_sums, cnt_d, sel_d = jax.device_get((
+            tuple(windowed(lr) for lr in limb_rows),
+            windowed(valid.astype(jnp.int64)), sel,
+        ))
+        cnt, sel_h = np.asarray(cnt_d), np.asarray(sel_d)
 
         total = np.zeros(cap, dtype=object)
         base = 1

@@ -150,18 +150,14 @@ class Evaluator:
 
     def _host_udf(self, e: ir.HostUDF, b: Batch, memo: dict) -> ColumnVal:
         """Materialize args to Arrow, call the bridge callback, re-ingest."""
-        import jax
-
         from auron_tpu.bridge.udf import lookup_udf
-        from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+        from auron_tpu.columnar.batch import _arrow_to_device, host_arrow_cols
 
         args = [self._eval(a, b, memo) for a in e.args]
         cap = b.capacity
-        host_args = []
-        for cv in args:
-            vals = np.asarray(jax.device_get(cv.values))
-            mask = np.asarray(jax.device_get(cv.validity))
-            host_args.append(_device_to_arrow(vals, mask, cv.dtype, cv.dict))
+        # host UDF evaluates on host by contract; host_arrow_cols makes the
+        # one batched transfer for all args
+        host_args = host_arrow_cols(args)
         result = lookup_udf(e.name)(host_args, cap)
         assert len(result) == cap, "host UDF must return one value per slot"
         v, m, d = _arrow_to_device(result, e.out_dtype, cap)
@@ -367,7 +363,7 @@ class Evaluator:
                 return None
             import jax
 
-            host = np.asarray(jax.device_get(cv.values))
+            host = np.asarray(jax.device_get(cv.values))  # auronlint: sync-point -- scalar-subquery constant probe, once per plan
             if host.size == 0 or not (host == host.flat[0]).all():
                 return None
             v = int(host.flat[0])
@@ -420,7 +416,7 @@ class Evaluator:
         import jax
 
         def host_side(cv: ColumnVal):
-            vals = np.asarray(jax.device_get(cv.values)).astype(np.int64)
+            vals = np.asarray(jax.device_get(cv.values)).astype(np.int64)  # auronlint: sync-point -- documented host-exact decimal path (one sync, O(distinct pairs))
             if cv.dtype.is_wide_decimal:
                 entries = cv.dict.to_pylist()
                 vals = np.clip(vals, 0, max(len(entries) - 1, 0))

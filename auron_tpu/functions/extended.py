@@ -38,13 +38,11 @@ def _host_rowwise(name: str, py_fn, out_dtype_fn):
 
     @registry.register(name, out_dtype_fn)
     def _f(args, cap, py_fn=py_fn):
-        from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+        from auron_tpu.columnar.batch import _arrow_to_device, host_arrow_cols
 
-        host_cols = []
-        for cv in args:
-            vals = np.asarray(jax.device_get(cv.values))
-            mask = np.asarray(jax.device_get(cv.validity))
-            host_cols.append(_device_to_arrow(vals, mask, cv.dtype, cv.dict).to_pylist())
+        # python-fallback scalar fn runs on host by contract; one batched
+        # transfer for all argument columns
+        host_cols = [a.to_pylist() for a in host_arrow_cols(args)]
         out_rows = [py_fn(*row) for row in zip(*host_cols)] if host_cols else []
         out_dt = (
             out_dtype_fn([a.dtype for a in args]) if callable(out_dtype_fn) else out_dtype_fn
@@ -581,7 +579,7 @@ def _make_array(args, cap):
     """make_array(c1, c2, ...) — Spark CreateArray (reference:
     spark_make_array.rs). NULL elements stay inside the list; the result is
     never NULL. Host-assembled into the LIST dictionary representation."""
-    from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+    from auron_tpu.columnar.batch import _arrow_to_device, host_arrow_cols
 
     if not args:
         # Spark's array() — zero elements, element type NULL
@@ -593,11 +591,9 @@ def _make_array(args, cap):
         return _cv(v, jnp.ones(cap, bool), out_dt, d)
     el_t = args[0].dtype
     out_dt = T.DataType(T.TypeKind.LIST, inner=(el_t,))
-    host_cols = []
-    for cv in args:
-        v = np.asarray(jax.device_get(cv.values))
-        m = np.asarray(jax.device_get(cv.validity))
-        host_cols.append(_device_to_arrow(v, m, cv.dtype, cv.dict).to_pylist())
+    # list construction materializes host rows (dictionary path); one
+    # batched transfer for all element columns
+    host_cols = [a.to_pylist() for a in host_arrow_cols(args)]
     rows = [list(vals) for vals in zip(*host_cols)]
     arr = pa.array(rows, type=out_dt.to_arrow())
     v, m, d = _arrow_to_device(arr, out_dt, cap)
@@ -607,7 +603,7 @@ def _make_array(args, cap):
 @registry.register("named_struct")
 def _named_struct(args, cap):
     """named_struct(name1, col1, name2, col2, ...) — names are literals."""
-    from auron_tpu.columnar.batch import _arrow_to_device, _device_to_arrow
+    from auron_tpu.columnar.batch import _arrow_to_device, host_arrow_cols
 
     names = [_scalar_arg(args[i]) for i in range(0, len(args), 2)]
     val_cvs = [args[i] for i in range(1, len(args), 2)]
@@ -616,11 +612,9 @@ def _named_struct(args, cap):
         inner=tuple(cv.dtype for cv in val_cvs),
         struct_names=tuple(names),
     )
-    host_cols = []
-    for cv in val_cvs:
-        v = np.asarray(jax.device_get(cv.values))
-        m = np.asarray(jax.device_get(cv.validity))
-        host_cols.append(_device_to_arrow(v, m, cv.dtype, cv.dict).to_pylist())
+    # struct construction materializes host rows (dictionary path); one
+    # batched transfer for all member columns
+    host_cols = [a.to_pylist() for a in host_arrow_cols(val_cvs)]
     rows = [dict(zip(names, vals)) for vals in zip(*host_cols)]
     arr = pa.array(rows, type=out_dt.to_arrow())
     v, m, d = _arrow_to_device(arr, out_dt, cap)
@@ -801,8 +795,9 @@ def _hex(args, cap):
         )
     # integral: uppercase hex of the unsigned 64-bit two's complement
     v = a.values.astype(jnp.int64)
-    host = np.asarray(jax.device_get(v)).astype(np.uint64)
-    mask = np.asarray(jax.device_get(a.validity))
+    # auronlint: sync-point -- hex formatting transforms the dictionary host-side; one batched transfer
+    host_d, mask_d = jax.device_get((v, a.validity))
+    host, mask = np.asarray(host_d).astype(np.uint64), np.asarray(mask_d)
     ss = [format(int(x), "X") for x in host]
     arr = pa.array([s if m else None for s, m in zip(ss, mask)], type=pa.string())
     from auron_tpu.columnar.batch import _arrow_to_device

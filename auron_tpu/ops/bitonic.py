@@ -109,7 +109,7 @@ def _substage(x: jnp.ndarray, flat: jnp.ndarray, R: int, k: int, j: int) -> jnp.
     )
     lt = jnp.zeros((R, _LANES), dtype=bool)
     eq = jnp.ones((R, _LANES), dtype=bool)
-    for p in range(x.shape[0]):
+    for p in range(x.shape[0]):  # auronlint: disable=R5 -- unrolled loop over packed key PLANES inside the jitted network, not rows
         a, b = x[p], partner[p]
         lt = lt | (eq & (a < b))
         eq = eq & (a == b)

@@ -139,7 +139,7 @@ def segment_by_keys(
         order = sorted_ops[-1]
 
     diff = jnp.zeros(cap, dtype=bool).at[0].set(True)
-    for w in sorted_words:
+    for w in sorted_words:  # auronlint: disable=R1 -- loop over the key-word operand tuple (column count, not rows)
         diff = diff | jnp.concatenate([jnp.ones(1, bool), w[1:] != w[:-1]])
     boundary = diff & sel_sorted
     seg_ids_live = jnp.cumsum(boundary.astype(jnp.int32)) - 1
@@ -157,9 +157,10 @@ def host_order(words: list[jnp.ndarray], sel: jnp.ndarray) -> jnp.ndarray:
     stable). Call OUTSIDE jit; pass the result as ``order``."""
     import numpy as np
 
-    dead_first = np.asarray(jax.device_get(jnp.where(sel, jnp.uint64(0), jnp.uint64(1))))
-    host_words = [np.asarray(jax.device_get(w)) for w in words]
-    operands = [dead_first, *host_words]
+    # auronlint: sync-point -- documented eager host boundary ("call OUTSIDE jit"); one batched transfer
+    dead_d, words_d = jax.device_get(
+        (jnp.where(sel, jnp.uint64(0), jnp.uint64(1)), tuple(words)))
+    operands = [np.asarray(dead_d), *[np.asarray(w) for w in words_d]]
     return jnp.asarray(np.lexsort(tuple(reversed(operands))).astype(np.int32))
 
 

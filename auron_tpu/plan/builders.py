@@ -179,6 +179,24 @@ def union(children: list[pb.PhysicalPlanNode]) -> pb.PhysicalPlanNode:
     return _wrap(union=pb.UnionNode(children=children))
 
 
+def rename_columns(child: pb.PhysicalPlanNode, names: list[str]) -> pb.PhysicalPlanNode:
+    return _wrap(rename_columns=pb.RenameColumnsNode(child=child, names=list(names)))
+
+
+def empty_partitions(schema: T.Schema, num_partitions: int) -> pb.PhysicalPlanNode:
+    return _wrap(empty_partitions=pb.EmptyPartitionsNode(
+        schema=schema_to_proto(schema), num_partitions=num_partitions))
+
+
+def coalesce_batches(child: pb.PhysicalPlanNode, target_rows: int = 0) -> pb.PhysicalPlanNode:
+    return _wrap(coalesce_batches=pb.CoalesceBatchesNode(
+        child=child, target_rows=target_rows))
+
+
+def debug(child: pb.PhysicalPlanNode, tag: str = "debug") -> pb.PhysicalPlanNode:
+    return _wrap(debug=pb.DebugNode(child=child, tag=tag))
+
+
 def expand(child, projections: list[list[ir.Expr]], names: list[str]) -> pb.PhysicalPlanNode:
     """ROLLUP/CUBE lowering: one output batch per projection per input."""
     n = pb.ExpandNode(child=child, names=names)

@@ -91,6 +91,43 @@ def explain(op: ExecOperator, indent: int = 0) -> str:
     return "\n".join(lines)
 
 
+#: Per-variant detail attributes rendered by ``explain_proto``. EVERY plan
+#: oneof variant in proto/plan.proto MUST have an entry here — auronlint
+#: R4 cross-checks this registry against the proto, so a new operator
+#: cannot ship without deciding what its explain line shows. Structural
+#: nodes with nothing to say carry an explicit empty tuple.
+PLAN_DETAILS: dict[str, tuple[str, ...]] = {
+    "memory_scan": ("resource_id",),
+    "ffi_reader": ("resource_id",),
+    "parquet_scan": ("fs_resource_id",),
+    "project": (),
+    "filter": (),
+    "limit": ("limit",),
+    "union": (),
+    "expand": (),
+    "rename_columns": (),
+    "empty_partitions": ("num_partitions",),
+    "coalesce_batches": ("target_rows",),
+    "hash_agg": (),          # mode rendered as a special case below
+    "sort": ("fetch",),
+    "sort_merge_join": (),
+    "hash_join": ("cached_build_id",),
+    "shuffle_writer": (),    # partitioning rendered as a special case
+    "ipc_reader": ("resource_id",),
+    "window": (),
+    "generate": ("generator",),
+    "parquet_sink": ("output_path",),
+    "ipc_writer": ("resource_id",),
+    "debug": ("tag",),
+    "orc_scan": ("fs_resource_id",),
+    "orc_sink": ("output_path",),
+    "rss_shuffle_writer": ("rss_resource_id",),
+    "mesh_exchange": ("exchange_id",),
+    "kafka_scan": ("topic", "format", "startup_mode", "on_error",
+                   "source_resource_id"),
+}
+
+
 def explain_proto(node, indent: int = 0) -> str:
     """Render a protobuf plan tree (works for driver-resolved nodes like
     mesh_exchange / kafka_scan that never become exec operators)."""
@@ -99,8 +136,7 @@ def explain_proto(node, indent: int = 0) -> str:
     which = node.WhichOneof("plan")
     inner = getattr(node, which)
     details = []
-    for attr in ("resource_id", "topic", "format", "startup_mode", "on_error",
-                 "output_path", "exchange_id", "generator", "limit"):
+    for attr in PLAN_DETAILS.get(which, ()):
         v = getattr(inner, attr, None)
         if v:
             details.append(f"{attr}={v}")

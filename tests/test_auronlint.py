@@ -1,0 +1,554 @@
+"""auronlint gate: rule-family fixtures + whole-tree cleanliness.
+
+Each rule family R1-R5 is exercised three ways — firing on a violating
+fixture, honoring a suppression comment (with its required reason), and
+staying quiet on clean code. The final test runs the real suite over the
+real tree and fails on any unsuppressed finding, which is what makes the
+engine invariants (host-sync hygiene, bounded compile cache, capacity
+bucketing, registry lockstep, vectorization) regressions instead of
+style advice.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.auronlint import ALL_RULES, REPO_ROOT, lint_source, run_tree
+from tools.auronlint.report import Finding, Report
+from tools.auronlint.rules import (
+    HostSyncRule,
+    RegistrySyncRule,
+    RetraceRule,
+    ShapeBucketRule,
+    VectorizeRule,
+)
+
+
+def _lint(src: str, rule, rel: str = "fixture.py"):
+    return lint_source(textwrap.dedent(src), rel, [rule])
+
+
+def _hits(report: Report, rule_name: str):
+    return [f for f in report.findings if f.rule == rule_name and not f.suppressed]
+
+
+def _suppressed(report: Report, rule_name: str):
+    return [f for f in report.findings if f.rule == rule_name and f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# R1 host-sync hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_r1_fires_on_item_read():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs):
+            s = jnp.sum(xs)
+            return s.item()
+        """,
+        HostSyncRule(),
+    )
+    assert len(_hits(rep, "R1")) == 1
+    assert ".item()" in rep.findings[0].message
+
+
+def test_r1_fires_on_scalar_coercion_and_iteration():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs):
+            dev = jnp.cumsum(xs)
+            n = int(dev[-1])
+            for row in dev:
+                pass
+            if dev.any():
+                n += 1
+            return n
+        """,
+        HostSyncRule(),
+    )
+    msgs = " | ".join(f.message for f in _hits(rep, "R1"))
+    assert len(_hits(rep, "R1")) == 3
+    assert "int()" in msgs and "iterating" in msgs and "bool()" in msgs
+
+
+def test_r1_suppression_honored_and_reason_required():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs):
+            s = jnp.sum(xs)
+            return s.item()  # auronlint: disable=R1 -- test fixture reason
+        """,
+        HostSyncRule(),
+    )
+    assert not _hits(rep, "R1")
+    (sup,) = _suppressed(rep, "R1")
+    assert sup.reason == "test fixture reason"
+
+    # a reasonless suppression is itself a finding
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs):
+            s = jnp.sum(xs)
+            return s.item()  # auronlint: disable=R1
+        """,
+        HostSyncRule(),
+    )
+    assert [f for f in rep.findings if f.rule == "lint.suppression"]
+
+
+def test_r1_sync_point_declares_allowed_boundary():
+    rep = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def f(xs):
+            total = jax.device_get(jnp.sum(xs))  # auronlint: sync-point -- one count per batch
+            return total
+        """,
+        HostSyncRule(),
+    )
+    assert not rep.findings  # declared sync points are not findings at all
+
+
+def test_r1_clean_code_stays_clean():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs):
+            n = int(xs.shape[0])     # static metadata, not a sync
+            out = jnp.zeros(n)
+            cols = [xs, out]
+            for c in cols:           # python container, not a device array
+                pass
+            return out
+        """,
+        HostSyncRule(),
+    )
+    assert not rep.findings
+
+
+def test_r1_allowlisted_paths_are_exempt():
+    src = """
+    import jax.numpy as jnp
+
+    def f(xs):
+        return jnp.sum(xs).item()
+    """
+    rep = lint_source(textwrap.dedent(src),
+                      "auron_tpu/exec/shuffle/writer.py", [HostSyncRule()])
+    assert not rep.findings
+
+
+# ---------------------------------------------------------------------------
+# R2 retrace / compile-cache discipline
+# ---------------------------------------------------------------------------
+
+
+def test_r2_fires_on_undeclared_scalar_param():
+    rep = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def kernel(x, reverse=False):
+            return x
+        """,
+        RetraceRule(),
+    )
+    assert len(_hits(rep, "R2")) == 1
+    assert "static" in rep.findings[0].message
+
+
+def test_r2_fires_on_unhashable_default_and_stale_static_name():
+    rep = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("renamed_flag",))
+        def kernel(x, opts=[]):
+            return x
+        """,
+        RetraceRule(),
+    )
+    msgs = " | ".join(f.message for f in _hits(rep, "R2"))
+    assert "unhashable" in msgs and "stale" in msgs
+
+
+def test_r2_fires_on_device_closure_capture():
+    rep = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def outer(data):
+            big = jnp.asarray(data)
+
+            @jax.jit
+            def inner(y):
+                return y + big
+
+            return inner
+        """,
+        RetraceRule(),
+    )
+    assert any("closes over device array 'big'" in f.message
+               for f in _hits(rep, "R2"))
+
+
+def test_r2_suppression_honored():
+    rep = _lint(
+        """
+        import jax
+
+        @jax.jit  # auronlint: disable=R2 -- traced once at import, fixture
+        def kernel(x, reverse=False):
+            return x
+        """,
+        RetraceRule(),
+    )
+    assert not _hits(rep, "R2") and _suppressed(rep, "R2")
+
+
+def test_r2_clean_jit_site():
+    rep = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("reverse",))
+        def kernel(x, reverse=False):
+            return x
+        """,
+        RetraceRule(),
+    )
+    assert not rep.findings
+
+
+# ---------------------------------------------------------------------------
+# R3 shape-bucket discipline
+# ---------------------------------------------------------------------------
+
+
+def test_r3_fires_on_data_derived_shape():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs: jnp.ndarray):
+            n = int(jnp.sum(xs))
+            return jnp.zeros(n)
+        """,
+        ShapeBucketRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert len(_hits(rep, "R3")) == 1
+    assert "data-dependent" in rep.findings[0].message
+
+
+def test_r3_fires_on_item_shape():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(counts):
+            total = jnp.cumsum(counts)[-1].item()
+            return jnp.empty(total)
+        """,
+        ShapeBucketRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert len(_hits(rep, "R3")) == 1
+
+
+def test_r3_suppression_honored():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs: jnp.ndarray):
+            n = int(jnp.sum(xs))
+            return jnp.zeros(n)  # auronlint: disable=R3 -- fixture: bounded by test harness
+        """,
+        ShapeBucketRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert not _hits(rep, "R3") and _suppressed(rep, "R3")
+
+
+def test_r3_clean_capacity_shapes():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        CAP = 4096
+
+        def f(xs: jnp.ndarray):
+            a = jnp.zeros(CAP)
+            b = jnp.zeros(xs.shape[0])
+            c = jnp.zeros((CAP, 2))
+            return a, b, c
+        """,
+        ShapeBucketRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert not rep.findings
+
+
+# ---------------------------------------------------------------------------
+# R4 registry completeness
+# ---------------------------------------------------------------------------
+
+_MINI_PROTO = """
+syntax = "proto3";
+message PhysicalPlanNode {
+  oneof plan {
+    ScanNode scan = 1;
+    FilterNode filter = 2;
+  }
+}
+message PhysicalExprNode {
+  oneof expr {
+    ColumnExpr column = 1;
+  }
+}
+"""
+
+_MINI_PLANNER_OK = """
+def plan_from_proto(p):
+    which = p.WhichOneof("plan")
+    if which == "scan":
+        return 1
+    if which == "filter":
+        return 2
+
+
+def expr_from_proto(p):
+    which = p.WhichOneof("expr")
+    if which == "column":
+        return 1
+"""
+
+_MINI_PLANNER_DRIFTED = """
+def plan_from_proto(p):
+    which = p.WhichOneof("plan")
+    if which == "scan":
+        return 1
+
+
+def expr_from_proto(p):
+    which = p.WhichOneof("expr")
+    if which == "column":
+        return 1
+"""
+
+_MINI_EXPLAIN = """
+PLAN_DETAILS = {"scan": (), "filter": ()}
+"""
+
+_MINI_BUILDERS = """
+def expr_to_proto(e):
+    n = X()
+    n.column.index = 0
+    return n
+
+
+def scan():
+    return W(scan=1)
+
+
+def filter_():
+    return W(filter=1)
+"""
+
+
+def _write_mini_tree(tmp_path, planner_src, explain_src=_MINI_EXPLAIN):
+    at = tmp_path / "auron_tpu"
+    for d in ("proto", "plan", "convert", "functions"):
+        (at / d).mkdir(parents=True, exist_ok=True)
+    (at / "proto" / "plan.proto").write_text(_MINI_PROTO)
+    (at / "plan" / "planner.py").write_text(planner_src)
+    (at / "plan" / "explain.py").write_text(explain_src)
+    (at / "plan" / "builders.py").write_text(_MINI_BUILDERS)
+    (at / "convert" / "exprs.py").write_text("_FN_RENAME = {}\n")
+    return str(tmp_path)
+
+
+def test_r4_fires_on_registry_drift(tmp_path):
+    root = _write_mini_tree(tmp_path, _MINI_PLANNER_DRIFTED)
+    findings = list(RegistrySyncRule().check_tree(root))
+    msgs = " | ".join(m for _, _, m in findings)
+    assert "plan variant 'filter' has no plan_from_proto dispatch" in msgs
+
+
+def test_r4_fires_on_missing_explain_entry(tmp_path):
+    root = _write_mini_tree(
+        tmp_path, _MINI_PLANNER_OK, explain_src='PLAN_DETAILS = {"scan": ()}\n'
+    )
+    findings = list(RegistrySyncRule().check_tree(root))
+    msgs = " | ".join(m for _, _, m in findings)
+    assert "plan variant 'filter' missing from PLAN_DETAILS" in msgs
+
+
+def test_r4_clean_mini_tree(tmp_path):
+    root = _write_mini_tree(tmp_path, _MINI_PLANNER_OK)
+    findings = [
+        (rel, line, m)
+        for rel, line, m in RegistrySyncRule().check_tree(root)
+        if "function registry unimportable" not in m
+    ]
+    assert findings == []
+
+
+def test_r4_suppression_honored(tmp_path):
+    from tools.auronlint.core import lint_paths
+
+    drifted = _MINI_PLANNER_DRIFTED.replace(
+        "def plan_from_proto(p):",
+        "def plan_from_proto(p):  # auronlint: disable=R4 -- fixture: drift acknowledged",
+    )
+    root = _write_mini_tree(tmp_path, drifted)
+    rep = lint_paths([os.path.join(root, "auron_tpu")], root,
+                     [RegistrySyncRule()])
+    r4 = [f for f in rep.findings if f.rule == "R4"
+          and "plan_from_proto dispatch" in f.message]
+    assert r4 and all(f.suppressed for f in r4)
+
+
+def test_r4_real_tree_registries_in_lockstep():
+    """The real repo's registries must be drift-free right now."""
+    findings = [
+        (rel, line, m)
+        for rel, line, m in RegistrySyncRule().check_tree(REPO_ROOT)
+        if "function registry unimportable" not in m
+    ]
+    assert findings == [], "\n".join(m for _, _, m in findings)
+
+
+# ---------------------------------------------------------------------------
+# R5 vectorization ban
+# ---------------------------------------------------------------------------
+
+
+def test_r5_fires_on_per_row_loop():
+    rep = _lint(
+        """
+        def f(batch):
+            out = []
+            for i in range(batch.num_rows):
+                out.append(i)
+            return out
+        """,
+        VectorizeRule(),
+        rel="auron_tpu/exec/fixture.py",
+    )
+    assert len(_hits(rep, "R5")) == 1
+
+
+def test_r5_fires_on_capacity_wide_loop_over_device():
+    rep = _lint(
+        """
+        import jax.numpy as jnp
+
+        def f(xs):
+            vals = jnp.abs(xs)
+            return [vals[i] for i in range(vals.shape[0])]
+        """,
+        VectorizeRule(),
+        rel="auron_tpu/exec/fixture.py",
+    )
+    assert len(_hits(rep, "R5")) == 1
+
+
+def test_r5_suppression_honored():
+    rep = _lint(
+        """
+        def f(batch):
+            for i in range(batch.num_rows):  # auronlint: disable=R5 -- fixture: per-run loop
+                pass
+        """,
+        VectorizeRule(),
+        rel="auron_tpu/exec/fixture.py",
+    )
+    assert not _hits(rep, "R5") and _suppressed(rep, "R5")
+
+
+def test_r5_clean_loops_pass():
+    rep = _lint(
+        """
+        def f(batches, cols):
+            for b in batches:          # per-batch orchestration
+                pass
+            for c in cols:             # per-column
+                pass
+            for i in range(0, 100, 8):  # stepped chunk loop
+                pass
+        """,
+        VectorizeRule(),
+        rel="auron_tpu/exec/fixture.py",
+    )
+    assert not rep.findings
+
+
+def test_r5_only_scopes_hot_paths():
+    src = """
+    def f(batch):
+        for i in range(batch.num_rows):
+            pass
+    """
+    rep = lint_source(textwrap.dedent(src), "auron_tpu/models/tpcds.py",
+                      [VectorizeRule()])
+    assert not rep.findings
+
+
+# ---------------------------------------------------------------------------
+# shared report schema
+# ---------------------------------------------------------------------------
+
+
+def test_report_json_schema_shared_with_jvm_lint():
+    from tools import jvm_lint
+
+    rep = run_tree(rules=[HostSyncRule()])
+    doc = json.loads(rep.to_json())
+    assert doc["schema"] == 1 and doc["tool"] == "auronlint"
+    assert set(doc["counts"]) == {"total", "unsuppressed", "suppressed"}
+
+    jrep = jvm_lint.run_report()
+    jdoc = json.loads(jrep.to_json())
+    assert jdoc["schema"] == 1 and jdoc["tool"] == "jvm_lint"
+    assert set(jdoc["counts"]) == set(doc["counts"])
+    # both serialize the same Finding fields
+    f = Finding("t", "r", "p", 1, "m")
+    keys = set(f.to_dict())
+    for d in doc["findings"] + jdoc["findings"]:
+        assert set(d) == keys
+    assert Finding.from_dict(f.to_dict()) == f
+
+
+# ---------------------------------------------------------------------------
+# the gate: whole tree, zero unsuppressed findings
+# ---------------------------------------------------------------------------
+
+
+def test_whole_tree_zero_unsuppressed_findings():
+    rep = run_tree(rules=ALL_RULES)
+    bad = rep.unsuppressed
+    assert not bad, "\n" + "\n".join(f.render() for f in bad)
+    # every suppression in the tree carries a reason
+    assert all(f.reason for f in rep.suppressed)

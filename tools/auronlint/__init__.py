@@ -1,0 +1,41 @@
+"""auronlint — engine-invariant static analysis for the JAX/TPU side.
+
+Five rule families over ``auron_tpu/`` (see docs/auronlint.md):
+
+  R1  host-sync hygiene      implicit device->host transfers
+  R2  retrace discipline     bounded jit compile cache
+  R3  shape buckets          no data-derived dims
+  R4  registry lockstep      proto <-> convert <-> exec <-> explain
+  R5  vectorization ban      no per-row python loops in hot paths
+
+Run as ``make lint`` / ``python -m tools.auronlint``; gated in tier-1 by
+``tests/test_auronlint.py``. Shares its finding/report schema with
+``tools/jvm_lint.py`` (tools/auronlint/report.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.auronlint.core import lint_paths, lint_source
+from tools.auronlint.report import Finding, Report
+from tools.auronlint.rules import ALL_RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_tree(root: str | None = None, rules=ALL_RULES) -> Report:
+    """Lint the whole engine tree (the `make lint` / tier-1 entry point)."""
+    root = root or REPO_ROOT
+    return lint_paths([os.path.join(root, "auron_tpu")], root, rules)
+
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "REPO_ROOT",
+    "Report",
+    "lint_paths",
+    "lint_source",
+    "run_tree",
+]

@@ -1,0 +1,44 @@
+"""CLI: python -m tools.auronlint [paths...] [--json] [--show-suppressed]
+
+Exit status 0 = zero unsuppressed findings (the `make lint` contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools.auronlint import ALL_RULES, REPO_ROOT, lint_paths, run_tree
+
+    p = argparse.ArgumentParser(prog="auronlint", description=__doc__)
+    p.add_argument("paths", nargs="*", help="files/dirs (default: auron_tpu/)")
+    p.add_argument("--json", action="store_true", help="machine-readable report")
+    p.add_argument("--show-suppressed", action="store_true")
+    p.add_argument("--rules", help="comma-separated rule ids (default: all)")
+    args = p.parse_args(argv)
+
+    rules = ALL_RULES
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",")}
+        rules = tuple(r for r in ALL_RULES if r.name in wanted)
+    if args.paths:
+        report = lint_paths(
+            [os.path.abspath(x) for x in args.paths], REPO_ROOT, rules
+        )
+    else:
+        report = run_tree(rules=rules)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render(show_suppressed=args.show_suppressed))
+    return 0 if report.ok() else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

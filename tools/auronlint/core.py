@@ -1,0 +1,489 @@
+"""auronlint core: source model, suppression comments, scope/taint analysis.
+
+The engine's invariants (ARCHITECTURE.md "TPU-first, not a port") are
+*structural*: static capacity-bucketed shapes, a bounded jit compile
+cache, host syncs only at blocking boundaries, converter/executor/explain
+registries in lockstep. XLA checks none of them — a stray ``.item()`` in a
+per-batch loop only surfaces rounds later as a perf-gate regression. This
+module is the shared substrate the rule plugins build on:
+
+- ``SourceModule``: one parsed file — AST, comment-derived suppressions,
+  declared sync points, and enclosing-function spans;
+- ``ScopeInfo``: per-function device/taint name sets, the cheap forward
+  dataflow every value-tracking rule (R1/R2/R3/R5) consumes;
+- the runner (``lint_paths``) that walks the tree, applies suppressions
+  and folds per-module + tree-level rule output into one ``Report``.
+
+Suppression grammar (a reason after ``--`` is REQUIRED; a reasonless
+suppression is itself a finding)::
+
+    x = n.item()            # auronlint: disable=R1 -- one sync per batch
+    # auronlint: disable=R3,R5 -- <reason>       (alone: applies to next line)
+    def f():                # auronlint: disable-function=R5 -- <reason>
+    total = int(counts.sum())  # auronlint: sync-point -- ragged-expansion count
+
+``sync-point`` is not a suppression: it *declares* an allowed device->host
+boundary (the blocking-boundary contract), and R1 treats the line exactly
+like the runtime/task.py / exec/shuffle/ allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from tools.auronlint.report import Finding, Report
+
+TOOL = "auronlint"
+
+#: module roots whose results are device arrays
+_DEVICE_ROOTS = {"jnp", "jax", "lax", "pl", "pltpu"}
+
+#: attributes of a device array that are host-side static metadata, not
+#: device values (int(x.shape[0]) is NOT a sync — shapes are static)
+_META_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "itemsize", "name",
+               "sharding", "addressable_shards", "global_shards",
+               "device_buffers", "weak_type"}
+
+#: jnp./np./jax. functions that return host python values (dtype queries,
+#: static introspection) — calling them is never a device computation
+_HOST_RETURNING = {
+    "issubdtype", "iinfo", "finfo", "can_cast", "result_type", "promote_types",
+    "isscalar", "ndim", "shape", "size", "dtype", "device_count",
+    "local_device_count", "devices", "local_devices", "process_index",
+    "process_count", "default_backend", "tree_structure", "tree_leaves",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*auronlint:\s*(disable|disable-function|sync-point)"
+    r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
+    r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
+)
+
+
+@dataclass
+class Suppression:
+    kind: str            # "disable" | "disable-function" | "sync-point"
+    rules: frozenset     # rule ids; empty = all rules
+    reason: str
+    line: int            # line the comment sits on
+    standalone: bool     # comment-only line (applies to the next code line)
+
+    def covers_rule(self, rule: str) -> bool:
+        return not self.rules or rule in self.rules
+
+
+@dataclass
+class ScopeInfo:
+    """Name classification for one function (or the module top level).
+
+    ``device``:  names bound to on-device array values;
+    ``tainted``: host Python values *derived from data* (an ``.item()``
+                 read, ``int()`` of a device value, ``len()`` of a device
+                 array) — the values R3 bans from shape positions.
+    """
+
+    node: ast.AST                      # FunctionDef / Module
+    device: set = field(default_factory=set)
+    tainted: set = field(default_factory=set)
+    params: set = field(default_factory=set)
+
+
+def _root_name(expr: ast.AST) -> str | None:
+    while isinstance(expr, (ast.Attribute, ast.Subscript, ast.Call)):
+        expr = expr.func if isinstance(expr, ast.Call) else expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class SourceModule:
+    """One parsed source file plus its comment annotations."""
+
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.suppressions: list[Suppression] = []
+        self.bad_suppressions: list[int] = []   # reasonless -> lint finding
+        self._parse_comments(src)
+        self.func_spans = self._function_spans()
+        self.scopes = self._build_scopes()
+
+    # -- comments -----------------------------------------------------------
+
+    def _parse_comments(self, src: str) -> None:
+        code_lines = set()
+        try:
+            toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+        except (tokenize.TokenError, IndentationError):
+            return
+        for t in toks:
+            if t.type not in (
+                tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+                tokenize.INDENT, tokenize.DEDENT, tokenize.ENDMARKER,
+            ):
+                code_lines.add(t.start[0])
+        for t in toks:
+            if t.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(t.string)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in (m.group("rules") or "").split(",") if r.strip()
+            )
+            reason = (m.group("reason") or "").strip()
+            line = t.start[0]
+            if not reason:
+                self.bad_suppressions.append(line)
+            self.suppressions.append(
+                Suppression(m.group(1), rules, reason, line,
+                            standalone=line not in code_lines)
+            )
+
+    def _function_spans(self) -> list[tuple[int, int]]:
+        spans = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+        return spans
+
+    def _lines_covered(self, sup: Suppression) -> set[int]:
+        if sup.kind == "disable-function":
+            for lo, hi in sorted(self.func_spans):
+                if lo <= sup.line <= hi:
+                    return set(range(lo, hi + 1))
+            return {sup.line}
+        covered = {sup.line}
+        if sup.standalone:
+            covered.add(sup.line + 1)
+        return covered
+
+    def suppression_for(self, rule: str, line: int) -> Suppression | None:
+        for sup in self.suppressions:
+            if sup.kind == "sync-point":
+                continue
+            if sup.covers_rule(rule) and line in self._lines_covered(sup):
+                return sup
+        return None
+
+    def is_sync_point(self, line: int) -> bool:
+        return any(
+            s.kind == "sync-point" and line in self._lines_covered(s)
+            for s in self.suppressions
+        )
+
+    # -- scope / taint analysis --------------------------------------------
+
+    def _build_scopes(self) -> dict[ast.AST, ScopeInfo]:
+        scopes: dict[ast.AST, ScopeInfo] = {}
+
+        def visit(owner: ast.AST, body: list) -> None:
+            info = ScopeInfo(owner)
+            scopes[owner] = info
+            if isinstance(owner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = owner.args
+                for arg in (
+                    list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])
+                ):
+                    info.params.add(arg.arg)
+                    if arg.annotation is not None and _annotation_is_array(
+                        arg.annotation
+                    ):
+                        info.device.add(arg.arg)
+            # forward pass over this scope's own statements
+            for stmt in body:
+                _scan_stmt(stmt, info, visit)
+
+        visit(self.tree, self.tree.body)
+        return scopes
+
+    def scope_of(self, node: ast.AST) -> ScopeInfo:
+        """Innermost enclosing function scope for a node, via a line->scope
+        map built once per module (the naive per-node scan was O(nodes x
+        functions) over the whole tree)."""
+        if not hasattr(self, "_line_scope"):
+            table: dict[int, ScopeInfo] = {}
+            # wider (outer) spans first so inner spans overwrite them
+            owners = sorted(
+                (o for o in self.scopes if o is not self.tree),
+                key=lambda o: (o.end_lineno or o.lineno) - o.lineno,
+                reverse=True,
+            )
+            for owner in owners:
+                info = self.scopes[owner]
+                for ln in range(owner.lineno, (owner.end_lineno or owner.lineno) + 1):
+                    table[ln] = info
+            self._line_scope = table
+        return self._line_scope.get(
+            getattr(node, "lineno", -1), self.scopes[self.tree]
+        )
+
+
+def _annotation_is_array(ann: ast.AST) -> bool:
+    try:
+        text = ast.unparse(ann)
+    except Exception:
+        return False
+    if re.match(r"\s*(list|tuple|dict|set|Sequence|Iterable|Iterator|"
+                r"Optional\[\s*(list|tuple|dict)|typing\.)", text):
+        return False  # container OF arrays: python iteration over it is fine
+    if re.search(r"\bnp\.ndarray\b|\bnumpy\.|\bpa\.|\bpyarrow\.|\bpd\.", text):
+        return False  # host-side arrays (numpy / arrow / pandas) never sync
+    return bool(re.search(r"\b(Array|ndarray)\b", text))
+
+
+def _scan_stmt(stmt: ast.AST, info: ScopeInfo, visit) -> None:
+    """One statement of the owning scope: update name sets, recurse into
+    nested defs as their own scopes (they see a *snapshot* via closure —
+    good enough for lint)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        visit(stmt, stmt.body)
+        return
+    if isinstance(stmt, ast.ClassDef):
+        for s in stmt.body:
+            _scan_stmt(s, info, visit)
+        return
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        value = stmt.value
+        if value is not None:
+            dev = is_device_expr(value, info)
+            taint = is_tainted_expr(value, info)
+            for t in targets:
+                for name in _target_names(t):
+                    info.device.discard(name)
+                    info.tainted.discard(name)
+                    if dev:
+                        info.device.add(name)
+                    if taint:
+                        info.tainted.add(name)
+    elif isinstance(stmt, ast.For):
+        if is_device_expr(stmt.iter, info):
+            for name in _target_names(stmt.target):
+                info.tainted.add(name)   # row values pulled to host
+    # recurse into compound statements' bodies within the SAME scope
+    for fieldname in ("body", "orelse", "finalbody"):
+        for s in getattr(stmt, fieldname, []) or []:
+            _scan_stmt(s, info, visit)
+    for h in getattr(stmt, "handlers", []) or []:
+        for s in h.body:
+            _scan_stmt(s, info, visit)
+
+
+def _target_names(t: ast.AST) -> list[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out += _target_names(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+def is_device_expr(expr: ast.AST, info: ScopeInfo) -> bool:
+    """Conservatively: does this expression produce an on-device array?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in info.device
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _META_ATTRS:
+            return False
+        return is_device_expr(expr.value, info)
+    if isinstance(expr, ast.Subscript):
+        return is_device_expr(expr.value, info)
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Attribute):
+            root = _root_name(f)
+            if root in _DEVICE_ROOTS:
+                # jnp.* / jax.* / lax.* produce device values — except the
+                # explicit host-transfer entry points (those are R1 sinks)
+                # and static/dtype introspection helpers
+                return f.attr not in ("device_get", "block_until_ready") \
+                    and f.attr not in _HOST_RETURNING
+            if f.attr in ("item", "tolist", "to_pylist", "to_numpy",
+                          "to_pandas", "block_until_ready"):
+                return False   # host transfer: result is a python value
+            # method on a device value (x.astype, x.sum, x.at[i].set, ...)
+            return is_device_expr(f.value, info)
+        return False
+    if isinstance(expr, ast.BinOp):
+        return is_device_expr(expr.left, info) or is_device_expr(expr.right, info)
+    if isinstance(expr, ast.UnaryOp):
+        return is_device_expr(expr.operand, info)
+    if isinstance(expr, ast.BoolOp):
+        return any(is_device_expr(v, info) for v in expr.values)
+    if isinstance(expr, ast.Compare):
+        if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in expr.ops):
+            return False
+        return is_device_expr(expr.left, info) or any(
+            is_device_expr(c, info) for c in expr.comparators
+        )
+    if isinstance(expr, ast.IfExp):
+        return is_device_expr(expr.body, info) or is_device_expr(expr.orelse, info)
+    return False
+
+
+def is_tainted_expr(expr: ast.AST, info: ScopeInfo) -> bool:
+    """Does this expression yield a *data-derived host value* (the thing R3
+    bans from shape positions)?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in info.tainted:
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("item", "tolist"):
+                return True
+            if (
+                isinstance(f, ast.Name)
+                and f.id in ("int", "float", "len")
+                and node.args
+                and is_device_expr(node.args[0], info)
+            ):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# rule plugin interface + runner
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """One rule family. Subclasses set ``name``/``doc`` and implement
+    ``check_module`` (per-file) and/or ``check_tree`` (whole-repo)."""
+
+    name = "R?"
+    doc = ""
+
+    def check_module(self, mod: SourceModule):
+        return ()
+
+    def check_tree(self, root: str):
+        return ()
+
+
+def iter_py_files(base: str) -> list[str]:
+    out = []
+    for r, dirs, files in os.walk(base):
+        dirs[:] = [d for d in dirs if d not in ("__pycache__",)]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out.append(os.path.join(r, f))
+    return sorted(out)
+
+
+#: generated / non-engine files never linted
+EXCLUDED_RELS = {"auron_tpu/proto/plan_pb2.py"}
+
+
+def lint_paths(paths: list[str], root: str, rules) -> Report:
+    """Lint files/dirs under ``root`` with the given rule instances."""
+    report = Report(tool=TOOL)
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files += iter_py_files(p)
+        else:
+            files.append(p)
+    seen = set()
+    modules: dict[str, SourceModule] = {}
+    for path in files:
+        rel = os.path.relpath(path, root)
+        if rel in EXCLUDED_RELS or rel in seen:
+            continue
+        seen.add(rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            mod = SourceModule(path, rel, src)
+            modules[rel] = mod
+        except (OSError, SyntaxError) as e:
+            report.findings.append(Finding(
+                TOOL, "lint.parse", rel, getattr(e, "lineno", 0) or 0,
+                f"unparseable source: {e}",
+            ))
+            continue
+        for line in mod.bad_suppressions:
+            report.findings.append(Finding(
+                TOOL, "lint.suppression", rel, line,
+                "suppression comment without a reason "
+                "(write `# auronlint: ... -- <why>`)",
+            ))
+        for rule in rules:
+            for line, message in rule.check_module(mod):
+                sup = mod.suppression_for(rule.name, line)
+                report.findings.append(Finding(
+                    TOOL, rule.name, rel, line, message,
+                    suppressed=sup is not None,
+                    reason=sup.reason if sup else "",
+                ))
+    for rule in rules:
+        for rel, line, message in rule.check_tree(root):
+            sup = None
+            mod = modules.get(rel)
+            if mod is None and line:
+                # tree findings may point at files outside the linted set
+                # (e.g. plan/planner.py when linting one subdir) — load
+                # them so their suppressions still apply
+                try:
+                    fp = os.path.join(root, rel)
+                    with open(fp, encoding="utf-8") as f:
+                        mod = modules[rel] = SourceModule(fp, rel, f.read())
+                except (OSError, SyntaxError):
+                    mod = None
+            if mod is not None and line:
+                sup = mod.suppression_for(rule.name, line)
+            report.findings.append(Finding(
+                TOOL, rule.name, rel, line, message,
+                suppressed=sup is not None,
+                reason=sup.reason if sup else "",
+            ))
+    _dedup(report)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report
+
+
+def _dedup(report: Report) -> None:
+    """Two calls on one line produce one finding — a reader fixes the line,
+    not the call."""
+    seen = set()
+    out = []
+    for f in report.findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    report.findings = out
+
+
+def lint_source(src: str, rel: str, rules) -> Report:
+    """Lint one in-memory snippet (test fixtures)."""
+    report = Report(tool=TOOL)
+    mod = SourceModule(rel, rel, src)
+    for line in mod.bad_suppressions:
+        report.findings.append(Finding(
+            TOOL, "lint.suppression", rel, line,
+            "suppression comment without a reason",
+        ))
+    for rule in rules:
+        for line, message in rule.check_module(mod):
+            sup = mod.suppression_for(rule.name, line)
+            report.findings.append(Finding(
+                TOOL, rule.name, rel, line, message,
+                suppressed=sup is not None,
+                reason=sup.reason if sup else "",
+            ))
+    _dedup(report)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

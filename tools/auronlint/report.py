@@ -1,0 +1,107 @@
+"""Shared finding/report model for the repo's static-analysis gates.
+
+Both structural gates — ``tools/jvm_lint.py`` (JVM shim) and
+``tools/auronlint`` (the Python engine) — emit this one schema, so CI and
+humans consume a uniform machine-readable report regardless of which side
+of the bridge a finding lives on.
+
+JSON schema (version 1)::
+
+    {
+      "schema": 1,
+      "tool": "auronlint" | "jvm_lint",
+      "counts": {"total": N, "unsuppressed": N, "suppressed": N},
+      "findings": [
+        {"tool": ..., "rule": ..., "path": ..., "line": N,
+         "message": ..., "suppressed": bool, "reason": ...},
+        ...
+      ]
+    }
+
+``line`` is 1-based; 0 means a file- or tree-level finding. ``rule`` is a
+short stable id (``R1``..``R5`` for auronlint rule families, ``jvm.*`` for
+the shim gate) so suppressions and dashboards can key on it.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+SCHEMA_VERSION = 1
+
+
+@dataclass
+class Finding:
+    tool: str
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        text = f"{loc}: [{self.rule}] {self.message}"
+        if self.suppressed:
+            text += f"  (suppressed: {self.reason or 'no reason given'})"
+        return text
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(
+            tool=d["tool"], rule=d["rule"], path=d["path"],
+            line=int(d.get("line", 0)), message=d["message"],
+            suppressed=bool(d.get("suppressed", False)),
+            reason=d.get("reason", ""),
+        )
+
+
+@dataclass
+class Report:
+    tool: str
+    findings: list[Finding] = field(default_factory=list)
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    def ok(self) -> bool:
+        return not self.unsuppressed
+
+    def to_json(self, indent: int | None = 2) -> str:
+        return json.dumps(
+            {
+                "schema": SCHEMA_VERSION,
+                "tool": self.tool,
+                "counts": {
+                    "total": len(self.findings),
+                    "unsuppressed": len(self.unsuppressed),
+                    "suppressed": len(self.suppressed),
+                },
+                "findings": [f.to_dict() for f in self.findings],
+            },
+            indent=indent,
+        )
+
+    def render(self, show_suppressed: bool = False) -> str:
+        lines = [f.render() for f in self.unsuppressed]
+        if show_suppressed:
+            lines += [f.render() for f in self.suppressed]
+        n_sup = len(self.suppressed)
+        lines.append(
+            f"{self.tool}: {len(self.unsuppressed)} finding(s), "
+            f"{n_sup} suppressed"
+        )
+        return "\n".join(lines)

@@ -1,0 +1,25 @@
+"""Rule plugin registry. Adding a rule = one module with a Rule subclass,
+one entry here, one section in docs/auronlint.md."""
+
+from tools.auronlint.rules.host_sync import HostSyncRule
+from tools.auronlint.rules.registry_sync import RegistrySyncRule
+from tools.auronlint.rules.retrace import RetraceRule
+from tools.auronlint.rules.shapes import ShapeBucketRule
+from tools.auronlint.rules.vectorize import VectorizeRule
+
+ALL_RULES = (
+    HostSyncRule(),
+    RetraceRule(),
+    ShapeBucketRule(),
+    RegistrySyncRule(),
+    VectorizeRule(),
+)
+
+__all__ = [
+    "ALL_RULES",
+    "HostSyncRule",
+    "RegistrySyncRule",
+    "RetraceRule",
+    "ShapeBucketRule",
+    "VectorizeRule",
+]

@@ -1,0 +1,124 @@
+"""R1 — host-sync hygiene.
+
+Every implicit device->host transfer stalls the TPU pipeline; the engine's
+contract (ARCHITECTURE.md: "host syncs only at blocking boundaries") allows
+them only where the batch pump blocks anyway. R1 flags:
+
+- ``x.item()`` / ``x.tolist()`` — explicit scalar/list reads;
+- ``int(x)`` / ``float(x)`` / ``bool(x)`` over a device value;
+- ``np.asarray(x)`` / ``np.array(x)`` / ``jax.device_get(x)`` over a
+  device value — whole-array materialization;
+- ``for row in device_array`` — per-element host iteration;
+- ``if device_expr:`` / ``while device_expr:`` — implicit ``bool()`` sync.
+
+Allowlist (declared sync points, per the module docstring of
+``tools/auronlint/core.py``): everything under ``runtime/task.py`` and
+``exec/shuffle/`` (the blocking boundaries themselves), plus any line
+carrying ``# auronlint: sync-point -- <reason>`` (ragged-expansion count
+reads and friends declare themselves there).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.auronlint.core import Rule, SourceModule, is_device_expr
+
+#: whole files / dirs that ARE the blocking boundaries
+ALLOWED_PREFIXES = (
+    "auron_tpu/runtime/task.py",
+    "auron_tpu/exec/shuffle/",
+)
+
+
+class HostSyncRule(Rule):
+    name = "R1"
+    doc = "host-sync hygiene: implicit device->host transfers"
+
+    def check_module(self, mod: SourceModule):
+        rel = mod.rel.replace("\\", "/")
+        if rel.startswith(ALLOWED_PREFIXES):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.comprehension):
+                line = getattr(node.iter, "lineno", 0)
+            else:
+                line = getattr(node, "lineno", 0)
+            if not line or mod.is_sync_point(line):
+                continue
+            scope = mod.scope_of(node if not isinstance(node, ast.comprehension)
+                                 else node.iter)
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in ("item", "tolist") \
+                        and not node.args and not node.keywords \
+                        and is_device_expr(f.value, scope):
+                    yield line, (
+                        f".{f.attr}() is a blocking device->host read; move "
+                        "it to a declared sync point or mark the line "
+                        "`# auronlint: sync-point -- <why>`"
+                    )
+                    continue
+                if (
+                    isinstance(f, ast.Name)
+                    and f.id in ("int", "float", "bool")
+                    and len(node.args) == 1
+                    and is_device_expr(node.args[0], scope)
+                ):
+                    yield line, (
+                        f"{f.id}() over a device value forces a host sync; "
+                        "keep the value on device or read it at a declared "
+                        "sync point"
+                    )
+                    continue
+                if isinstance(f, ast.Name) and f.id == "device_get" \
+                        and node.args:
+                    # `from jax import device_get` form — same transfer,
+                    # same declaration requirement
+                    yield line, (
+                        "device_get() is a blocking device->host transfer; "
+                        "declare it (`# auronlint: sync-point -- <why>`) or "
+                        "move it to a blocking boundary (runtime/task.py, "
+                        "exec/shuffle/)"
+                    )
+                    continue
+                if isinstance(f, ast.Attribute) and node.args:
+                    root = f.value.id if isinstance(f.value, ast.Name) else None
+                    # device_get is a transfer BY NAME: every site outside
+                    # the blocking boundaries must declare itself
+                    if root == "jax" and f.attr == "device_get":
+                        yield line, (
+                            "jax.device_get() is a blocking device->host "
+                            "transfer; declare it (`# auronlint: sync-point "
+                            "-- <why>`) or move it to a blocking boundary "
+                            "(runtime/task.py, exec/shuffle/)"
+                        )
+                        continue
+                    if root == "np" and f.attr in ("asarray", "array") \
+                            and is_device_expr(node.args[0], scope):
+                        yield line, (
+                            f"np.{f.attr}() materializes a device array "
+                            "on host; transfers belong to blocking "
+                            "boundaries (runtime/task.py, exec/shuffle/)"
+                        )
+                        continue
+            elif isinstance(node, ast.For):
+                if is_device_expr(node.iter, scope):
+                    yield line, (
+                        "iterating a device array pulls every element to "
+                        "host one sync at a time; vectorize or read once "
+                        "at a sync point"
+                    )
+            elif isinstance(node, ast.comprehension):
+                if is_device_expr(node.iter, scope):
+                    yield line, (
+                        "comprehension over a device array is per-element "
+                        "host iteration; vectorize it"
+                    )
+            elif isinstance(node, (ast.If, ast.While)):
+                if is_device_expr(node.test, scope):
+                    yield line, (
+                        "branching on a device value calls bool() -> host "
+                        "sync; compute the predicate at a declared sync "
+                        "point or fold it into the device program"
+                    )

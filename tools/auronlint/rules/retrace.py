@@ -1,0 +1,227 @@
+"""R2 — retrace / compile-cache discipline.
+
+The compile cache stays bounded only because every jit signature is a
+finite set of (capacity bucket, dtype, static config) tuples. A jit site
+that traces on a Python scalar it never declared static retraces per
+value; a closure-captured batch array bakes one compiled program per
+batch object. R2 flags, per ``jax.jit`` site:
+
+- a wrapped function with scalar-default parameters (bool/int/str/tuple
+  defaults — compile-time config by construction) and NO
+  ``static_argnames``/``static_argnums`` declaration;
+- ``static_argnames`` naming parameters the function does not have
+  (registry drift after a rename);
+- unhashable parameter defaults (list/dict/set) — jit static args must
+  hash;
+- a nested jitted function closing over a device array bound in the
+  enclosing function (pass it as an argument instead).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.auronlint.core import Rule, SourceModule, is_device_expr
+
+
+def _is_jit_ref(expr: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` reference."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr == "jit" and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "jax"
+    return isinstance(expr, ast.Name) and expr.id == "jit"
+
+
+def _jit_call_kwargs(call: ast.Call) -> dict[str, ast.AST] | None:
+    """If ``call`` is a jit application — ``jax.jit(f, ...)`` or
+    ``partial(jax.jit, ...)`` — return its keyword map, else None."""
+    if _is_jit_ref(call.func):
+        return {k.arg: k.value for k in call.keywords if k.arg}
+    f = call.func
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+        isinstance(f, ast.Attribute) and f.attr == "partial"
+    )
+    if is_partial and call.args and _is_jit_ref(call.args[0]):
+        return {k.arg: k.value for k in call.keywords if k.arg}
+    return None
+
+
+def _jit_sites(mod: SourceModule):
+    """Yield (FunctionDef, kwargs, site_line) for every resolvable jit
+    application: decorators first, then ``name = jax.jit(fn)`` /
+    ``jax.jit(local_def)`` calls."""
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    yield node, {}, dec.lineno
+                elif isinstance(dec, ast.Call):
+                    kw = _jit_call_kwargs(dec)
+                    if kw is not None:
+                        yield node, kw, dec.lineno
+        elif isinstance(node, ast.Call):
+            kw = _jit_call_kwargs(node)
+            if kw is None or not node.args:
+                continue
+            target = node.args[0]
+            if _is_jit_ref(target):
+                continue  # partial(jax.jit, ...) itself; decorator form above
+            if isinstance(target, ast.Name) and target.id in defs:
+                yield defs[target.id], kw, node.lineno
+
+
+def _scalar_default_params(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    named = list(a.posonlyargs) + list(a.args)
+    out = []
+    for arg, default in zip(named[len(named) - len(a.defaults):], a.defaults):
+        if isinstance(default, ast.Constant) and isinstance(
+            default.value, (bool, int, str)
+        ):
+            out.append(arg.arg)
+        elif isinstance(default, ast.Tuple):
+            out.append(arg.arg)
+    for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+        if isinstance(default, ast.Constant) and isinstance(
+            default.value, (bool, int, str)
+        ):
+            out.append(arg.arg)
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    return {x.arg for x in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+
+
+def _static_names(kw: dict[str, ast.AST]) -> list[str] | None:
+    """Literal static_argnames, if statically readable."""
+    v = kw.get("static_argnames")
+    if v is None:
+        return None
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return [v.value]
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = []
+        for e in v.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class RetraceRule(Rule):
+    name = "R2"
+    doc = "jit retrace/compile-cache discipline"
+
+    def check_module(self, mod: SourceModule):
+        seen: set[tuple[int, str]] = set()
+
+        def emit(line, msg):
+            key = (line, msg)
+            if key not in seen:
+                seen.add(key)
+                return [(line, msg)]
+            return []
+
+        for fn, kw, site_line in _jit_sites(mod):
+            has_static = "static_argnames" in kw or "static_argnums" in kw
+            scalar_params = _scalar_default_params(fn)
+            if scalar_params and not has_static:
+                yield from emit(site_line, (
+                    f"jit of '{fn.name}' declares no static_argnames/"
+                    f"static_argnums but parameter(s) "
+                    f"{', '.join(repr(p) for p in scalar_params)} default to "
+                    "python scalars — each distinct value retraces; declare "
+                    "them static"
+                ))
+            names = _static_names(kw)
+            if names is not None:
+                missing = [n for n in names if n not in _param_names(fn)]
+                if missing:
+                    yield from emit(site_line, (
+                        f"static_argnames {missing} not parameters of "
+                        f"'{fn.name}' — stale after a rename?"
+                    ))
+                elif scalar_params:
+                    uncovered = [p for p in scalar_params if p not in names]
+                    if uncovered:
+                        yield from emit(site_line, (
+                            f"jit of '{fn.name}': scalar-default parameter(s) "
+                            f"{uncovered} missing from static_argnames"
+                        ))
+            for arg, default in self._all_defaults(fn):
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    yield from emit(default.lineno, (
+                        f"jitted '{fn.name}' parameter '{arg}' has an "
+                        "unhashable default — jit static args must hash"
+                    ))
+            # closure capture of device arrays from the enclosing function
+            enclosing = self._enclosing_scope(mod, fn)
+            if enclosing is not None:
+                bound = self._bound_in(fn)
+                for name, line in self._loads_in(fn):
+                    if name in bound:
+                        continue
+                    if name in enclosing.device:
+                        yield from emit(line, (
+                            f"jitted '{fn.name}' closes over device array "
+                            f"'{name}' from the enclosing function — every "
+                            "new array object recompiles; pass it as an "
+                            "argument"
+                        ))
+
+    @staticmethod
+    def _all_defaults(fn: ast.FunctionDef):
+        a = fn.args
+        named = list(a.posonlyargs) + list(a.args)
+        for arg, d in zip(named[len(named) - len(a.defaults):], a.defaults):
+            yield arg.arg, d
+        for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                yield arg.arg, d
+
+    @staticmethod
+    def _enclosing_scope(mod: SourceModule, fn: ast.FunctionDef):
+        """ScopeInfo of the function lexically containing ``fn``, or None
+        when ``fn`` is module/class level."""
+        best = None
+        best_span = float("inf")
+        for owner, info in mod.scopes.items():
+            if owner is fn or not isinstance(
+                owner, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            lo, hi = owner.lineno, owner.end_lineno or owner.lineno
+            if lo < fn.lineno <= hi and hi - lo < best_span:
+                best, best_span = info, hi - lo
+        return best
+
+    @staticmethod
+    def _bound_in(fn: ast.FunctionDef) -> set[str]:
+        bound = set()
+        a = fn.args
+        for arg in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            + ([a.vararg] if a.vararg else []) + ([a.kwarg] if a.kwarg else [])
+        ):
+            bound.add(arg.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                bound.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                bound.add(node.name)
+        return bound
+
+    @staticmethod
+    def _loads_in(fn: ast.FunctionDef):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                yield node.id, node.lineno

@@ -1,0 +1,74 @@
+"""R3 — shape-bucket discipline.
+
+XLA compiles one program per shape; the engine keeps the cache bounded by
+deriving every array shape from capacity constants or ``.shape`` of
+existing buffers (power-of-two buckets, `columnar/batch.py`). An array
+constructed from a *data-derived* Python int (an ``.item()`` read, an
+``int()`` of a device value, a ``len()`` of a device array) compiles one
+program per observed cardinality and can OOM the compile cache. R3 flags
+array-constructing calls in ``exec/``, ``ops/``, ``exprs/`` whose shape
+argument is tainted by such a value.
+
+Literal ints, UPPER_CASE capacity constants, ``x.shape`` reads and plain
+untraced names all pass — the rule only fires on provably data-derived
+shapes, so a hit is worth reading.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.auronlint.core import Rule, SourceModule, is_tainted_expr
+
+SCOPED_PREFIXES = ("auron_tpu/exec/", "auron_tpu/ops/", "auron_tpu/exprs/")
+
+#: call name -> index of the shape argument (None = every argument is a
+#: shape component, as in reshape)
+_CONSTRUCTORS = {"zeros": 0, "ones": 0, "empty": 0, "full": 0,
+                 "broadcast_to": 1, "reshape": None, "arange": 0,
+                 "tile": 1}
+
+
+class ShapeBucketRule(Rule):
+    name = "R3"
+    doc = "capacity-bucketed shapes: no data-derived dims"
+
+    def check_module(self, mod: SourceModule):
+        rel = mod.rel.replace("\\", "/")
+        if not rel.startswith(SCOPED_PREFIXES):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) or f.attr not in _CONSTRUCTORS:
+                continue
+            root = f.value.id if isinstance(f.value, ast.Name) else None
+            if root == "np":
+                # host numpy scratch (dictionary transforms etc) never
+                # becomes an XLA program shape
+                continue
+            scope = mod.scope_of(node)
+            shape_args = self._shape_args(node, f.attr)
+            for arg in shape_args:
+                if is_tainted_expr(arg, scope):
+                    yield node.lineno, (
+                        f"shape of {f.attr}() derives from a data-dependent "
+                        "host value — one XLA program per observed size; "
+                        "round up to a capacity bucket or reuse an input's "
+                        ".shape"
+                    )
+                    break
+
+    @staticmethod
+    def _shape_args(call: ast.Call, name: str) -> list[ast.AST]:
+        idx = _CONSTRUCTORS[name]
+        out = []
+        for k in call.keywords:
+            if k.arg in ("shape", "new_sizes", "reps"):
+                out.append(k.value)
+        if idx is None:
+            out += list(call.args)
+        elif len(call.args) > idx:
+            out.append(call.args[idx])
+        return out

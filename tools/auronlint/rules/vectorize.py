@@ -1,0 +1,75 @@
+"""R5 — vectorization ban in hot paths.
+
+Per-row work belongs to XLA (ARCHITECTURE.md: "Python orchestrates batch
+flow, XLA owns all per-row work"). A Python ``for`` over rows turns the
+VPU into an interpreter. R5 flags, inside ``ops/`` and ``exec/`` only:
+
+- ``for i in range(batch.num_rows)`` and friends — ``range()`` whose bound
+  mentions a ``num_rows`` attribute, a data-derived host count, or
+  ``.shape[...]``/``len()`` of a device array (capacity-wide loops are
+  still per-row loops);
+- the same iterables inside list/set/dict comprehensions.
+
+Loops over columns, partitions, batches, files, sorted runs — anything
+not row-indexed — pass untouched. Host-side loops that are genuinely
+per-run/per-block (loser-tree merges, spill block pumps) get a
+``disable`` with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.auronlint.core import Rule, SourceModule, is_device_expr
+
+SCOPED_PREFIXES = ("auron_tpu/ops/", "auron_tpu/exec/")
+
+_ROWCOUNT_ATTRS = {"num_rows", "nrows", "n_rows"}
+
+
+class VectorizeRule(Rule):
+    name = "R5"
+    doc = "no python-level per-row loops in hot paths"
+
+    def check_module(self, mod: SourceModule):
+        rel = mod.rel.replace("\\", "/")
+        if not rel.startswith(SCOPED_PREFIXES):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.For):
+                it, line = node.iter, node.lineno
+            elif isinstance(node, ast.comprehension):
+                it, line = node.iter, getattr(node.iter, "lineno", 0)
+            else:
+                continue
+            scope = mod.scope_of(it)
+            if self._is_per_row_range(it, scope, mod):
+                yield line, (
+                    "python loop over per-row batch data — per-row work "
+                    "belongs in the jitted program (vmap/segment ops); "
+                    "if this loop is per-run/per-block, say so in a "
+                    "suppression reason"
+                )
+
+    def _is_per_row_range(self, it: ast.AST, scope, mod: SourceModule) -> bool:
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range"):
+            return False
+        if len(it.args) == 3:
+            return False  # stepped range = chunked emission, not per-row
+        for arg in it.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Attribute) and sub.attr in _ROWCOUNT_ATTRS:
+                    return True
+                if isinstance(sub, ast.Name) and sub.id in scope.tainted:
+                    return True
+                if isinstance(sub, ast.Subscript):
+                    v = sub.value
+                    if isinstance(v, ast.Attribute) and v.attr == "shape" \
+                            and is_device_expr(v.value, scope):
+                        return True
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                        and sub.func.id == "len" and sub.args \
+                        and is_device_expr(sub.args[0], scope):
+                    return True
+        return False

@@ -413,6 +413,44 @@ def check_api_signatures() -> list[str]:
     return findings
 
 
+def _classify(s: str) -> tuple[str, str, int, str]:
+    """(rule, path, line, message) for one legacy finding string — the
+    adapter onto the shared report schema (tools/auronlint/report.py)."""
+    rule = "jvm.structural"
+    if re.search(r"unterminated|unmatched|unclosed", s):
+        rule = "jvm.lexical"
+    elif "host API" in s or "host-engine" in s:
+        rule = "jvm.api-signature"
+    elif "NativeBridge" in s:
+        rule = "jvm.abi"
+    elif s.startswith("wire key"):
+        rule = "jvm.wire-key"
+    m = re.match(
+        r"^(?P<path>\S+?\.(?:scala|java)):\s*(?:line\s+(?P<l1>\d+):\s*)?"
+        r"(?:(?P<l2>\d+):\s*)?(?P<msg>.*)$", s,
+    )
+    if m:
+        return rule, m.group("path"), int(m.group("l1") or m.group("l2") or 0), \
+            m.group("msg")
+    return rule, "jvm", 0, s
+
+
+def run_report():
+    """All findings as the shared Finding/Report schema that auronlint
+    also emits — one machine-readable format across both gates."""
+    import sys
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from tools.auronlint.report import Finding, Report
+
+    rep = Report(tool="jvm_lint")
+    for s in run_all():
+        rule, path, line, msg = _classify(s)
+        rep.findings.append(Finding("jvm_lint", rule, path, line, msg))
+    return rep
+
+
 def run_all() -> list[str]:
     """Every finding across all checks (empty = clean)."""
     findings: list[str] = []
@@ -447,6 +485,12 @@ def run_all() -> list[str]:
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "--json" in sys.argv:
+        rep = run_report()
+        print(rep.to_json())
+        raise SystemExit(0 if rep.ok() else 1)
     problems = run_all()
     for p in problems:
         print(p)
