@@ -1,4 +1,4 @@
-.PHONY: proto test native jvm-compile bench lint
+.PHONY: proto test native jvm-compile bench lint perfcheck
 
 # keep `make` (no target) regenerating the proto, as before the lint gate
 .DEFAULT_GOAL := proto
@@ -11,6 +11,13 @@
 lint:
 	JAX_PLATFORMS=cpu python -m tools.auronlint
 	python tools/jvm_lint.py
+
+# Runtime half of the R1 host-sync contract: replay a tiny SF<=1 q3-class
+# breakdown and fail if any declared sync site exceeds the per-batch/
+# per-task multiplicity budget its sync-point comment promises
+# (tools/perfcheck.py; budgets parsed by tools/auronlint/syncbudget.py).
+perfcheck:
+	JAX_PLATFORMS=cpu python tools/perfcheck.py
 
 proto:
 	protoc --python_out=. auron_tpu/proto/plan.proto

@@ -46,6 +46,19 @@ def bucket_capacity(n: int) -> int:
     return c
 
 
+def compaction_bucket(n_live: int, in_capacity: int) -> int | None:
+    """THE compaction policy shared by every sparse-output boundary (join
+    chain, BHJ unique-compact, selectivity predictor): the capacity bucket
+    to compact ``n_live`` rows into, or None when compaction would not pay
+    and the batch should stay dense at ``in_capacity``. The 4x threshold is
+    the measured break-even of one extra gather of every output column
+    against the smaller downstream batches."""
+    cap = bucket_capacity(max(n_live, 1))
+    if cap * 4 > in_capacity:
+        return None
+    return cap
+
+
 class DeviceBatch(NamedTuple):
     """The array-only pytree consumed by jitted kernels."""
 
@@ -189,7 +202,7 @@ class Batch:
 
     def num_rows(self) -> int:
         """Live row count — host sync."""
-        return int(jax.device_get(self.device.num_rows()))  # auronlint: sync-point -- num_rows() IS the engine's count-read API
+        return int(jax.device_get(self.device.num_rows()))  # auronlint: sync-point(call) -- num_rows() IS the engine's count-read API
 
     def col_values(self, i: int) -> jnp.ndarray:
         return self.device.values[i]
@@ -202,6 +215,17 @@ class Batch:
         return Batch(schema or self.schema, dev,
                      dicts if dicts is not None else self.dicts)
 
+    def prefetch_host(self) -> None:
+        """Start non-blocking device->host copies of every array so a later
+        ``to_arrow`` finds the data already landed (the task pump calls
+        this for host-FFI consumers — the copy overlaps the NEXT batch's
+        device compute instead of stalling inside ``device_get``)."""
+        from auron_tpu.runtime.transfer import start_host_transfer
+
+        dev = self.device
+        start_host_transfer(dev.sel, *dev.values, *dev.validity)
+        self._host_prefetched = True
+
     # ---- materialization ----
 
     def to_arrow(self, compact: bool = True,
@@ -213,8 +237,16 @@ class Batch:
         values per row — the engine-to-engine interchange mode used by
         shuffle/spill, where the reader re-ingests codes directly. The
         default materializes, for external consumers (JVM sink, pandas)."""
-        # auronlint: sync-point -- to_arrow materializes for external consumers; one transfer for the whole pytree
-        dev = jax.device_get(self.device)
+        if getattr(self, "_host_prefetched", False):
+            # the pump started this copy batches ago (prefetch_host):
+            # account the landing as an async harvest, not a stall
+            from auron_tpu.utils.profiling import async_read_scope
+
+            with async_read_scope():
+                dev = jax.device_get(self.device)  # auronlint: sync-point(1/batch) -- prefetched host materialization harvest (async-accounted)
+        else:
+            # auronlint: sync-point(call) -- to_arrow materializes for external consumers; one transfer for the whole pytree
+            dev = jax.device_get(self.device)
         sel = np.asarray(dev.sel)
         idx = np.nonzero(sel)[0] if compact else np.arange(self.capacity)
         return host_rows_to_arrow(self.schema, self.dicts, dev.values,
@@ -406,7 +438,7 @@ def host_arrow_cols(cvs) -> list[pa.Array]:
     .dtype/.dict) as host arrow arrays for host-evaluation contracts
     (UDF/UDTF fallbacks, dictionary-transforming functions) — ONE batched
     device transfer for every column."""
-    moved = jax.device_get(tuple((cv.values, cv.validity) for cv in cvs))  # auronlint: sync-point -- host-evaluation contract; one batched transfer for all columns
+    moved = jax.device_get(tuple((cv.values, cv.validity) for cv in cvs))  # auronlint: sync-point(call) -- host-evaluation contract; one batched transfer for all columns
     return [
         _device_to_arrow(np.asarray(v), np.asarray(m), cv.dtype, cv.dict)
         for cv, (v, m) in zip(cvs, moved)

@@ -167,7 +167,7 @@ def _range_partitioning_proto(fields, num: int, bound_rows: list) -> pb.Partitio
     ]
     import jax
 
-    # auronlint: sync-point -- range-bound sampling at plan time (driver side, once per query); one batched transfer
+    # auronlint: sync-point(call) -- range-bound sampling at plan time (driver side, once per query); one batched transfer
     words_d, sel_d = jax.device_get((tuple(sort_operands(keys, specs)),
                                      sample.device.sel))
     words = [np.asarray(w) for w in words_d]

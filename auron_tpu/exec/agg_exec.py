@@ -322,11 +322,11 @@ class HashAggExec(ExecOperator):
                 # transfer (its reduce has completed by now), so steady
                 # state pays ONE host round-trip per batch.
                 if pending_g is None:
-                    n = int(jax.device_get(b.device.num_rows()))  # auronlint: sync-point -- first-batch live-count read (see comment above)
+                    n = int(jax.device_get(b.device.num_rows()))  # auronlint: sync-point(4/task) -- first-batch live-count read (see comment above)
                 else:
                     n, gp = (
                         int(x)
-                        for x in jax.device_get(  # auronlint: sync-point -- steady state: ONE round-trip per batch (count + prior group count)
+                        for x in jax.device_get(  # auronlint: sync-point(1/batch) -- steady state: ONE round-trip per batch (count + prior group count)
                             (b.device.num_rows(), pending_g)
                         )
                     )
@@ -359,7 +359,7 @@ class HashAggExec(ExecOperator):
                     inter = self._to_intermediate(b, ctx)
                 n, g = (
                     int(x)
-                    for x in jax.device_get(  # auronlint: sync-point -- merge modes: one combined transfer per batch
+                    for x in jax.device_get(  # auronlint: sync-point(1/batch) -- merge modes: one combined transfer per batch
                         (b.device.num_rows(), inter.device.num_rows())
                     )
                 )
@@ -436,7 +436,7 @@ class HashAggExec(ExecOperator):
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
                 if dense is not None:
-                    with ctx.metrics.timer("elapsed_compute"):
+                    with ctx.metrics.timer("elapsed_compute", count=True):
                         leftovers = fold_dense(b)
                     if leftovers is None:
                         continue
@@ -444,11 +444,15 @@ class HashAggExec(ExecOperator):
                         yield from process_generic(nb)
                     continue
                 yield from process_generic(b)
-            # end of stream: resolve the in-flight deferred dense fold via
-            # the same protocol, synchronously (there is no next batch to
-            # piggyback the flag read on)
+            # end of stream: resolve the in-flight deferred dense folds
+            # (up to window-depth of them) via the same protocol,
+            # synchronously (there is no next batch to piggyback on)
             if dense is not None:
                 for nb in dense.finish_pending():
+                    if dense is None:
+                        # a prior retry forced permanent fallback
+                        yield from process_generic(nb)
+                        continue
                     with ctx.metrics.timer("elapsed_compute"):
                         leftovers = fold_dense(nb, defer=False)
                     for gb in leftovers or ():
@@ -657,7 +661,7 @@ class HashAggExec(ExecOperator):
         cv = cols[0]
         sv = cv.values[order]
         sm = cv.validity[order] & seg.sel_sorted
-        # auronlint: sync-point -- host UDAF accumulation is host work by contract; one batched transfer
+        # auronlint: sync-point(call) -- host UDAF accumulation is host work by contract; one batched transfer
         ids_d, sv_d, sm_d, ng_d = jax.device_get((seg.seg_ids, sv, sm, seg.num_groups))
         ids_np, sv_np, sm_np = np.asarray(ids_d), np.asarray(sv_d), np.asarray(sm_d)
         n_groups = int(ng_d)
@@ -709,7 +713,7 @@ class HashAggExec(ExecOperator):
         cv = cols[0]
         sv = cv.values[order]
         sm = cv.validity[order] & seg.sel_sorted
-        # auronlint: sync-point -- collect_list/set materializes per-group python lists; one batched transfer
+        # auronlint: sync-point(call) -- collect_list/set materializes per-group python lists; one batched transfer
         ids_d, sv_d, sm_d, ng_d = jax.device_get((seg.seg_ids, sv, sm, seg.num_groups))
         ids_np, sv_np, sm_np = np.asarray(ids_d), np.asarray(sv_d), np.asarray(sm_d)
         n_groups = int(ng_d)
@@ -749,7 +753,7 @@ class HashAggExec(ExecOperator):
 
         spec = lookup_udaf(a.udaf)
         cap = int(state_cv.values.shape[0])
-        # auronlint: sync-point -- UDAF state decode is host work by contract; one batched transfer
+        # auronlint: sync-point(call) -- UDAF state decode is host work by contract; one batched transfer
         codes_d, valid_d = jax.device_get((state_cv.values, state_cv.validity))
         codes, valid = np.asarray(codes_d), np.asarray(valid_d)
         entries = state_cv.dict.to_pylist()
@@ -844,7 +848,7 @@ class HashAggExec(ExecOperator):
 
         st = sum_type(in_t)
         k = _n_limbs(st.precision)
-        # auronlint: sync-point -- exact wide-decimal totals need python ints (host by design); one batched transfer incl. the avg count column
+        # auronlint: sync-point(call) -- exact wide-decimal totals need python ints (host by design); one batched transfer incl. the avg count column
         limbs, valid_d, cnt_d = jax.device_get((
             tuple(c.values for c in cols[:k]), cols[0].validity,
             cols[k].values if len(cols) > k else None,
@@ -1568,7 +1572,17 @@ class _DenseAggState:
         self.valids: tuple | None = None
         self.present: jnp.ndarray | None = None
         self._hint: list | None = None  # (mn, mx) per key across resets
-        self._pending: tuple | None = None  # (batch, ok-flag) fold in flight
+        # k-deep deferred folds in flight: (batch, ok-flag) FIFO whose flag
+        # transfers ride the async window (runtime/transfer.py) — resolved
+        # k batches late so the steady state never blocks on a fold outcome.
+        # Holds up to k batches' device arrays; k is the transfer window
+        # depth (runtime.transfer.window.depth).
+        from collections import deque
+
+        from auron_tpu.utils.config import TRANSFER_WINDOW_DEPTH
+
+        self._pending: "deque" = deque()
+        self._depth = max(1, ctx.conf.get(TRANSFER_WINDOW_DEPTH))
         self._retry: list = []  # batches whose deferred fold was a no-op
         self._base_cfg = (
             exec_.mode == PARTIAL,
@@ -1650,7 +1664,9 @@ class _DenseAggState:
     def take_retry(self) -> list:
         """Batches whose deferred fold turned out to be a no-op (out of
         range); they must be re-folded after drain+reset or routed to the
-        generic path."""
+        generic path. Any still-unresolved in-flight folds are resolved
+        first (a drain+reset invalidates their table)."""
+        self._retry.extend(self.finish_pending())
         r, self._retry = self._retry, []
         return r
 
@@ -1660,15 +1676,19 @@ class _DenseAggState:
         return r
 
     def finish_pending(self) -> list:
-        """Resolve the in-flight deferred fold; returns the batch(es) that
-        were NOT folded (empty when the fold landed)."""
-        if self._pending is None:
-            return []
-        pb, flag = self._pending
-        self._pending = None
-        if not bool(jax.device_get(flag)):  # auronlint: sync-point -- one-scalar fold-outcome read per flush
-            return [pb]
-        return []
+        """Resolve EVERY in-flight deferred fold; returns the batch(es)
+        that were NOT folded (empty when all folds landed). The flag
+        transfers were started at dispatch, so these harvests are
+        normally already host-resident (async-read accounting)."""
+        from auron_tpu.runtime.transfer import harvest
+
+        failed = []
+        while self._pending:
+            pb, flag = self._pending.popleft()
+            (ok,) = harvest(flag)
+            if not bool(ok):
+                failed.append(pb)
+        return failed
 
     def update(self, b: Batch, defer: bool = True):
         """Fold one batch in. Returns True (folded, or fold in flight),
@@ -1677,15 +1697,27 @@ class _DenseAggState:
         False (the union range can never fit LIMIT: fall back for good).
 
         The anchored fold is ONE fused program that checks ranges and
-        conditionally folds (all-or-nothing), returning a flag; with
-        ``defer`` the flag is read when the NEXT batch arrives, so the
-        steady state has no blocking host round-trip per batch. Table
-        footprint is bounded by LIMIT slots x field widths, accounted as
-        an unspillable consumer."""
-        failed = self.finish_pending()
-        if failed:
-            self._retry = failed
-            return "restart"
+        conditionally folds (all-or-nothing), returning a flag whose
+        device->host transfer starts at dispatch; with ``defer`` the flag
+        is harvested k batches later from the async window, so the steady
+        state has no blocking host round-trip per batch. Table footprint
+        is bounded by LIMIT slots x field widths (+ up to k in-flight
+        batches), accounted as an unspillable consumer."""
+        from auron_tpu.runtime.transfer import harvest, start_host_transfer
+
+        if defer and len(self._pending) >= self._depth:
+            # window full: harvest the OLDEST fold's outcome (its transfer
+            # has ridden behind k batches of device compute)
+            pb0, flag0 = self._pending.popleft()
+            (ok0,) = harvest(flag0)
+            if not bool(ok0):
+                self._retry.append(pb0)
+                return "restart"
+        elif not defer:
+            failed = self.finish_pending()
+            if failed:
+                self._retry.extend(failed)
+                return "restart"
         keys, per_agg = self._keys_and_inputs(b)
         if self.bases is not None:
             self.vals, self.valids, self.present, flag = _dense_update_jit(
@@ -1697,9 +1729,10 @@ class _DenseAggState:
                 per_agg, cfg=self._base_cfg + (self.dims,), size=self.size,
             )
             if defer:
-                self._pending = (b, flag)
+                start_host_transfer(flag)
+                self._pending.append((b, flag))
                 return True
-            if not bool(jax.device_get(flag)):  # auronlint: sync-point -- one-scalar fold-outcome read per fold
+            if not bool(jax.device_get(flag)):  # auronlint: sync-point(8/task) -- fold-outcome read on the synchronous (end-of-stream/restart) path only
                 # the fold was an all-or-nothing no-op; the CALLER re-folds
                 # this batch after drain+reset (it is NOT queued in _retry —
                 # every restart handler already re-submits the batch it
@@ -1707,7 +1740,7 @@ class _DenseAggState:
                 return "restart"
             return True
         stats = [
-            int(x) for x in jax.device_get(_dense_key_range_jit(  # auronlint: sync-point -- dense-table eligibility stats, one fused read per batch
+            int(x) for x in jax.device_get(_dense_key_range_jit(  # auronlint: sync-point(8/task) -- dense-table anchor/re-anchor stats: first batch + O(log span) restarts, not steady state
                 tuple(k.values for k in keys),
                 tuple(k.validity for k in keys),
                 b.device.sel,
@@ -1785,7 +1818,7 @@ class _DenseAggState:
         if self.bases is None or self.present is None:
             return None, 0
         ex = self.exec
-        g = int(jax.device_get(jnp.sum(self.present)))  # auronlint: sync-point -- group count read once at table emission (blocking boundary)
+        g = int(jax.device_get(jnp.sum(self.present)))  # auronlint: sync-point(4/task) -- group count read once at table emission (blocking boundary)
         if g == 0:
             return None, 0
         slot = jnp.arange(self.size, dtype=jnp.int64)
@@ -1815,9 +1848,13 @@ class _DenseAggState:
         return compact_batch(sb, bucket_capacity(g)), g
 
     def mem_used(self) -> int:
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
+        # in-flight deferred folds pin their batches until harvest
+        total = sum(batch_nbytes(pb) for pb, _ in self._pending)
         if self.vals is None:
-            return 0
-        total = self.size  # present bools
+            return total
+        total += self.size  # present bools
         for v in self.vals:
             total += v.size * v.dtype.itemsize
         for m in self.valids:
@@ -1830,3 +1867,4 @@ class _DenseAggState:
 
     def release(self, mm) -> None:
         self.vals = self.valids = self.present = None
+        self._pending.clear()  # drop in-flight fold refs (cancel path)

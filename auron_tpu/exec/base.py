@@ -108,7 +108,7 @@ class ExecOperator:
             if rows_dev is not None:
                 import jax
 
-                node.add("output_rows", int(jax.device_get(rows_dev)))  # auronlint: sync-point -- conf-gated metrics read (default off)
+                node.add("output_rows", int(jax.device_get(rows_dev)))  # auronlint: sync-point(1/batch) -- conf-gated metrics read (default off)
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
         raise NotImplementedError

@@ -106,7 +106,7 @@ class GenerateExec(ExecOperator):
             counts = jnp.where(live & has_elems, row_len, 0)
         counts = counts.astype(jnp.int64)
         offsets = jnp.cumsum(counts)
-        total = int(jax.device_get(offsets[-1])) if b.capacity else 0  # auronlint: sync-point -- ragged-expansion total, one per batch (ARCHITECTURE.md contract)
+        total = int(jax.device_get(offsets[-1])) if b.capacity else 0  # auronlint: sync-point(1/batch) -- ragged-expansion total, one per batch (ARCHITECTURE.md contract)
         if total == 0:
             return
         starts = offsets - counts
@@ -156,7 +156,7 @@ class GenerateExec(ExecOperator):
         from auron_tpu.columnar.batch import _device_to_arrow
 
         fn, out_schema = lookup_udtf(self.udtf)
-        # auronlint: sync-point -- host UDTF evaluates on host by contract; one batched transfer
+        # auronlint: sync-point(call) -- host UDTF evaluates on host by contract; one batched transfer
         vals_d, mask_d, sel_d = jax.device_get((cv.values, cv.validity, b.device.sel))
         vals, mask, sel = np.asarray(vals_d), np.asarray(mask_d), np.asarray(sel_d)
         host_arg = _device_to_arrow(vals, mask, cv.dtype, cv.dict).to_pylist()

@@ -30,12 +30,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from auron_tpu.columnar.batch import Batch, bucket_capacity
+from auron_tpu.columnar.batch import Batch, compaction_bucket, compaction_index
 from auron_tpu.exec.basic import batch_from_columns
+from auron_tpu.exec.selectivity import SelectivityPredictor, predictor_enabled
 from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import ColumnVal
 from auron_tpu.exec.joins import core
 from auron_tpu.exec.joins.driver import _compact_join_output_enabled
+from auron_tpu.runtime.transfer import TransferWindow
+from auron_tpu.utils.config import TRANSFER_WINDOW_DEPTH
 
 
 def clear_chain_memos(top, partition: int, ctx) -> None:
@@ -230,57 +233,20 @@ def _run_chain(
     bwords_all = tuple(b.words for b in builds)
     n_lives = tuple(jnp.int32(b.n_live) for b in builds)
 
-    def dispatch(pb):
-        """Async half: ALL levels' canon + probe + selection AND as ONE
-        program (single pass over the probe keys). No host sync here —
-        finish() syncs one batch later, so the mask transfer of batch i
-        overlaps batch i+1's device compute (and, on remote accelerators,
-        hides link latency)."""
-        kv_all = tuple(
-            tuple(pb.col_values(c) for c in key_cols)
-            for key_cols in key_cols_per_level
-        )
-        km_all = tuple(
-            tuple(pb.col_validity(c) for c in key_cols)
-            for key_cols in key_cols_per_level
-        )
-        sel_out, bis = _chain_probe_all_jit(
-            kv_all, km_all, pb.device.sel,
-            luts, lut_bases, bwords_all, n_lives,
-            cfgs=level_cfgs,
-        )
-        return pb, sel_out, list(bis)
+    # steady-state pipeline state: EWMA selectivity predictor picks the
+    # compaction bucket ahead of time; the k-deep transfer window carries
+    # each batch's actual live count host-ward while later batches compute
+    # (docs/pipeline.md). First batch seeds the EWMA via the blocking path.
+    pred = (
+        SelectivityPredictor(ctx.conf)
+        if compact_mode and predictor_enabled(ctx.conf)
+        else None
+    )
+    window = TransferWindow(ctx.conf.get(TRANSFER_WINDOW_DEPTH))
 
-    def finish(state) -> Batch:
-        pb, sel_out, bis = state
-        if compact_mode:
-            sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point -- compaction index at the chain blocking boundary
-            idx_np = np.flatnonzero(sel_np)
-            n_live = int(idx_np.size)
-            out_cap = bucket_capacity(max(n_live, 1))
-        else:
-            # accelerator mode: dense output, ZERO host syncs in the chain
-            out_cap = pb.capacity
-
-        if out_cap * 4 > pb.capacity:
-            # dense output: compaction wouldn't pay (same threshold as
-            # driver._emit_unique_compacted) — gather build columns at
-            # full width, keep probe columns as zero-copy views
-            c_b, c_bm = _chain_take_dense_jit(
-                bvals_all, bmasks_all, tuple(bis), sel_out
-            )
-            c_p = c_pm = None
-            new_sel = sel_out
-        else:
-            idx_pad = np.zeros(out_cap, dtype=np.int32)
-            idx_pad[:n_live] = idx_np
-            c_p, c_pm, c_b, c_bm, new_sel = _chain_take_jit(
-                tuple(pb.col_values(c) for c in probe_cols),
-                tuple(pb.col_validity(c) for c in probe_cols),
-                bvals_all, bmasks_all,
-                tuple(bis),
-                jnp.asarray(idx_pad), jnp.int32(n_live),
-            )
+    def assemble(pb, c_p, c_pm, c_b, c_bm, new_sel) -> Batch:
+        """Output batch from gathered arrays; c_p None = probe columns
+        stay zero-copy views at full width (dense output)."""
         out_cols = []
         for (src, ci), f in zip(out_map, out_schema):
             if src == -1:
@@ -302,23 +268,143 @@ def _run_chain(
         out = batch_from_columns(out_cols, out_schema.names, new_sel)
         return Batch(out_schema, out.device, out.dicts)
 
-    # one-deep software pipeline: dispatch batch i+1 before syncing batch i
-    pending = None
+    def take_at(pb, sel_out, bis, out_cap: int):
+        """Device-side compaction into a static bucket: index, gather and
+        live count in ONE program — no host round-trip."""
+        return _chain_take_pred_jit(
+            tuple(pb.col_values(c) for c in probe_cols),
+            tuple(pb.col_validity(c) for c in probe_cols),
+            bvals_all, bmasks_all, tuple(bis), sel_out,
+            out_cap=out_cap,
+        )
+
+    def dispatch(pb):
+        """Async half: ALL levels' canon + probe + selection AND as ONE
+        program (single pass over the probe keys), then the compacted (or
+        dense) gather at the PREDICTED bucket. No host sync here — the live
+        count rides the transfer window and is harvested k batches later,
+        overlapping device compute (and, on remote accelerators, hiding
+        link latency). Returns (async-arrays, finish-state)."""
+        kv_all = tuple(
+            tuple(pb.col_values(c) for c in key_cols)
+            for key_cols in key_cols_per_level
+        )
+        km_all = tuple(
+            tuple(pb.col_validity(c) for c in key_cols)
+            for key_cols in key_cols_per_level
+        )
+        sel_out, bis = _chain_probe_all_jit(
+            kv_all, km_all, pb.device.sel,
+            luts, lut_bases, bwords_all, n_lives,
+            cfgs=level_cfgs,
+        )
+        bis = list(bis)
+        if not compact_mode:
+            return (), ("dense", pb, sel_out, bis, None)
+        pred_cap = pred.predict(pb.capacity) if pred is not None else None
+        if pred_cap is None:
+            if pred is None:
+                # predictor off, compaction on: ship the selection MASK
+                # through the window so the per-batch read still overlaps
+                # k batches of compute (the pre-predictor 1-deep pipeline,
+                # deepened and async-accounted)
+                return (sel_out,), ("sync", pb, sel_out, bis, None)
+            # no history yet: classic blocking seed path (eager, once)
+            return (), ("sync", pb, sel_out, bis, None)
+        if compaction_bucket(pred_cap, pb.capacity) is None:
+            # predicted survival too high for compaction to pay: dense
+            # emit, still sync-free (live count observed asynchronously)
+            n_live_dev = _sel_count_jit(sel_out)
+            return (n_live_dev,), ("pdense", pb, sel_out, bis, None)
+        taken = take_at(pb, sel_out, bis, pred_cap)
+        return (taken[-1],), ("pred", pb, sel_out, bis, (taken, pred_cap))
+
+    def finish(resolved, state) -> Batch:
+        mode, pb, sel_out, bis, extra = state
+        if mode == "dense":
+            # accelerator mode: dense output, ZERO host syncs in the chain
+            c_b, c_bm = _chain_take_dense_jit(
+                bvals_all, bmasks_all, tuple(bis), sel_out
+            )
+            return assemble(pb, None, None, c_b, c_bm, sel_out)
+        if mode == "sync":
+            if resolved:
+                sel_np = resolved[0]  # windowed mask (predictor off)
+            else:
+                sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point(2/task) -- chain compaction seed read: first batch of a stream
+            idx_np = np.flatnonzero(sel_np)
+            n_live = int(idx_np.size)
+            if pred is not None:
+                pred.observe(n_live)
+            out_cap = compaction_bucket(n_live, pb.capacity)
+            if out_cap is None:
+                c_b, c_bm = _chain_take_dense_jit(
+                    bvals_all, bmasks_all, tuple(bis), sel_out
+                )
+                return assemble(pb, None, None, c_b, c_bm, sel_out)
+            idx_pad = np.zeros(out_cap, dtype=np.int32)
+            idx_pad[:n_live] = idx_np
+            c_p, c_pm, c_b, c_bm, new_sel = _chain_take_jit(
+                tuple(pb.col_values(c) for c in probe_cols),
+                tuple(pb.col_validity(c) for c in probe_cols),
+                bvals_all, bmasks_all,
+                tuple(bis),
+                jnp.asarray(idx_pad), jnp.int32(n_live),
+            )
+            return assemble(pb, c_p, c_pm, c_b, c_bm, new_sel)
+        # predicted modes: the live count was harvested from the window
+        n_live = int(resolved[0])
+        if mode == "pdense":
+            pred.observe(n_live)
+            c_b, c_bm = _chain_take_dense_jit(
+                bvals_all, bmasks_all, tuple(bis), sel_out
+            )
+            return assemble(pb, None, None, c_b, c_bm, sel_out)
+        taken, pred_cap = extra
+        pred.observe(n_live, predicted=pred_cap)
+        if n_live > pred_cap:
+            # mispredict: the compacted gather truncated rows. Repair from
+            # the still-held device state at the CORRECT bucket — pure
+            # recompute, no extra sync (n_live is already host-side).
+            ctx.metrics.add("sel_mispredicts", 1)
+            out_cap = compaction_bucket(n_live, pb.capacity)
+            if out_cap is None:
+                c_b, c_bm = _chain_take_dense_jit(
+                    bvals_all, bmasks_all, tuple(bis), sel_out
+                )
+                return assemble(pb, None, None, c_b, c_bm, sel_out)
+            taken = take_at(pb, sel_out, bis, out_cap)
+        c_p, c_pm, c_b, c_bm, new_sel, _ = taken
+        return assemble(pb, c_p, c_pm, c_b, c_bm, new_sel)
+
+    # k-deep software pipeline: batch i's live count is harvested while
+    # batches i+1..i+k compute; emission order stays FIFO. Seed-path
+    # batches ("sync": no prediction yet) finish EAGERLY so the first
+    # batch's observation unblocks prediction for the second — they only
+    # occur as a stream prefix, while the window is still empty.
     for pb in probe_child_stream:
         ctx.check_cancelled()
-        with ctx.metrics.timer("probe_time"):
-            cur = dispatch(pb)
-            if pending is not None:
-                ready = finish(pending)
+        with ctx.metrics.timer("probe_time", count=True):
+            arrays, state = dispatch(pb)
+            if state[0] == "dense" or (
+                pred is not None and state[0] == "sync" and not len(window)
+            ):
+                # dense (accelerator) mode has no host read to overlap —
+                # emit immediately instead of pinning k batches of probe/
+                # build-index state in the window
+                ready = [finish((), state)]
             else:
-                ready = None
-            pending = cur
-        if ready is not None:
-            yield ready
-    if pending is not None:
+                ready = [
+                    finish(resolved, st)
+                    for resolved, st in window.push(arrays, state)
+                ]
+        yield from ready
+    for resolved, state in window.drain():
         with ctx.metrics.timer("probe_time"):
-            ready = finish(pending)
+            ready = finish(resolved, state)
         yield ready
+    if pred is not None and pred.predictions:
+        ctx.metrics.add("sel_pred_batches", pred.predictions)
 
 
 from functools import partial
@@ -362,6 +448,33 @@ def _and_all(sel, oks):
     for ok in oks:
         sel = sel & ok
     return sel
+
+
+@jax.jit
+def _sel_count_jit(sel):
+    return jnp.sum(sel.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _chain_take_pred_jit(
+    probe_vals, probe_masks, build_vals, build_masks, bis, sel, out_cap: int
+):
+    """Sync-free variant of _chain_take_jit: the compaction index is
+    computed ON DEVICE from the selection mask at a *predicted* static
+    bucket, and the actual live count is returned for asynchronous
+    harvest — if it exceeds out_cap the caller repairs by re-taking at
+    the correct bucket (rows beyond out_cap are truncated here)."""
+    idx, new_sel = compaction_index(sel, out_cap)
+    n_live = jnp.sum(sel.astype(jnp.int32))
+    c_p = tuple(v[idx] for v in probe_vals)
+    c_pm = tuple(m[idx] & new_sel for m in probe_masks)
+    c_b = []
+    c_bm = []
+    for lv_vals, lv_masks, bi in zip(build_vals, build_masks, bis):
+        c_bi = bi[idx]
+        c_b.append(tuple(v[c_bi] for v in lv_vals))
+        c_bm.append(tuple(m[c_bi] & new_sel for m in lv_masks))
+    return c_p, c_pm, tuple(c_b), tuple(c_bm), new_sel, n_live
 
 
 @jax.jit

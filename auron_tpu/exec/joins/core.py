@@ -273,7 +273,7 @@ def _maybe_pack(vals, words, sel) -> PackSpec | None:
     for cv in vals:
         if cv.dtype.kind not in _PACKABLE_KINDS or cv.dtype.is_dict_encoded:
             return None
-    mins, maxs = (x.tolist() for x in jax.device_get(_key_minmax_jit(tuple(words), sel)))  # auronlint: sync-point -- one fused min/max read decides LUT eligibility per build
+    mins, maxs = (x.tolist() for x in jax.device_get(_key_minmax_jit(tuple(words), sel)))  # auronlint: sync-point(8/task) -- one fused min/max read decides LUT eligibility per build
     if any(mn > mx for mn, mx in zip(mins, maxs)):  # no live rows
         return None
     bits = [max(int(mx - mn).bit_length(), 1) for mn, mx in zip(mins, maxs)]
@@ -382,7 +382,7 @@ def prepare_build(
             T.TypeKind.DATE32, T.TypeKind.TIMESTAMP)
         and not vals[0].dtype.is_dict_encoded
     ):
-        n_live, kmin_h, kmax_h = (int(x) for x in jax.device_get(_key_range_jit(words[0], sel)))  # auronlint: sync-point -- one fused key-range read per build
+        n_live, kmin_h, kmax_h = (int(x) for x in jax.device_get(_key_range_jit(words[0], sel)))  # auronlint: sync-point(8/task) -- one fused key-range read per build
         # pigeonhole pre-check: more live rows than distinct slots guarantees
         # duplicates, so a pairs-producing build can never be unique — skip
         # the scatter pass (and its sync) instead of building tables that the
@@ -397,7 +397,7 @@ def prepare_build(
             row_lut, exists, has_dup_d = _scatter_luts_jit(
                 words[0], sel, jnp.int64(kmin_h), size=size
             )
-            has_dup = bool(jax.device_get(has_dup_d))  # auronlint: sync-point -- one-scalar duplicate probe per build
+            has_dup = bool(jax.device_get(has_dup_d))  # auronlint: sync-point(8/task) -- one-scalar duplicate probe per build
             if not has_dup:
                 return PreparedBuild(
                     batch=big, words=[words[0]], n_live=n_live,
@@ -414,7 +414,7 @@ def prepare_build(
     # presorted pre-check: SMJ build sides arrive straight from SortExec,
     # already clustered with live rows in a prefix — detecting that on
     # device (one tiny sync) skips the whole sort + all-column permute
-    sorted_flag, stats0 = jax.device_get(_presorted_stats_jit(sel, tuple(words)))  # auronlint: sync-point -- one tiny sync skips the whole sort (see comment above)
+    sorted_flag, stats0 = jax.device_get(_presorted_stats_jit(sel, tuple(words)))  # auronlint: sync-point(8/task) -- one tiny sync skips the whole sort (see comment above)
     if bool(sorted_flag):
         clustered = big
         stats = stats0
@@ -435,7 +435,7 @@ def prepare_build(
     sorted_words = list(sorted_words)
     # uniqueness stats ride ONE transfer (integer-like keys took the LUT
     # fast path above, so no dense table is built here)
-    n_live, has_dup_h, _, _ = (int(x) for x in jax.device_get(stats))  # auronlint: sync-point -- build-plan stats, one read per build
+    n_live, has_dup_h, _, _ = (int(x) for x in jax.device_get(stats))  # auronlint: sync-point(8/task) -- build-plan stats, one read per build
     unique = n_live > 0 and not has_dup_h
     uniq_words = run_starts = None
     n_uniq = 0
@@ -641,6 +641,27 @@ def _gather_build_jit(build_vals, build_masks, bi, ok):
     )
 
 
+@partial(jax.jit, static_argnames=("out_cap",))
+def _unique_compact_take_pred_jit(
+    probe_vals, probe_masks, bi, ok, build_vals, build_masks, sel, out_cap: int
+):
+    """Sync-free compaction at a PREDICTED static bucket: the row index is
+    computed on device from the selection mask (no host flatnonzero, no
+    blocking live-count read). Rows beyond ``out_cap`` are truncated — the
+    caller harvests the true live count asynchronously and repairs a
+    too-small bucket by re-taking (exec/selectivity.py protocol)."""
+    from auron_tpu.columnar.batch import compaction_index
+
+    idx, new_sel = compaction_index(sel, out_cap)
+    c_pvals = tuple(v[idx] for v in probe_vals)
+    c_pmasks = tuple(m[idx] & new_sel for m in probe_masks)
+    c_bi = bi[idx]
+    c_ok = ok[idx] & new_sel
+    out_bvals = tuple(v[c_bi] for v in build_vals)
+    out_bmasks = tuple(m[c_bi] & c_ok for m in build_masks)
+    return c_pvals, c_pmasks, out_bvals, out_bmasks, new_sel
+
+
 @partial(jax.jit, static_argnames=("bcap", "use_lut", "probe_outer", "key_kinds"))
 def _unique_join_emit_jit(
     key_vals,
@@ -767,7 +788,7 @@ def expand_pairs(
     output batches is the caller's job (it knows the column order).
     """
     offsets = jnp.cumsum(counts)
-    total = int(jax.device_get(offsets[-1])) if counts.shape[0] else 0  # auronlint: sync-point -- ragged join-pair total, one per batch (ARCHITECTURE.md contract)
+    total = int(jax.device_get(offsets[-1])) if counts.shape[0] else 0  # auronlint: sync-point(1/batch) -- ragged join-pair total, one per batch (ARCHITECTURE.md contract)
     pcap = probe_batch.capacity
     bcap = build.batch.capacity
     probe_matched = counts > 0

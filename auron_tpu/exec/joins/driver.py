@@ -42,6 +42,30 @@ def _compact_join_output_enabled() -> bool:
     return not is_tpu()  # auto: syncs are cheap on CPU, costly on the link
 
 
+class UniqueProbePipeline:
+    """Per-probe-stream state for the sync-free unique-join compaction
+    boundary: a selectivity predictor picking the output bucket ahead of
+    time plus a k-deep async transfer window carrying each batch's actual
+    live count host-ward while later batches compute (docs/pipeline.md).
+
+    Owned by the hash-join exec (one per partition stream — the driver
+    itself is shared across concurrently running partitions) and passed
+    into ``probe_batch``; the exec MUST call ``EquiJoinDriver.finish_probe``
+    after the last probe batch to drain in-flight emissions."""
+
+    def __init__(self, conf):
+        from auron_tpu.exec.selectivity import (
+            SelectivityPredictor, predictor_enabled,
+        )
+        from auron_tpu.runtime.transfer import TransferWindow
+        from auron_tpu.utils.config import TRANSFER_WINDOW_DEPTH
+
+        self.pred = (
+            SelectivityPredictor(conf) if predictor_enabled(conf) else None
+        )
+        self.window = TransferWindow(conf.get(TRANSFER_WINDOW_DEPTH))
+
+
 class EquiJoinDriver:
     def __init__(
         self,
@@ -113,8 +137,14 @@ class EquiJoinDriver:
         )
         return core.prepare_build(build_batches, keys, schema, need_pairs=need_pairs)
 
-    def probe_batch(self, build: PreparedBuild, pb: Batch) -> Iterator[Batch]:
-        """Probe one batch; updates build.matched in place."""
+    def probe_batch(
+        self, build: PreparedBuild, pb: Batch,
+        pipe: "UniqueProbePipeline | None" = None,
+    ) -> Iterator[Batch]:
+        """Probe one batch; updates build.matched in place. ``pipe``
+        (optional) enables the sync-free pipelined compaction path on the
+        unique-build fast path — emissions then lag dispatch by up to the
+        window depth, and the caller must drain via ``finish_probe``."""
         probe_keys = self.left_keys if self.probe_is_left else self.right_keys
         pvals = _key_columns(pb, probe_keys)
         if build.pack is not None:
@@ -149,7 +179,7 @@ class EquiJoinDriver:
             # must keep the original sort order valid -> it does, because
             # unify_key_dicts maps build codes first (identity order).
         if build.unique:
-            yield from self._probe_batch_unique(build, pb, pvals)
+            yield from self._probe_batch_unique(build, pb, pvals, pipe)
             if orig_build is not build:
                 orig_build.matched = build.matched
             return
@@ -201,7 +231,8 @@ class EquiJoinDriver:
                 yield self._emit_probe_exists(pb, probe_matched)
 
     def _probe_batch_unique(
-        self, build: PreparedBuild, pb: Batch, pvals
+        self, build: PreparedBuild, pb: Batch, pvals,
+        pipe: "UniqueProbePipeline | None" = None,
     ) -> Iterator[Batch]:
         """Unique-build probe: each probe row has <=1 match, so one batch at
         probe capacity covers every join type — probe columns stay as views
@@ -236,7 +267,9 @@ class EquiJoinDriver:
             and _compact_join_output_enabled()
         )
         if compact_ok:
-            yield from self._emit_unique_compacted(build, pb, pvals, bcol_ids, proj)
+            yield from self._emit_unique_compacted(
+                build, pb, pvals, bcol_ids, proj, pipe
+            )
             return
 
         bi, ok, bvals, bmasks, sel_out = core._unique_join_emit_jit(
@@ -300,11 +333,12 @@ class EquiJoinDriver:
                 yield self._emit_probe_exists(pb, ok & pb.device.sel)
 
     def _emit_unique_compacted(
-        self, build: PreparedBuild, pb: Batch, pvals, bcol_ids, proj
+        self, build: PreparedBuild, pb: Batch, pvals, bcol_ids, proj,
+        pipe: "UniqueProbePipeline | None" = None,
     ) -> Iterator[Batch]:
         import jax
 
-        from auron_tpu.columnar.batch import bucket_capacity
+        from auron_tpu.columnar.batch import compaction_bucket
 
         bb = build.batch
         nl = len(self.left_schema)
@@ -322,40 +356,133 @@ class EquiJoinDriver:
         )
         if self.build_mark or self.build_outer:
             build.matched = build.matched.at[bi].max(ok, mode="drop")
-        # ONE transfer: the selection mask itself (it was going to sync for
-        # the live count anyway; the mask is 1 byte/row and yields the
-        # compaction index host-side via flatnonzero)
-        sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point -- compaction index at the join blocking boundary
-        idx_np = np.flatnonzero(sel_np)
-        n_live = int(idx_np.size)
-        out_cap = bucket_capacity(max(n_live, 1))
         pcol_ids = [
             (oi if oi < nl else oi - nl)
             for oi in proj
             if (oi < nl) == self.probe_is_left
         ]
-        if out_cap * 4 > pb.capacity:
-            # dense output: compaction wouldn't pay — plain gathers
+        pred = pipe.pred if pipe is not None else None
+        pred_cap = pred.predict(pb.capacity) if pred is not None else None
+        if pred_cap is None:
+            # seed/fallback path: ONE transfer — the selection mask itself
+            # (it was going to sync for the live count anyway; the mask is
+            # 1 byte/row and yields the compaction index host-side via
+            # flatnonzero). Steady state replaces this with the predicted
+            # bucket below: first batch of a stream only.
+            sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point(2/task) -- unique-join compaction seed read: first batch of a stream (and predictor-off fallback)
+            idx_np = np.flatnonzero(sel_np)
+            n_live = int(idx_np.size)
+            if pred is not None:
+                pred.observe(n_live)
+            out_cap = compaction_bucket(n_live, pb.capacity)
+            if out_cap is None:
+                # dense output: compaction wouldn't pay — plain gathers
+                bvals, bmasks = core._gather_build_jit(
+                    tuple(bb.col_values(c) for c in bcol_ids),
+                    tuple(bb.col_validity(c) for c in bcol_ids),
+                    bi, ok,
+                )
+                c_pvals = c_pmasks = None
+                new_sel = sel_out
+            else:
+                idx_pad = np.zeros(out_cap, dtype=np.int32)
+                idx_pad[:n_live] = idx_np
+                c_pvals, c_pmasks, bvals, bmasks, new_sel = core._unique_compact_take_jit(
+                    tuple(pb.col_values(c) for c in pcol_ids),
+                    tuple(pb.col_validity(c) for c in pcol_ids),
+                    bi, ok,
+                    tuple(bb.col_values(c) for c in bcol_ids),
+                    tuple(bb.col_validity(c) for c in bcol_ids),
+                    jnp.asarray(idx_pad), jnp.int32(n_live),
+                )
+            yield self._unique_out_batch(
+                pb, bb, proj, pcol_ids, bcol_ids,
+                c_pvals, c_pmasks, bvals, bmasks, new_sel,
+            )
+            return
+        # predicted path: compaction index computed ON DEVICE at the
+        # predicted bucket (or dense when prediction says compaction won't
+        # pay) — no host sync; the actual live count is harvested from the
+        # transfer window k batches later and mispredicts repair there
+        if compaction_bucket(pred_cap, pb.capacity) is None:
             bvals, bmasks = core._gather_build_jit(
                 tuple(bb.col_values(c) for c in bcol_ids),
                 tuple(bb.col_validity(c) for c in bcol_ids),
                 bi, ok,
             )
-            p_at = None
-            c_pvals = c_pmasks = None
-            new_sel = sel_out
+            taken = (None, None, bvals, bmasks, sel_out)
+            state = (pb, bb, proj, pcol_ids, bcol_ids, taken,
+                     None, bi, ok, sel_out)
         else:
-            idx_pad = np.zeros(out_cap, dtype=np.int32)
-            idx_pad[:n_live] = idx_np
-            c_pvals, c_pmasks, bvals, bmasks, new_sel = core._unique_compact_take_jit(
+            taken = core._unique_compact_take_pred_jit(
                 tuple(pb.col_values(c) for c in pcol_ids),
                 tuple(pb.col_validity(c) for c in pcol_ids),
                 bi, ok,
                 tuple(bb.col_values(c) for c in bcol_ids),
                 tuple(bb.col_validity(c) for c in bcol_ids),
-                jnp.asarray(idx_pad), jnp.int32(n_live),
+                sel_out, out_cap=pred_cap,
             )
-            p_at = {c: k for k, c in enumerate(pcol_ids)}
+            state = (pb, bb, proj, pcol_ids, bcol_ids, taken,
+                     pred_cap, bi, ok, sel_out)
+        for resolved, st in pipe.window.push((n_live_dev,), state):
+            yield self._finish_unique_compacted(resolved, st, pred)
+
+    def finish_probe(self, pipe: "UniqueProbePipeline | None") -> Iterator[Batch]:
+        """Drain the pipelined compaction window at end of the probe
+        stream (emissions lag dispatch by the window depth)."""
+        if pipe is None:
+            return
+        for resolved, st in pipe.window.drain():
+            yield self._finish_unique_compacted(resolved, st, pipe.pred)
+
+    def _finish_unique_compacted(self, resolved, state, pred) -> Batch:
+        """Harvest half of the predicted compaction: observe the actual
+        live count, repair a too-small bucket by re-taking from the
+        still-held device state (pure recompute — no extra sync)."""
+        from auron_tpu.columnar.batch import compaction_bucket
+        from auron_tpu.exec.base import current_context
+
+        pb, bb, proj, pcol_ids, bcol_ids, taken, pred_cap, bi, ok, sel_out = state
+        n_live = int(resolved[0])
+        if pred is not None:
+            pred.observe(n_live, predicted=pred_cap)
+        if pred_cap is not None and n_live > pred_cap:
+            ctx = current_context()
+            if ctx is not None:
+                ctx.metrics.add("sel_mispredicts", 1)
+            out_cap = compaction_bucket(n_live, pb.capacity)
+            if out_cap is None:
+                bvals, bmasks = core._gather_build_jit(
+                    tuple(bb.col_values(c) for c in bcol_ids),
+                    tuple(bb.col_validity(c) for c in bcol_ids),
+                    bi, ok,
+                )
+                taken = (None, None, bvals, bmasks, sel_out)
+            else:
+                taken = core._unique_compact_take_pred_jit(
+                    tuple(pb.col_values(c) for c in pcol_ids),
+                    tuple(pb.col_validity(c) for c in pcol_ids),
+                    bi, ok,
+                    tuple(bb.col_values(c) for c in bcol_ids),
+                    tuple(bb.col_validity(c) for c in bcol_ids),
+                    sel_out, out_cap=out_cap,
+                )
+        c_pvals, c_pmasks, bvals, bmasks, new_sel = taken
+        return self._unique_out_batch(
+            pb, bb, proj, pcol_ids, bcol_ids,
+            c_pvals, c_pmasks, bvals, bmasks, new_sel,
+        )
+
+    def _unique_out_batch(
+        self, pb, bb, proj, pcol_ids, bcol_ids,
+        c_pvals, c_pmasks, bvals, bmasks, new_sel,
+    ) -> Batch:
+        """Assemble the projected output batch; c_pvals None = dense output
+        (probe columns stay zero-copy views at full width)."""
+        nl = len(self.left_schema)
+        p_at = (
+            None if c_pvals is None else {c: k for k, c in enumerate(pcol_ids)}
+        )
         b_at = {c: k for k, c in enumerate(bcol_ids)}
         out_cols = []
         for oi in proj:
@@ -380,7 +507,7 @@ class EquiJoinDriver:
                               bb.schema[ci].dtype, bb.dicts[ci])
                 )
         out = batch_from_columns(out_cols, self.out_schema.names, new_sel)
-        yield Batch(self.out_schema, out.device, out.dicts)
+        return Batch(self.out_schema, out.device, out.dicts)
 
     def finish(self, build: PreparedBuild) -> Iterator[Batch]:
         bb = build.batch

@@ -31,12 +31,18 @@ class MetricNode:
         self.values[metric] = int(value)
 
     @contextmanager
-    def timer(self, metric: str):
+    def timer(self, metric: str, count: bool = False):
+        """Accumulate wall nanos into ``metric``; with ``count`` also bump
+        ``{metric}_n`` — hot loops use it so breakdowns can express
+        per-batch multiplicities (sync-budget checks divide site counts by
+        these), not just totals."""
         t0 = time.perf_counter_ns()
         try:
             yield
         finally:
             self.add(metric, time.perf_counter_ns() - t0)
+            if count:
+                self.add(metric + "_n", 1)
 
     def snapshot(self) -> dict:
         """Flatten to {name: {metric: value}, children: [...]} for the bridge."""

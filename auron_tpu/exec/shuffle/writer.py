@@ -58,10 +58,9 @@ class ShuffleWriterExec(ExecOperator):
         # reference's spill-merge path (sort_repartitioner.rs:98-151)
         mm.register(staging)
         try:
-            for b in self.child_stream(0, partition, ctx):
-                ctx.check_cancelled()
-                with ctx.metrics.timer("repart_time"):
-                    parts = partition_batch(b, self.partitioning, ctx)
+            for parts in partitioned_stream(
+                self.child_stream(0, partition, ctx), self.partitioning, ctx
+            ):
                 nbytes = sum(rb.nbytes for _, rb in parts)
                 mm.acquire(staging, nbytes)
                 staging.add_all(parts)
@@ -287,10 +286,9 @@ class RssShuffleWriterExec(ExecOperator):
                 staged[pid].clear()
                 staged_bytes[pid] = 0
 
-        for b in self.child_stream(0, partition, ctx):
-            ctx.check_cancelled()
-            with ctx.metrics.timer("repart_time"):
-                parts = partition_batch(b, self.partitioning, ctx)
+        for parts in partitioned_stream(
+            self.child_stream(0, partition, ctx), self.partitioning, ctx
+        ):
             for pid, rb in parts:
                 staged[pid].append(rb)
                 staged_bytes[pid] += rb.nbytes
@@ -304,19 +302,40 @@ class RssShuffleWriterExec(ExecOperator):
         yield  # pragma: no cover
 
 
-def partition_batch(
+def stage_partition_batch(
     b: Batch, partitioning: Partitioning, ctx: ExecutionContext
-) -> list[tuple[int, pa.RecordBatch]]:
-    """Cluster a batch by partition id on device; return per-partition arrow
-    slices (host). Dead rows are excluded. The device portion (pid sort +
-    counts + gather) is one jitted program per batch shape."""
-    from auron_tpu.columnar.batch import bucket_capacity, prefix_slice
-
+):
+    """Dispatch half of the repartition: compute partition ids (and, on
+    accelerators, the pid-clustered gather) on device and START the
+    device->host copies — the writer loops finish one batch behind, so
+    the transfer overlaps the child's next batch of compute
+    (docs/pipeline.md; this is the spill/shuffle-count member of the
+    async transfer window)."""
     from auron_tpu.ops import hostsort
+    from auron_tpu.runtime.transfer import start_host_transfer
 
     pids = partitioning.partition_ids(b, ctx)
     n_out = partitioning.num_partitions
     if hostsort.use_host_sort():
+        dev = b.device
+        start_host_transfer(pids, dev.sel, *dev.values, *dev.validity)
+        return (b, pids, None, None)
+    clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
+    start_host_transfer(counts)
+    return (b, None, clustered_dev, counts)
+
+
+def finish_partition_batch(
+    staged, partitioning: Partitioning, ctx: ExecutionContext
+) -> list[tuple[int, pa.RecordBatch]]:
+    """Harvest half: resolve the staged transfers and slice per-partition
+    arrow blocks. Dead rows are excluded."""
+    from auron_tpu.columnar.batch import bucket_capacity, prefix_slice
+    from auron_tpu.utils.profiling import async_read_scope
+
+    b, pids, clustered_dev, counts = staged
+    n_out = partitioning.num_partitions
+    if pids is not None:
         # CPU host: the clustered rows are headed to HOST Arrow blocks
         # anyway, so pull the WHOLE batch once and do everything — stable
         # integer argsort (numpy radix), live-prefix slicing, per-column
@@ -327,7 +346,8 @@ def partition_batch(
         # for accelerators, where the gather belongs on-device.
         from auron_tpu.columnar.batch import host_rows_to_arrow
 
-        pids_np, dev = jax.device_get((pids, b.device))  # numpy leaves
+        with async_read_scope():  # copies started at stage time
+            pids_np, dev = jax.device_get((pids, b.device))  # numpy leaves
         sort_pid = np.where(dev.sel, pids_np.astype(np.int32), n_out)
         counts_np = np.bincount(sort_pid, minlength=n_out + 1)[:n_out]
         order_live = np.argsort(sort_pid, kind="stable")[: int(counts_np.sum())]
@@ -341,8 +361,8 @@ def partition_batch(
                 out.append((pid, rb.slice(start, c)))
             start += c
         return out
-    clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
-    counts_np = np.asarray(jax.device_get(counts))[:n_out]
+    with async_read_scope():  # count copy started at stage time
+        counts_np = np.asarray(jax.device_get(counts))[:n_out]
     clustered = Batch(b.schema, clustered_dev, b.dicts)
     total_live = int(counts_np.sum())
     # live rows sort to the front (dead rows got pid=n_out): pull only the
@@ -357,3 +377,25 @@ def partition_batch(
             out.append((pid, rb.slice(start, c)))
         start += c
     return out
+
+
+def partitioned_stream(child_iter, partitioning: Partitioning, ctx):
+    """One-deep stage/finish pipeline over a batch stream: batch i's
+    device->host transfer rides behind batch i+1's dispatch, so the
+    writer never blocks on the child's compute tail."""
+    pending = None
+    for b in child_iter:
+        ctx.check_cancelled()
+        with ctx.metrics.timer("repart_time", count=True):
+            cur = stage_partition_batch(b, partitioning, ctx)
+            parts = (
+                finish_partition_batch(pending, partitioning, ctx)
+                if pending is not None else None
+            )
+        pending = cur
+        if parts is not None:
+            yield parts
+    if pending is not None:
+        with ctx.metrics.timer("repart_time"):
+            parts = finish_partition_batch(pending, partitioning, ctx)
+        yield parts

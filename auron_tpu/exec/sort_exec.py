@@ -251,7 +251,7 @@ class _SortedRun:
         self.key_words = key_words
 
     def to_host(self) -> "_HostRun":
-        # auronlint: sync-point -- spill tier: device->host is the operation itself; one batched transfer
+        # auronlint: sync-point(call) -- spill tier: device->host is the operation itself; one batched transfer
         dev, words = jax.device_get((self.batch.device, self.key_words))
         n = int(np.sum(np.asarray(dev.sel)))
         return _HostRun(

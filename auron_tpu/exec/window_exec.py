@@ -211,7 +211,7 @@ class WindowExec(ExecOperator):
         out = batch_from_columns(cols, names, sel_sorted)
         whole = Batch(self.schema, out.device, out.dicts)
         # chunked emission like sort
-        n = int(jax.device_get(jnp.sum(sel_sorted)))  # auronlint: sync-point -- live count for chunked emission, once per blocking window
+        n = int(jax.device_get(jnp.sum(sel_sorted)))  # auronlint: sync-point(4/task) -- live count for chunked emission, once per blocking window
         chunk = bucket_capacity(ctx.batch_size())
         if n <= chunk:
             yield whole
@@ -400,7 +400,7 @@ class WindowExec(ExecOperator):
             )
             return cum[jnp.clip(peer_end - 1, 0, cap - 1)] - base
 
-        # auronlint: sync-point -- exact wide-decimal window sums need python ints (host by design); one batched transfer
+        # auronlint: sync-point(call) -- exact wide-decimal window sums need python ints (host by design); one batched transfer
         limb_sums, cnt_d, sel_d = jax.device_get((
             tuple(windowed(lr) for lr in limb_rows),
             windowed(valid.astype(jnp.int64)), sel,

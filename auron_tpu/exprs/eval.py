@@ -363,7 +363,7 @@ class Evaluator:
                 return None
             import jax
 
-            host = np.asarray(jax.device_get(cv.values))  # auronlint: sync-point -- scalar-subquery constant probe, once per plan
+            host = np.asarray(jax.device_get(cv.values))  # auronlint: sync-point(2/task) -- scalar-subquery constant probe, once per plan
             if host.size == 0 or not (host == host.flat[0]).all():
                 return None
             v = int(host.flat[0])
@@ -416,7 +416,7 @@ class Evaluator:
         import jax
 
         def host_side(cv: ColumnVal):
-            vals = np.asarray(jax.device_get(cv.values)).astype(np.int64)  # auronlint: sync-point -- documented host-exact decimal path (one sync, O(distinct pairs))
+            vals = np.asarray(jax.device_get(cv.values)).astype(np.int64)  # auronlint: sync-point(1/batch) -- documented host-exact decimal path (one sync, O(distinct pairs))
             if cv.dtype.is_wide_decimal:
                 entries = cv.dict.to_pylist()
                 vals = np.clip(vals, 0, max(len(entries) - 1, 0))

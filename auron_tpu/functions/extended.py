@@ -795,7 +795,7 @@ def _hex(args, cap):
         )
     # integral: uppercase hex of the unsigned 64-bit two's complement
     v = a.values.astype(jnp.int64)
-    # auronlint: sync-point -- hex formatting transforms the dictionary host-side; one batched transfer
+    # auronlint: sync-point(call) -- hex formatting transforms the dictionary host-side; one batched transfer
     host_d, mask_d = jax.device_get((v, a.validity))
     host, mask = np.asarray(host_d).astype(np.uint64), np.asarray(mask_d)
     ss = [format(int(x), "X") for x in host]

@@ -91,7 +91,7 @@ class SparkBloomFilter:
     # ---- serde (binary payload shipped through plans/literals) ----
 
     def serialize(self) -> bytes:
-        w = np.asarray(jax.device_get(self.words)).astype("<u4").tobytes()  # auronlint: sync-point -- serialize() is the broadcast/spill boundary
+        w = np.asarray(jax.device_get(self.words)).astype("<u4").tobytes()  # auronlint: sync-point(call) -- serialize() is the broadcast/spill boundary
         return struct.pack("<III", 1, self.num_hashes, self.num_bits) + w
 
     @staticmethod

@@ -157,7 +157,7 @@ def host_order(words: list[jnp.ndarray], sel: jnp.ndarray) -> jnp.ndarray:
     stable). Call OUTSIDE jit; pass the result as ``order``."""
     import numpy as np
 
-    # auronlint: sync-point -- documented eager host boundary ("call OUTSIDE jit"); one batched transfer
+    # auronlint: sync-point(2/batch) -- documented eager host boundary ("call OUTSIDE jit"); one batched transfer
     dead_d, words_d = jax.device_get(
         (jnp.where(sel, jnp.uint64(0), jnp.uint64(1)), tuple(words)))
     operands = [np.asarray(dead_d), *[np.asarray(w) for w in words_d]]

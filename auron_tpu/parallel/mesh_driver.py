@@ -506,12 +506,12 @@ class MeshQueryDriver:
             if on_tpu:
                 live_pid = jnp.where(b.device.sel, pid.astype(jnp.int32), -1)
                 counts[src] = np.asarray(
-                    jax.device_get(  # auronlint: sync-point -- routing histogram read at the exchange stage boundary
+                    jax.device_get(  # auronlint: sync-point(4/task) -- routing histogram read at the exchange stage boundary
                         partition_histogram_pallas(live_pid, self.n_parts)
                     )
                 )
                 continue
-            # auronlint: sync-point -- exchange routing histogram read at the stage boundary; one batched transfer
+            # auronlint: sync-point(4/task) -- exchange routing histogram read at the stage boundary; one batched transfer
             sel_d, pid_d = jax.device_get((b.device.sel, pid))
             sel = np.asarray(sel_d)
             pid_h = np.asarray(pid_d)[sel]
@@ -670,7 +670,7 @@ class MeshQueryDriver:
             place(sel),
             place(pid),
         )
-        assert int(jax.device_get(overflow)) == 0, "sized from exact counts"  # auronlint: sync-point -- one-scalar overflow invariant check per exchange
+        assert int(jax.device_get(overflow)) == 0, "sized from exact counts"  # auronlint: sync-point(4/task) -- one-scalar overflow invariant check per exchange
 
         # expose the addressable partitions (all of them single-process;
         # only this process's shards in SPMD) as a partition-keyed mapping
@@ -905,7 +905,7 @@ def _spmd_shard_rows(mesh, n_parts: int, local_arr) -> jax.Array:
     this with its own rows; together they form the full array)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    host = np.asarray(jax.device_get(local_arr))  # auronlint: sync-point -- SPMD global-array assembly at the stage boundary
+    host = np.asarray(jax.device_get(local_arr))  # auronlint: sync-point(4/task) -- SPMD global-array assembly at the stage boundary
     global_shape = (n_parts,) + tuple(host.shape[1:])
     return jax.make_array_from_process_local_data(
         NamedSharding(mesh, P(PARTITION_AXIS)), host, global_shape

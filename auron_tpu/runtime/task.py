@@ -54,6 +54,13 @@ class TaskRuntime:
         self._queue: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._error: BaseException | None = None
         self._finalized = False
+        # flipped by the first next_arrow(): the pump then starts the
+        # device->host copy of each batch BEFORE enqueueing it, so the
+        # consumer's to_arrow finds the bytes already landed (the copy
+        # overlaps the next batch's device compute instead of stalling
+        # inside device_get — the pump-side half of the async transfer
+        # window, runtime/transfer.py)
+        self._host_prefetch = False
         self._thread = threading.Thread(target=self._pump, daemon=True, name="auron-task-pump")
         self._thread.start()
 
@@ -72,7 +79,16 @@ class TaskRuntime:
                 # hostsort test). Host sorts therefore compute their order
                 # EAGERLY and pass it into the jit as data
                 # (ops/segments.py host_order).
+                from auron_tpu.utils.profiling import EngineCounters
+
+                counters = EngineCounters._installed
                 for batch in self.plan.execute(self.ctx.partition_id, self.ctx):
+                    if counters is not None:
+                        # per-batch denominator for sync-budget checks
+                        # (tools/perfcheck.py); no-op unless profiling is on
+                        counters.note_batch()
+                    if self._host_prefetch:
+                        batch.prefetch_host()
                     self._queue.put(batch)
         except TaskCancelled:
             pass
@@ -102,7 +118,10 @@ class TaskRuntime:
         return item
 
     def next_arrow(self) -> pa.RecordBatch | None:
-        """Next batch materialized to Arrow — the host FFI boundary."""
+        """Next batch materialized to Arrow — the host FFI boundary.
+        Signals the pump to prefetch device->host copies for every
+        subsequent batch (this consumer is going to materialize them all)."""
+        self._host_prefetch = True
         b = self.next_batch()
         return None if b is None else b.to_arrow()
 

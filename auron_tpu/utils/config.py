@@ -182,6 +182,37 @@ JOIN_COMPACT_OUTPUT = str_conf(
     "(costs one host sync per probe batch): auto = on for CPU hosts, off "
     "on accelerators where the sync round-trip outweighs the saved gather",
 )
+SELECTIVITY_PREDICTOR_ENABLE = str_conf(
+    "exec.selectivity.predictor", "auto", "exec",
+    "predict the compacted-output capacity bucket from an EWMA of prior "
+    "batches' live counts instead of blocking on a per-batch device_get "
+    "(exec/selectivity.py; mispredicts repair via re-emit): on | off | "
+    "auto = on wherever compaction itself is on",
+)
+SELECTIVITY_EWMA_ALPHA = float_conf(
+    "exec.selectivity.ewma.alpha", 0.3, "exec",
+    "EWMA weight of the newest batch's live count in the selectivity "
+    "predictor (higher = faster tracking, more bucket churn)",
+)
+SELECTIVITY_HEADROOM = float_conf(
+    "exec.selectivity.headroom", 1.5, "exec",
+    "multiplier over the EWMA live count before bucketing the predicted "
+    "capacity — absorbs batch-to-batch selectivity noise without a "
+    "mispredict/repair cycle",
+)
+SELECTIVITY_SHRINK_PATIENCE = int_conf(
+    "exec.selectivity.shrink.patience", 4, "exec",
+    "consecutive batches the demand must sit at half the predicted bucket "
+    "(or less) before the predictor shrinks it — hysteresis so an "
+    "oscillating selectivity doesn't thrash buckets (and jit shapes)",
+)
+TRANSFER_WINDOW_DEPTH = int_conf(
+    "runtime.transfer.window.depth", 4, "runtime",
+    "depth k of the async device->host transfer window: residual scalar "
+    "reads (compaction live counts, dense-agg fold flags) are harvested k "
+    "batches after their transfer starts, overlapping device compute "
+    "(runtime/transfer.py). 1 = classic one-deep pipeline",
+)
 HOST_SORT_MODE = str_conf(
     "exec.host.sort", "auto", "exec",
     "compute order permutations host-side via a callback lexsort instead of "

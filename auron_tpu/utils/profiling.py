@@ -12,7 +12,30 @@ failing the run.
 
 from __future__ import annotations
 
+import threading
 import time
+from contextlib import contextmanager
+
+# thread-local marker set by the async-transfer window while it harvests a
+# read whose device->host copy was STARTED batches ago (runtime/transfer.py):
+# the harvest is a copy completion, not a pipeline stall, so it is accounted
+# as an async_read instead of a host sync. A harvest that still blocks
+# (> _STALL_S) is attributed to its site like any sync — an "async" window
+# that stalls must stay visible in the breakdown.
+_async_ctx = threading.local()
+
+_STALL_S = 0.001
+
+
+@contextmanager
+def async_read_scope():
+    """Mark device->host reads on this thread as async-window harvests."""
+    prev = getattr(_async_ctx, "on", False)
+    _async_ctx.on = True
+    try:
+        yield
+    finally:
+        _async_ctx.on = prev
 
 
 class EngineCounters:
@@ -26,9 +49,19 @@ class EngineCounters:
         self.compile_s = 0.0
         self.syncs = 0
         self.sync_s = 0.0
+        # async-window harvests (transfer started k batches earlier);
+        # separated so host_syncs measures pipeline stalls, not reads
+        self.async_reads = 0
+        self.async_read_s = 0.0
+        # batches pumped through task runtimes — the per-batch denominator
+        # for sync-budget checks (tools/perfcheck.py)
+        self.batches = 0
         # per-call-site sync attribution (engine frame nearest the sync);
         # cheap enough to keep always-on: one stack walk per *blocking* sync
         self.sync_sites: dict[str, list] = {}
+        # record every blocking sync's site regardless of duration (the
+        # sync-budget gate counts multiplicities, not just stalls)
+        self.record_all_sites = False
 
     def _record_site(self, dt: float) -> None:
         import sys as _sys
@@ -78,10 +111,18 @@ class EngineCounters:
                     return orig_value.fget(arr)
                 finally:
                     dt = time.perf_counter() - t0
-                    self.syncs += 1
-                    self.sync_s += dt
-                    if dt > 0.001:
-                        self._record_site(dt)
+                    if getattr(_async_ctx, "on", False):
+                        self.async_reads += 1
+                        self.async_read_s += dt
+                        if dt > _STALL_S:
+                            # the window was too shallow: the harvest still
+                            # blocked — keep it visible in the site table
+                            self._record_site(dt)
+                    else:
+                        self.syncs += 1
+                        self.sync_s += dt
+                        if dt > _STALL_S or self.record_all_sites:
+                            self._record_site(dt)
 
             _ja.ArrayImpl._value = counted_value
         except Exception:
@@ -89,12 +130,18 @@ class EngineCounters:
         cls._installed = self
         return self
 
+    def note_batch(self) -> None:
+        self.batches += 1
+
     def reset(self) -> None:
         """Zero all counters (e.g. after an untimed warmup run)."""
         self.compiles = 0
         self.compile_s = 0.0
         self.syncs = 0
         self.sync_s = 0.0
+        self.async_reads = 0
+        self.async_read_s = 0.0
+        self.batches = 0
         self.sync_sites.clear()
 
     def snapshot(self) -> dict:
@@ -104,5 +151,8 @@ class EngineCounters:
             "compile_s": round(self.compile_s, 3),
             "host_syncs": self.syncs,
             "host_sync_s": round(self.sync_s, 3),
+            "async_reads": self.async_reads,
+            "async_read_s": round(self.async_read_s, 3),
+            "batches": self.batches,
             "sync_sites": {k: [v[0], round(v[1], 3)] for k, v in top},
         }

@@ -146,6 +146,11 @@ def _ensure_live_backend() -> None:
 def main() -> None:
     import auron_tpu  # noqa: F401
     from auron_tpu.models import tpcds
+    from auron_tpu.utils.profiling import EngineCounters
+
+    # engine-level sync accounting rides the BENCH record so the
+    # trajectory catches sync regressions, not just throughput
+    counters = EngineCounters.install()
 
     sf = float(os.environ.get("BENCH_SF", "8"))
     # one map/reduce partition per accelerator: the bench box has ONE
@@ -189,6 +194,7 @@ def main() -> None:
         tpcds.run_q3_class(
             data, n_map=n_parts, n_reduce=n_parts, work_dir=wd0, ingested=ingested
         )
+    counters.reset()  # attribute syncs to the timed runs only, not warmup
     engine_s = float("inf")
     for _ in range(2):
         with tempfile.TemporaryDirectory(prefix="auron_bench_") as wd:
@@ -197,6 +203,7 @@ def main() -> None:
                 data, n_map=n_parts, n_reduce=n_parts, work_dir=wd, ingested=ingested
             )
             engine_s = min(engine_s, time.perf_counter() - t0)
+    sync_snap = counters.snapshot()  # covers BOTH timed runs
 
     # result check (differential gate, tolerance like the reference's
     # QueryResultComparator double tolerance)
@@ -227,6 +234,12 @@ def main() -> None:
         "ingest_gb_s": round(n_bytes / ingest_s / 1e9, 3),
         "fact_gb_per_s": round(fact_gb_per_s, 3),
         "mem_roofline_est_pct": roofline_est_pct,
+        # host-coordination profile of the two timed runs (the cost class
+        # the sync-free pipeline attacks; see docs/pipeline.md)
+        "host_syncs": sync_snap["host_syncs"],
+        "host_sync_s": sync_snap["host_sync_s"],
+        "async_reads": sync_snap["async_reads"],
+        "sync_sites": sync_snap["sync_sites"],
     }
     if backend in ("tpu", "axon"):
         # settle the cluster-sort verdict on real hardware while we have

@@ -523,3 +523,59 @@ def test_dense_agg_sentinel_key_extremes():
            .sort_values("k").reset_index(drop=True))
     assert out["k"].tolist() == [0, 1, 2, big]
     assert out["c"].tolist() == [2, 1, 2, 1]
+
+
+def test_dense_agg_k_deep_window_interleaved_restarts():
+    """The deferred-fold window is now k batches deep (async flag
+    harvests, runtime/transfer.py): interleaved out-of-range batches mean
+    MULTIPLE in-flight folds can fail and each must re-fold exactly once
+    after the drain+re-anchor — totals stay equal to pandas at every
+    window depth."""
+    import pandas as pd
+
+    from auron_tpu.utils.config import TRANSFER_WINDOW_DEPTH, active_conf
+
+    rng = __import__("numpy").random.default_rng(3)
+    key_batches = []
+    # alternate between three far-apart ranges so deferred folds keep
+    # landing out-of-range mid-window
+    for i in range(12):
+        base = [0, 500_000, 2_000_000_000][i % 3]
+        key_batches.append((base + rng.integers(0, 50, 40)).tolist())
+    all_k = [k for ks in key_batches for k in ks]
+    want = (
+        pd.DataFrame({"k": all_k, "v": [1.0] * len(all_k)})
+        .groupby("k").agg(c=("v", "size"), s=("v", "sum")).reset_index()
+        .sort_values("k").reset_index(drop=True)
+    )
+
+    conf = active_conf()
+    saved = conf.get(TRANSFER_WINDOW_DEPTH)
+    try:
+        for depth in (1, 3, 6):
+            conf.set(TRANSFER_WINDOW_DEPTH, depth)
+            batches = [
+                Batch.from_pydict({"k": ks, "v": [1.0] * len(ks)})
+                for ks in key_batches
+            ]
+            agg = HashAggExec(
+                MemoryScanExec.single(batches),
+                [(col(0), "k")],
+                [(AggExpr("count_star", None), "c"),
+                 (AggExpr("sum", col(1)), "s")],
+                "partial",
+            )
+            final = HashAggExec(
+                agg, [(col(0), "k")],
+                [(AggExpr("count_star", None), "c"),
+                 (AggExpr("sum", col(1)), "s")],
+                "final",
+            )
+            out = (final.collect().to_pandas()
+                   .sort_values("k").reset_index(drop=True))
+            assert out["k"].tolist() == want["k"].tolist(), f"depth={depth}"
+            assert out["c"].tolist() == want["c"].tolist(), f"depth={depth}"
+            assert out["s"].tolist() == [float(x) for x in want["s"]], \
+                f"depth={depth}"
+    finally:
+        conf.set(TRANSFER_WINDOW_DEPTH, saved)

@@ -552,3 +552,72 @@ def test_whole_tree_zero_unsuppressed_findings():
     assert not bad, "\n" + "\n".join(f.render() for f in bad)
     # every suppression in the tree carries a reason
     assert all(f.reason for f in rep.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# sync-point multiplicity budgets (syncbudget.py + perfcheck contract)
+# ---------------------------------------------------------------------------
+
+
+def test_r1_sync_point_budget_declares_boundary():
+    rep = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def f(xs):
+            total = jax.device_get(jnp.sum(xs))  # auronlint: sync-point(1/batch) -- one count per batch
+            seed = jax.device_get(xs)  # auronlint: sync-point(2/task) -- stream seed read
+            ext = jax.device_get(xs)  # auronlint: sync-point(call) -- external API contract
+            return total, seed, ext
+        """,
+        HostSyncRule(),
+    )
+    assert not rep.findings  # budgeted sync points are clean declarations
+
+
+def test_malformed_sync_point_budget_is_a_finding():
+    rep = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def f(xs):
+            a = jax.device_get(xs)  # auronlint: sync-point(weekly) -- nonsense unit
+            b = jax.device_get(xs)  # auronlint: disable(1/batch)=R1 -- budget on a disable
+            return a, b
+        """,
+        HostSyncRule(),
+    )
+    assert len([f for f in rep.findings if f.rule == "lint.suppression"]) == 2
+
+
+def test_parse_sync_budget_grammar():
+    from tools.auronlint.core import parse_sync_budget
+
+    assert parse_sync_budget("1/batch") == (1, "batch")
+    assert parse_sync_budget(" 8 / task ") == (8, "task")
+    assert parse_sync_budget("call") == (0, "call")
+    assert parse_sync_budget("1/flush") is None
+    assert parse_sync_budget("batch") is None
+    assert parse_sync_budget("") is None
+
+
+def test_syncbudget_collects_engine_declarations():
+    """Every sync-point in the live tree parses to a budget, and the known
+    hot-path sites resolve through the runtime-site matcher."""
+    from tools.auronlint.syncbudget import (
+        budget_for_site, collect_sync_points, site_allowlisted,
+    )
+
+    points = collect_sync_points(REPO_ROOT)
+    assert len(points) > 20
+    assert all(p.unit in ("batch", "task", "call") for p in points)
+    # the chain seed read (exec/joins/chain.py) must be task-budgeted now —
+    # a per-batch budget there would mask the whole tentpole regressing
+    chain_pts = [p for p in points if p.rel.endswith("joins/chain.py")]
+    assert chain_pts and all(p.unit == "task" for p in chain_pts)
+    hit = budget_for_site(f"{chain_pts[0].rel.split('auron_tpu/')[1]}:{chain_pts[0].line}", points)
+    assert hit is not None and hit.unit == "task"
+    assert site_allowlisted("exec/shuffle/writer.py:330")
+    assert not site_allowlisted("exec/joins/chain.py:1")

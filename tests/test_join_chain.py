@@ -188,3 +188,174 @@ def test_three_level_chain_with_nulls():
     exp.columns = got.columns
     exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
     pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# sync-free predicted compaction (exec/selectivity.py + runtime/transfer.py)
+# ---------------------------------------------------------------------------
+
+
+from auron_tpu.exec.selectivity import SelectivityPredictor as _RealPredictor
+
+
+class _SpyPredictor:
+    """Wraps SelectivityPredictor construction so tests can assert the
+    predicted path (and its mispredict/repair protocol) actually ran."""
+
+    instances: list = []
+
+    def __new__(cls, conf=None):
+        p = _RealPredictor(conf)
+        cls.instances.append(p)
+        return p
+
+
+def _with_spy(monkeypatch):
+    import auron_tpu.exec.selectivity as sel_mod
+
+    _SpyPredictor.instances = []
+    monkeypatch.setattr(chain_mod, "SelectivityPredictor", _SpyPredictor)
+    monkeypatch.setattr(sel_mod, "SelectivityPredictor", _SpyPredictor)
+    return _SpyPredictor
+
+
+def _run_both_modes(top_builder):
+    """Collect with predictor on (default) vs off (blocking per-batch
+    sync) — the two must produce identical row sets."""
+    from auron_tpu.utils.config import (
+        JOIN_COMPACT_OUTPUT, SELECTIVITY_PREDICTOR_ENABLE, active_conf,
+    )
+
+    conf = active_conf()
+    saved_c = conf.get(JOIN_COMPACT_OUTPUT)
+    saved_p = conf.get(SELECTIVITY_PREDICTOR_ENABLE)
+    conf.set(JOIN_COMPACT_OUTPUT, "on")
+    try:
+        conf.set(SELECTIVITY_PREDICTOR_ENABLE, "on")
+        got_pred = _collect_sorted(top_builder())
+        conf.set(SELECTIVITY_PREDICTOR_ENABLE, "off")
+        got_sync = _collect_sorted(top_builder())
+    finally:
+        conf.set(JOIN_COMPACT_OUTPUT, saved_c)
+        conf.set(SELECTIVITY_PREDICTOR_ENABLE, saved_p)
+    return got_pred, got_sync
+
+
+def test_chain_predictor_forced_mispredict_repair(monkeypatch):
+    """Selectivity jumps from ~0 to ~100% mid-stream: the predicted bucket
+    is far too small, the repair path must re-emit and the results stay
+    bit-identical to the blocking mode AND the pandas oracle."""
+    spy = _with_spy(monkeypatch)
+    n = 6000
+    # chunk 0 (1000 rows, capacity 1024): almost nothing survives (seeds a
+    # tiny bucket, and compaction pays at cap 1024); later chunks: every
+    # row survives -> guaranteed bucket-too-small repair
+    k0 = np.where(np.arange(n) < 1000, 999, np.arange(n) % 8)
+    fact = pd.DataFrame({"k0": k0, "k1": np.arange(n) % 4, "amt": np.arange(n)})
+    d1 = pd.DataFrame({"id1": np.arange(8), "d1v": np.arange(8) * 10})
+    d2 = pd.DataFrame({"id2": np.arange(4), "d2v": np.arange(4) * 7})
+
+    def build():
+        node = _mk(fact, chunk=1000)
+        for dim, fk in [(d1, 0), (d2, 1)]:
+            node = BroadcastHashJoinExec(
+                node, _mk(dim), [col(fk)], [col(0)], "inner",
+                build_side="right",
+            )
+        return node
+
+    got_pred, got_sync = _run_both_modes(build)
+    pd.testing.assert_frame_equal(got_pred, got_sync, check_dtype=False)
+    exp = _oracle(fact, [d1, d2], ["k0", "k1"])
+    exp.columns = got_pred.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got_pred, exp, check_dtype=False)
+    assert any(p.mispredicts > 0 for p in spy.instances), \
+        "selectivity jump must exercise the bucket-too-small repair path"
+    assert any(p.predictions > 0 for p in spy.instances)
+
+
+def test_chain_predictor_parity_fuzz(monkeypatch):
+    """Randomized selectivity patterns: predictor-compacted vs blocking
+    output row sets are identical (and match pandas) across seeds."""
+    spy = _with_spy(monkeypatch)
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(800, 4000))
+        nd1 = int(rng.integers(4, 60))
+        nd2 = int(rng.integers(4, 40))
+        # per-chunk selectivity regime shifts (chunk size 257 is coprime
+        # with the regime length so bucket demand keeps moving)
+        regime = rng.integers(1, 4, size=n)
+        hi = nd1 + int(rng.integers(1, 30))
+        k0 = np.where(regime == 1, rng.integers(0, max(nd1 // 4, 1), n),
+             np.where(regime == 2, rng.integers(0, hi, n),
+                      rng.integers(nd1, hi, n)))
+        fact = pd.DataFrame({
+            "k0": k0,
+            "k1": rng.integers(0, nd2 + 3, n),
+            "amt": rng.normal(size=n).round(3),
+        })
+        d1 = pd.DataFrame({"id1": np.arange(nd1), "d1v": np.arange(nd1) * 10})
+        d2 = pd.DataFrame({"id2": np.arange(nd2), "d2v": np.arange(nd2) * 7})
+
+        def build():
+            node = _mk(fact, chunk=257)
+            for dim, fk in [(d1, 0), (d2, 1)]:
+                node = BroadcastHashJoinExec(
+                    node, _mk(dim), [col(fk)], [col(0)], "inner",
+                    build_side="right",
+                )
+            return node
+
+        got_pred, got_sync = _run_both_modes(build)
+        pd.testing.assert_frame_equal(got_pred, got_sync, check_dtype=False)
+        exp = _oracle(fact, [d1, d2], ["k0", "k1"])
+        exp.columns = got_pred.columns
+        exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got_pred, exp, check_dtype=False)
+    assert any(p.predictions > 0 for p in spy.instances)
+
+
+def test_bhj_driver_predictor_parity_with_mispredict(monkeypatch):
+    """Single unique-build BHJ (driver._emit_unique_compacted path): the
+    pipelined predicted compaction must match the blocking mode and the
+    oracle, including a forced bucket-too-small repair."""
+    spy = _with_spy(monkeypatch)
+    n = 6000
+    # chunk 0 (capacity 1024) nearly empty output; later chunks ~full
+    k0 = np.where(np.arange(n) < 1000, 99999, np.arange(n) % 16)
+    fact = pd.DataFrame({"k0": k0, "amt": np.arange(n) * 1.5})
+    d1 = pd.DataFrame({"id1": np.arange(16), "d1v": np.arange(16) * 10})
+
+    def build():
+        return BroadcastHashJoinExec(
+            _mk(fact, chunk=1000), _mk(d1), [col(0)], [col(0)], "inner",
+            build_side="right",
+        )
+
+    got_pred, got_sync = _run_both_modes(build)
+    pd.testing.assert_frame_equal(got_pred, got_sync, check_dtype=False)
+    exp = _oracle(fact, [d1], ["k0"])
+    exp.columns = got_pred.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got_pred, exp, check_dtype=False)
+    assert any(p.mispredicts > 0 for p in spy.instances)
+
+
+def test_chain_window_depth_one_matches(monkeypatch):
+    """Window depth 1 (classic one-deep pipeline) stays correct."""
+    from auron_tpu.utils.config import TRANSFER_WINDOW_DEPTH, active_conf
+
+    conf = active_conf()
+    saved = conf.get(TRANSFER_WINDOW_DEPTH)
+    conf.set(TRANSFER_WINDOW_DEPTH, 1)
+    try:
+        fact, d1, d2 = _fact_dims(n=700, seed=5)
+        got = _collect_sorted(_star(fact, [d1, d2], [0, 1]))
+    finally:
+        conf.set(TRANSFER_WINDOW_DEPTH, saved)
+    exp = _oracle(fact, [d1, d2], ["k0", "k1"])
+    exp.columns = got.columns
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)

@@ -1,0 +1,96 @@
+"""Unit coverage for the sync-free pipeline pieces: the selectivity
+predictor (exec/selectivity.py) and the async transfer window
+(runtime/transfer.py)."""
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import compaction_bucket
+from auron_tpu.exec.selectivity import SelectivityPredictor, predictor_enabled
+from auron_tpu.runtime.transfer import TransferWindow, harvest
+from auron_tpu.utils.config import (
+    Configuration,
+    JOIN_COMPACT_OUTPUT,
+    SELECTIVITY_EWMA_ALPHA,
+    SELECTIVITY_HEADROOM,
+    SELECTIVITY_PREDICTOR_ENABLE,
+    SELECTIVITY_SHRINK_PATIENCE,
+)
+
+
+def _conf(**kv):
+    c = Configuration()
+    for k, v in kv.items():
+        c.set(k, v)
+    return c
+
+
+def test_compaction_bucket_policy():
+    # the one shared dense-vs-compact threshold (chain + driver + predictor)
+    assert compaction_bucket(100, 1024) == 128
+    assert compaction_bucket(0, 1024) == 128       # clamp to min bucket
+    assert compaction_bucket(200, 1024) == 256
+    assert compaction_bucket(300, 1024) is None    # 512*4 > 1024: dense
+    assert compaction_bucket(100, 128) is None     # tiny batch: dense
+
+
+def test_predictor_seeds_then_predicts_and_grows_immediately():
+    p = SelectivityPredictor(_conf())
+    assert p.predict(1 << 20) is None              # no history: seed path
+    p.observe(100)
+    b1 = p.predict(1 << 20)
+    assert b1 is not None and b1 >= 128
+    # overflow -> immediate growth (never two repairs for one regime shift)
+    p.observe(50_000, predicted=b1)
+    assert p.mispredicts == 1
+    assert p.predict(1 << 20) >= 50_000
+
+
+def test_predictor_shrinks_only_after_patience():
+    c = _conf(**{SELECTIVITY_SHRINK_PATIENCE.key: 3,
+                 SELECTIVITY_EWMA_ALPHA.key: 1.0,
+                 SELECTIVITY_HEADROOM.key: 1.0})
+    p = SelectivityPredictor(c)
+    p.observe(10_000)
+    big = p.predict(1 << 20)
+    p.observe(10)   # 1 low batch
+    assert p.predict(1 << 20) == big
+    p.observe(10)   # 2
+    assert p.predict(1 << 20) == big
+    p.observe(10)   # 3 -> shrink
+    assert p.predict(1 << 20) < big
+
+
+def test_predictor_clamped_to_input_capacity():
+    p = SelectivityPredictor(_conf())
+    p.observe(1 << 20)
+    assert p.predict(1024) <= 1024
+
+
+def test_predictor_enabled_knob_follows_compaction():
+    on = _conf(**{SELECTIVITY_PREDICTOR_ENABLE.key: "on"})
+    off = _conf(**{SELECTIVITY_PREDICTOR_ENABLE.key: "off"})
+    auto_off = _conf(**{JOIN_COMPACT_OUTPUT.key: "off"})
+    assert predictor_enabled(on)
+    assert not predictor_enabled(off)
+    assert not predictor_enabled(auto_off)
+
+
+def test_transfer_window_fifo_and_depth():
+    w = TransferWindow(2)
+    got = []
+    for i in range(6):
+        got += w.push((jnp.int32(i),), f"p{i}")
+    # depth 2: pushes 3..6 each evict the oldest
+    assert [pl for _, pl in got] == ["p0", "p1", "p2", "p3"]
+    got += list(w.drain())
+    assert [pl for _, pl in got] == [f"p{i}" for i in range(6)]
+    assert [int(r[0]) for r, _ in got] == list(range(6))
+    assert len(w) == 0
+
+
+def test_transfer_window_empty_arrays_and_harvest():
+    w = TransferWindow(1)
+    out = w.push((), "a") + w.push((), "b")
+    assert [pl for _, pl in out] == ["a"]
+    (v,) = harvest(jnp.arange(3))
+    assert list(v) == [0, 1, 2]

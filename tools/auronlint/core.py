@@ -60,9 +60,29 @@ _HOST_RETURNING = {
 
 _SUPPRESS_RE = re.compile(
     r"#\s*auronlint:\s*(disable|disable-function|sync-point)"
+    r"(?:\((?P<budget>[^)]*)\))?"
     r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
     r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
 )
+
+#: sync-point multiplicity budget: ``<count>/batch`` (scales with batches —
+#: the per-batch sync tax the runtime budget gate polices), ``<count>/task``
+#: (bounded per task: build stats, anchors, drains), or ``call`` (an
+#: external-API contract — to_arrow, num_rows — whose rate the CALLER owns).
+#: A sync-point without a budget defaults to 1/batch in the budget gate
+#: (tools/perfcheck.py): undeclared multiplicity is assumed worst-case.
+_BUDGET_RE = re.compile(r"^(?:(\d+)\s*/\s*(batch|task)|call)$")
+
+
+def parse_sync_budget(budget: str) -> tuple[int, str] | None:
+    """(count, unit) for a valid budget string, (0, "call") for the
+    caller-owned contract form, None when malformed."""
+    m = _BUDGET_RE.match(budget.strip())
+    if not m:
+        return None
+    if m.group(1) is None:
+        return (0, "call")
+    return (int(m.group(1)), m.group(2))
 
 
 @dataclass
@@ -72,6 +92,7 @@ class Suppression:
     reason: str
     line: int            # line the comment sits on
     standalone: bool     # comment-only line (applies to the next code line)
+    budget: str = ""     # sync-point multiplicity, e.g. "1/batch" (optional)
 
     def covers_rule(self, rule: str) -> bool:
         return not self.rules or rule in self.rules
@@ -109,6 +130,7 @@ class SourceModule:
         self.tree = ast.parse(src, filename=path)
         self.suppressions: list[Suppression] = []
         self.bad_suppressions: list[int] = []   # reasonless -> lint finding
+        self.bad_budgets: list[int] = []        # malformed budget -> finding
         self._parse_comments(src)
         self.func_spans = self._function_spans()
         self.scopes = self._build_scopes()
@@ -137,12 +159,19 @@ class SourceModule:
                 r.strip() for r in (m.group("rules") or "").split(",") if r.strip()
             )
             reason = (m.group("reason") or "").strip()
+            budget = (m.group("budget") or "").strip()
             line = t.start[0]
             if not reason:
                 self.bad_suppressions.append(line)
+            if budget and (
+                m.group(1) != "sync-point" or parse_sync_budget(budget) is None
+            ):
+                # a budget only means something on a sync-point, and must
+                # parse as <count>/batch | <count>/task | call
+                self.bad_budgets.append(line)
             self.suppressions.append(
                 Suppression(m.group(1), rules, reason, line,
-                            standalone=line not in code_lines)
+                            standalone=line not in code_lines, budget=budget)
             )
 
     def _function_spans(self) -> list[tuple[int, int]]:
@@ -420,6 +449,12 @@ def lint_paths(paths: list[str], root: str, rules) -> Report:
                 "suppression comment without a reason "
                 "(write `# auronlint: ... -- <why>`)",
             ))
+        for line in mod.bad_budgets:
+            report.findings.append(Finding(
+                TOOL, "lint.suppression", rel, line,
+                "malformed sync-point budget (write `# auronlint: "
+                "sync-point(<count>/batch|<count>/task|call) -- <why>`)",
+            ))
         for rule in rules:
             for line, message in rule.check_module(mod):
                 sup = mod.suppression_for(rule.name, line)
@@ -475,6 +510,11 @@ def lint_source(src: str, rel: str, rules) -> Report:
         report.findings.append(Finding(
             TOOL, "lint.suppression", rel, line,
             "suppression comment without a reason",
+        ))
+    for line in mod.bad_budgets:
+        report.findings.append(Finding(
+            TOOL, "lint.suppression", rel, line,
+            "malformed sync-point budget",
         ))
     for rule in rules:
         for line, message in rule.check_module(mod):

@@ -1,0 +1,99 @@
+"""Sync-point budget registry: the runtime half of R1.
+
+R1 statically forces every device->host transfer to a declared sync point;
+this module reads those declarations back OUT of the source — including the
+multiplicity budget each one carries — so the runtime budget gate
+(tools/perfcheck.py) can compare a measured per-site sync count against
+what the site *promised*:
+
+    # auronlint: sync-point(2/task) -- unique-join compaction seed read
+    # auronlint: sync-point(1/batch) -- ragged-expansion total
+    # auronlint: sync-point(call) -- to_arrow materializes for consumers
+
+``N/batch`` scales with pumped batches, ``N/task`` with finalized tasks,
+``call`` is a caller-owned external contract (exempt from the gate). A
+declaration WITHOUT a budget is treated as 1/batch — worst case — so an
+unannotated site cannot hide a per-batch regression.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tools.auronlint.core import (
+    SourceModule, iter_py_files, parse_sync_budget,
+)
+
+#: blocking boundaries allowlisted by prefix in R1 (no per-line comments
+#: there); the budget gate exempts them the same way
+ALLOWED_PREFIXES = (
+    "auron_tpu/runtime/task.py",
+    "auron_tpu/exec/shuffle/",
+)
+
+
+@dataclass(frozen=True)
+class SyncPoint:
+    rel: str           # path relative to the repo root, e.g. auron_tpu/...
+    line: int
+    count: int         # 0 with unit "call"
+    unit: str          # "batch" | "task" | "call"
+    reason: str
+
+
+def collect_sync_points(root: str, subdir: str = "auron_tpu") -> list[SyncPoint]:
+    """Walk the engine tree and return every declared sync point with its
+    parsed budget (defaulting to 1/batch, see module docstring)."""
+    out: list[SyncPoint] = []
+    base = os.path.join(root, subdir)
+    for path in iter_py_files(base):
+        rel = os.path.relpath(path, root).replace("\\", "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                mod = SourceModule(path, rel, f.read())
+        except (OSError, SyntaxError):
+            continue
+        for sup in mod.suppressions:
+            if sup.kind != "sync-point":
+                continue
+            parsed = parse_sync_budget(sup.budget) if sup.budget else (1, "batch")
+            if parsed is None:
+                parsed = (1, "batch")  # malformed: worst case (also a finding)
+            count, unit = parsed
+            # a standalone comment declares the NEXT line (the call site
+            # the runtime frame will report)
+            line = sup.line + 1 if sup.standalone else sup.line
+            out.append(SyncPoint(rel, line, count, unit, sup.reason))
+    return out
+
+
+def budget_for_site(
+    site: str, points: list[SyncPoint], tolerance: int = 5
+) -> SyncPoint | None:
+    """Match a runtime site string (``path/inside/auron_tpu.py:NN`` as the
+    profiling hook reports it) to its declaration. Exact line first, then
+    the nearest declaration within ``tolerance`` lines of the same file —
+    multi-line call expressions report interior lines."""
+    path, _, lineno = site.rpartition(":")
+    try:
+        line = int(lineno)
+    except ValueError:
+        return None
+    rel = path if path.startswith("auron_tpu/") else "auron_tpu/" + path
+    best: SyncPoint | None = None
+    for p in points:
+        if p.rel != rel:
+            continue
+        d = abs(p.line - line)
+        if d == 0:
+            return p
+        if d <= tolerance and (best is None or d < abs(best.line - line)):
+            best = p
+    return best
+
+
+def site_allowlisted(site: str) -> bool:
+    path = site.rpartition(":")[0]
+    rel = path if path.startswith("auron_tpu/") else "auron_tpu/" + path
+    return rel.startswith(ALLOWED_PREFIXES)

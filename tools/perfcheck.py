@@ -1,0 +1,121 @@
+"""Sync-budget regression gate (`make perfcheck`).
+
+Replays a tiny (SF<=1) q3-class pipeline — the join-chain + dense-agg +
+shuffle shape whose per-batch host syncs caused the SF=50 anti-scaling —
+under the engine counters with full site recording, then checks every
+observed BLOCKING sync site against the multiplicity budget its
+`# auronlint: sync-point(<budget>) -- <reason>` declaration promises
+(tools/auronlint/syncbudget.py):
+
+- ``N/batch``  -> allowed up to N x batches-pumped
+- ``N/task``   -> allowed up to N x tasks-finalized
+- ``call``     -> caller-owned external contract, exempt
+- no budget    -> treated as 1/batch (worst case)
+- undeclared site -> hard failure (R1 should have caught it statically)
+
+Async-window harvests (runtime/transfer.py) are NOT syncs and do not
+count; a harvest that stalls >1ms still shows in the site table, so a
+window regression surfaces here as a budget breach at the harvest site.
+
+Env: PERFCHECK_SF (default 0.5), PERFCHECK_PARTS (default 2). Exits
+nonzero on any breach and prints one JSON line per site plus a summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from auron_tpu.utils.profiling import EngineCounters
+
+    counters = EngineCounters.install()
+    counters.record_all_sites = True
+
+    import threading
+
+    from auron_tpu.bridge import api
+    from auron_tpu.exec.metrics import MetricNode
+    from auron_tpu.models import tpcds
+    from tools.auronlint.syncbudget import (
+        budget_for_site, collect_sync_points, site_allowlisted,
+    )
+
+    tasks = [0]
+    op_batches = [0]  # max per-operator batch count seen (see below)
+    lock = threading.Lock()
+
+    def sink(snap: dict) -> None:
+        with lock:
+            tasks[0] += 1
+            # hot loops count their input batches via timer(count=True)
+            # ({metric}_n); the LARGEST such counter is the real
+            # per-operator batch rate — the pump-level batch count alone
+            # undercounts by the plan's fan-in (a task that folds 100
+            # probe batches may emit 2), which would fail 1/batch sites
+            # spuriously
+            for k, v in MetricNode.flat_totals(snap).items():
+                if k.endswith("_n"):
+                    op_batches[0] = max(op_batches[0], int(v))
+
+    api.set_metrics_sink(sink)
+
+    sf = float(os.environ.get("PERFCHECK_SF", "0.5"))
+    n_parts = int(os.environ.get("PERFCHECK_PARTS", "2"))
+    data = tpcds.generate(sf=sf, seed=7)
+    ws = tempfile.mkdtemp(prefix="auron_perfcheck_")
+    # one warmup pass so compiles/first-touch host work don't pollute the
+    # measured pass, then the budgeted run
+    tpcds.run_q3_class(data, n_map=n_parts, n_reduce=n_parts,
+                       work_dir=os.path.join(ws, "warm"))
+    counters.reset()
+    tasks[0] = 0
+    op_batches[0] = 0
+    tpcds.run_q3_class(data, n_map=n_parts, n_reduce=n_parts,
+                       work_dir=os.path.join(ws, "run"))
+
+    points = collect_sync_points(ROOT)
+    # N/batch budgets are declared against OPERATOR input batches; the
+    # pump count is a floor (a stream the sink never times still pumps)
+    batches = max(counters.batches, op_batches[0], 1)
+    n_tasks = max(tasks[0], 1)
+    failures = 0
+    for site, (count, secs) in sorted(counters.sync_sites.items()):
+        if site == "?" or site_allowlisted(site):
+            status = "allowlisted"
+            limit = None
+        else:
+            p = budget_for_site(site, points)
+            if p is None:
+                status, limit = "UNDECLARED", 0
+            elif p.unit == "call":
+                status, limit = "call-contract", None
+            else:
+                denom = batches if p.unit == "batch" else n_tasks
+                limit = p.count * denom
+                status = "ok" if count <= limit else "OVER-BUDGET"
+        if status in ("UNDECLARED", "OVER-BUDGET"):
+            failures += 1
+        print(json.dumps({
+            "site": site, "syncs": count, "sync_s": round(secs, 3),
+            "status": status, "limit": limit,
+        }))
+    print(json.dumps({
+        "metric": "perfcheck", "sf": sf, "batches": batches,
+        "tasks": n_tasks, "host_syncs": counters.syncs,
+        "async_reads": counters.async_reads,
+        "sites": len(counters.sync_sites), "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
