@@ -51,11 +51,23 @@ from auron_tpu.exprs import Evaluator, ir
 from auron_tpu.exprs import decimal_math as D
 from auron_tpu.exprs.eval import ColumnVal
 from auron_tpu.ops import hostsort
+# top-level on purpose: binsearch/hashing hold module-level jnp constants or
+# feed jitted programs — lazy in-trace imports would leak tracers (see
+# ops/segments.py import note)
+from auron_tpu.ops import binsearch, hashing
 from auron_tpu.ops import segments as S
 from auron_tpu.utils.config import (
+    AGG_INCREMENTAL_ENABLE,
+    AGG_INCREMENTAL_FINGERPRINT,
+    AGG_INCREMENTAL_FP_BITS,
+    AGG_INCREMENTAL_MERGEPATH,
+    AGG_INCREMENTAL_PROBE,
     PARTIAL_AGG_SKIPPING_ENABLE,
     PARTIAL_AGG_SKIPPING_MIN_ROWS,
     PARTIAL_AGG_SKIPPING_RATIO,
+    TRANSFER_WINDOW_DEPTH,
+    active_conf,
+    resolve_tri,
 )
 
 PARTIAL = "partial"
@@ -227,20 +239,101 @@ class HashAggExec(ExecOperator):
             tuple((a, t) for (a, _), t in zip(aggs, self._agg_input_types)),
         )
 
-    def _sort_flags(self, sel) -> tuple:
-        """(host_sort, device_impl) resolved from config at call time —
-        static members of the reduce cfg so the jit cache retraces on a
-        config change instead of reusing a stale compiled sort choice."""
-        if hostsort.use_host_sort():
-            return (True, "lax")
+    def _sort_flags(self, sel, force_full_sort: bool = False, conf=None) -> tuple:
+        """(host_sort, device_impl, fingerprint, fp_bits) resolved from
+        config at call time — static members of the reduce cfg so the jit
+        cache retraces on a config change instead of reusing a stale
+        compiled sort choice. ``force_full_sort`` pins the legacy
+        full-word segmentation regardless of config (the dedup reduce a
+        FINAL-mode merge needs after a fingerprint collision)."""
+        conf = conf if conf is not None else active_conf()
+        fingerprint = (
+            not force_full_sort
+            and self.n_keys >= 1
+            and self._fingerprint_on(conf)
+        )
+        fp_bits = conf.get(AGG_INCREMENTAL_FP_BITS) if fingerprint else 64
+        if hostsort.use_host_sort(conf):
+            return (True, "lax", fingerprint, fp_bits)
+        if fingerprint:
+            # fixed 3-operand (dead, fp, iota) sort: lax.sort is the right
+            # impl at that width on every backend (ops/bitonic tuning
+            # targets the wide-operand case this path removes)
+            return (False, "lax", True, fp_bits)
         from auron_tpu.ops import bitonic
 
         n_words = self.n_keys + (1 if self.n_keys else 0)  # + null-bits word
         n_narrow = 1 if 0 < self.n_keys <= 32 else 0  # null-bits word rides narrow
         return (
             False,
-            bitonic.sort_impl_for(n_words, int(sel.shape[0]), n_narrow),
+            bitonic.sort_impl_for(n_words, int(sel.shape[0]), n_narrow),  # auronlint: sort-payload -- legacy full-word grouping fallback (fingerprint off / collision dedup): exactness needs every key word as a sort plane
+            False,
+            64,
         )
+
+    @staticmethod
+    def _tri(opt, conf=None) -> bool:
+        """Resolve an on|off|auto incremental knob: auto = accelerators
+        only. Every incremental building block (fingerprint hash amortized
+        by a narrower sort, scatter-add, merge-rank permutation build) is
+        a win on vector units and a loss on XLA:CPU, whose scatters lower
+        to serial loops and whose grouping sort is already the host
+        lexsort (ops/hostsort.py) — same fork, same default.
+
+        ``conf``: REQUIRED on any path a cross-thread spill can reach
+        (_merge and below): active_conf() is thread-local, so the spilling
+        thread would otherwise resolve a FOREIGN task's knobs and e.g.
+        fingerprint a layout sorted under different fp.bits."""
+        return resolve_tri(
+            (conf if conf is not None else active_conf()).get(opt),
+            jax.default_backend() != "cpu",
+        )
+
+    def _fingerprint_on(self, conf=None) -> bool:
+        conf = conf if conf is not None else active_conf()
+        return bool(
+            conf.get(AGG_INCREMENTAL_ENABLE)
+            and self._tri(AGG_INCREMENTAL_FINGERPRINT, conf)
+        )
+
+    def _keys_dict_free(self) -> bool:
+        """No group-key column is dictionary-encoded: fingerprints of key
+        words are then stable across batches (dict codes are per-batch
+        vocabularies — a cross-batch remap would reorder every fp-sorted
+        run), the precondition for sorted-state probing and merge-path."""
+        return all(
+            not self.inter_schema[i].dtype.is_dict_encoded
+            for i in range(self.n_keys)
+        )
+
+    def _mergepath_eligible(self, conf=None) -> bool:
+        return (
+            self.n_keys >= 1
+            and not self._has_host_aggs
+            and self._keys_dict_free()
+            and self._fingerprint_on(conf)
+            and self._tri(AGG_INCREMENTAL_MERGEPATH, conf)
+        )
+
+    def _probe_eligible(self) -> bool:
+        """Sorted-state probe/scatter: every aggregate must have a pure
+        device scatter-update form and every column it touches a stable
+        cross-batch encoding (no per-batch dictionaries)."""
+        if self.n_keys < 1 or self._has_host_aggs or not self._keys_dict_free():
+            return False
+        if not (self._fingerprint_on() and self._tri(AGG_INCREMENTAL_PROBE)):
+            return False
+        for (a, _), in_t in zip(self.aggs, self._agg_input_types):
+            if a.func not in (
+                "sum", "avg", "count", "count_star", "min", "max",
+                "first", "first_ignores_null",
+            ):
+                return False
+            if in_t is not None and in_t.is_dict_encoded:
+                # covers strings AND wide (p>18) decimal inputs; narrow
+                # inputs with wide SUM types keep the device limb path
+                return False
+        return True
 
     # ------------------------------------------------------------------
 
@@ -324,12 +417,17 @@ class HashAggExec(ExecOperator):
                 if pending_g is None:
                     n = int(jax.device_get(b.device.num_rows()))  # auronlint: sync-point(4/task) -- first-batch live-count read (see comment above)
                 else:
-                    n, gp = (
+                    g_dev, coll_dev, inter_ref = pending_g
+                    scalars = [b.device.num_rows(), g_dev]
+                    if coll_dev is not None:
+                        scalars.append(coll_dev)
+                    got = [
                         int(x)
-                        for x in jax.device_get(  # auronlint: sync-point(1/batch) -- steady state: ONE round-trip per batch (count + prior group count)
-                            (b.device.num_rows(), pending_g)
-                        )
-                    )
+                        for x in jax.device_get(tuple(scalars))  # auronlint: sync-point(1/batch) -- steady state: ONE round-trip per batch (count + prior group count + fp collision flag)
+                    ]
+                    n, gp = got[0], got[1]
+                    if coll_dev is not None:
+                        _note_collision(inter_ref, got[2], ctx.metrics)
                     seen_groups += gp
                     # replace the previous batch's staged-rows proxy with
                     # its exact group count, so low-cardinality aggs don't
@@ -350,25 +448,34 @@ class HashAggExec(ExecOperator):
                     b = compact_batch(b, bucket_capacity(n))
                 with ctx.metrics.timer("elapsed_compute"):
                     inter = self._to_intermediate(b, ctx)
-                pending_g = inter.device.num_rows()
+                pending_g = (
+                    inter.device.num_rows(),
+                    getattr(inter, "_fp_collision", None),
+                    inter,
+                )
                 g = pending_proxy = min(n, inter.capacity)  # proxy; the
                 # exact count settles one batch later via pending_g
             else:
                 # merge modes never compact: one combined transfer
                 with ctx.metrics.timer("elapsed_compute"):
                     inter = self._to_intermediate(b, ctx)
-                n, g = (
+                coll_dev = getattr(inter, "_fp_collision", None)
+                scalars = [b.device.num_rows(), inter.device.num_rows()]
+                if coll_dev is not None:
+                    scalars.append(coll_dev)
+                got = [
                     int(x)
-                    for x in jax.device_get(  # auronlint: sync-point(1/batch) -- merge modes: one combined transfer per batch
-                        (b.device.num_rows(), inter.device.num_rows())
-                    )
-                )
+                    for x in jax.device_get(tuple(scalars))  # auronlint: sync-point(1/batch) -- merge modes: one combined transfer per batch (+ fp collision flag)
+                ]
+                n, g = got[0], got[1]
+                if coll_dev is not None:
+                    _note_collision(inter, got[2], ctx.metrics)
                 if n == 0:
                     return
                 # groups live in a valid prefix and g is exact here:
                 # stage at the group bucket so merge concat scales
                 # with groups, not the input capacity
-                inter = prefix_slice(inter, bucket_capacity(max(g, 1)))
+                inter = self._prefix_slice_meta(inter, bucket_capacity(max(g, 1)))
             seen_rows += n
             if self.mode != PARTIAL:
                 seen_groups += g
@@ -432,6 +539,13 @@ class HashAggExec(ExecOperator):
                     return left
             return None
 
+        # sorted-state probe/scatter: engages once a compact() has produced
+        # an fp-sorted state batch (and the dense table, which runs in
+        # front, is out of the picture)
+        probe = _ProbeScatter(self, ctx, table) if self._probe_eligible() else None
+        if probe is not None:
+            mm.register(probe, spillable=False)
+
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
@@ -443,6 +557,17 @@ class HashAggExec(ExecOperator):
                     for nb in leftovers:
                         yield from process_generic(nb)
                     continue
+                if probe is not None and not skipping:
+                    with ctx.metrics.timer("elapsed_compute", count=True):
+                        folded, misses, hit_rows = probe.fold(b)
+                    # probed hits are rows with ZERO new groups: they must
+                    # keep pulling the skip heuristic's cardinality ratio
+                    # down (only the generic path updates it otherwise)
+                    seen_rows += hit_rows
+                    for mb in misses:
+                        yield from process_generic(mb)
+                    if folded:
+                        continue
                 yield from process_generic(b)
             # end of stream: resolve the in-flight deferred dense folds
             # (up to window-depth of them) via the same protocol,
@@ -457,12 +582,18 @@ class HashAggExec(ExecOperator):
                         leftovers = fold_dense(nb, defer=False)
                     for gb in leftovers or ():
                         yield from process_generic(gb)
+            if probe is not None:
+                for mb in probe.finish():
+                    yield from process_generic(mb)
         finally:
             if dense is not None:
                 drain_dense_into_table()
                 mm.unregister(dense)
                 dense.release(mm)
                 dense = None
+            if probe is not None:
+                mm.unregister(probe)
+                probe.release()
             mm.unregister(table)
 
         if skipping:
@@ -479,6 +610,43 @@ class HashAggExec(ExecOperator):
             yield state
 
     # ------------------------------------------------------------------
+
+    def _keys_and_inputs(self, b: Batch):
+        """(key ColumnVals, per-agg ((values, validity), ...) input pairs)
+        for one batch — the raw-vs-merge input extraction shared by the
+        dense table and the probe/scatter path (column alignment against
+        inter_schema must never diverge between them)."""
+        if self.mode == PARTIAL:
+            ev = Evaluator(self.children[0].schema)
+            keys = ev.evaluate(b, [g for g, _ in self.groupings])
+            per_agg = []
+            for (a, _), in_t in zip(self.aggs, self._agg_input_types):
+                if a.expr is None:
+                    per_agg.append(())
+                    continue
+                cv = ev.evaluate(b, [a.expr])[0]
+                if a.func in ("sum", "avg") and not is_wide_sum(in_t):
+                    # wide sums consume the raw input (limb machinery) —
+                    # same rule as _to_intermediate
+                    cv = ev._cast(cv, sum_type(in_t))
+                per_agg.append(((cv.values, cv.validity),))
+            return keys, tuple(per_agg)
+        keys = self._state_keys(b)
+        per_agg = tuple(
+            tuple((cv.values, cv.validity) for cv in grp)
+            for grp in self._intermediate_groups(b)
+        )
+        return keys, per_agg
+
+    def _state_keys(self, b: Batch) -> list[ColumnVal]:
+        """Key-column ColumnVal view of an intermediate-layout batch — THE
+        key extraction shared by merge/dedup/merge-path/probe so their key
+        views can never diverge."""
+        return [
+            ColumnVal(b.col_values(i), b.col_validity(i),
+                      self.inter_schema[i].dtype, b.dicts[i])
+            for i in range(self.n_keys)
+        ]
 
     def _intermediate_groups(self, b: Batch, ofs: int | None = None):
         """Per-agg groups of intermediate-field ColumnVals starting at
@@ -521,31 +689,174 @@ class HashAggExec(ExecOperator):
                     agg_inputs.append([cv])
             return self._group_reduce(b.device.sel, keys, agg_inputs, raw=True)
         else:
-            keys = [
-                ColumnVal(b.col_values(i), b.col_validity(i), self.inter_schema[i].dtype, b.dicts[i])
-                for i in range(self.n_keys)
-            ]
+            keys = self._state_keys(b)
             return self._group_reduce(
                 b.device.sel, keys, self._intermediate_groups(b), raw=False
             )
 
-    def _merge(self, state: list[Batch], staged: list[Batch]) -> Batch | None:
+    def _merge(
+        self,
+        state: list[Batch],
+        staged: list[Batch],
+        metrics=None,
+        final: bool = False,
+        conf=None,
+    ) -> Batch | None:
+        """Merge prefix-packed group batches into one state batch.
+
+        Three forms, picked per call from cheap host evidence:
+        - merge-path (the incremental fast path): every part is an
+          fp-sorted collision-free run → pairwise binsearch merge-rank
+          merges (segment_merged), no sort at all;
+        - legacy concat + sort-segmentation: any part without fp
+          provenance (dense drains, disk runs) or with a collision flag;
+        - forced FULL-WORD legacy: ``final`` and a collision was seen —
+          the output IS the operator's final state, and only the full-word
+          sort guarantees a colliding key can't surface as two split
+          groups."""
         parts = [s for s in state + staged if s is not None]
         if not parts:
             return None
-        if len(parts) == 1:
+        collided = self._resolve_fp_flags(parts, metrics)
+        if len(parts) == 1 and not (final and collided):
             return parts[0]
+        if (
+            not collided
+            and len(parts) > 1
+            and self._mergepath_eligible(conf)
+            and all(getattr(p, "_fp_order", False) for p in parts)
+        ):
+            if metrics is not None:
+                with metrics.timer("merge_path_s"):
+                    acc = self._merge_path(parts, metrics, conf)
+            else:
+                acc = self._merge_path(parts, metrics, conf)
+            if final and getattr(acc, "_fp_collision_host", False):
+                # the collision AROSE in this very merge (two clean runs,
+                # colliding keys across them): the output would be the
+                # final state, so dedup with the full-word sort now
+                acc = self._dedup_full_sort(acc, conf)
+            return acc
         big = device_concat(parts)
-        keys = [
-            ColumnVal(big.col_values(i), big.col_validity(i), self.inter_schema[i].dtype, big.dicts[i])
-            for i in range(self.n_keys)
-        ]
+        keys = self._state_keys(big)
         merged = self._group_reduce(
-            big.device.sel, keys, self._intermediate_groups(big), raw=False
+            big.device.sel, keys, self._intermediate_groups(big), raw=False,
+            force_full_sort=final and collided, conf=conf,
         )
         # shrink back to a compact capacity bucket (host sync on group count)
+        coll_dev = getattr(merged, "_fp_collision", None)
+        if coll_dev is not None:
+            g, coll = (
+                int(x) for x in jax.device_get((merged.device.num_rows(), coll_dev))  # auronlint: sync-point(2/task) -- merge group-count read; the collision flag rides the same transfer
+            )
+            if coll and metrics is not None:
+                # merged is this call's fresh reduce output — no other
+                # thread can have counted it yet (unlike the shared staged
+                # batches behind _FP_FLAG_LOCK)
+                metrics.add("fp_collision_batches", 1)
+            merged._fp_collision_host = bool(coll)
+            out = self._prefix_slice_meta(merged, bucket_capacity(max(g, 1)))
+            if final and coll:
+                # collision arose in THIS fp-ordered merge — same dedup
+                out = self._dedup_full_sort(out, conf)
+            return out
+        g = merged.num_rows()
+        return self._prefix_slice_meta(merged, bucket_capacity(max(g, 1)))
+
+    def _dedup_full_sort(self, b: Batch, conf=None) -> Batch:
+        """Re-reduce one merged state batch with the legacy FULL-WORD sort:
+        the exactness backstop for a FINAL-mode merge whose own layout
+        picked up a fingerprint collision (split groups must never surface
+        as output rows). One extra sort over the (group-bucketed) state —
+        collisions are ~n²/2⁻⁶⁴, so this path is test-hook territory."""
+        keys = self._state_keys(b)
+        merged = self._group_reduce(
+            b.device.sel, keys, self._intermediate_groups(b), raw=False,
+            force_full_sort=True, conf=conf,
+        )
         g = merged.num_rows()
         return prefix_slice(merged, bucket_capacity(max(g, 1)))
+
+    def _merge_path(self, parts: list[Batch], metrics, conf=None) -> Batch:
+        """Sequential pairwise merge-rank merges: acc ⊕ part is two
+        fp-sorted runs laid back to back by device_concat, permuted by two
+        binary searches and segment-reduced — O(n log n) compares instead
+        of re-sorting state + staged from scratch every merge (the q5-class
+        merge_time blowup at agg_exec.py:393-396)."""
+        acc = parts[0]
+        for p in parts[1:]:
+            big = device_concat([acc, p])
+            keys = self._state_keys(big)
+            fp_a = getattr(acc, "_inc_fp", None)
+            fp_b = getattr(p, "_inc_fp", None)
+            if fp_a is not None and fp_b is not None:
+                # both runs carry their (dead-masked) fingerprints from the
+                # reduce that produced them — concatenate instead of
+                # re-hashing every key word per pair merge; pad rows are
+                # dead, so they take the MAX sentinel like any dead slot
+                fp_cat = jnp.concatenate([fp_a, fp_b])
+                pad = big.capacity - fp_cat.shape[0]
+                if pad:
+                    fp_cat = jnp.pad(
+                        fp_cat, (0, pad),
+                        constant_values=np.uint64(0xFFFFFFFFFFFFFFFF),
+                    )
+            else:
+                fp_cat = None
+            merged = self._group_reduce(
+                big.device.sel, keys, self._intermediate_groups(big),
+                raw=False, merge_cap_a=acc.capacity, fp=fp_cat, conf=conf,
+            )
+            # ONE transfer: the compaction bucket read the legacy path pays
+            # anyway, plus the cross-run collision flag riding along
+            g, coll = (
+                int(x) for x in jax.device_get(  # auronlint: sync-point(2/task) -- merge-path group-count + collision read, once per pair merge (amortized by the staging threshold)
+                    (merged.device.num_rows(),
+                     getattr(merged, "_fp_collision"))
+                )
+            )
+            merged._fp_collision_host = bool(coll)
+            if coll and metrics is not None:
+                metrics.add("fp_collision_batches", 1)
+            acc = self._prefix_slice_meta(merged, bucket_capacity(max(g, 1)))
+        return acc
+
+    def _resolve_fp_flags(self, parts: list[Batch], metrics) -> bool:
+        """Read (once, batched) the not-yet-read collision flags of
+        fp-segmented parts; returns whether ANY part is collision-flagged.
+        Parts with no fp provenance count as clean here — they only
+        disqualify the merge-path, not correctness."""
+        unread = [
+            p for p in parts
+            if getattr(p, "_fp_order", False)
+            and not hasattr(p, "_fp_collision_host")
+            and hasattr(p, "_fp_collision")
+        ]
+        if unread:
+            flags = jax.device_get(  # auronlint: sync-point(2/task) -- batched read of per-run collision flags at merge boundaries only
+                tuple(p._fp_collision for p in unread)
+            )
+            for p, f in zip(unread, flags):
+                with _FP_FLAG_LOCK:
+                    fresh = not hasattr(p, "_fp_collision_host")
+                    if fresh:
+                        p._fp_collision_host = bool(f)
+                if fresh and f and metrics is not None:
+                    metrics.add("fp_collision_batches", 1)
+        return any(getattr(p, "_fp_collision_host", False) for p in parts)
+
+    @staticmethod
+    def _prefix_slice_meta(b: Batch, new_cap: int) -> Batch:
+        """prefix_slice that carries the fp provenance over to the sliced
+        handle (groups live in the prefix, so sortedness survives)."""
+        out = prefix_slice(b, new_cap)
+        if out is not b:
+            for attr in ("_fp_order", "_fp_collision", "_fp_collision_host"):
+                if hasattr(b, attr):
+                    setattr(out, attr, getattr(b, attr))
+            if hasattr(b, "_inc_fp"):
+                out._inc_fp = b._inc_fp[:new_cap]
+        return out
 
     # ------------------------------------------------------------------
 
@@ -555,11 +866,19 @@ class HashAggExec(ExecOperator):
         keys: list[ColumnVal],
         agg_cols: list[list[ColumnVal]],
         raw: bool,
+        merge_cap_a: int | None = None,
+        force_full_sort: bool = False,
+        fp: jnp.ndarray | None = None,
+        conf=None,
     ) -> Batch:
         """Group + reduce one batch. When every aggregate is device-native
         the whole reduction runs as ONE jitted program (cached per shape
         signature); host-side aggregates (collect/UDAF pull data to host)
-        keep the eager path."""
+        keep the eager path.
+
+        ``merge_cap_a`` switches segmentation to the sort-free merge-rank
+        over two fp-sorted runs (merge-path _merge); ``force_full_sort``
+        pins the legacy full-word sort (collision-dedup reduces)."""
         if not self._has_host_aggs:
             key_v = tuple(k.values for k in keys)
             key_m = tuple(k.validity for k in keys)
@@ -571,20 +890,24 @@ class HashAggExec(ExecOperator):
                     zip(self.aggs, self._agg_input_types), agg_cols
                 )
             )
-            flags = self._sort_flags(sel)
+            flags = self._sort_flags(sel, force_full_sort=force_full_sort,
+                                     conf=conf)
             # host-sort order computes EAGERLY and enters the jit as data:
             # no pure_callback may live inside the compiled program
             # (concurrent callback-bearing XLA:CPU programs wedge). The
             # canonical words ride along so the jit doesn't recompute them.
-            if flags[0] and self.n_keys:
+            if flags[0] and self.n_keys and merge_cap_a is None:
                 words = S.key_words(keys)
-                order = S.host_order(words, sel)
+                if flags[2]:
+                    order, fp = S.host_order_fp(words, sel, flags[3])
+                else:
+                    order = S.host_order(words, sel)
                 words = tuple(words)
             else:
                 words, order = None, None
-            out_v, out_m, group_valid = _reduce_arrays_jit(
-                sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words,
-                cfg=self._reduce_cfg + flags, raw=raw,
+            out_v, out_m, group_valid, collision, group_fp = _reduce_arrays_jit(
+                sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words, fp,
+                cfg=self._reduce_cfg + flags, raw=raw, merge_cap_a=merge_cap_a,
             )
             out_vals = []
             dict_map = self._output_dicts(keys, agg_cols)
@@ -592,8 +915,26 @@ class HashAggExec(ExecOperator):
                 f = self.inter_schema[i]
                 out_vals.append(ColumnVal(v, m, f.dtype, dict_map[i]))
             out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
-            return Batch(self.inter_schema, out.device, out.dicts)
-        return self._group_reduce_eager(sel, keys, agg_cols, raw)
+            res = Batch(self.inter_schema, out.device, out.dicts)
+            self._attach_fp_meta(res, flags, collision, merge_cap_a)
+            if group_fp is not None:
+                res._inc_fp = group_fp
+            return res
+        return self._group_reduce_eager(
+            sel, keys, agg_cols, raw,
+            force_full_sort=force_full_sort, conf=conf,
+        )
+
+    @staticmethod
+    def _attach_fp_meta(out: Batch, flags, collision, merge_cap_a=None) -> None:
+        """Fingerprint-mode provenance on a reduce output: ``_fp_order``
+        (groups emerged in fingerprint order — probe/merge-path capable)
+        and ``_fp_collision`` (device scalar, read lazily: some fp run held
+        more than one key, so fps are NOT unique in this batch)."""
+        fp_used = bool(flags[2]) or merge_cap_a is not None
+        if fp_used and collision is not None:
+            out._fp_order = True
+            out._fp_collision = collision
 
     def _output_dicts(self, keys: list[ColumnVal], agg_cols: list[list[ColumnVal]]):
         """Host dictionaries for each intermediate output column (positions
@@ -614,24 +955,38 @@ class HashAggExec(ExecOperator):
         keys: list[ColumnVal],
         agg_cols: list[list[ColumnVal]],
         raw: bool,
+        force_full_sort: bool = False,
+        conf=None,
     ) -> Batch:
-        flags = self._sort_flags(sel)
+        # force_full_sort/conf MUST thread through like the jit branch:
+        # dropping them here would turn the FINAL-merge collision dedup
+        # into a no-op for host-agg operators (same colliding fps, same
+        # split group re-emitted) and let a cross-thread spill resolve
+        # fingerprint knobs from a foreign task's conf
+        flags = self._sort_flags(sel, force_full_sort=force_full_sort,
+                                 conf=conf)
         # same invariant as the jit path: segment_by_keys is itself jitted,
         # so the host-sort order must enter it as data (never a callback
         # inside a compiled program — pump threads run concurrently)
+        fp = None
         if flags[0] and self.n_keys:
             words = S.key_words(keys)
-            order = S.host_order(words, sel)
+            if flags[2]:
+                order, fp = S.host_order_fp(words, sel, flags[3])
+            else:
+                order = S.host_order(words, sel)
             words = tuple(words)
         else:
             words, order = None, None
-        out_vals, group_valid = _reduce_columns(
+        out_vals, group_valid, seg = _reduce_columns(
             sel, keys, agg_cols, raw,
             self._reduce_cfg + flags,
-            collect_cb=self._host_agg_cb, order=order, words=words,
+            collect_cb=self._host_agg_cb, order=order, words=words, fp=fp,
         )
         out = batch_from_columns(out_vals, self.inter_schema.names, group_valid)
-        return Batch(self.inter_schema, out.device, out.dicts)
+        res = Batch(self.inter_schema, out.device, out.dicts)
+        self._attach_fp_meta(res, flags, seg.collision)
+        return res
 
 
     def _host_agg_cb(self, a, in_t, cols, order, seg, cap, raw, group_valid):
@@ -994,7 +1349,7 @@ class _AggTableConsumer:
             old = self.staged[-1]
             if new_cap >= old.capacity:
                 return
-            shrunk = prefix_slice(old, new_cap)
+            shrunk = HashAggExec._prefix_slice_meta(old, new_cap)
             self.staged[-1] = shrunk
             self._staged_bytes += batch_nbytes(shrunk) - batch_nbytes(old)
 
@@ -1003,7 +1358,8 @@ class _AggTableConsumer:
 
         with self._lock:
             self.state = self.exec._merge(
-                [self.state] if self.state is not None else [], self.staged
+                [self.state] if self.state is not None else [], self.staged,
+                metrics=self.ctx.metrics, conf=self.ctx.conf,
             )
             self.staged, self.staged_rows, self._staged_bytes = [], 0, 0
             self._state_bytes = (
@@ -1058,11 +1414,18 @@ class _AggTableConsumer:
             ds.release()
 
     def collect_state(self) -> Batch | None:
-        """Merge staged + state + parked disk runs into the final state."""
+        """Merge state + staged + parked disk runs into the final state.
+
+        State FIRST — the same part order compact() uses — so
+        position-resolved aggregates (`first`) prefer the earliest data in
+        stream order; the probe/scatter path relies on this (a probed hit
+        keeps the state's value, which must match what the merge of an
+        unprobed run would have picked)."""
         with self._lock:
-            parts: list[Batch] = list(self.staged)
+            parts: list[Batch] = []
             if self.state is not None:
                 parts.append(self.state)
+            parts.extend(self.staged)
             parked, self.parked = self.parked, []
             self.staged, self.staged_rows, self.state = [], 0, None
             self._staged_bytes = self._state_bytes = 0
@@ -1072,7 +1435,12 @@ class _AggTableConsumer:
             ds.release()
         if not parts:
             return None
-        return self.exec._merge([], parts)
+        # `final`: in FINAL mode this merge's output IS the operator output
+        # — a fingerprint collision anywhere forces the full-word dedup
+        return self.exec._merge(
+            [], parts, metrics=self.ctx.metrics,
+            final=self.exec.mode == FINAL, conf=self.ctx.conf,
+        )
 
 
 def _input_type_from_intermediate(a: AggExpr, first_field: T.Field) -> T.DataType | None:
@@ -1135,15 +1503,19 @@ def _minmax_rank_aux(a: AggExpr, cols: list[ColumnVal]):
 
 
 def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None,
-                    order=None, words=None):
+                    order=None, words=None, fp=None, merge_cap_a=None):
     """Segment + reduce already-evaluated columns.
 
     cfg = (n_keys, key_dtypes, ((AggExpr, in_t), ...), host_sort,
-    device_impl) — pure
+    device_impl, fingerprint, fp_bits) — pure
     values, so the jitted wrapper's compile cache is shared by every operator
     instance with the same aggregate signature; host_sort rides in cfg so a
-    config change retraces instead of hitting a stale compiled choice."""
-    n_keys, key_dtypes, agg_specs, host_sort, device_impl = cfg
+    config change retraces instead of hitting a stale compiled choice.
+
+    ``merge_cap_a``: segment TWO back-to-back fp-sorted runs (state ⊕
+    staged, split at that capacity) via the binsearch merge-rank instead of
+    any sort — the merge-path form of _merge."""
+    n_keys, key_dtypes, agg_specs, host_sort, device_impl, fingerprint, fp_bits = cfg
     cap = int(sel.shape[0])
     if n_keys == 0:
         # global aggregation: single segment containing all live rows
@@ -1158,10 +1530,14 @@ def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None
     else:
         if words is None:
             words = S.key_words(keys)
-        seg = S.segment_by_keys(
-            list(words), sel, order, host_sort=host_sort,
-            device_impl=device_impl, n_key_cols=n_keys,
-        )
+        if merge_cap_a is not None:
+            seg = S.segment_merged(list(words), sel, merge_cap_a, fp_bits, fp)
+        else:
+            seg = S.segment_by_keys(
+                list(words), sel, order, fp, host_sort=host_sort,
+                device_impl=device_impl, n_key_cols=n_keys,
+                fingerprint=fingerprint, fp_bits=fp_bits,
+            )
     order = seg.order
 
     out_vals: list[ColumnVal] = []
@@ -1183,7 +1559,7 @@ def _reduce_columns(sel, keys, agg_cols, raw, cfg, collect_cb=None, agg_aux=None
             _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid,
                         collect_cb, aux)
         )
-    return out_vals, group_valid
+    return out_vals, group_valid, seg
 
 
 def _reduce_one(a, in_t, cols, order, seg, cap, raw, group_valid,
@@ -1361,8 +1737,10 @@ def _reduce_wide_sum(in_t, cols, sortg, ids, cap, raw, group_valid, aux=None):
     return out
 
 
-def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words, cfg, raw):
-    n_keys, key_dtypes, agg_specs, _host_sort, _device_impl = cfg
+def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words,
+                        fp, cfg, raw, merge_cap_a=None):
+    n_keys = cfg[0]
+    key_dtypes = cfg[1]
     keys = [
         ColumnVal(v, m, dt, None) for (v, m, dt) in zip(key_v, key_m, key_dtypes)
     ]
@@ -1370,20 +1748,35 @@ def _reduce_arrays_impl(sel, key_v, key_m, agg_v, agg_m, agg_aux, order, words, 
         [ColumnVal(v, m, T.NULL, None) for v, m in zip(vs, ms)]
         for vs, ms in zip(agg_v, agg_m)
     ]
-    out_vals, group_valid = _reduce_columns(
+    out_vals, group_valid, seg = _reduce_columns(
         sel, keys, agg_cols, raw, cfg, agg_aux=agg_aux, order=order,
-        words=words,
+        words=words, fp=fp, merge_cap_a=merge_cap_a,
     )
+    if seg.fp_sorted is not None:
+        # per-OUTPUT-ROW fingerprints (dead slots -> MAX, the probe's dead
+        # sentinel): cached on the state batch so steady-state probing
+        # never re-hashes the invariant state keys
+        cap = sel.shape[0]
+        slot = jnp.clip(seg.group_of_slot, 0, cap - 1)
+        group_fp = jnp.where(
+            group_valid, seg.fp_sorted[slot], jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        )
+    else:
+        group_fp = None
     return (
         tuple(cv.values for cv in out_vals),
         tuple(cv.validity for cv in out_vals),
         group_valid,
+        seg.collision,  # None on the legacy full-word path (static per cfg)
+        group_fp,
     )
 
 
 import jax as _jax  # noqa: E402
 
-_reduce_arrays_jit = _jax.jit(_reduce_arrays_impl, static_argnames=("cfg", "raw"))
+_reduce_arrays_jit = _jax.jit(
+    _reduce_arrays_impl, static_argnames=("cfg", "raw", "merge_cap_a")
+)
 
 
 # ---------------------------------------------------------------------------
@@ -1547,6 +1940,21 @@ def _next_pow2_agg(n: int) -> int:
     return p
 
 
+def _bincount_i64(idx: np.ndarray, v: np.ndarray, size: int) -> np.ndarray:
+    """Exact int64 segment sums via np.bincount: bincount accumulates in
+    float64 (exact only to 2^53), so the value splits into four 16-bit
+    limbs whose per-limb sums stay exact (<= 2^16 * cap << 2^53); the
+    recombination wraps mod 2^64 — the same wrapping the device int64
+    scatter-add exhibits."""
+    u = v.astype(np.uint64)
+    out = np.zeros(size, np.uint64)
+    for shift in (0, 16, 32, 48):
+        part = ((u >> np.uint64(shift)) & np.uint64(0xFFFF)).astype(np.float64)
+        s = np.bincount(idx, weights=part, minlength=size + 1)[:size]
+        out += s.astype(np.uint64) << np.uint64(shift)
+    return out.view(np.int64)
+
+
 class _DenseAggState:
     """Dense table accumulator for HashAggExec (1-3 packed integer keys).
 
@@ -1579,9 +1987,11 @@ class _DenseAggState:
         # depth (runtime.transfer.window.depth).
         from collections import deque
 
-        from auron_tpu.utils.config import TRANSFER_WINDOW_DEPTH
-
         self._pending: "deque" = deque()
+        # owner-thread mutations vs MemManager mem_used() polls from OTHER
+        # operator threads: deque iteration during a concurrent append
+        # raises — take this lock around every _pending touch
+        self._pending_lock = threading.Lock()
         self._depth = max(1, ctx.conf.get(TRANSFER_WINDOW_DEPTH))
         self._retry: list = []  # batches whose deferred fold was a no-op
         self._base_cfg = (
@@ -1591,6 +2001,17 @@ class _DenseAggState:
                 zip(exec_.aggs, exec_._agg_input_types)
             ),
         )
+        # CPU-backend fold substrate: XLA:CPU lowers the segment scatters
+        # to serial loops (~8x slower than np.bincount at 1M rows — the
+        # hostsort fork, applied to scatter-reduce), so on that backend the
+        # table lives in host numpy: sums/counts fold via bincount, min/max
+        # via np.minimum/maximum.at (vectorized ufunc.at, numpy >= 1.24).
+        from auron_tpu.ops import hostscatter
+
+        # _dense_eligible() already restricted the aggregate set to
+        # sum/avg/count/count_star/min/max — all of which the host fold
+        # implements — so backend policy is the only remaining question
+        self._host = hostscatter.use_host_scatter()
 
     def reset(self) -> None:
         """Forget the table (after a drain) so the next update re-anchors.
@@ -1611,33 +2032,11 @@ class _DenseAggState:
         self.size = 0
         self.vals = self.valids = self.present = None
 
-    # -- input extraction ------------------------------------------------
+    # -- input extraction lives on the exec (_keys_and_inputs): shared with
+    # the probe/scatter path so column alignment can't diverge -----------
 
     def _keys_and_inputs(self, b: Batch):
-        ex = self.exec
-        if ex.mode == PARTIAL:
-            ev = Evaluator(ex.children[0].schema)
-            keys = ev.evaluate(b, [g for g, _ in ex.groupings])
-            per_agg = []
-            for (a, _), in_t in zip(ex.aggs, ex._agg_input_types):
-                if a.expr is None:
-                    per_agg.append(())
-                    continue
-                cv = ev.evaluate(b, [a.expr])[0]
-                if a.func in ("sum", "avg"):
-                    cv = ev._cast(cv, sum_type(in_t))
-                per_agg.append(((cv.values, cv.validity),))
-            return keys, tuple(per_agg)
-        keys = [
-            ColumnVal(b.col_values(i), b.col_validity(i),
-                      ex.inter_schema[i].dtype, b.dicts[i])
-            for i in range(ex.n_keys)
-        ]
-        per_agg = tuple(
-            tuple((cv.values, cv.validity) for cv in grp)
-            for grp in ex._intermediate_groups(b)
-        )
-        return keys, per_agg
+        return self.exec._keys_and_inputs(b)
 
     def _alloc(self, size: int) -> None:
         ex = self.exec
@@ -1677,17 +2076,22 @@ class _DenseAggState:
 
     def finish_pending(self) -> list:
         """Resolve EVERY in-flight deferred fold; returns the batch(es)
-        that were NOT folded (empty when all folds landed). The flag
+        that were NOT folded (empty when all folds landed). The flag/column
         transfers were started at dispatch, so these harvests are
         normally already host-resident (async-read accounting)."""
         from auron_tpu.runtime.transfer import harvest
 
         failed = []
         while self._pending:
-            pb, flag = self._pending.popleft()
-            (ok,) = harvest(flag)
-            if not bool(ok):
-                failed.append(pb)
+            with self._pending_lock:
+                pb, payload = self._pending.popleft()
+            if self._host:
+                if self._fold_host(payload) != True:
+                    failed.append(pb)
+            else:
+                (ok,) = harvest(payload)
+                if not bool(ok):
+                    failed.append(pb)
         return failed
 
     def update(self, b: Batch, defer: bool = True):
@@ -1705,10 +2109,13 @@ class _DenseAggState:
         batches), accounted as an unspillable consumer."""
         from auron_tpu.runtime.transfer import harvest, start_host_transfer
 
+        if self._host:
+            return self._update_host(b, defer=defer)
         if defer and len(self._pending) >= self._depth:
             # window full: harvest the OLDEST fold's outcome (its transfer
             # has ridden behind k batches of device compute)
-            pb0, flag0 = self._pending.popleft()
+            with self._pending_lock:
+                pb0, flag0 = self._pending.popleft()
             (ok0,) = harvest(flag0)
             if not bool(ok0):
                 self._retry.append(pb0)
@@ -1730,7 +2137,8 @@ class _DenseAggState:
             )
             if defer:
                 start_host_transfer(flag)
-                self._pending.append((b, flag))
+                with self._pending_lock:
+                    self._pending.append((b, flag))
                 return True
             if not bool(jax.device_get(flag)):  # auronlint: sync-point(8/task) -- fold-outcome read on the synchronous (end-of-stream/restart) path only
                 # the fold was an all-or-nothing no-op; the CALLER re-folds
@@ -1749,8 +2157,27 @@ class _DenseAggState:
         n = stats[0]
         if n == 0:
             return True
-        mins = stats[1::2]
-        maxs = stats[2::2]
+        if not self._anchor_from_stats(stats[1::2], stats[2::2]):
+            return False
+        # constant between re-anchors: upload once, reuse per batch
+        self._bases_dev = jnp.asarray(self.bases, jnp.int64)
+        self._his_dev = jnp.asarray(self._his, jnp.int64)
+        self._alloc(bucket_capacity(self.size_hint))
+        self.vals, self.valids, self.present, _ = _dense_update_jit(
+            self.vals, self.valids, self.present,
+            self._bases_dev, self._his_dev,
+            tuple(k.values for k in keys),
+            tuple(k.validity for k in keys),
+            b.device.sel,
+            per_agg, cfg=self._base_cfg + (self.dims,), size=self.size,
+        )
+        return True
+
+    def _anchor_from_stats(self, mins, maxs) -> bool:
+        """Anchor the table from observed per-key [min, max] ranges (plus
+        the drained-range hint): pick padded pow-2 dims, bases and guard
+        bounds. Returns False when the union range can never fit LIMIT.
+        Shared by the device and host-scatter paths; the caller allocates."""
         spans = []
         for i, (mn, mx) in enumerate(zip(mins, maxs)):
             hint = self._hint[i] if self._hint is not None else None
@@ -1799,26 +2226,225 @@ class _DenseAggState:
         # overflow-free Python ints and clamped to int64 (see kernel note)
         i64max = (1 << 63) - 1
         self._his = [min(b + d - 2, i64max) for b, d in zip(bases, pads)]
-        # constant between re-anchors: upload once, reuse per batch
-        self._bases_dev = jnp.asarray(self.bases, jnp.int64)
-        self._his_dev = jnp.asarray(self._his, jnp.int64)
-        self._alloc(bucket_capacity(product(pads)))
-        self.vals, self.valids, self.present, _ = _dense_update_jit(
-            self.vals, self.valids, self.present,
-            self._bases_dev, self._his_dev,
+        self.size_hint = product(pads)
+        return True
+
+    # -- host-scatter fold (CPU backend: np.bincount beats XLA scatters) --
+
+    def _update_host(self, b: Batch, defer: bool = True):
+        """Host-scatter fold with the SAME k-deep deferred protocol as the
+        device path: the batch's key/input columns start their device->host
+        copies at dispatch and the numpy fold (guard + np.bincount) runs
+        when the entry falls out of the window — the pull is an
+        async-window harvest, not a per-batch stall. Anchoring (no table
+        yet / post-restart) resolves synchronously like the device path's
+        stats read. int64 sums split into 16-bit limbs so bincount's
+        float64 accumulator stays exact (wraps mod 2^64 like the device
+        scatter)."""
+        from auron_tpu.runtime.transfer import start_host_transfer
+
+        if defer and len(self._pending) >= self._depth:
+            with self._pending_lock:
+                pb, payload = self._pending.popleft()
+            if self._fold_host(payload) != True:
+                self._retry.append(pb)
+                # unlike the device path (whose deferred folds already
+                # LANDED on device — only flags are pending), host folds
+                # execute at harvest: resolve every remaining in-flight
+                # entry into the still-anchored table NOW, or the caller's
+                # drain would discard their rows
+                self._retry.extend(self.finish_pending())
+                return "restart"
+        elif not defer:
+            failed = self.finish_pending()
+            if failed:
+                self._retry.extend(failed)
+                return "restart"
+        keys, per_agg = self._keys_and_inputs(b)
+        pytree = (
+            b.device.sel,
             tuple(k.values for k in keys),
             tuple(k.validity for k in keys),
-            b.device.sel,
-            per_agg, cfg=self._base_cfg + (self.dims,), size=self.size,
+            per_agg,
         )
+        leaves, treedef = jax.tree_util.tree_flatten(pytree)
+        if self.bases is None or not defer:
+            # resolve NOW: no anchored table yet (first batch, post-restart
+            # refolds — a can-never-fit range must report False
+            # synchronously so the fallback protocol terminates), or the
+            # caller is on the synchronous end-of-stream/retry path. A
+            # blocking read by design, so it carries its own per-task
+            # budget instead of riding the async-harvest site.
+            got = jax.device_get(tuple(leaves))  # auronlint: sync-point(8/task) -- host-scatter anchor/re-anchor/end-of-stream fold: first batch + O(log span) restarts, not steady state
+            return self._fold_host_arrays(
+                *jax.tree_util.tree_unflatten(treedef, got)
+            )
+        start_host_transfer(*leaves)
+        with self._pending_lock:
+            self._pending.append((b, (leaves, treedef)))
         return True
+
+    def _fold_host(self, payload):
+        """Resolve one deferred entry: harvest the landed arrays and fold."""
+        from auron_tpu.runtime.transfer import harvest
+
+        leaves, treedef = payload
+        return self._fold_host_arrays(
+            *jax.tree_util.tree_unflatten(treedef, harvest(*leaves))
+        )
+
+    def _fold_host_arrays(self, sel_d, kv_d, km_d, agg_d):
+        sel = np.asarray(sel_d)
+        kvs = [np.asarray(v) for v in kv_d]
+        kms = [np.asarray(m) for m in km_d]
+        if not sel.any():
+            return True
+        if self.bases is None:
+            mins, maxs = [], []
+            imax = np.iinfo(np.int64).max
+            imin = np.iinfo(np.int64).min
+            for v, m in zip(kvs, kms):
+                ok = sel & m
+                if ok.any():
+                    s = v[ok].astype(np.int64)
+                    mins.append(int(s.min()))
+                    maxs.append(int(s.max()))
+                else:
+                    mins.append(imax)
+                    maxs.append(imin)
+            if not self._anchor_from_stats(mins, maxs):
+                return False
+            self._alloc_host(bucket_capacity(self.size_hint))
+        # range guard, same semantics as the fused device guard
+        for i, (v, m) in enumerate(zip(kvs, kms)):
+            ok = sel & m
+            if not ok.any():
+                continue
+            if self.dims[i] == 1:
+                return "restart"  # NULL-lane-only key saw a real value
+            s = v[ok].astype(np.int64)
+            if int(s.min()) < self.bases[i] or int(s.max()) > self._his[i]:
+                return "restart"
+        size = self.size
+        idx = np.zeros(sel.shape, np.int64)
+        stride = 1
+        for i, (v, m) in enumerate(zip(kvs, kms)):
+            if self.dims[i] > 1:
+                off = np.where(
+                    m,
+                    np.clip(v.astype(np.int64), self.bases[i], self._his[i])
+                    - self.bases[i] + 1,
+                    0,
+                )
+                idx += off * stride
+            stride *= self.dims[i]
+        idx = np.where(sel, np.clip(idx, 0, size - 1), size)
+
+        def bc(weights=None):
+            return np.bincount(idx, weights=weights, minlength=size + 1)[:size]
+
+        live_cnt = bc(sel.astype(np.float64))
+        self.present |= live_cnt > 0
+        raw = self._base_cfg[0]
+        fi = 0
+        for (a, _), ins in zip(self.exec.aggs, agg_d):
+            func = a.func
+            ins = [(np.asarray(v), np.asarray(m)) for v, m in ins]
+            if func in ("count", "count_star"):
+                if not raw:
+                    v, _ = ins[0]
+                    contrib = _bincount_i64(idx, np.where(sel, v, 0), size)
+                elif func == "count_star":
+                    contrib = live_cnt.astype(np.int64)
+                else:
+                    _, m = ins[0]
+                    contrib = bc((m & sel).astype(np.float64)).astype(np.int64)
+                self.vals[fi] += contrib
+                fi += 1
+                continue
+            if func in ("min", "max"):
+                # np.minimum/maximum.at: vectorized since numpy 1.24, ~9x
+                # the XLA serial scatter at 1M rows. NaN-propagating like
+                # the device path's lax.min/max.
+                v, m = ins[0]
+                ok = m & sel
+                old = self.vals[fi]
+                if func == "min":
+                    ident = S._max_identity(old.dtype)
+                    contrib = np.full(size + 1, ident, old.dtype)
+                    np.minimum.at(contrib, idx, np.where(ok, v, ident).astype(old.dtype))
+                    both = np.minimum(old, contrib[:size])
+                else:
+                    ident = S._min_identity(old.dtype)
+                    contrib = np.full(size + 1, ident, old.dtype)
+                    np.maximum.at(contrib, idx, np.where(ok, v, ident).astype(old.dtype))
+                    both = np.maximum(old, contrib[:size])
+                cv_valid = bc(ok.astype(np.float64)) > 0
+                old_valid = self.valids[fi]
+                self.vals[fi] = np.where(
+                    old_valid & cv_valid, both,
+                    np.where(cv_valid, contrib[:size], old),
+                )
+                self.valids[fi] = old_valid | cv_valid
+                fi += 1
+                continue
+            # sum / avg
+            v, m = ins[0]
+            ok = m & sel
+            if self.vals[fi].dtype.kind == "f":
+                s = bc(np.where(ok, v.astype(np.float64), 0.0))
+            else:
+                s = _bincount_i64(idx, np.where(ok, v.astype(np.int64), 0), size)
+            self.vals[fi] += s.astype(self.vals[fi].dtype)
+            self.valids[fi] |= bc(ok.astype(np.float64)) > 0
+            fi += 1
+            if func == "avg":
+                if raw:
+                    c = bc(ok.astype(np.float64)).astype(np.int64)
+                else:
+                    cv, _ = ins[1]
+                    c = _bincount_i64(idx, np.where(sel, cv, 0), size)
+                self.vals[fi] += c
+                fi += 1
+        return True
+
+    def _alloc_host(self, size: int) -> None:
+        ex = self.exec
+        vals, valids = [], []
+        for (a, _), in_t in zip(ex.aggs, ex._agg_input_types):
+            fields = intermediate_fields(a, in_t if in_t is not None else T.INT64, "x")
+            for f in fields:
+                dt = np.dtype(f.dtype.physical_dtype().name)
+                if a.func == "min" and f.name.endswith("#min"):
+                    fill = S._max_identity(dt)
+                elif a.func == "max" and f.name.endswith("#max"):
+                    fill = S._min_identity(dt)
+                else:
+                    fill = 0
+                vals.append(np.full(size, fill, dt))
+                valids.append(np.zeros(size, bool) if f.nullable else None)
+        self.vals = vals
+        self.valids = valids
+        self.present = np.zeros(size, bool)
+        self.size = size
 
     def state_batch_and_count(self) -> tuple[Batch | None, int]:
         """Materialize the table as a (sparse-sel) intermediate batch."""
         if self.bases is None or self.present is None:
             return None, 0
         ex = self.exec
-        g = int(jax.device_get(jnp.sum(self.present)))  # auronlint: sync-point(4/task) -- group count read once at table emission (blocking boundary)
+        if self._host:
+            g = int(self.present.sum())  # host arrays: no device sync
+            present = jnp.asarray(self.present)
+            acc_vals = [jnp.asarray(v) for v in self.vals]
+            acc_valids = [
+                jnp.asarray(m) if m is not None else None for m in self.valids
+            ]
+        else:
+            g = int(jax.device_get(jnp.sum(self.present)))  # auronlint: sync-point(4/task) -- group count read once at table emission (blocking boundary)
+            present = self.present
+            acc_vals = list(self.vals)
+            acc_valids = list(self.valids)
         if g == 0:
             return None, 0
         slot = jnp.arange(self.size, dtype=jnp.int64)
@@ -1829,17 +2455,17 @@ class _DenseAggState:
             phys = key_f.dtype.physical_dtype()
             coord = (slot // stride) % self.dims[i]
             vals = (coord - 1 + self.bases[i]).astype(phys)
-            cols.append(ColumnVal(vals, self.present & (coord > 0), key_f.dtype, None))
+            cols.append(ColumnVal(vals, present & (coord > 0), key_f.dtype, None))
             stride *= self.dims[i]
         for fi, f in enumerate(ex.inter_schema.fields[ex.n_keys:]):
-            m = self.valids[fi]
+            m = acc_valids[fi]
             cols.append(ColumnVal(
-                self.vals[fi],
-                (m & self.present) if m is not None else self.present,
+                acc_vals[fi],
+                (m & present) if m is not None else present,
                 f.dtype,
                 None,
             ))
-        out = batch_from_columns(cols, ex.inter_schema.names, self.present)
+        out = batch_from_columns(cols, ex.inter_schema.names, present)
         sb = Batch(ex.inter_schema, out.device, out.dicts)
         from auron_tpu.columnar.batch import compact_batch
 
@@ -1851,7 +2477,9 @@ class _DenseAggState:
         from auron_tpu.exec.sort_exec import batch_nbytes
 
         # in-flight deferred folds pin their batches until harvest
-        total = sum(batch_nbytes(pb) for pb, _ in self._pending)
+        with self._pending_lock:
+            pending = list(self._pending)
+        total = sum(batch_nbytes(pb) for pb, _ in pending)
         if self.vals is None:
             return total
         total += self.size  # present bools
@@ -1867,4 +2495,343 @@ class _DenseAggState:
 
     def release(self, mm) -> None:
         self.vals = self.valids = self.present = None
-        self._pending.clear()  # drop in-flight fold refs (cancel path)
+        with self._pending_lock:
+            self._pending.clear()  # drop in-flight fold refs (cancel path)
+
+
+# ---------------------------------------------------------------------------
+# Incremental sorted-state probe/scatter (exec.agg.incremental.probe)
+# ---------------------------------------------------------------------------
+
+
+#: check-and-set guard for a Batch's ``_fp_collision_host``: the operator
+#: thread (_note_collision) and a cross-thread spill's merge
+#: (_resolve_fp_flags, under the table lock the operator does NOT hold
+#: here) may race on the same staged batch — without this, both could see
+#: the flag unset and double-count fp_collision_batches
+_FP_FLAG_LOCK = threading.Lock()
+
+
+def _note_collision(ref: Batch, coll: int, metrics) -> None:
+    """Record a just-read fingerprint collision flag exactly once per
+    reduce output (merge boundaries may race the per-batch read)."""
+    with _FP_FLAG_LOCK:
+        if hasattr(ref, "_fp_collision_host"):
+            return
+        ref._fp_collision_host = bool(coll)
+    if coll:
+        metrics.add("fp_collision_batches", 1)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _state_fp_jit(skey_v, skey_m, state_sel, *, cfg):
+    """State-row fingerprints (dead slots -> MAX): computed ONCE per state
+    batch and cached as ``_inc_fp`` — merges produce it for free, this is
+    the fallback for states that predate the cache (e.g. read back from a
+    spill run)."""
+    _raw, _specs, key_dtypes, fp_bits = cfg
+    skeys = [ColumnVal(v, m, dt, None)
+             for v, m, dt in zip(skey_v, skey_m, key_dtypes)]
+    return jnp.where(
+        state_sel,
+        hashing.fingerprint64(S.key_words(skeys), fp_bits),
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _probe_scatter_jit(
+    state_sel, state_fp, skey_v, skey_m, sacc_v, sacc_m, key_v, key_m, sel,
+    agg_ins, *, cfg,
+):
+    """ONE fused program: binary-search every batch row into the
+    fingerprint-sorted state, verify TRUE key-word equality at the found
+    slot (a colliding fingerprint is a miss, never a wrong fold), and
+    scatter-add the hit rows straight into the state accumulators.
+
+    Steady-state repeating-key batches therefore cost O(n log S) compares
+    plus one scatter per accumulator column — no sort. Miss rows come back
+    as a selection mask; the host resolves their count k batches later
+    through the async transfer window and routes only those through
+    sort-segmentation + staging."""
+    raw, agg_specs, key_dtypes, fp_bits = cfg
+    s_cap = state_sel.shape[0]
+    cap = sel.shape[0]
+    skeys = [ColumnVal(v, m, dt, None)
+             for v, m, dt in zip(skey_v, skey_m, key_dtypes)]
+    bkeys = [ColumnVal(v, m, dt, None)
+             for v, m, dt in zip(key_v, key_m, key_dtypes)]
+    # state WORDS are still needed for the equality check (cheap views);
+    # the state fp — the expensive chained hash — arrives precomputed
+    swords = S.key_words(skeys)
+    bwords = S.key_words(bkeys)
+    fp = hashing.fingerprint64(bwords, fp_bits)
+    slot = binsearch.lower_bound_dyn([state_fp], [fp], jnp.int32(s_cap))
+    slotc = jnp.clip(slot, 0, s_cap - 1)
+    hit = sel & state_sel[slotc] & (state_fp[slotc] == fp)
+    for sw, bw in zip(swords, bwords):
+        hit = hit & (sw[slotc] == bw)
+    idx = jnp.where(hit, slotc, s_cap)
+    nseg = s_cap + 1
+
+    def ssum(vals):
+        return jax.ops.segment_sum(vals, idx, num_segments=nseg)[:s_cap]
+
+    def sany(flags):
+        return _seg_any(flags, idx, nseg)[:s_cap]
+
+    new_v = list(sacc_v)
+    new_m = list(sacc_m)
+    fi = 0
+    for (a, in_t), ins in zip(agg_specs, agg_ins):
+        func = a.func
+        if func in ("count", "count_star"):
+            if not raw:
+                v, _ = ins[0]
+                contrib = ssum(jnp.where(hit, v, 0).astype(jnp.int64))
+            elif func == "count_star":
+                contrib = ssum(hit.astype(jnp.int64))
+            else:
+                _, m = ins[0]
+                contrib = ssum((hit & m).astype(jnp.int64))
+            new_v[fi] = sacc_v[fi] + contrib
+            fi += 1
+            continue
+        if func in ("sum", "avg"):
+            wide = is_wide_sum(in_t)
+            k = _n_limbs(sum_type(in_t).precision) if wide else 1
+            if wide:
+                if raw:
+                    v, m = ins[0]
+                    ok = hit & m
+                    cur = jnp.where(ok, v.astype(jnp.int64), jnp.int64(0))
+                    limb_vals = []
+                    for _ in range(k - 1):
+                        limb_vals.append(jnp.mod(cur, _LIMB_BASE))
+                        cur = jnp.floor_divide(cur, _LIMB_BASE)
+                    limb_vals.append(cur)
+                    oks = [ok] * k
+                else:
+                    limb_vals, oks = [], []
+                    for i in range(k):
+                        v, m = ins[i]
+                        oks.append(hit & m)
+                        limb_vals.append(
+                            jnp.where(oks[-1], v.astype(jnp.int64), jnp.int64(0))
+                        )
+                for i, (lv, ok) in enumerate(zip(limb_vals, oks)):
+                    new_v[fi + i] = sacc_v[fi + i] + ssum(lv)
+                    new_m[fi + i] = sacc_m[fi + i] | sany(ok)
+            else:
+                v, m = ins[0]
+                ok = hit & m
+                new_v[fi] = sacc_v[fi] + ssum(jnp.where(ok, v, jnp.zeros_like(v)))
+                new_m[fi] = sacc_m[fi] | sany(ok)
+            fi += k
+            if func == "avg":
+                if raw:
+                    c = ssum((hit & ins[0][1]).astype(jnp.int64))
+                else:
+                    cv, _ = ins[k]
+                    c = ssum(jnp.where(hit, cv, 0).astype(jnp.int64))
+                new_v[fi] = sacc_v[fi] + c
+                fi += 1
+            continue
+        if func in ("min", "max"):
+            v, m = ins[0]
+            ok = hit & m
+            if func == "min":
+                ident = S._max_identity(v.dtype)
+                contrib = jax.ops.segment_min(
+                    jnp.where(ok, v, jnp.asarray(ident, v.dtype)), idx,
+                    num_segments=nseg,
+                )[:s_cap]
+                both = jnp.minimum(sacc_v[fi], contrib)
+            else:
+                ident = S._min_identity(v.dtype)
+                contrib = jax.ops.segment_max(
+                    jnp.where(ok, v, jnp.asarray(ident, v.dtype)), idx,
+                    num_segments=nseg,
+                )[:s_cap]
+                both = jnp.maximum(sacc_v[fi], contrib)
+            cv_valid = sany(ok)
+            old_valid = sacc_m[fi]
+            new_v[fi] = jnp.where(
+                old_valid & cv_valid, both,
+                jnp.where(cv_valid, contrib, sacc_v[fi]),
+            )
+            new_m[fi] = old_valid | cv_valid
+            fi += 1
+            continue
+        if func in ("first", "first_ignores_null"):
+            v, m = ins[0]
+            if raw:
+                elig = hit & (m if func == "first_ignores_null" else jnp.ones_like(m))
+            else:
+                sv, _ = ins[1]
+                elig = hit & sv.astype(bool)
+            pos = jnp.arange(cap, dtype=jnp.int32)
+            first_pos = jax.ops.segment_min(
+                jnp.where(elig, pos, cap), idx, num_segments=nseg
+            )[:s_cap]
+            has = first_pos < cap
+            safe = jnp.clip(first_pos, 0, cap - 1)
+            fv = v[safe]
+            fm = m[safe] & has
+            seen_old = sacc_v[fi + 1].astype(bool)
+            take = has & ~seen_old
+            new_v[fi] = jnp.where(take, fv.astype(sacc_v[fi].dtype), sacc_v[fi])
+            new_m[fi] = jnp.where(take, fm, sacc_m[fi])
+            new_v[fi + 1] = seen_old | has
+            fi += 2
+            continue
+        raise AssertionError(func)
+    miss = sel & ~hit
+    return (
+        tuple(new_v), tuple(new_m), miss,
+        jnp.sum(miss).astype(jnp.int64), jnp.sum(hit).astype(jnp.int64),
+    )
+
+
+class _ProbeScatter:
+    """Sorted-state probe/scatter driver (exec.agg.incremental.probe).
+
+    Wraps the per-batch _probe_scatter_jit fold with the table-lock
+    discipline (a cross-thread spill must serialize against the in-place
+    state swap) and the k-deep deferred miss window (the miss count is
+    harvested from the async transfer window, so a fully-hitting steady
+    state never blocks on a per-batch read). Registered as an unspillable
+    memory consumer for the up-to-k pinned in-flight batches."""
+
+    def __init__(self, exec_: "HashAggExec", ctx: ExecutionContext,
+                 table: "_AggTableConsumer"):
+        from collections import deque
+
+        self.name = f"agg-probe-{id(exec_):x}"
+        self.exec = exec_
+        self.ctx = ctx
+        self.table = table
+        self._pending: "deque" = deque()
+        # same discipline as _DenseAggState: MemManager polls mem_used()
+        # from other operator threads while fold()/harvest mutate
+        self._pending_lock = threading.Lock()
+        self._depth = max(1, ctx.conf.get(TRANSFER_WINDOW_DEPTH))
+        self._cfg = (
+            exec_.mode == PARTIAL,
+            tuple((a, t) for (a, _), t in
+                  zip(exec_.aggs, exec_._agg_input_types)),
+            tuple(exec_.inter_schema[i].dtype for i in range(exec_.n_keys)),
+            active_conf().get(AGG_INCREMENTAL_FP_BITS),
+        )
+
+    def _ready(self) -> bool:
+        st = self.table.state
+        return st is not None and getattr(st, "_fp_order", False)
+
+    def fold(self, b: Batch) -> tuple[bool, list[Batch], int]:
+        """Probe one batch into the state. Returns (folded, miss_batches,
+        hit_rows): miss_batches are PRIOR batches whose deferred miss count
+        came back nonzero — the caller routes them through the generic path
+        with their selection narrowed to the miss rows — and hit_rows is
+        the number of rows those prior folds scattered into the state,
+        which the caller must feed into the partial-skip heuristic's row
+        counter (rows with ZERO new groups: hit-heavy streams must pull
+        the observed cardinality ratio DOWN, not vanish from it)."""
+        from auron_tpu.runtime.transfer import start_host_transfer
+
+        self._harvested_hits = 0
+        out: list[Batch] = []
+        if len(self._pending) >= self._depth:
+            out += self._harvest_one()
+        with self.table._lock:
+            ready = self._ready()
+        if not ready:
+            # a spill parked the state mid-window: the caller stages THIS
+            # batch generically right away, so every older in-flight
+            # batch's miss rows must stage first — drain the window now or
+            # first/first_ignores_null would see rows out of stream order
+            out += self.finish()
+            return False, out, self._harvested_hits
+        keys, per_agg = self.exec._keys_and_inputs(b)
+        nk = self.exec.n_keys
+        ncols = len(self.exec.inter_schema.fields)
+        with self.table._lock:
+            st = self.table.state
+            if st is None or not getattr(st, "_fp_order", False):
+                # a concurrent spill took the state between the peek and
+                # the fold — same stream-order obligation as above
+                st = None
+            else:
+                skey_v = tuple(st.col_values(i) for i in range(nk))
+                skey_m = tuple(st.col_validity(i) for i in range(nk))
+                state_fp = getattr(st, "_inc_fp", None)
+                if state_fp is None:
+                    # cache miss (state predating the reduce-attached cache,
+                    # e.g. decoded from a spill run): hash once, keep forever —
+                    # probe folds never change the key columns
+                    state_fp = st._inc_fp = _state_fp_jit(
+                        skey_v, skey_m, st.device.sel, cfg=self._cfg
+                    )
+                new_v, new_m, miss, miss_n, hit_n = _probe_scatter_jit(
+                    st.device.sel, state_fp, skey_v, skey_m,
+                    tuple(st.col_values(i) for i in range(nk, ncols)),
+                    tuple(st.col_validity(i) for i in range(nk, ncols)),
+                    tuple(k.values for k in keys),
+                    tuple(k.validity for k in keys),
+                    b.device.sel, per_agg, cfg=self._cfg,
+                )
+                dev = DeviceBatch(
+                    st.device.sel,
+                    skey_v + new_v,
+                    skey_m + new_m,
+                )
+                ns = Batch(st.schema, dev, st.dicts)
+                ns._inc_fp = state_fp
+                for attr in ("_fp_order", "_fp_collision", "_fp_collision_host"):
+                    if hasattr(st, attr):
+                        setattr(ns, attr, getattr(st, attr))
+                # in-place accumulator swap: keys, sel, capacity, bytes all
+                # unchanged, so the consumer's memory accounting stands
+                self.table.state = ns
+        if st is None:
+            out += self.finish()
+            return False, out, self._harvested_hits
+        start_host_transfer(miss_n, hit_n)
+        with self._pending_lock:
+            self._pending.append((b, miss, miss_n, hit_n))
+        return True, out, self._harvested_hits
+
+    def _harvest_one(self) -> list[Batch]:
+        from auron_tpu.runtime.transfer import harvest
+
+        with self._pending_lock:
+            b, miss, miss_n, hit_n = self._pending.popleft()
+        mn, hn = (int(x) for x in harvest(miss_n, hit_n))
+        self.ctx.metrics.add("probe_hit_rows", hn)
+        self._harvested_hits = getattr(self, "_harvested_hits", 0) + hn
+        if mn == 0:
+            return []
+        return [
+            b.with_device(DeviceBatch(miss, b.device.values, b.device.validity))
+        ]
+
+    def finish(self) -> list[Batch]:
+        """Resolve every in-flight deferred fold (end of stream)."""
+        out: list[Batch] = []
+        while self._pending:
+            out += self._harvest_one()
+        return out
+
+    def mem_used(self) -> int:
+        from auron_tpu.exec.sort_exec import batch_nbytes
+
+        with self._pending_lock:
+            pending = list(self._pending)
+        return sum(batch_nbytes(pb) for pb, _, _, _ in pending)
+
+    def spill(self) -> int:
+        return 0  # pinned in-flight batches only; resolved within k batches
+
+    def release(self) -> None:
+        with self._pending_lock:
+            self._pending.clear()

@@ -94,19 +94,73 @@ class ProjectExec(ExecOperator):
             yield out
 
 
+#: expression nodes whose evaluation is a pure jnp program (no host
+#: dictionary transforms, no partition/row-offset context, no callbacks) —
+#: the set FilterExec may compile into one fused selection program
+_FUSABLE_EXPR_NODES = (
+    ir.Column, ir.Literal, ir.Cast, ir.BinaryOp, ir.Not, ir.IsNull,
+    ir.IsNotNull, ir.If, ir.Case, ir.Coalesce,
+)
+
+
+def _predicate_fusable(e: ir.Expr, schema: T.Schema) -> bool:
+    if not isinstance(e, _FUSABLE_EXPR_NODES):
+        return False
+    dt = e.dtype_of(schema)
+    if dt.is_dict_encoded or dt.kind in (
+        T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT
+    ):
+        return False
+    return all(_predicate_fusable(c, schema) for c in e.children())
+
+
+from functools import partial as _partial  # noqa: E402
+
+import jax as _jax  # noqa: E402
+
+
+@_partial(_jax.jit, static_argnames=("schema", "preds"))
+def _filter_sel_jit(dev: DeviceBatch, *, schema: T.Schema, preds: tuple):
+    """The whole predicate chain as ONE compiled program per (schema,
+    predicates, capacity bucket): the compare/mask ops fuse into a single
+    pass, and per-batch work is one dispatch instead of an eager op chain
+    that serializes against concurrently running jitted programs on the
+    executor (the q5-class FilterExec time was that serialization, not
+    filter math)."""
+    ev = Evaluator(schema, partition_id=0, row_offset=0, resources={})
+    b = Batch(schema, dev, (None,) * len(schema.fields))
+    sel = dev.sel
+    memo: dict = {}
+    for p in preds:
+        cv = ev._eval(p, b, memo)
+        sel = sel & cv.validity & cv.values.astype(bool)
+    return sel
+
+
 class FilterExec(ExecOperator):
     def __init__(self, child: ExecOperator, predicates: list[ir.Expr]):
         super().__init__([child], child.schema)
         self.predicates = predicates
+        self._fusable = all(
+            _predicate_fusable(p, child.schema) for p in predicates
+        )
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
-        ev = Evaluator(self.children[0].schema)
+        from auron_tpu.utils.config import FILTER_FUSE
+
+        fuse = self._fusable and ctx.conf.get(FILTER_FUSE)
+        schema = self.children[0].schema
+        preds = tuple(self.predicates)
+        ev = None if fuse else Evaluator(schema)
         for b in self.child_stream(0, partition, ctx):
             with ctx.metrics.timer("elapsed_compute"):
-                sel = b.device.sel
-                for p in self.predicates:
-                    cv = ev.evaluate(b, [p])[0]
-                    sel = sel & cv.validity & cv.values.astype(bool)
+                if fuse:
+                    sel = _filter_sel_jit(b.device, schema=schema, preds=preds)
+                else:
+                    sel = b.device.sel
+                    for p in self.predicates:
+                        cv = ev.evaluate(b, [p])[0]
+                        sel = sel & cv.validity & cv.values.astype(bool)
                 yield b.with_device(
                     DeviceBatch(sel, b.device.values, b.device.validity)
                 )

@@ -168,7 +168,7 @@ def _prepare_build_jit(key_sel, row_sel, words, values, validity, order, *,
     if device_sort:
         live_first = jnp.where(key_sel, jnp.uint64(0), jnp.uint64(1))
         iota = jnp.arange(cap, dtype=jnp.int32)
-        sorted_ops = lax.sort(
+        sorted_ops = lax.sort(  # auronlint: sort-payload -- join build clustering probes by FULL key words (binsearch equality); a fingerprint plane cannot serve lexicographic probes
             tuple([live_first, *words, iota]), num_keys=len(words) + 1
         )
         sorted_words = tuple(sorted_ops[1:-1])

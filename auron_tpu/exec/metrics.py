@@ -30,9 +30,33 @@ class MetricNode:
     def set(self, metric: str, value: int) -> None:
         self.values[metric] = int(value)
 
+    #: metric-name suffixes that mean "wall nanos from timer()" — shared
+    #: with the bench/perf_gate top_ops rollups so a newly named timer
+    #: (e.g. merge_path_s) can't silently fall out of the time rankings.
+    #: "elapsed_compute" predates the suffix convention and is matched by
+    #: name (endswith makes that uniform).
+    TIME_SUFFIXES = ("_time", "_nanos", "_s", "elapsed_compute")
+
+    #: timers that run NESTED inside another timer above (merge_path_s
+    #: ticks inside merge_time): rendered normally, but excluded from
+    #: per-op time totals or their nanos would count twice
+    NESTED_TIMERS = frozenset({"merge_path_s"})
+
+    @staticmethod
+    def op_seconds(metrics: dict) -> float:
+        """Total timer seconds for one operator's metric dict — THE shared
+        definition behind bench.py/perf_gate.py top_ops rankings (nested
+        sub-timers excluded exactly once, here)."""
+        return sum(
+            v for m, v in metrics.items()
+            if m.endswith(MetricNode.TIME_SUFFIXES)
+            and m not in MetricNode.NESTED_TIMERS
+        ) / 1e9
+
     @contextmanager
     def timer(self, metric: str, count: bool = False):
-        """Accumulate wall nanos into ``metric``; with ``count`` also bump
+        """Accumulate wall nanos into ``metric`` (name it with a
+        TIME_SUFFIXES suffix); with ``count`` also bump
         ``{metric}_n`` — hot loops use it so breakdowns can express
         per-batch multiplicities (sync-budget checks divide site counts by
         these), not just totals."""
@@ -72,12 +96,30 @@ class MetricNode:
         rec(snapshot)
         return out
 
+    @staticmethod
+    def accumulate_op_totals(snapshot: dict, into: dict) -> None:
+        """Fold a snapshot() tree into a per-OPERATOR metric rollup (op
+        name = node name with the per-instance ``.N`` suffix stripped) —
+        THE shared walker behind the bench.py/perf_gate.py top_ops
+        sections, kept next to op_seconds so a change to node naming or
+        rollup shape can't make the two trajectories silently diverge."""
+
+        def rec(node: dict) -> None:
+            op = (node.get("name") or "<node>").split(".")[0]
+            tot = into.setdefault(op, {})
+            for k, v in node.get("values", {}).items():
+                tot[k] = tot.get(k, 0) + int(v)
+            for c in node.get("children", ()):
+                rec(c)
+
+        rec(snapshot)
+
     def render(self, indent: int = 0) -> str:
         """Human-readable metric tree (the engine-side analog of the
         reference's Spark-UI metric surfacing, auron-spark-ui)."""
 
         def fmt(k: str, v: int) -> str:
-            if k.endswith("_time") or k.endswith("_nanos"):
+            if k.endswith(MetricNode.TIME_SUFFIXES):
                 return f"{k}={v / 1e6:.1f}ms"
             return f"{k}={v}"
 

@@ -351,7 +351,7 @@ def ordered_sort(
         word_narrow = (False,) * n_words
     assert len(word_narrow) == n_words, (len(word_narrow), n_words)
     if impl is None:
-        impl = sort_impl_for(
+        impl = sort_impl_for(  # auronlint: sort-payload -- generic ORDER BY: the operand planes ARE the user's sort keys, all must participate
             n_words, operands[0].shape[0], n_narrow_words=sum(word_narrow)
         )
     if impl in ("jnp", "pallas"):

@@ -298,6 +298,41 @@ def xxhash64_bytes(
 
 
 # ---------------------------------------------------------------------------
+# group-key fingerprints
+# ---------------------------------------------------------------------------
+
+#: seed for group-key fingerprints (Spark's hash seed; any fixed value works —
+#: fingerprints never leave the engine, unlike the partition hashes above)
+_FP_SEED = 42
+
+
+def fingerprint64(words: list[jnp.ndarray], bits: int = 64) -> jnp.ndarray:
+    """One 64-bit fingerprint per row from K canonical uint64 key words
+    (ops/segments.key_words), chained xxhash64 like Spark's multi-column
+    hashing (the hash of word k seeds word k+1).
+
+    Grouping sorts ``(dead, fingerprint, iota)`` — 3 fixed operands —
+    instead of the full K+2-operand word tuple; true key equality is then
+    verified per fingerprint segment (collisions are ~n^2/2^64 but must be
+    *detected*, never assumed away). ``bits`` truncates the fingerprint to
+    its low ``bits`` bits — a test hook that forces collisions
+    deterministically (exec.agg.incremental.fp.bits); production leaves 64.
+    """
+    fp = jnp.full(words[0].shape, jnp.uint64(_FP_SEED))
+    for w in words:
+        fp = xxhash64_u64s([w.astype(jnp.uint64)], fp)
+    if bits < 64:
+        fp = fp & jnp.uint64((1 << max(bits, 1)) - 1)
+    else:
+        # UINT64_MAX is reserved as the dead-row sentinel in the sorted
+        # runs (segment_merged, probe state): a live key hashing to it
+        # (p = 2^-64) would alias a dead slot and dodge collision
+        # detection — clamp it away globally so no consumer can forget
+        fp = jnp.minimum(fp, jnp.uint64(0xFFFFFFFFFFFFFFFE))
+    return fp
+
+
+# ---------------------------------------------------------------------------
 # partition ids
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,31 @@
+"""Backend-adaptive scatter-reduce policy.
+
+The dense-agg fold is a handful of ``jax.ops.segment_*`` scatters per
+batch. On accelerators those are fast fused scatter kernels — the right
+call. XLA:CPU however lowers scatters to SERIAL per-element loops (the
+platform even advertises prefer-no-scatter; see columnar/batch.py
+compaction_index), measured ~8x slower than a host ``np.bincount`` over the
+same 1M-row batch. This is the hostsort fork (ops/hostsort.py), applied to
+scatter-reduce: on the CPU backend the dense table lives in host numpy and
+folds via bincount (exec/agg_exec._DenseAggState._update_host); on
+accelerators the fused device scatter stays.
+
+min/max folds use ``np.minimum.at``/``np.maximum.at`` (vectorized since
+numpy 1.24, ~9x the XLA serial scatter at 1M rows); collect/UDAF
+aggregations keep their eager host path and the rest of the eligibility
+check lives with the fold (_DenseAggState).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from auron_tpu.utils.config import AGG_DENSE_HOST_SCATTER, active_conf, resolve_tri
+
+
+def use_host_scatter() -> bool:
+    """Call-time decision: host bincount fold or device segment scatters."""
+    return resolve_tri(
+        active_conf().get(AGG_DENSE_HOST_SCATTER),
+        jax.default_backend() == "cpu",
+    )

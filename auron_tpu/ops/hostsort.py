@@ -21,17 +21,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from auron_tpu.utils.config import HOST_SORT_MODE, active_conf
+from auron_tpu.utils.config import HOST_SORT_MODE, active_conf, resolve_tri
 
 
-def use_host_sort() -> bool:
-    """Trace-time decision: host lexsort or device lax.sort."""
-    mode = active_conf().get(HOST_SORT_MODE)
-    if mode == "on":
-        return True
-    if mode == "off":
-        return False
-    return jax.default_backend() == "cpu"
+def use_host_sort(conf=None) -> bool:
+    """Trace-time decision: host lexsort or device lax.sort.
+
+    ``conf``: pass the task's own Configuration on any path a
+    cross-thread spill can reach — active_conf() is thread-local, so the
+    spilling thread would otherwise resolve a foreign task's knob."""
+    return resolve_tri(
+        (conf if conf is not None else active_conf()).get(HOST_SORT_MODE),
+        jax.default_backend() == "cpu",
+    )
 
 
 def _lexsort_cb(*words):

@@ -9,11 +9,20 @@ tables don't map to the TPU's vector units, so the TPU-native design is
 1. each group-key column is normalized to a canonical uint64 word
    (0 for NULL; a packed null-bits word distinguishes NULL from 0 and makes
    SQL GROUP BY treat NULLs as equal);
-2. one multi-operand ``lax.sort`` clusters equal keys (dead rows — sel=0 —
-   sort to the end via a leading liveness key);
-3. segment boundaries are adjacent-difference compares; segment ids are a
-   cumsum; every aggregate becomes a ``jax.ops.segment_*`` reduction with a
-   **static** segment count equal to the batch capacity.
+2. a sort clusters equal keys (dead rows — sel=0 — sort to the end via a
+   leading liveness key). Two forms: the legacy multi-operand sort over
+   every key word, and the INCREMENTAL fingerprint form (docs/agg.md) that
+   sorts only ``(dead, fingerprint64(words), iota)`` — 3 fixed operands —
+   and gathers the columns by the permutation;
+3. segment boundaries are adjacent-difference compares over the FULL words
+   (exact even when fingerprints collide — a collision is detected and
+   flagged, never assumed away); segment ids are a cumsum; every aggregate
+   becomes a ``jax.ops.segment_*`` reduction with a **static** segment
+   count equal to the batch capacity.
+
+Fingerprint-sorted runs additionally merge WITHOUT sorting via the
+binsearch merge-rank (``merge_rank_order`` / ``segment_merged``) — the
+merge-path half of the incremental design.
 
 Output groups land in a padded batch (one slot per potential group) with a
 validity prefix — shapes stay static for XLA, the dynamic group count only
@@ -30,6 +39,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from auron_tpu import types as T
+# top-level on purpose: hashing holds module-level jnp constants — a lazy
+# import inside a jitted function would CREATE them under the trace and
+# leak dead tracers into the module cache
+from auron_tpu.ops import binsearch, hashing
 from auron_tpu.exprs.eval import ColumnVal
 
 
@@ -83,17 +96,69 @@ class Segmentation(NamedTuple):
     group_of_slot: jnp.ndarray  # sorted position of each group's first row
     num_groups: jnp.ndarray  # dynamic scalar
     sel_sorted: jnp.ndarray  # liveness in sorted order
+    # fingerprint-mode extras (None on the legacy full-word sort path):
+    fp_sorted: jnp.ndarray | None = None  # uint64 fingerprints, sorted order
+    collision: jnp.ndarray | None = None  # bool scalar: some fp run holds >1 key
 
 
-@partial(jax.jit, static_argnames=("host_sort", "device_impl", "n_key_cols"))
+def _finish_segmentation(
+    order, sorted_words, sel_sorted, cap, fp_sorted=None
+) -> Segmentation:
+    """Shared segmentation tail over an ALREADY-CLUSTERED layout: boundaries
+    from adjacent full-word compares (exact under fingerprint collisions —
+    a colliding fp run splits at every key change instead of fusing keys),
+    segment ids as a cumsum, first-row slots via segment_min.
+
+    In fingerprint mode the collision flag marks batches where an fp run
+    held more than one distinct key: such a batch's groups are correct but
+    may be SPLIT (same key in two segments when a colliding key interleaves)
+    and its fps are not unique — downstream (exec/agg_exec) counts it,
+    excludes it from merge-path/probe fast paths, and re-reduces where a
+    split group could escape to output."""
+    word_change = jnp.zeros(cap, dtype=bool)
+    for w in sorted_words:  # auronlint: disable=R1 -- loop over the key-word operand tuple (column count, not rows)
+        word_change = word_change | jnp.concatenate(
+            [jnp.zeros(1, bool), w[1:] != w[:-1]]
+        )
+    diff = word_change.at[0].set(True)
+    boundary = diff & sel_sorted
+    seg_ids_live = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_ids = jnp.where(sel_sorted, seg_ids_live, cap)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    group_of_slot = jax.ops.segment_min(
+        jnp.arange(cap, dtype=jnp.int32), seg_ids, num_segments=cap + 1
+    )[:cap]
+    collision = None
+    if fp_sorted is not None:
+        fp_same = jnp.concatenate(
+            [jnp.zeros(1, bool), fp_sorted[1:] == fp_sorted[:-1]]
+        )
+        live_adj = sel_sorted & jnp.concatenate(
+            [jnp.zeros(1, bool), sel_sorted[:-1]]
+        )
+        collision = jnp.any(live_adj & fp_same & word_change)
+    return Segmentation(
+        order, seg_ids, boundary, group_of_slot, num_groups, sel_sorted,
+        fp_sorted, collision,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("host_sort", "device_impl", "n_key_cols", "fingerprint",
+                     "fp_bits"),
+)
 def segment_by_keys(
     words: list[jnp.ndarray],
     sel: jnp.ndarray,
     order: jnp.ndarray | None = None,
+    fp: jnp.ndarray | None = None,
     *,
     host_sort: bool,
     device_impl: str = "lax",
     n_key_cols: int = 0,
+    fingerprint: bool = False,
+    fp_bits: int = 64,
 ) -> Segmentation:
     """host_sort and device_impl are REQUIRED static values: callers must
     resolve them from config OUTSIDE the trace (jit caches are keyed by
@@ -102,17 +167,52 @@ def segment_by_keys(
     on-device sort when host_sort is False: 'lax' | 'jnp' | 'pallas'
     (ops/bitonic.py network paths).
 
+    With ``fingerprint`` the K+2-operand sort collapses to a fixed
+    3-operand ``(dead, fingerprint64(words), iota)`` sort (iota as a key:
+    fully stable, same tie order as the stable host lexsort); key/payload
+    columns are gathered by the resulting permutation and segment
+    boundaries still come from FULL word compares, so output is exact even
+    when fingerprints collide (see _finish_segmentation). Groups emerge in
+    fingerprint order, which exec/agg_exec exploits for sorted-state
+    probing and merge-path merges.
+
     With host_sort, EVERY caller must precompute ``order`` eagerly
-    (host_order) and pass it as data: this function is itself jitted, so
-    an order=None host_sort call compiles the pure_callback into an
-    XLA:CPU program — and concurrent callback-bearing programs wedge the
-    intra-op pool (runtime/task.py invariant). The in-trace callback is
-    kept only as a single-threaded-context fallback."""
+    (host_order / host_order_fp) and pass it as data: this function is
+    itself jitted, so an order=None host_sort call compiles the
+    pure_callback into an XLA:CPU program — and concurrent
+    callback-bearing programs wedge the intra-op pool (runtime/task.py
+    invariant). The in-trace callback is kept only as a
+    single-threaded-context fallback."""
     from auron_tpu.ops import hostsort
 
     cap = sel.shape[0]
     dead_first_key = jnp.where(sel, jnp.uint64(0), jnp.uint64(1))
     iota = jnp.arange(cap, dtype=jnp.int32)
+    if fingerprint:
+        if fp is None:
+            # host-sort callers pass the fp they already computed for the
+            # eager lexsort (host_order_fp) — hashing twice per batch would
+            # cancel the narrower sort's savings
+            fp = hashing.fingerprint64(words, fp_bits)
+        if host_sort:
+            if order is None:
+                order = hostsort.order_by_words((dead_first_key, fp))
+            sel_sorted = sel[order]
+            fp_sorted = fp[order]
+        else:
+            # iota is a KEY (num_keys=3): ties resolve in batch order, the
+            # same stable semantics as the host lexsort — `first` and
+            # staged-run layouts stay identical across backends
+            # auronlint: sort-payload -- fixed 3-operand fingerprint sort (the payload-thin form)
+            s_dead, fp_sorted, order = lax.sort(
+                (dead_first_key, fp, iota), num_keys=3
+            )
+            # the sort already emitted the sorted planes — no re-gather
+            sel_sorted = s_dead == 0
+        sorted_words = tuple(w[order] for w in words)
+        return _finish_segmentation(
+            order, sorted_words, sel_sorted, cap, fp_sorted=fp_sorted
+        )
     if host_sort:
         if order is None:
             order = hostsort.order_by_words((dead_first_key, *words))
@@ -129,26 +229,17 @@ def segment_by_keys(
             narrow = [True] + [False] * len(words) + [False]
             if 0 < n_key_cols <= 32 and len(words) == n_key_cols + 1:
                 narrow[len(words)] = True
+            # auronlint: sort-payload -- legacy full-word grouping sort: the operand list scales with key columns by design; the fingerprint path above is the thin form
             sorted_ops = bitonic.bitonic_sort(
                 tuple(operands), impl=device_impl, narrow=tuple(narrow)
             )
         else:
+            # auronlint: sort-payload -- legacy full-word grouping sort (collision-free exact fallback for the fingerprint path)
             sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1)
         sel_sorted = sorted_ops[0] == 0
         sorted_words = sorted_ops[1:-1]
         order = sorted_ops[-1]
-
-    diff = jnp.zeros(cap, dtype=bool).at[0].set(True)
-    for w in sorted_words:  # auronlint: disable=R1 -- loop over the key-word operand tuple (column count, not rows)
-        diff = diff | jnp.concatenate([jnp.ones(1, bool), w[1:] != w[:-1]])
-    boundary = diff & sel_sorted
-    seg_ids_live = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    seg_ids = jnp.where(sel_sorted, seg_ids_live, cap)
-    num_groups = jnp.sum(boundary.astype(jnp.int32))
-    group_of_slot = jax.ops.segment_min(
-        jnp.arange(cap, dtype=jnp.int32), seg_ids, num_segments=cap + 1
-    )[:cap]
-    return Segmentation(order, seg_ids, boundary, group_of_slot, num_groups, sel_sorted)
+    return _finish_segmentation(order, sorted_words, sel_sorted, cap)
 
 
 def host_order(words: list[jnp.ndarray], sel: jnp.ndarray) -> jnp.ndarray:
@@ -162,6 +253,92 @@ def host_order(words: list[jnp.ndarray], sel: jnp.ndarray) -> jnp.ndarray:
         (jnp.where(sel, jnp.uint64(0), jnp.uint64(1)), tuple(words)))
     operands = [np.asarray(dead_d), *[np.asarray(w) for w in words_d]]
     return jnp.asarray(np.lexsort(tuple(reversed(operands))).astype(np.int32))
+
+
+@partial(jax.jit, static_argnames=("fp_bits",))
+def _fp_dead_jit(words, sel, fp_bits: int):
+    return (
+        jnp.where(sel, jnp.uint64(0), jnp.uint64(1)),
+        hashing.fingerprint64(list(words), fp_bits),
+    )
+
+
+def host_order_fp(
+    words: list[jnp.ndarray], sel: jnp.ndarray, fp_bits: int = 64
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """EAGER host lexsort (order, fingerprints) for the fingerprint path:
+    the fingerprint computes on device (one tiny jitted program) and only
+    TWO arrays cross to the host — np.lexsort cost stops scaling with
+    key-column count. np.lexsort is stable, matching the device path's
+    iota tie key. The device fp array is returned so the downstream jit
+    consumes it as data instead of hashing the words a second time."""
+    import numpy as np
+
+    dead_dev, fp_dev = _fp_dead_jit(tuple(words), sel, fp_bits)
+    # auronlint: sync-point(2/batch) -- fingerprint host-sort boundary: 2 fixed arrays per batch regardless of key count (vs 2+K for host_order)
+    dead_d, fp_d = jax.device_get((dead_dev, fp_dev))
+    order = jnp.asarray(
+        np.lexsort((np.asarray(fp_d), np.asarray(dead_d))).astype(np.int32)
+    )
+    return order, fp_dev
+
+
+def merge_rank_order(
+    fp: jnp.ndarray, sel: jnp.ndarray, cap_a: int
+) -> jnp.ndarray:
+    """Merge-path permutation for TWO fp-sorted runs laid out back to back
+    in one array (A = [0, cap_a), B = [cap_a, cap)), each a live prefix
+    sorted ascending by fingerprint. Returns the stable-merge order (A
+    before B on ties) computed with two binary searches — O(n log n) word
+    compares against the O(n log^2 n) multi-operand re-sort it replaces —
+    placing dead/pad rows after every live row.
+
+    Call inside jit; fp must already be masked to UINT64_MAX on dead rows.
+    """
+    cap = fp.shape[0]
+    cap_b = cap - cap_a
+    fp_a, fp_b = fp[:cap_a], fp[cap_a:]
+    # A[i] lands after every B < it; B[j] after every A <= it (A wins ties,
+    # so equal-fingerprint groups from the two runs come out ADJACENT)
+    pos_a = jnp.arange(cap_a, dtype=jnp.int32) + binsearch.lower_bound_dyn(
+        [fp_b], [fp_a], jnp.int32(cap_b)
+    )
+    pos_b = jnp.arange(cap_b, dtype=jnp.int32) + binsearch.upper_bound_dyn(
+        [fp_a], [fp_b], jnp.int32(cap_a)
+    )
+    return (
+        jnp.zeros(cap, jnp.int32)
+        .at[pos_a].set(jnp.arange(cap_a, dtype=jnp.int32))
+        .at[pos_b].set(cap_a + jnp.arange(cap_b, dtype=jnp.int32))
+    )
+
+
+def segment_merged(
+    words: list[jnp.ndarray],
+    sel: jnp.ndarray,
+    cap_a: int,
+    fp_bits: int = 64,
+    fp: jnp.ndarray | None = None,
+) -> Segmentation:
+    """Segmentation of two back-to-back fp-sorted runs WITHOUT a sort:
+    merge-rank the fingerprints (merge_rank_order), then the standard
+    word-exact segmentation tail. The collision flag reports any fp run
+    holding >1 distinct key in the merged layout (cross-run fingerprint
+    collisions included). Call inside jit.
+
+    ``fp``: the runs' cached dead-masked fingerprints laid out like the
+    columns (exec/agg_exec passes the concatenated ``_inc_fp`` arrays so
+    every pair merge skips the O(rows x K) re-hash)."""
+    if fp is None:
+        fp = hashing.fingerprint64(words, fp_bits)
+        fp = jnp.where(sel, fp, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    order = merge_rank_order(fp, sel, cap_a)
+    sel_sorted = sel[order]
+    sorted_words = tuple(w[order] for w in words)
+    cap = sel.shape[0]
+    return _finish_segmentation(
+        order, sorted_words, sel_sorted, cap, fp_sorted=fp[order]
+    )
 
 
 # ---------------------------------------------------------------------------

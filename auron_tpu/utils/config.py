@@ -100,6 +100,19 @@ def active_conf() -> Configuration:
     return getattr(_local, "conf", None) or _GLOBAL
 
 
+def resolve_tri(mode: str, auto: bool) -> bool:
+    """THE resolution rule for on|off|auto backend-policy knobs
+    (exec.agg.incremental.*, exec.agg.dense.host.scatter, the host-sort
+    fork): explicit on/off win, auto defers to the caller's backend
+    predicate. One definition so a grammar change (or a new mode) cannot
+    silently diverge between the forks."""
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return auto
+
+
 class conf_scope:
     """Context manager installing a Configuration for the current thread.
 
@@ -247,6 +260,56 @@ PARTIAL_AGG_SKIPPING_RATIO = float_conf(
 PARTIAL_AGG_SKIPPING_MIN_ROWS = int_conf(
     "partial.agg.skipping.min.rows", 20480, "agg", ""
 )
+AGG_INCREMENTAL_ENABLE = bool_conf(
+    "exec.agg.incremental.enable", True, "agg",
+    "umbrella for incremental grouped aggregation (docs/agg.md): "
+    "fingerprint-sort segmentation, sorted-state probe/scatter and "
+    "merge-path state merges. False = the legacy full-word "
+    "sort-segmentation path everywhere (bit-identical results either way)",
+)
+AGG_INCREMENTAL_FINGERPRINT = str_conf(
+    "exec.agg.incremental.fingerprint", "auto", "agg",
+    "sort (dead, fingerprint64, iota) — 3 fixed operands — instead of the "
+    "K+2 key-word operands, verifying true key equality per fingerprint "
+    "segment; collision batches are exact (word-compare boundaries), "
+    "counted (fp_collision_batches) and excluded from the probe/merge-path "
+    "fast paths. on | off | auto = on for accelerators, off on the CPU "
+    "backend (where the host lexsort already wins and the extra hashing "
+    "loses — measured on the q93-class bool-key agg)",
+)
+AGG_INCREMENTAL_PROBE = str_conf(
+    "exec.agg.incremental.probe", "auto", "agg",
+    "binary-search each incoming row into the fingerprint-sorted state "
+    "batch and scatter-add rows whose group already exists straight into "
+    "the state accumulators — repeating-key steady state pays O(n log S) + "
+    "one scatter, no sort; only miss rows flow to sort-segmentation. "
+    "on | off | auto = accelerators only (XLA:CPU lowers the scatter to a "
+    "serial loop that costs more than the sort it replaces)",
+)
+AGG_INCREMENTAL_MERGEPATH = str_conf(
+    "exec.agg.incremental.mergepath", "auto", "agg",
+    "merge fingerprint-sorted state and staged runs with a binsearch "
+    "merge-rank permutation instead of concat-and-re-sort (the q5-class "
+    "merge_time blowup); falls back to the full re-sort whenever a run is "
+    "not confirmed collision-free. on | off | auto = accelerators only "
+    "(the merge-rank permutation build is a scatter — serial on XLA:CPU)",
+)
+AGG_INCREMENTAL_FP_BITS = int_conf(
+    "exec.agg.incremental.fp.bits", 64, "agg",
+    "fingerprint width; < 64 truncates to the low bits. A TEST hook: tiny "
+    "widths force deterministic fingerprint collisions so the "
+    "collision-detection/fallback machinery is exercisable — production "
+    "stays at 64",
+)
+AGG_DENSE_HOST_SCATTER = str_conf(
+    "exec.agg.dense.host.scatter", "auto", "agg",
+    "fold dense-agg batches with host np.bincount (sums/counts) and "
+    "np.minimum/maximum.at (min/max) instead of on-device segment "
+    "scatters: on | off | auto = on for the CPU backend, where XLA lowers "
+    "segment scatters to serial loops ~8x slower (the hostsort fork, "
+    "applied to scatter-reduce). Accelerators keep the fused device "
+    "scatter",
+)
 AGG_SPILL_BUCKETS = int_conf(
     "agg.spill.buckets", 64, "agg",
     "number of hash buckets for spilled aggregation merge (agg/agg_ctx.rs:611)",
@@ -301,6 +364,14 @@ PARQUET_LATE_MATERIALIZATION = bool_conf(
     "groups with zero matches (page/dictionary-check analog)",
 )
 CASE_SENSITIVE = bool_conf("case.sensitive", False, "sql", "identifier resolution")
+FILTER_FUSE = bool_conf(
+    "exec.filter.fuse", True, "exec",
+    "compile trace-safe filter predicates into ONE jitted program per "
+    "(schema, predicate, capacity-bucket) instead of eager per-op "
+    "dispatch: fuses the compare/mask chain into a single pass and stops "
+    "eager dispatch from serializing against concurrent jitted programs "
+    "on the executor (the q5-class FilterExec misattribution)",
+)
 UDF_FALLBACK_ENABLE = bool_conf(
     "udf.fallback.enable", True, "expr",
     "evaluate unconvertible expressions via host callback (SparkUDFWrapper analog)",

@@ -144,13 +144,29 @@ def _ensure_live_backend() -> None:
 
 
 def main() -> None:
+    import threading
+
     import auron_tpu  # noqa: F401
+    from auron_tpu.bridge import api
+    from auron_tpu.exec.metrics import MetricNode
     from auron_tpu.models import tpcds
     from auron_tpu.utils.profiling import EngineCounters
 
     # engine-level sync accounting rides the BENCH record so the
     # trajectory catches sync regressions, not just throughput
     counters = EngineCounters.install()
+
+    # per-operator rollup (same sink shape as perf_gate.py) so the BENCH
+    # record carries a top_ops section — op-level regressions show in the
+    # BENCH_r* trajectory even when end-to-end throughput still passes
+    op_totals: dict[str, dict[str, int]] = {}
+    sink_lock = threading.Lock()
+
+    def sink(snap: dict) -> None:
+        with sink_lock:
+            MetricNode.accumulate_op_totals(snap, op_totals)
+
+    api.set_metrics_sink(sink)
 
     sf = float(os.environ.get("BENCH_SF", "8"))
     # one map/reduce partition per accelerator: the bench box has ONE
@@ -195,6 +211,8 @@ def main() -> None:
             data, n_map=n_parts, n_reduce=n_parts, work_dir=wd0, ingested=ingested
         )
     counters.reset()  # attribute syncs to the timed runs only, not warmup
+    with sink_lock:
+        op_totals.clear()  # attribute top_ops to the timed runs only
     engine_s = float("inf")
     for _ in range(2):
         with tempfile.TemporaryDirectory(prefix="auron_bench_") as wd:
@@ -240,6 +258,14 @@ def main() -> None:
         "host_sync_s": sync_snap["host_sync_s"],
         "async_reads": sync_snap["async_reads"],
         "sync_sites": sync_snap["sync_sites"],
+        # op -> elapsed compute seconds over BOTH timed runs, top 5
+        "top_ops": {
+            k: round(MetricNode.op_seconds(tot), 3)
+            for k, tot in sorted(
+                op_totals.items(),
+                key=lambda kv: -MetricNode.op_seconds(kv[1]),
+            )[:5]
+        },
     }
     if backend in ("tpu", "axon"):
         # settle the cluster-sort verdict on real hardware while we have

@@ -123,17 +123,7 @@ def run_one(name: str, ws: str) -> None:
         for k, v in MetricNode.flat_totals(snap).items():
             flat_totals[k] = flat_totals.get(k, 0) + int(v)
 
-        def rec(node: dict) -> None:
-            name_ = node.get("name") or "<node>"
-            # strip the per-instance ".N" child suffixes down to the op name
-            op = name_.split(".")[0]
-            tot = op_totals.setdefault(op, {})
-            for k, v in node.get("values", {}).items():
-                tot[k] = tot.get(k, 0) + int(v)
-            for c in node.get("children", ()):
-                rec(c)
-
-        rec(snap)
+        MetricNode.accumulate_op_totals(snap, op_totals)
 
     api.set_metrics_sink(sink)
 
@@ -215,14 +205,15 @@ def run_one(name: str, ws: str) -> None:
         "backend": backend, "error": err,
     }), flush=True)
     # second line: where the time went (op rollup sorted by compute time)
-    ranked = sorted(
-        op_totals.items(),
-        key=lambda kv: -sum(v for m, v in kv[1].items()
-                            if m.endswith("_time") or m.endswith("_nanos")),
-    )
+    op_seconds = MetricNode.op_seconds
+    ranked = sorted(op_totals.items(), key=lambda kv: -op_seconds(kv[1]))
     print(json.dumps({
         "breakdown": name, "sf": sf, "tasks": len(trees),
         "counters": counters.snapshot(),
+        # op -> elapsed compute seconds, top 5: the trajectory-diffable
+        # shape (BENCH_r*/PERF_BREAKDOWN_*) that catches an op-level
+        # regression even when the end-to-end speedup still passes
+        "top_ops": {k: round(op_seconds(v), 3) for k, v in ranked[:5]},
         "flat": {k: flat_totals[k] for k in sorted(flat_totals)},
         "ops": {k: v for k, v in ranked},
     }), flush=True)

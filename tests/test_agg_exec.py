@@ -465,44 +465,74 @@ def test_udaf_accumulator_state_spills(tmp_path):
         MemManager.init()
 
 
-def test_dense_agg_deferred_restart_no_double_fold():
+@pytest.fixture(params=["auto", "off"], ids=["hostfold", "devicefold"])
+def dense_fold_substrate(request):
+    """Run a dense-agg test under BOTH fold substrates. On the CPU CI
+    backend AGG_DENSE_HOST_SCATTER=auto resolves to the host numpy
+    bincount fold, which would leave the accelerator device-scatter path
+    (_dense_update_jit dispatch + its deferred-flag protocol) with zero
+    coverage — the 'off' pin keeps that path exercised here."""
+    from auron_tpu.utils.config import AGG_DENSE_HOST_SCATTER, active_conf
+
+    conf = active_conf()
+    saved = conf.get(AGG_DENSE_HOST_SCATTER)
+    conf.set(AGG_DENSE_HOST_SCATTER, request.param)
+    try:
+        yield request.param
+    finally:
+        conf.set(AGG_DENSE_HOST_SCATTER, saved)
+
+
+def test_dense_agg_deferred_restart_no_double_fold(dense_fold_substrate):
     """Dense-table folds are deferred (flag read one batch late). A batch
     whose keys outgrow the anchored range must fold EXACTLY once after the
     drain+re-anchor — both mid-stream and when the growth lands on the
     last batch (resolved at end of stream). Regression: the q88-class last
     band was double-counted."""
+    # min/max ride along so BOTH fold substrates (np.minimum/maximum.at
+    # on the host, segment_min/max on device) face the restart protocol
+    aggs = [
+        (AggExpr("count_star", None), "c"), (AggExpr("sum", col(1)), "s"),
+        (AggExpr("min", col(1)), "mn"), (AggExpr("max", col(1)), "mx"),
+    ]
+
     def run(key_batches):
         batches = [
-            Batch.from_pydict({"k": ks, "v": [1.0] * len(ks)})
+            Batch.from_pydict({"k": ks, "v": [float(k % 7) for k in ks]})
             for ks in key_batches
         ]
         agg = HashAggExec(
-            MemoryScanExec.single(batches),
-            [(col(0), "k")],
-            [(AggExpr("count_star", None), "c"),
-             (AggExpr("sum", col(1)), "s")],
-            "partial",
+            MemoryScanExec.single(batches), [(col(0), "k")], aggs, "partial",
         )
-        final = HashAggExec(
-            agg, [(col(0), "k")],
-            [(AggExpr("count_star", None), "c"), (AggExpr("sum", col(1)), "s")],
-            "final",
-        )
+        final = HashAggExec(agg, [(col(0), "k")], aggs, "final")
         return (final.collect().to_pandas()
                 .sort_values("k").reset_index(drop=True))
 
-    # growth on the LAST batch: its restart resolves at end of stream
-    out = run([[0, 0, 1], [1, 1], [100000, 100000]])
-    assert out["k"].tolist() == [0, 1, 100000]
-    assert out["c"].tolist() == [2, 3, 2]
-    assert out["s"].tolist() == [2.0, 3.0, 2.0]
-    # growth mid-stream: restart then more in-range batches
-    out = run([[5, 5], [900000], [5, 6], [900001]])
-    assert out["k"].tolist() == [5, 6, 900000, 900001]
-    assert out["c"].tolist() == [3, 1, 1, 1]
+    def want(key_batches):
+        ks = [k for band in key_batches for k in band]
+        return (
+            pd.DataFrame({"k": ks, "v": [float(k % 7) for k in ks]})
+            .groupby("k")
+            .agg(c=("v", "size"), s=("v", "sum"), mn=("v", "min"),
+                 mx=("v", "max"))
+            .reset_index().sort_values("k").reset_index(drop=True)
+        )
+
+    for key_batches in (
+        # growth on the LAST batch: its restart resolves at end of stream
+        [[0, 0, 1], [1, 1], [100000, 100000]],
+        # growth mid-stream: restart then more in-range batches
+        [[5, 5], [900000], [5, 6], [900001]],
+    ):
+        out, exp = run(key_batches), want(key_batches)
+        assert out["k"].tolist() == exp["k"].tolist()
+        assert out["c"].tolist() == exp["c"].tolist()
+        assert out["s"].tolist() == exp["s"].tolist()
+        assert out["mn"].tolist() == exp["mn"].tolist()
+        assert out["mx"].tolist() == exp["mx"].tolist()
 
 
-def test_dense_agg_sentinel_key_extremes():
+def test_dense_agg_sentinel_key_extremes(dense_fold_substrate):
     """A key near the int64 extremes must trigger the dense table's
     re-anchor (then permanent fallback), never fold into a clamped slot:
     the fused guard compares against host-computed bounds instead of
@@ -525,7 +555,151 @@ def test_dense_agg_sentinel_key_extremes():
     assert out["c"].tolist() == [2, 1, 2, 1]
 
 
-def test_dense_agg_k_deep_window_interleaved_restarts():
+def test_probe_scatter_k_deep_interleaved_misses():
+    """Probe/scatter mirror of the dense k-deep test below: once a compact
+    has produced an fp-sorted state, hit batches scatter straight into the
+    state while miss batches resolve k batches LATE through the async
+    window and re-enter the generic path with their selection narrowed to
+    the miss rows. Interleaving known-key and new-band batches at several
+    window depths, every row must still count exactly once vs pandas."""
+    import pandas as pd
+
+    from auron_tpu.utils.config import (
+        AGG_INCREMENTAL_FINGERPRINT,
+        AGG_INCREMENTAL_MERGEPATH,
+        AGG_INCREMENTAL_PROBE,
+        BATCH_SIZE,
+        PARTIAL_AGG_SKIPPING_ENABLE,
+        TRANSFER_WINDOW_DEPTH,
+        Configuration,
+        conf_scope,
+    )
+
+    rng = np.random.default_rng(4)
+    key_batches = []
+    # phase 1: enough distinct keys to cross the staging threshold (the
+    # 1<<15 merge floor) so compact() builds the probe-able state
+    pool = np.arange(40_000) * 1_000_003 + 7  # dense-ineligible spread
+    for i in range(17):
+        key_batches.append(pool[i * 2048:(i + 1) * 2048].tolist())
+    # phase 2: interleave state hits with new-band misses so multiple
+    # in-flight deferred folds keep resolving against a moving state
+    for i in range(12):
+        if i % 3 == 2:
+            band = 900_000_000_000 + i * 10_000  # brand-new keys: misses
+            key_batches.append((band + rng.integers(0, 200, 512)).tolist())
+        else:
+            key_batches.append(rng.choice(pool[:34_000], 512).tolist())
+    all_k = [k for ks in key_batches for k in ks]
+    want = (
+        pd.DataFrame({"k": all_k, "v": [1.0] * len(all_k)})
+        .groupby("k").agg(c=("v", "size"), s=("v", "sum")).reset_index()
+        .sort_values("k").reset_index(drop=True)
+    )
+
+    aggs = [(AggExpr("count_star", None), "c"), (AggExpr("sum", col(1)), "s")]
+    probed_depths = []
+    for depth in (1, 3, 6):
+        conf = (Configuration().set(TRANSFER_WINDOW_DEPTH, depth)
+                .set(BATCH_SIZE, 2048)
+                # incremental mechanisms pinned on (auto = accelerator-only)
+                .set(AGG_INCREMENTAL_FINGERPRINT, "on")
+                .set(AGG_INCREMENTAL_PROBE, "on")
+                .set(AGG_INCREMENTAL_MERGEPATH, "on")
+                # phase 1 is all-distinct by construction — the pass-through
+                # heuristic would drain the state this test probes into
+                .set(PARTIAL_AGG_SKIPPING_ENABLE, False))
+        with conf_scope(conf):
+            batches = [
+                Batch.from_pydict({"k": ks, "v": [1.0] * len(ks)})
+                for ks in key_batches
+            ]
+            agg = HashAggExec(
+                MemoryScanExec.single(batches), [(col(0), "k")], aggs, "partial")
+            ctx = ExecutionContext(conf=conf)
+            mid = list(agg.execute(0, ctx))
+            final = HashAggExec(
+                MemoryScanExec.single(mid), [(col(0), "k")], aggs, "final")
+            out = pd.concat(
+                b.to_pandas() for b in final.execute(0, ExecutionContext(conf=conf))
+            ).sort_values("k").reset_index(drop=True)
+        assert out["k"].tolist() == want["k"].tolist(), f"depth={depth}"
+        assert out["c"].tolist() == want["c"].tolist(), f"depth={depth}"
+        assert out["s"].tolist() == [float(x) for x in want["s"]], f"depth={depth}"
+        probed_depths.append(ctx.metrics.values.get("probe_hit_rows", 0))
+    # the probe actually engaged (phase-2 hit batches scattered into state)
+    assert all(p > 0 for p in probed_depths), probed_depths
+
+
+def test_probe_scatter_all_agg_kinds_bit_identical():
+    """Every probe-foldable aggregate kind through an ACTUALLY-probing
+    stream (state built, then repeating-key batches scatter into it):
+    sum/count/count_star/avg/min/max/first_ignores_null must come out
+    bit-identical to the legacy path. Dyadic values keep float sums exact,
+    so the scatter's summation order can't legally differ."""
+    import pandas as pd
+
+    from auron_tpu.utils.config import (
+        AGG_INCREMENTAL_ENABLE,
+        AGG_INCREMENTAL_FINGERPRINT,
+        AGG_INCREMENTAL_MERGEPATH,
+        AGG_INCREMENTAL_PROBE,
+        BATCH_SIZE,
+        PARTIAL_AGG_SKIPPING_ENABLE,
+        Configuration,
+        conf_scope,
+    )
+
+    rng = np.random.default_rng(8)
+    pool = np.arange(36_000) * 1_000_003 + 13
+    key_batches = [pool[i * 2048:(i + 1) * 2048].tolist() for i in range(17)]
+    for i in range(8):
+        key_batches.append(rng.choice(pool[:30_000], 512).tolist())
+    val_batches = [
+        (rng.integers(-(1 << 20), 1 << 20, len(ks)) / 1024.0).tolist()
+        for ks in key_batches
+    ]
+    aggs = [
+        (AggExpr("sum", col(1)), "s"), (AggExpr("count", col(1)), "c"),
+        (AggExpr("count_star", None), "cs"), (AggExpr("avg", col(1)), "a"),
+        (AggExpr("min", col(1)), "mn"), (AggExpr("max", col(1)), "mx"),
+        (AggExpr("first_ignores_null", col(1)), "f"),
+    ]
+
+    def run(enable):
+        mode = "on" if enable else "off"
+        conf = (Configuration().set(BATCH_SIZE, 2048)
+                .set(AGG_INCREMENTAL_ENABLE, enable)
+                .set(AGG_INCREMENTAL_FINGERPRINT, mode)
+                .set(AGG_INCREMENTAL_PROBE, mode)
+                .set(AGG_INCREMENTAL_MERGEPATH, mode)
+                .set(PARTIAL_AGG_SKIPPING_ENABLE, False))
+        with conf_scope(conf):
+            batches = [
+                Batch.from_pydict({"k": ks, "v": vs})
+                for ks, vs in zip(key_batches, val_batches)
+            ]
+            agg = HashAggExec(
+                MemoryScanExec.single(batches), [(col(0), "k")], aggs, "partial")
+            ctx = ExecutionContext(conf=conf)
+            mid = list(agg.execute(0, ctx))
+            final = HashAggExec(
+                MemoryScanExec.single(mid), [(col(0), "k")], aggs, "final")
+            out = pd.concat(
+                b.to_pandas() for b in final.execute(0, ExecutionContext(conf=conf))
+            ).sort_values("k").reset_index(drop=True)
+        return out, ctx.metrics.values.get("probe_hit_rows", 0)
+
+    inc, hits = run(True)
+    leg, _ = run(False)
+    assert hits > 0, "stream never probed — test shape regressed"
+    assert len(inc) == len(leg)
+    for c in inc.columns:
+        for a, b in zip(inc[c], leg[c]):
+            assert (pd.isna(a) and pd.isna(b)) or a == b, (c, a, b)
+
+
+def test_dense_agg_k_deep_window_interleaved_restarts(dense_fold_substrate):
     """The deferred-fold window is now k batches deep (async flag
     harvests, runtime/transfer.py): interleaved out-of-range batches mean
     MULTIPLE in-flight folds can fail and each must re-fold exactly once
@@ -579,3 +753,84 @@ def test_dense_agg_k_deep_window_interleaved_restarts():
                 f"depth={depth}"
     finally:
         conf.set(TRANSFER_WINDOW_DEPTH, saved)
+
+
+def test_probe_scatter_spill_park_preserves_first_stream_order(monkeypatch):
+    """A spill can park the state mid-window (probe goes un-ready while
+    deferred miss batches are still in flight). The NEXT batch then stages
+    generically right away — so the probe must drain its window first, or
+    a key whose stream-FIRST occurrence sits in a pending miss batch would
+    stage after a later batch's rows and `first` would pick the wrong
+    value. Simulated by clearing the state's _fp_order right after the
+    miss batch's fold (what a real cross-thread spill does to the probe's
+    view), then feeding the same keys again with different values."""
+    import pandas as pd
+
+    from auron_tpu.exec import agg_exec as agg_mod
+    from auron_tpu.utils.config import (
+        AGG_INCREMENTAL_FINGERPRINT,
+        AGG_INCREMENTAL_MERGEPATH,
+        AGG_INCREMENTAL_PROBE,
+        BATCH_SIZE,
+        PARTIAL_AGG_SKIPPING_ENABLE,
+        TRANSFER_WINDOW_DEPTH,
+        Configuration,
+        conf_scope,
+    )
+
+    pool = np.arange(40_000) * 1_000_003 + 7  # dense-ineligible spread
+    frames = []
+    # phase 1: cross the staging threshold so compact() builds the state
+    for i in range(17):
+        frames.append((pool[i * 2048:(i + 1) * 2048], 0.0))
+    frames.append((pool[:512], 0.0))            # 18: hits — probe engaged
+    band = 900_000_000_000 + np.arange(512)
+    frames.append((band, 1.0))                  # 19: miss batch, defers
+    frames.append((band, 2.0))                  # 20: post-park, same keys
+    PARK_AFTER = 19
+
+    calls = {"n": 0, "folded": {}}
+    orig_fold = agg_mod._ProbeScatter.fold
+
+    def fold_wrap(self, b):
+        res = orig_fold(self, b)
+        calls["n"] += 1
+        calls["folded"][calls["n"]] = res[0]
+        if calls["n"] == PARK_AFTER:
+            with self.table._lock:
+                st = self.table.state
+                assert st is not None and getattr(st, "_fp_order", False), \
+                    "test shape regressed: state not probe-able at the park point"
+                st._fp_order = False  # what a spill does to the probe's view
+        return res
+
+    monkeypatch.setattr(agg_mod._ProbeScatter, "fold", fold_wrap)
+
+    aggs = [(AggExpr("first", col(1)), "f"), (AggExpr("count_star", None), "c")]
+    conf = (Configuration().set(TRANSFER_WINDOW_DEPTH, 6)
+            .set(BATCH_SIZE, 2048)
+            .set(AGG_INCREMENTAL_FINGERPRINT, "on")
+            .set(AGG_INCREMENTAL_PROBE, "on")
+            .set(AGG_INCREMENTAL_MERGEPATH, "on")
+            .set(PARTIAL_AGG_SKIPPING_ENABLE, False))
+    with conf_scope(conf):
+        batches = [
+            Batch.from_pydict({"k": ks.tolist(), "v": [v] * len(ks)})
+            for ks, v in frames
+        ]
+        agg = HashAggExec(
+            MemoryScanExec.single(batches), [(col(0), "k")], aggs, "partial")
+        mid = list(agg.execute(0, ExecutionContext(conf=conf)))
+        final = HashAggExec(
+            MemoryScanExec.single(mid), [(col(0), "k")], aggs, "final")
+        out = pd.concat(
+            b.to_pandas() for b in final.execute(0, ExecutionContext(conf=conf))
+        ).sort_values("k").reset_index(drop=True)
+
+    assert calls["folded"][PARK_AFTER], "miss batch did not probe-fold"
+    assert not calls["folded"][PARK_AFTER + 1], "park did not disengage probe"
+    got_band = out[out["k"] >= 900_000_000_000]
+    assert got_band["c"].tolist() == [2] * len(band)   # no row lost or doubled
+    # stream-first value is the PENDING miss batch's 1.0, not the
+    # post-park batch's 2.0
+    assert got_band["f"].tolist() == [1.0] * len(band)

@@ -1,4 +1,8 @@
-"""Randomized aggregation differential testing vs pandas groupby."""
+"""Randomized aggregation differential testing vs pandas groupby, plus the
+incremental-vs-legacy bit-identity fuzz (docs/agg.md contract: with
+exec.agg.incremental.enable flipped, the SAME rows with the SAME exact
+values must come out — fingerprint grouping, sorted-state probe/scatter
+and merge-path merges are pure execution-strategy changes)."""
 
 import numpy as np
 import pandas as pd
@@ -11,6 +15,16 @@ from auron_tpu.exec.agg_exec import FINAL, PARTIAL, AggExpr, HashAggExec
 from auron_tpu.exec.base import ExecutionContext
 from auron_tpu.exec.basic import MemoryScanExec
 from auron_tpu.exprs.ir import col
+from auron_tpu.utils.config import (
+    AGG_INCREMENTAL_ENABLE,
+    AGG_INCREMENTAL_FINGERPRINT,
+    AGG_INCREMENTAL_FP_BITS,
+    AGG_INCREMENTAL_MERGEPATH,
+    AGG_INCREMENTAL_PROBE,
+    BATCH_SIZE,
+    Configuration,
+    conf_scope,
+)
 
 
 @pytest.mark.parametrize("seed", range(8))
@@ -65,6 +79,308 @@ def test_agg_fuzz(seed):
                 assert pd.isna(g)  # SQL: all-null group -> NULL (pandas: 0.0 for sum)
             else:
                 assert g == pytest.approx(w, rel=1e-9), (colname, g, w)
+
+
+# ---------------------------------------------------------------------------
+# incremental-vs-legacy bit-identity (exec.agg.incremental.*)
+# ---------------------------------------------------------------------------
+
+
+def _run_pipeline(batches_fn, groupings, aggs, conf):
+    """partial -> final under an explicit Configuration; canonical-sorted
+    pandas frame of the result."""
+    with conf_scope(conf):
+        scan = MemoryScanExec.single(batches_fn())
+        partial = HashAggExec(scan, groupings, aggs, PARTIAL)
+        ctx = ExecutionContext(conf=conf)
+        mid = list(partial.execute(0, ctx)) or [Batch.empty(partial.inter_schema)]
+        final = HashAggExec(MemoryScanExec.single(mid), groupings, aggs, FINAL)
+        ctx2 = ExecutionContext(conf=conf)
+        frames = [b.to_pandas() for b in final.execute(0, ctx2)]
+    out = pd.concat(frames)
+    keys = [name for _, name in groupings]
+    out = out.sort_values(keys, na_position="last").reset_index(drop=True)
+    return out, ctx.metrics.values, ctx2.metrics.values
+
+
+def _assert_bit_identical(inc: pd.DataFrame, leg: pd.DataFrame):
+    assert len(inc) == len(leg), (len(inc), len(leg))
+    assert list(inc.columns) == list(leg.columns)
+    for c in inc.columns:
+        for i, (a, b) in enumerate(zip(inc[c], leg[c])):
+            if pd.isna(a) and pd.isna(b):
+                continue
+            assert a == b, (c, i, a, b)
+
+
+def _inc_conf(enable: bool, fp_bits: int = 64, batch_size: int = 131072):
+    # mechanisms pinned "on" explicitly: their auto default is
+    # accelerator-only and this suite runs on the CPU backend
+    mode = "on" if enable else "off"
+    return (
+        Configuration()
+        .set(AGG_INCREMENTAL_ENABLE, enable)
+        .set(AGG_INCREMENTAL_FINGERPRINT, mode)
+        .set(AGG_INCREMENTAL_PROBE, mode)
+        .set(AGG_INCREMENTAL_MERGEPATH, mode)
+        .set(AGG_INCREMENTAL_FP_BITS, fp_bits)
+        .set(BATCH_SIZE, batch_size)
+    )
+
+
+_EXACT_AGGS = [
+    (AggExpr("sum", col(2)), "s"),
+    (AggExpr("count", col(2)), "c"),
+    (AggExpr("count_star", None), "cs"),
+    (AggExpr("min", col(2)), "mn"),
+    (AggExpr("max", col(2)), "mx"),
+]
+
+
+def _dyadic_frame(seed: int, n: int, key_fn):
+    """Group keys + a float column of dyadic rationals (k/1024, |k| < 2^20):
+    float64 sums of these are EXACT, so the result is independent of
+    summation order — the property that makes bit-identity assertable
+    across different grouping strategies (and the one the collision test
+    leans on: forced collisions legally reorder partial sums)."""
+    rng = np.random.default_rng(seed)
+    v = rng.integers(-(1 << 20), 1 << 20, n) / 1024.0
+    v = np.where(rng.random(n) < 0.1, np.nan, v)
+    df = pd.DataFrame({"k1": key_fn(rng, n), "k2": rng.integers(0, 5, n),
+                       "v": pd.array(v, dtype="Float64")})
+    return df
+
+
+def _batches_of(df, chunk=1024):
+    return lambda: [
+        Batch.from_arrow(
+            pa.RecordBatch.from_pandas(df.iloc[i:i + chunk], preserve_index=False)
+        )
+        for i in range(0, len(df), chunk)
+    ]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_incremental_bit_identical_plain(seed):
+    """Production shape (64-bit fingerprints, no collisions): EVERYTHING —
+    first included — must be bit-identical between the incremental and
+    legacy paths."""
+    # big key spread keeps the dense direct-address path out of the way so
+    # the fingerprint/probe/merge-path machinery is what actually runs
+    df = _dyadic_frame(seed, 20000,
+                       lambda rng, n: rng.integers(0, 400, n) * 1_000_003)
+    aggs = _EXACT_AGGS + [
+        (AggExpr("avg", col(2)), "a"),
+        (AggExpr("first_ignores_null", col(2)), "f"),
+    ]
+    groupings = [(col(0), "k1"), (col(1), "k2")]
+    mk = _batches_of(df)
+    inc, pm, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(True, batch_size=1024))
+    leg, _, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(False, batch_size=1024))
+    _assert_bit_identical(inc, leg)
+    assert pm.get("fp_collision_batches", 0) == 0
+
+
+@pytest.mark.parametrize("fp_bits", [1, 3, 8])
+def test_incremental_bit_identical_forced_collisions(fp_bits):
+    """Seeded-hash collision forcing (exec.agg.incremental.fp.bits): tiny
+    fingerprint widths make every batch collide. Deterministic aggregates
+    must still be bit-identical — collisions may only change GROUPING
+    ORDER internally, never values — and the collisions must be visible
+    in the fp_collision_batches counter. (`first` is excluded here: under
+    collisions both paths make different-but-equally-valid Spark `first`
+    picks; its collision behavior is pinned separately below.)"""
+    df = _dyadic_frame(fp_bits, 12000,
+                       lambda rng, n: rng.integers(0, 300, n) * 1_000_003)
+    groupings = [(col(0), "k1"), (col(1), "k2")]
+    mk = _batches_of(df)
+    inc, pm, fm = _run_pipeline(
+        mk, groupings, _EXACT_AGGS + [(AggExpr("avg", col(2)), "a")],
+        _inc_conf(True, fp_bits, batch_size=1024),
+    )
+    leg, _, _ = _run_pipeline(
+        mk, groupings, _EXACT_AGGS + [(AggExpr("avg", col(2)), "a")],
+        _inc_conf(False, batch_size=1024),
+    )
+    _assert_bit_identical(inc, leg)
+    assert pm.get("fp_collision_batches", 0) > 0
+
+
+def test_incremental_first_under_collisions_is_a_valid_pick():
+    """`first` is Spark-nondeterministic across merges; under forced
+    collisions the incremental path may pick a different row than legacy.
+    The contract: the pick is some non-null value OF THAT GROUP."""
+    rng = np.random.default_rng(11)
+    n = 8000
+    df = pd.DataFrame({
+        "k1": rng.integers(0, 200, n) * 1_000_003,
+        "k2": np.zeros(n, np.int64),
+        "v": pd.array(rng.integers(0, 10_000, n).astype(float), dtype="Float64"),
+    })
+    groupings = [(col(0), "k1")]
+    aggs = [(AggExpr("first_ignores_null", col(2)), "f")]
+    mk = _batches_of(df)
+    inc, _, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(True, 2, batch_size=1024))
+    allowed = df.groupby("k1")["v"].agg(lambda s: set(s.dropna()))
+    assert len(inc) == len(allowed)
+    for k, f in zip(inc["k1"], inc["f"]):
+        assert f in allowed[k], (k, f)
+
+
+def test_incremental_collision_arising_at_final_merge():
+    """A collision can FIRST arise inside the final merge: three final
+    input parts, each internally collision-free (single key per part), but
+    key K lives in parts A and C while a colliding key K2 sits in B — the
+    merged fp order interleaves K(A), K2(B), K(C), splitting K. The FINAL
+    merge must dedup with the full-word sort: a key must never surface as
+    two output rows (review finding: the clean-parts fast path used to let
+    these split groups escape)."""
+    # FLOAT keys keep the dense direct-address path out (ints would take
+    # it and erase the parts' fp provenance); both collide at 1-bit fps
+    K, K2 = 7.0 * 10**13, 1.0 * 10**15
+    groupings = [(col(0), "k")]
+    aggs = [(AggExpr("sum", col(1)), "s"), (AggExpr("count_star", None), "c")]
+    for bits in (1,):
+        conf = _inc_conf(True, bits)
+        with conf_scope(conf):
+            # three SEPARATE partial runs -> three clean single-key parts
+            parts = []
+            for k, vals in ((K, [1.0, 3.0]), (K2, [10.0, 30.0]),
+                            (K, [100.0, 300.0])):
+                p = HashAggExec(
+                    MemoryScanExec.single(
+                        [Batch.from_pydict({"k": [k] * 2, "v": vals})]),
+                    groupings, aggs, PARTIAL)
+                parts.extend(p.execute(0, ExecutionContext(conf=conf)))
+            assert all(getattr(x, "_fp_order", False) for x in parts)
+            final = HashAggExec(
+                MemoryScanExec.single(parts), groupings, aggs, FINAL)
+            out = pd.concat(
+                b.to_pandas()
+                for b in final.execute(0, ExecutionContext(conf=conf))
+            ).sort_values("k").reset_index(drop=True)
+        assert out["k"].tolist() == [K, K2], out
+        assert out["s"].tolist() == [404.0, 40.0]
+        assert out["c"].tolist() == [4, 2]
+
+
+def test_incremental_collision_at_final_merge_host_aggs():
+    """Same clean-parts collision interleave as above, but with a HOST
+    aggregate (collect_list), which routes _group_reduce through the EAGER
+    branch: force_full_sort must thread through it too (review finding:
+    the eager branch used to drop it, re-colliding the same fingerprints
+    and emitting the split group as two output rows)."""
+    K, K2 = 7.0 * 10**13, 1.0 * 10**15
+    groupings = [(col(0), "k")]
+    aggs = [(AggExpr("collect_list", col(1)), "l"),
+            (AggExpr("count_star", None), "c")]
+    conf = _inc_conf(True, 1)
+    with conf_scope(conf):
+        parts = []
+        for k, vals in ((K, [1.0, 3.0]), (K2, [10.0, 30.0]),
+                        (K, [100.0, 300.0])):
+            p = HashAggExec(
+                MemoryScanExec.single(
+                    [Batch.from_pydict({"k": [k] * 2, "v": vals})]),
+                groupings, aggs, PARTIAL)
+            parts.extend(p.execute(0, ExecutionContext(conf=conf)))
+        assert all(getattr(x, "_fp_order", False) for x in parts)
+        final = HashAggExec(
+            MemoryScanExec.single(parts), groupings, aggs, FINAL)
+        out = pd.concat(
+            b.to_pandas()
+            for b in final.execute(0, ExecutionContext(conf=conf))
+        ).sort_values("k").reset_index(drop=True)
+    assert out["k"].tolist() == [K, K2], out
+    # one row per key; collect order across merged parts is unspecified
+    assert sorted(out["l"][0]) == [1.0, 3.0, 100.0, 300.0]
+    assert sorted(out["l"][1]) == [10.0, 30.0]
+    assert out["c"].tolist() == [4, 2]
+
+
+def test_incremental_null_vs_zero_group_keys():
+    """NULL and 0 keys are DIFFERENT groups (the packed null-bits word);
+    the fingerprint covers that word, so the distinction must survive the
+    incremental path bit-for-bit — including at colliding widths."""
+    rng = np.random.default_rng(5)
+    n = 6000
+    k = rng.integers(0, 4, n).astype(float)
+    k[rng.random(n) < 0.3] = np.nan  # NULL keys, overlapping value 0 keys
+    df = pd.DataFrame({
+        "k1": pd.array(np.where(np.isnan(k), np.nan, k * 0), dtype="Int64"),
+        "k2": rng.integers(0, 3, n),
+        "v": pd.array(rng.integers(-1000, 1000, n) / 4.0, dtype="Float64"),
+    })
+    groupings = [(col(0), "k1"), (col(1), "k2")]
+    mk = _batches_of(df, chunk=512)
+    for bits in (64, 2):
+        inc, _, _ = _run_pipeline(mk, groupings, _EXACT_AGGS, _inc_conf(True, bits))
+        leg, _, _ = _run_pipeline(mk, groupings, _EXACT_AGGS, _inc_conf(False))
+        _assert_bit_identical(inc, leg)
+        # NULL group present AND 0 group present, separately
+        assert inc["k1"].isna().any()
+        assert (inc["k1"] == 0).any()
+
+
+def test_incremental_dict_encoded_keys():
+    """String (dict-encoded) group keys: per-batch code vocabularies make
+    fingerprints batch-local, so probe/merge-path self-exclude — but the
+    fingerprint segmentation still runs per batch and the result must be
+    bit-identical to legacy."""
+    rng = np.random.default_rng(9)
+    n = 6000
+    df = pd.DataFrame({
+        "k1": rng.choice(["alpha", "beta", "gamma", "delta", None], n,
+                         p=[0.3, 0.3, 0.2, 0.1, 0.1]),
+        "k2": rng.integers(0, 4, n),
+        "v": pd.array(rng.integers(-4000, 4000, n) / 8.0, dtype="Float64"),
+    })
+    groupings = [(col(0), "k1"), (col(1), "k2")]
+    aggs = _EXACT_AGGS + [(AggExpr("first_ignores_null", col(2)), "f")]
+    mk = _batches_of(df, chunk=512)
+    inc, _, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(True))
+    leg, _, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(False))
+    _assert_bit_identical(inc, leg)
+
+
+def test_incremental_wide_decimal_sums():
+    """Wide-decimal sums (sum precision > 18, base-1e9 limb accumulators)
+    through the incremental path — limb columns scatter-add exactly, so
+    the totals are bit-identical at any fingerprint width."""
+    import decimal as d
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    vals = [d.Decimal(int(x)) * d.Decimal("0.01")
+            for x in rng.integers(-10**14, 10**14, n)]
+    df = pd.DataFrame({
+        "k1": rng.integers(0, 50, n) * 1_000_003,
+        "k2": rng.integers(0, 3, n),
+        "v": vals,
+    })
+    schema = T.Schema.of(
+        T.Field("k1", T.INT64), T.Field("k2", T.INT64),
+        T.Field("v", T.decimal(16, 2)),
+    )
+    chunk = 512
+    mk = lambda: [
+        Batch.from_pydict(
+            {c: df[c].iloc[i:i + chunk].tolist() for c in df.columns},
+            schema=schema,
+        )
+        for i in range(0, n, chunk)
+    ]
+    groupings = [(col(0), "k1"), (col(1), "k2")]
+    aggs = [(AggExpr("sum", col(2)), "s"), (AggExpr("avg", col(2)), "a"),
+            (AggExpr("count", col(2)), "c")]
+    for bits in (64, 2):
+        inc, _, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(True, bits, 512))
+        leg, _, _ = _run_pipeline(mk, groupings, aggs, _inc_conf(False, 64, 512))
+        _assert_bit_identical(inc, leg)
+    # and the totals are truly exact, not just consistent
+    want = df.groupby(["k1", "k2"])["v"].sum().reset_index()
+    want = want.sort_values(["k1", "k2"]).reset_index(drop=True)
+    assert inc["s"].tolist() == want["v"].tolist()
 
 
 @pytest.mark.parametrize("seed", range(6))

@@ -23,6 +23,7 @@ from tools.auronlint.rules import (
     RegistrySyncRule,
     RetraceRule,
     ShapeBucketRule,
+    SortPayloadRule,
     VectorizeRule,
 )
 
@@ -539,6 +540,98 @@ def test_report_json_schema_shared_with_jvm_lint():
     for d in doc["findings"] + jdoc["findings"]:
         assert set(d) == keys
     assert Finding.from_dict(f.to_dict()) == f
+
+
+# ---------------------------------------------------------------------------
+# R6 sort-payload discipline
+# ---------------------------------------------------------------------------
+
+
+def test_r6_fires_on_column_scaling_operands():
+    rep = _lint(
+        """
+        from jax import lax
+        import jax.numpy as jnp
+
+        def group(words, sel):
+            dead = jnp.where(sel, 0, 1)
+            iota = jnp.arange(sel.shape[0])
+            operands = [dead, *words, iota]
+            return lax.sort(tuple(operands), num_keys=len(operands) - 1)
+        """,
+        SortPayloadRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert len(_hits(rep, "R6")) == 1
+    assert "fingerprint" in rep.findings[0].message
+
+
+def test_r6_fires_on_comprehension_and_impl_choice():
+    rep = _lint(
+        """
+        from jax import lax
+        from auron_tpu.ops import bitonic
+
+        def group(cols, n_keys, cap):
+            impl = bitonic.sort_impl_for(n_keys + 1, cap)
+            return lax.sort(tuple(c for c in cols), num_keys=1)
+        """,
+        SortPayloadRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert len(_hits(rep, "R6")) == 2
+
+
+def test_r6_suppression_honored():
+    rep = _lint(
+        """
+        from jax import lax
+
+        def order_by(operands):
+            ops = [*operands]
+            return lax.sort(tuple(ops), num_keys=len(ops) - 1)  # auronlint: sort-payload -- ORDER BY sorts every user key by definition
+        """,
+        SortPayloadRule(),
+        rel="auron_tpu/exec/fixture.py",
+    )
+    assert not _hits(rep, "R6")
+    assert _suppressed(rep, "R6")
+
+
+def test_r6_self_referential_reassignment_no_recursion():
+    """`operands = operands + (iota,)` maps the name to an expression
+    mentioning itself; the resolver must flag it as scaling (self-append
+    grows the list), not recurse forever (regression: RecursionError
+    aborted the whole lint run)."""
+    rep = _lint(
+        """
+        from jax import lax
+
+        def group(operands, n):
+            operands = operands + (n,)
+            return lax.sort(operands, num_keys=1)
+        """,
+        SortPayloadRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert len(_hits(rep, "R6")) == 1
+
+
+def test_r6_fixed_arity_sorts_pass():
+    rep = _lint(
+        """
+        from jax import lax
+        import jax.numpy as jnp
+
+        def cluster(fp, sel):
+            dead = jnp.where(sel, 0, 1)
+            iota = jnp.arange(sel.shape[0])
+            return lax.sort((dead, fp, iota), num_keys=3)
+        """,
+        SortPayloadRule(),
+        rel="auron_tpu/ops/fixture.py",
+    )
+    assert not rep.findings
 
 
 # ---------------------------------------------------------------------------

@@ -1,12 +1,13 @@
 """auronlint — engine-invariant static analysis for the JAX/TPU side.
 
-Five rule families over ``auron_tpu/`` (see docs/auronlint.md):
+Six rule families over ``auron_tpu/`` (see docs/auronlint.md):
 
   R1  host-sync hygiene      implicit device->host transfers
   R2  retrace discipline     bounded jit compile cache
   R3  shape buckets          no data-derived dims
   R4  registry lockstep      proto <-> convert <-> exec <-> explain
   R5  vectorization ban      no per-row python loops in hot paths
+  R6  sort-payload           sort operand lists must stay fixed-arity
 
 Run as ``make lint`` / ``python -m tools.auronlint``; gated in tier-1 by
 ``tests/test_auronlint.py``. Shares its finding/report schema with

@@ -59,7 +59,7 @@ _HOST_RETURNING = {
 }
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*auronlint:\s*(disable|disable-function|sync-point)"
+    r"#\s*auronlint:\s*(disable|disable-function|sync-point|sort-payload)"
     r"(?:\((?P<budget>[^)]*)\))?"
     r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
     r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
@@ -195,6 +195,12 @@ class SourceModule:
     def suppression_for(self, rule: str, line: int) -> Suppression | None:
         for sup in self.suppressions:
             if sup.kind == "sync-point":
+                continue
+            if sup.kind == "sort-payload":
+                # a dedicated keyword (like sync-point) declaring a sort
+                # that MUST carry every column — suppresses R6 only
+                if rule == "R6" and line in self._lines_covered(sup):
+                    return sup
                 continue
             if sup.covers_rule(rule) and line in self._lines_covered(sup):
                 return sup

@@ -5,6 +5,7 @@ from tools.auronlint.rules.host_sync import HostSyncRule
 from tools.auronlint.rules.registry_sync import RegistrySyncRule
 from tools.auronlint.rules.retrace import RetraceRule
 from tools.auronlint.rules.shapes import ShapeBucketRule
+from tools.auronlint.rules.sortpayload import SortPayloadRule
 from tools.auronlint.rules.vectorize import VectorizeRule
 
 ALL_RULES = (
@@ -13,6 +14,7 @@ ALL_RULES = (
     ShapeBucketRule(),
     RegistrySyncRule(),
     VectorizeRule(),
+    SortPayloadRule(),
 )
 
 __all__ = [
@@ -21,5 +23,6 @@ __all__ = [
     "RegistrySyncRule",
     "RetraceRule",
     "ShapeBucketRule",
+    "SortPayloadRule",
     "VectorizeRule",
 ]

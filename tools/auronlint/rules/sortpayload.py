@@ -1,0 +1,129 @@
+"""R6 — sort-payload discipline.
+
+A multi-operand ``lax.sort`` pays for every operand plane in the
+comparator AND the permutation network; an operand list that grows with
+the key/payload COLUMN COUNT makes grouping cost O(K) sort planes per
+batch — the exact pattern the fingerprint-sort path removed from
+aggregation (ops/segments.py: sort ``(dead, fingerprint, iota)``, gather
+the K columns by the permutation afterwards). R6 flags ``lax.sort`` /
+``bitonic.bitonic_sort`` / ``sort_impl_for`` call sites whose operand
+list is built from a variable number of columns:
+
+- a tuple/list argument containing a starred expansion (``[dead, *words,
+  iota]``);
+- an argument (or a name assigned from one) built by ``tuple()``/
+  ``list()`` over a non-literal, or a comprehension;
+- ``sort_impl_for(n_words, ...)`` where the plane count is a non-literal
+  expression (the impl choice then scales with columns too).
+
+Fixed-arity sorts (``lax.sort((key, iota), num_keys=1)``) pass. Sites
+that legitimately sort a column-scaling operand list — the full-word
+grouping fallback, ORDER BY with user-specified sort keys — declare it:
+
+    sorted_ops = lax.sort(tuple(operands), num_keys=...)  # auronlint: sort-payload -- <why this sort must carry every column>
+
+``sort-payload`` is a dedicated suppression keyword (like ``sync-point``)
+so the reason reads as a design note, not a lint mute.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.auronlint.core import Rule, SourceModule
+
+_SORT_CALLEES = {"sort", "bitonic_sort", "sort_impl_for"}
+
+
+def _is_sort_call(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SORT_CALLEES:
+        root = f.value
+        if isinstance(root, ast.Name) and root.id in ("lax", "bitonic", "jax"):
+            return f.attr
+    if isinstance(f, ast.Name) and f.id in ("bitonic_sort", "sort_impl_for"):
+        return f.id
+    return None
+
+
+def _grows_with_columns(
+    expr: ast.AST, assigns: dict, _seen: frozenset = frozenset()
+) -> bool:
+    """Does this operand expression denote a column-count-scaling list?"""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(isinstance(e, ast.Starred) for e in expr.elts)
+    if isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+        return True
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in ("tuple", "list") and expr.args:
+            inner = expr.args[0]
+            # tuple((a, b)) of a literal is fixed-arity; tuple(operands),
+            # tuple(w for ...) scale with whatever built them
+            if isinstance(inner, (ast.Tuple, ast.List)):
+                return _grows_with_columns(inner, assigns, _seen)
+            return True
+    if isinstance(expr, ast.Name):
+        # cycle guard: `operands = operands + (iota,)` maps the name to an
+        # expression mentioning itself — treat a revisit as scaling (the
+        # self-append idiom grows the list) instead of recursing forever
+        if expr.id in _seen:
+            return True
+        src = assigns.get(expr.id)
+        if src is not None:
+            return _grows_with_columns(src, assigns, _seen | {expr.id})
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        # list concatenation: scaling if either side scales
+        return _grows_with_columns(
+            expr.left, assigns, _seen
+        ) or _grows_with_columns(expr.right, assigns, _seen)
+    return False
+
+
+class SortPayloadRule(Rule):
+    name = "R6"
+    doc = "sort operand lists must not scale with payload column count"
+
+    def check_module(self, mod: SourceModule):
+        rel = mod.rel.replace("\\", "/")
+        if not rel.startswith("auron_tpu/"):
+            return
+        # per-function name -> last assigned value expression (cheap flow:
+        # good enough to trace `operands = [a, *words, b]` to its sort)
+        assigns_by_scope: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table: dict = {}
+                for stmt in ast.walk(node):
+                    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                        t = stmt.targets[0]
+                        if isinstance(t, ast.Name):
+                            table[t.id] = stmt.value
+                assigns_by_scope[node] = table
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _is_sort_call(node)
+            if callee is None:
+                continue
+            scope_node = mod.scope_of(node).node
+            assigns = assigns_by_scope.get(scope_node, {})
+            if callee == "sort_impl_for":
+                # the plane-count argument: a non-literal means the impl
+                # decision scales with column count
+                if node.args and not isinstance(node.args[0], ast.Constant):
+                    yield node.lineno, (
+                        "sort_impl_for plane count scales with column "
+                        "count — sort a fixed fingerprint tuple and gather "
+                        "payloads by the permutation (ops/segments.py), or "
+                        "declare `# auronlint: sort-payload -- <reason>`"
+                    )
+                continue
+            if node.args and _grows_with_columns(node.args[0], assigns):
+                yield node.lineno, (
+                    f"{callee} operand list grows with payload column "
+                    "count (O(K) sort planes per batch) — sort (key, "
+                    "fingerprint, iota) and gather columns by the "
+                    "permutation instead, or declare "
+                    "`# auronlint: sort-payload -- <reason>`"
+                )
