@@ -1392,37 +1392,16 @@ def q5_class_oracle(data: TpcdsData) -> pd.DataFrame:
 # ---------------------------------------------------------------------------
 
 
-def _is_null_scalar(x) -> bool:
-    if isinstance(x, (list, tuple, dict, np.ndarray)):
-        return False
-    try:
-        return bool(pd.isna(x))
-    except (TypeError, ValueError):
-        return False
-
-
 def _cmp_frames(got: pd.DataFrame, want: pd.DataFrame, float_tol=1e-6) -> str | None:
     """Row-level comparison with double tolerance
-    (QueryResultComparator.scala:39-110 analog). None = match."""
-    if len(got) != len(want):
-        return f"row count {len(got)} != {len(want)}"
-    for c in want.columns:
-        if c not in got.columns:
-            return f"missing column {c}"
-        g, w = got[c].tolist(), want[c].tolist()
-        for i, (a, b) in enumerate(zip(g, w)):
-            a_null = _is_null_scalar(a)
-            b_null = _is_null_scalar(b)
-            if a_null or b_null:
-                if a_null != b_null:
-                    return f"{c}[{i}]: {a!r} != {b!r}"
-                continue
-            if isinstance(b, float):
-                if abs(float(a) - b) > float_tol * max(1.0, abs(b)):
-                    return f"{c}[{i}]: {a!r} != {b!r}"
-            elif a != b:
-                return f"{c}[{i}]: {a!r} != {b!r}"
-    return None
+    (QueryResultComparator.scala:39-110 analog). None = match.
+
+    One comparator for every differential surface: this gate, perf_gate.py
+    and the real-text SQL gate all resolve to models/compare.compare_frames,
+    so a tolerance-rule change cannot silently diverge between gates."""
+    from auron_tpu.models.compare import compare_frames
+
+    return compare_frames(got, want, float_tol)
 
 
 def run_q14b_class(data: TpcdsData) -> pd.DataFrame:

@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - jax 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map
 
 from auron_tpu.ops import hashing as H
 from auron_tpu.parallel.mesh import PARTITION_AXIS
@@ -48,7 +52,9 @@ def _slot_ranks(pids: jnp.ndarray, sel: jnp.ndarray, n_parts: int):
     s_key, order = lax.sort((key, iota), num_keys=1)
     # rank within equal-key run
     boundary = jnp.concatenate([jnp.ones(1, bool), s_key[1:] != s_key[:-1]])
-    run_start = jnp.maximum.accumulate(jnp.where(boundary, iota, 0))
+    # lax.cummax, not jnp.maximum.accumulate: the ufunc .accumulate
+    # methods only exist on jax >= 0.5
+    run_start = lax.cummax(jnp.where(boundary, iota, 0))
     rank_sorted = iota - run_start
     ranks = jnp.zeros(cap, jnp.int32).at[order].set(rank_sorted)
     return ranks

@@ -30,6 +30,13 @@ the heavy subset), BENCH_PARTS (default 2), PERF_GATE_CLASS_TIMEOUT
 per class, default 900 — a correct-but-slow class fails), and
 PERF_GATE_MIN_SPEEDUP (default 0.5; q3/q18/q93/q14 default 1.0).
 
+The floor RATCHETS (PERF_GATE_RATCHET=0 disables): PERF_RATCHET.json
+records each class's best passing speedup per scale factor, and a later
+run fails below max(class_floor, 0.9 * best) — the discounted 0.5x tiers
+stop a class from shipping slow, the ratchet stops a class that once ran
+at 1.2x from quietly sliding back toward its floor. New bests rewrite
+the file as they land (kill-safe, like the breakdown merge).
+
 The gate is RESUMABLE: PERF_GATE_RESUME=<path to a previous .out file>
 (or "auto" for PERF_GATE_SF{N}.out next to this script) re-emits the
 classes that already passed there and runs only the rest — a gate killed
@@ -219,6 +226,30 @@ def run_one(name: str, ws: str) -> None:
     }), flush=True)
 
 
+RATCHET_PATH = os.path.join(ROOT, "PERF_RATCHET.json")
+RATCHET_SLACK = 0.9  # a class may regress at most 10% below its best
+
+
+def _load_ratchet() -> dict:
+    """{f"{class}@sf{N}": best passing speedup}. Missing/corrupt = empty."""
+    try:
+        with open(RATCHET_PATH) as f:
+            d = json.load(f)
+        return {k: float(v) for k, v in d.items()}
+    except (OSError, ValueError, TypeError):
+        return {}
+
+
+def _save_ratchet(d: dict) -> None:
+    # temp + atomic replace: a kill mid-write must not truncate the file
+    # (a corrupt ratchet silently resets every class's floor)
+    tmp = RATCHET_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({k: d[k] for k in sorted(d)}, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, RATCHET_PATH)
+
+
 def _load_resume(path: str, sf: float) -> dict:
     """Passing per-class records from a previous gate's .out file (one
     JSON object per line): {class: record}. Only ok=true records at the
@@ -269,6 +300,8 @@ def main() -> None:
     if resume == "auto":
         resume = os.path.join(ROOT, f"PERF_GATE_SF{int(sf)}.out")
     resumed = _load_resume(resume, sf) if resume else {}
+    ratchet_on = os.environ.get("PERF_GATE_RATCHET", "1") != "0"
+    ratchet = _load_ratchet()
     ws = tempfile.mkdtemp(prefix="auron_perf_gate_")
     results = []
     breakdowns = {}
@@ -321,17 +354,35 @@ def main() -> None:
                 rec = {"class": name, "sf": sf, "ok": False, "engine_s": None,
                        "oracle_s": None, "speedup": None, "backend": None,
                        "error": f"child rc={proc.returncode}: {err_txt[-300:]}"}
-        # ---- the teeth: wall budget + minimum speedup are hard failures
+        # ---- the teeth: wall budget + minimum speedup are hard failures.
+        # The floor RATCHETS: once a class has passed at speedup B, it must
+        # stay above max(class_floor, 0.9*B) — a class hovering at its 0.5x
+        # discounted floor can't hide a regression from a better past self.
         if rec["ok"]:
             floor = MIN_SPEEDUP.get(name, DEFAULT_MIN_SPEEDUP)
+            rkey = f"{name}@sf{int(sf)}"
+            best = ratchet.get(rkey)
+            eff_floor = floor
+            if ratchet_on and best is not None:
+                eff_floor = max(floor, round(RATCHET_SLACK * best, 3))
+            rec["floor"] = eff_floor
             if rec["engine_s"] is not None and rec["engine_s"] > BUDGET_S:
                 rec["ok"] = False
                 rec["error"] = (
                     f"wall budget exceeded: {rec['engine_s']:.1f}s > {BUDGET_S:.0f}s"
                 )
-            elif rec["speedup"] is not None and rec["speedup"] < floor:
+            elif rec["speedup"] is not None and rec["speedup"] < eff_floor:
                 rec["ok"] = False
-                rec["error"] = f"speedup {rec['speedup']} < required {floor}"
+                rec["error"] = f"speedup {rec['speedup']} < required {eff_floor}" + (
+                    f" (ratchet: best {best})"
+                    if eff_floor > floor else "")
+            elif (
+                ratchet_on
+                and rec["speedup"] is not None
+                and rec["speedup"] > (best or 0.0)
+            ):
+                ratchet[rkey] = rec["speedup"]
+                _save_ratchet(ratchet)
         shutil.rmtree(os.path.join(ws, name), ignore_errors=True)
         results.append(rec)
         print(json.dumps(rec), flush=True)

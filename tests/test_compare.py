@@ -1,0 +1,177 @@
+"""Unit tests for the shared differential comparator (models/compare.py).
+
+Every tolerance rule the gates rely on has a direct test here: NULL-only-
+matches-NULL, the epsilon-OR-ULP float rule, decimal exactness (no float
+round trip), sorted-row canonicalization with NULLs-first ordering, and
+the delegation from models/tpcds._cmp_frames so the class gate and the
+SQL gate cannot diverge.
+"""
+
+import decimal
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from auron_tpu.models import tpcds
+from auron_tpu.models.compare import (
+    canonical_sort,
+    compare_frames,
+    float_close,
+    is_null_scalar,
+)
+
+
+def _df(**cols):
+    return pd.DataFrame(dict(cols))
+
+
+# ---------------------------------------------------------------------------
+# NULL rule
+# ---------------------------------------------------------------------------
+
+
+def test_null_scalar_forms():
+    assert is_null_scalar(None)
+    assert is_null_scalar(float("nan"))
+    assert is_null_scalar(pd.NA)
+    assert is_null_scalar(pd.NaT)
+    assert not is_null_scalar(0)
+    assert not is_null_scalar("")
+    assert not is_null_scalar([1, 2])  # containers are values, not NULLs
+    assert not is_null_scalar(np.array([1]))
+
+
+def test_null_matches_only_null():
+    assert compare_frames(_df(a=[None, 1.0]), _df(a=[np.nan, 1.0])) is None
+    err = compare_frames(_df(a=[0.0]), _df(a=[np.nan]))
+    assert err is not None and "a[0]" in err
+    err = compare_frames(_df(a=[None]), _df(a=[0.0]))
+    assert err is not None and "a[0]" in err
+
+
+# ---------------------------------------------------------------------------
+# float rule: relative epsilon OR ULP distance
+# ---------------------------------------------------------------------------
+
+
+def test_float_rel_epsilon():
+    assert float_close(1.0000001, 1.0, rel=1e-6)
+    assert not float_close(1.001, 1.0, rel=1e-6)
+    # tiny magnitudes: epsilon scales with max(1, |b|), keeping absolute
+    # 1e-6 room near zero
+    assert float_close(1e-9, 2e-9, rel=1e-6)
+
+
+def test_float_ulp_keeps_huge_magnitudes_honest():
+    b = 1e300
+    one_ulp = np.nextafter(b, np.inf)
+    assert float_close(float(one_ulp), b, rel=0.0)  # 1 ULP <= 4
+    # ~1e6 ULPs away but still within 1e-6 relative — the epsilon term
+    # accepts; with rel=0 the ULP term alone must reject
+    far = b * (1 + 1e-9)
+    assert float_close(far, b, rel=1e-6)
+    assert not float_close(far, b, rel=1e-12)
+
+
+def test_float_nonfinite_never_close():
+    assert float_close(float("inf"), float("inf"))  # == catches equals
+    assert not float_close(float("inf"), 1e308)
+    assert not float_close(float("nan"), float("nan"))  # NULLs handled upstream
+
+
+def test_float_sign_straddle_ulp():
+    # the int64 bit trick must stay monotone across the sign boundary
+    a = np.nextafter(0.0, -1.0)
+    assert float_close(float(a), float(np.nextafter(0.0, 1.0)), rel=0.0)
+
+
+def test_frame_float_tolerance_applied():
+    assert compare_frames(
+        _df(x=[1.0000001]), _df(x=[1.0]), float_tol=1e-6) is None
+    err = compare_frames(_df(x=[1.01]), _df(x=[1.0]), float_tol=1e-6)
+    assert err is not None
+
+
+# ---------------------------------------------------------------------------
+# decimal rule: exact numeric equality, never through a float round trip
+# ---------------------------------------------------------------------------
+
+
+def test_decimal_exactness():
+    d = decimal.Decimal
+    assert compare_frames(
+        _df(x=[d("1.10")]), _df(x=[d("1.1")])) is None  # numeric equality
+    # differs only past float53 precision: a float round trip would pass,
+    # the decimal rule must fail
+    a = d("0.10000000000000000001")
+    b = d("0.1")
+    assert float(a) == float(b)
+    err = compare_frames(_df(x=[a]), _df(x=[b]))
+    assert err is not None and "decimal exact" in err
+    # mixed: engine returns a string/float against a decimal oracle —
+    # still compared as decimals
+    assert compare_frames(_df(x=["1.50"]), _df(x=[d("1.5")])) is None
+    err = compare_frames(_df(x=["not-a-number"]), _df(x=[d("1.5")]))
+    assert err is not None
+
+
+# ---------------------------------------------------------------------------
+# structure rules
+# ---------------------------------------------------------------------------
+
+
+def test_row_count_and_missing_column():
+    assert "row count" in compare_frames(_df(a=[1]), _df(a=[1, 2]))
+    assert "missing column" in compare_frames(_df(a=[1]), _df(b=[1]))
+
+
+def test_exact_rule_for_other_types():
+    assert compare_frames(_df(s=["x"], i=[3]), _df(s=["x"], i=[3])) is None
+    assert compare_frames(_df(s=["x"]), _df(s=["y"])) is not None
+
+
+# ---------------------------------------------------------------------------
+# sorted-row canonicalization (the SQL gate's mode)
+# ---------------------------------------------------------------------------
+
+
+def test_sorted_rows_order_independent():
+    got = _df(k=[2, 1, 3], v=[2.0, 1.0, 3.0])
+    want = _df(k=[1, 2, 3], v=[1.0, 2.0, 3.0])
+    assert compare_frames(got, want) is not None  # unsorted mode: mismatch
+    assert compare_frames(got, want, sorted_rows=True) is None
+
+
+def test_sorted_rows_nulls_first_total_order():
+    df = _df(k=[3.0, None, 1.0])
+    out = canonical_sort(df)
+    assert is_null_scalar(out["k"][0])
+    assert out["k"].tolist()[1:] == [1.0, 3.0]
+
+
+def test_sorted_rows_extra_engine_columns_ignored():
+    got = _df(b=[2, 1], a=[20, 10], extra=[0, 0])
+    want = _df(a=[10, 20], b=[1, 2])
+    # sorted mode projects to the oracle's columns before canonicalizing
+    assert compare_frames(got, want, sorted_rows=True) is None
+
+
+def test_sorted_rows_value_mismatch_still_caught():
+    got = _df(k=[1, 2], v=[1.0, 99.0])
+    want = _df(k=[2, 1], v=[2.0, 1.0])
+    assert compare_frames(got, want, sorted_rows=True) is not None
+
+
+# ---------------------------------------------------------------------------
+# gate unification: tpcds._cmp_frames is the same comparator
+# ---------------------------------------------------------------------------
+
+
+def test_tpcds_cmp_frames_delegates():
+    d = decimal.Decimal
+    # decimal exactness now applies through the class-gate entry point too
+    err = tpcds._cmp_frames(
+        _df(x=[d("0.10000000000000000001")]), _df(x=[d("0.1")]))
+    assert err is not None and "decimal exact" in err
+    assert tpcds._cmp_frames(_df(x=[1.0 + 1e-9]), _df(x=[1.0])) is None
