@@ -1,16 +1,26 @@
-.PHONY: proto test native jvm-compile bench lint perfcheck sqlgate
+.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate
 
 # keep `make` (no target) regenerating the proto, as before the lint gate
 .DEFAULT_GOAL := proto
 
-# Both static gates, one uniform report schema (tools/auronlint/report.py):
-# auronlint = engine-invariant rules R1-R5 over auron_tpu/ (AST-based),
+# Both static gates, one uniform report schema (tools/auronlint/report.py;
+# --json and --sarif emitters on both):
+# auronlint = engine-invariant rules R1-R10 over auron_tpu/ (AST-based,
+#             R7-R10 interprocedural via tools/auronlint/callgraph.py),
 # jvm_lint  = structural/ABI/wire-contract checks over jvm/.
-# Exit nonzero on any unsuppressed finding. Also gated in tier-1 via
+# Exit nonzero on any unsuppressed finding OR a LINT_RATCHET.json
+# regression (per-rule suppression counts may only shrink; improvements
+# are persisted atomically). Also gated in tier-1 via
 # tests/test_auronlint.py and tests/test_jvm_contract.py.
 lint:
 	JAX_PLATFORMS=cpu python -m tools.auronlint
 	python tools/jvm_lint.py
+
+# Inner-loop fast mode: lint only git-touched engine files with the
+# per-file rules (the whole-package interprocedural pass R4/R7-R10 stays
+# in `make lint` and tier-1; no ratchet here — counts are tree-wide).
+lint-changed:
+	JAX_PLATFORMS=cpu python -m tools.auronlint --changed
 
 # Runtime half of the R1 host-sync contract: replay a tiny SF<=1 q3-class
 # breakdown and fail if any declared sync site exceeds the per-batch/

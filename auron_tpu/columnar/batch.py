@@ -202,6 +202,7 @@ class Batch:
 
     def num_rows(self) -> int:
         """Live row count — host sync."""
+        # auronlint: disable=R9 -- caller-owned count-read API by design: converting to N/batch would mis-promise plans stacking several count-reading operators; rate stays visible per-caller in profiling
         return int(jax.device_get(self.device.num_rows()))  # auronlint: sync-point(call) -- num_rows() IS the engine's count-read API
 
     def col_values(self, i: int) -> jnp.ndarray:
@@ -438,6 +439,7 @@ def host_arrow_cols(cvs) -> list[pa.Array]:
     .dtype/.dict) as host arrow arrays for host-evaluation contracts
     (UDF/UDTF fallbacks, dictionary-transforming functions) — ONE batched
     device transfer for every column."""
+    # auronlint: disable=R9 -- host-evaluation contract: the transfer rate equals the number of host-evaluated expressions the PLAN carries, owned by the expression tree, not an engine loop
     moved = jax.device_get(tuple((cv.values, cv.validity) for cv in cvs))  # auronlint: sync-point(call) -- host-evaluation contract; one batched transfer for all columns
     return [
         _device_to_arrow(np.asarray(v), np.asarray(m), cv.dtype, cv.dict)

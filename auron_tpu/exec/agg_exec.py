@@ -266,7 +266,7 @@ class HashAggExec(ExecOperator):
         n_narrow = 1 if 0 < self.n_keys <= 32 else 0  # null-bits word rides narrow
         return (
             False,
-            bitonic.sort_impl_for(n_words, int(sel.shape[0]), n_narrow),  # auronlint: sort-payload -- legacy full-word grouping fallback (fingerprint off / collision dedup): exactness needs every key word as a sort plane
+            bitonic.sort_impl_for(n_words, int(sel.shape[0]), n_narrow, conf=conf),  # auronlint: sort-payload -- legacy full-word grouping fallback (fingerprint off / collision dedup): exactness needs every key word as a sort plane
             False,
             64,
         )
@@ -415,6 +415,7 @@ class HashAggExec(ExecOperator):
                 # transfer (its reduce has completed by now), so steady
                 # state pays ONE host round-trip per batch.
                 if pending_g is None:
+                    # auronlint: disable=R9 -- first-batch-only branch: pending_g is None exactly once per stream (plus spill restarts, covered by the 4/task budget)
                     n = int(jax.device_get(b.device.num_rows()))  # auronlint: sync-point(4/task) -- first-batch live-count read (see comment above)
                 else:
                     g_dev, coll_dev, inter_ref = pending_g
@@ -747,6 +748,7 @@ class HashAggExec(ExecOperator):
         coll_dev = getattr(merged, "_fp_collision", None)
         if coll_dev is not None:
             g, coll = (
+                # auronlint: disable=R9 -- amortized: _merge fires once per merge_threshold (>= 4 batches) of staged rows, not per batch
                 int(x) for x in jax.device_get((merged.device.num_rows(), coll_dev))  # auronlint: sync-point(2/task) -- merge group-count read; the collision flag rides the same transfer
             )
             if coll and metrics is not None:
@@ -810,6 +812,7 @@ class HashAggExec(ExecOperator):
             # ONE transfer: the compaction bucket read the legacy path pays
             # anyway, plus the cross-run collision flag riding along
             g, coll = (
+                # auronlint: disable=R9 -- amortized: merge-path merges fire once per merge_threshold of staged rows, not per batch
                 int(x) for x in jax.device_get(  # auronlint: sync-point(2/task) -- merge-path group-count + collision read, once per pair merge (amortized by the staging threshold)
                     (merged.device.num_rows(),
                      getattr(merged, "_fp_collision"))
@@ -833,6 +836,7 @@ class HashAggExec(ExecOperator):
             and hasattr(p, "_fp_collision")
         ]
         if unread:
+            # auronlint: disable=R9 -- merge-boundary read: executes only inside _merge/_merge_path, whose rate is merge_threshold-amortized
             flags = jax.device_get(  # auronlint: sync-point(2/task) -- batched read of per-run collision flags at merge boundaries only
                 tuple(p._fp_collision for p in unread)
             )
@@ -1374,7 +1378,7 @@ class _AggTableConsumer:
         with self._lock:
             return self._staged_bytes + self._state_bytes
 
-    def spill(self) -> int:
+    def spill(self) -> int:  # auronlint: thread-root(foreign) -- MemManager dispatches spills (and the compact/merge below) on the requesting task's thread
         """Park the merged state as a compressed run (host-RAM tier first,
         demoted to disk under ledger pressure — memmgr.make_spill)."""
         from auron_tpu.memory.memmgr import make_spill
@@ -1386,7 +1390,7 @@ class _AggTableConsumer:
             with self.ctx.metrics.timer("spill_time"):
                 self.compact()
                 if self.state is not None:
-                    ds = make_spill()
+                    ds = make_spill(conf=self.ctx.conf)
                     ds.write_table(self.state.to_arrow(preserve_dicts=True))
                     self.parked.append(ds)
             self.ctx.metrics.add("spilled_aggs", 1)
@@ -1685,7 +1689,8 @@ def _decimal_limb_tables(d, scale: int, k: int):
             tabs[j][i] = r
         tabs[k - 1][i] = u
     if len(_LIMB_TABLE_CACHE) >= 64:
-        _LIMB_TABLE_CACHE.pop(next(iter(_LIMB_TABLE_CACHE)))
+        _LIMB_TABLE_CACHE.pop(next(iter(_LIMB_TABLE_CACHE)))  # auronlint: disable=R10 -- deliberate trace-time memo eviction: bounded cache of deterministic values, replay-safe
+    # auronlint: disable=R10 -- deliberate trace-time memo: the limb tables are a pure function of the dictionary key, so a cache hit on replay is bit-identical
     _LIMB_TABLE_CACHE[key] = (d, tabs)
     return tabs
 
@@ -2441,6 +2446,7 @@ class _DenseAggState:
                 jnp.asarray(m) if m is not None else None for m in self.valids
             ]
         else:
+            # auronlint: disable=R9 -- dense drains happen on dense-limit overflow (bounded by table growth, O(log) per task) and at stream end, not per batch
             g = int(jax.device_get(jnp.sum(self.present)))  # auronlint: sync-point(4/task) -- group count read once at table emission (blocking boundary)
             present = self.present
             acc_vals = list(self.vals)
@@ -2490,7 +2496,7 @@ class _DenseAggState:
                 total += m.size
         return total
 
-    def spill(self) -> int:
+    def spill(self) -> int:  # auronlint: thread-root(foreign) -- MemManager polls/dispatches from other tasks' threads
         return 0  # unspillable (fixed footprint); drained at stream end
 
     def release(self, mm) -> None:
@@ -2721,7 +2727,10 @@ class _ProbeScatter:
             tuple((a, t) for (a, _), t in
                   zip(exec_.aggs, exec_._agg_input_types)),
             tuple(exec_.inter_schema[i].dtype for i in range(exec_.n_keys)),
-            active_conf().get(AGG_INCREMENTAL_FP_BITS),
+            # ctx.conf, NOT active_conf(): the probe cfg must match the fp
+            # layout of THIS task's state even when a cross-thread spill
+            # merge touches it (the PR 3 fp.bits lesson, R7)
+            ctx.conf.get(AGG_INCREMENTAL_FP_BITS),
         )
 
     def _ready(self) -> bool:
@@ -2829,7 +2838,7 @@ class _ProbeScatter:
             pending = list(self._pending)
         return sum(batch_nbytes(pb) for pb, _, _, _ in pending)
 
-    def spill(self) -> int:
+    def spill(self) -> int:  # auronlint: thread-root(foreign) -- MemManager polls/dispatches from other tasks' threads
         return 0  # pinned in-flight batches only; resolved within k batches
 
     def release(self) -> None:

@@ -157,6 +157,7 @@ class GenerateExec(ExecOperator):
 
         fn, out_schema = lookup_udtf(self.udtf)
         # auronlint: sync-point(call) -- host UDTF evaluates on host by contract; one batched transfer
+        # auronlint: disable=R9 -- host-UDTF contract: the transfer rate is owned by the query's UDTF usage (one batched transfer per evaluated batch by design)
         vals_d, mask_d, sel_d = jax.device_get((cv.values, cv.validity, b.device.sel))
         vals, mask, sel = np.asarray(vals_d), np.asarray(mask_d), np.asarray(sel_d)
         host_arg = _device_to_arrow(vals, mask, cv.dtype, cv.dict).to_pylist()

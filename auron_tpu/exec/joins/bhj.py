@@ -48,7 +48,7 @@ class _BuildMemGuard:
     def mem_used(self) -> int:
         return self._bytes
 
-    def spill(self) -> int:
+    def spill(self) -> int:  # auronlint: thread-root(foreign) -- MemManager polls/dispatches from other tasks' threads
         return 0
 
 
@@ -114,7 +114,7 @@ class BroadcastHashJoinExec(ExecOperator):
                 if cached is None:
                     with ctx.metrics.timer("build_hash_map_time"):
                         batches = list(self.child_stream(build_child, partition, ctx))
-                        cached = self.driver.prepare(batches)
+                        cached = self.driver.prepare(batches, conf=ctx.conf)
                     if acquired:
                         store[key] = cached
             finally:
@@ -126,7 +126,7 @@ class BroadcastHashJoinExec(ExecOperator):
             )
         with ctx.metrics.timer("build_hash_map_time"):
             batches = list(self.child_stream(build_child, partition, ctx))
-            built = self.driver.prepare(batches)
+            built = self.driver.prepare(batches, conf=ctx.conf)
         return built
 
     def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:

@@ -331,6 +331,7 @@ def _run_chain(
             if resolved:
                 sel_np = resolved[0]  # windowed mask (predictor off)
             else:
+                # auronlint: disable=R9 -- first batch of a stream only: the predictor takes over afterwards (seed read)
                 sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point(2/task) -- chain compaction seed read: first batch of a stream
             idx_np = np.flatnonzero(sel_np)
             n_live = int(idx_np.size)

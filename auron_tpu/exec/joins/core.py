@@ -351,6 +351,7 @@ def prepare_build(
     key_exprs: list[ir.Expr],
     schema: T.Schema,
     need_pairs: bool = True,
+    conf=None,
 ) -> PreparedBuild:
     """``need_pairs=False`` (semi/anti probes that only test existence)
     licenses the duplicate-tolerant LUT fast path: with duplicates and no
@@ -420,7 +421,7 @@ def prepare_build(
         stats = stats0
         sorted_words = list(words)
     else:
-        if hostsort.use_host_sort():
+        if hostsort.use_host_sort(conf):
             order = S.host_order(words, sel)
             device_sort = False
         else:

@@ -123,7 +123,7 @@ class EquiJoinDriver:
 
     # ------------------------------------------------------------------
 
-    def prepare(self, build_batches: list[Batch]) -> PreparedBuild:
+    def prepare(self, build_batches: list[Batch], conf=None) -> PreparedBuild:
         schema = self.left_schema if self.build_side == "left" else self.right_schema
         keys = self.left_keys if self.build_side == "left" else self.right_keys
         # existence-only probes (probe-side semi/anti with no residual
@@ -135,7 +135,9 @@ class EquiJoinDriver:
             or self.build_mark
             or self.build_outer
         )
-        return core.prepare_build(build_batches, keys, schema, need_pairs=need_pairs)
+        return core.prepare_build(
+            build_batches, keys, schema, need_pairs=need_pairs, conf=conf
+        )
 
     def probe_batch(
         self, build: PreparedBuild, pb: Batch,
@@ -369,6 +371,7 @@ class EquiJoinDriver:
             # 1 byte/row and yields the compaction index host-side via
             # flatnonzero). Steady state replaces this with the predicted
             # bucket below: first batch of a stream only.
+            # auronlint: disable=R9 -- first batch of a stream (and predictor-off fallback): pred_cap is None only before the first observation
             sel_np = np.asarray(jax.device_get(sel_out))  # auronlint: sync-point(2/task) -- unique-join compaction seed read: first batch of a stream (and predictor-off fallback)
             idx_np = np.flatnonzero(sel_np)
             n_live = int(idx_np.size)

@@ -43,7 +43,7 @@ class SortMergeJoinExec(ExecOperator):
 
         with ctx.metrics.timer("build_time"):
             build_batches = list(self.child_stream(1, partition, ctx))
-            build = self.driver.prepare(build_batches)
+            build = self.driver.prepare(build_batches, conf=ctx.conf)
         # sync-free pipelined compaction on the unique-build fast path
         # (same boundary as BHJ; see driver.UniqueProbePipeline)
         pipe = UniqueProbePipeline(ctx.conf)

@@ -27,15 +27,18 @@ import pyarrow as pa
 from auron_tpu.utils.config import SPILL_COMPRESSION_CODEC, active_conf
 
 
-def _codec() -> str | None:
-    c = active_conf().get(SPILL_COMPRESSION_CODEC)
+def _codec(conf=None) -> str | None:
+    """``conf``: REQUIRED on any path a cross-thread spill can reach —
+    active_conf() is thread-local, so a spill dispatched by the memory
+    manager would otherwise compress with a FOREIGN task's codec (R7)."""
+    c = (conf if conf is not None else active_conf()).get(SPILL_COMPRESSION_CODEC)
     return None if c == "none" else c
 
 
-def encode_block(rb_or_table) -> bytes:
+def encode_block(rb_or_table, conf=None) -> bytes:
     """One length-prefixed compressed-IPC block from a table/batch."""
     sink = io.BytesIO()
-    codec = _codec()
+    codec = _codec(conf)
     options = pa.ipc.IpcWriteOptions(compression=codec)
     if isinstance(rb_or_table, pa.RecordBatch):
         schema = rb_or_table.schema

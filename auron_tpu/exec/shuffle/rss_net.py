@@ -59,22 +59,22 @@ class _Cursor:
         self.buf = buf
         self.pos = 0
 
-    def u8(self) -> int:
+    def u8(self) -> int:  # auronlint: disable-function=R8 -- per-call frame parser: one _Cursor per request frame, never crosses threads
         v = self.buf[self.pos]
         self.pos += 1
         return v
 
-    def u32(self) -> int:
+    def u32(self) -> int:  # auronlint: disable-function=R8 -- per-call frame parser: one _Cursor per request frame, never crosses threads
         (v,) = struct.unpack_from(">I", self.buf, self.pos)
         self.pos += 4
         return v
 
-    def u64(self) -> int:
+    def u64(self) -> int:  # auronlint: disable-function=R8 -- per-call frame parser: one _Cursor per request frame, never crosses threads
         (v,) = struct.unpack_from(">Q", self.buf, self.pos)
         self.pos += 8
         return v
 
-    def string(self) -> str:
+    def string(self) -> str:  # auronlint: disable-function=R8 -- per-call frame parser: one _Cursor per request frame, never crosses threads
         (n,) = struct.unpack_from(">H", self.buf, self.pos)
         self.pos += 2
         s = self.buf[self.pos : self.pos + n].decode()
@@ -115,7 +115,7 @@ class RssNetServer:
         except OSError:
             pass
 
-    def _serve(self) -> None:
+    def _serve(self) -> None:  # auronlint: thread-root(foreign) -- RSS accept loop thread: no task conf_scope installed
         import time
 
         while not self._stop:
@@ -130,7 +130,7 @@ class RssNetServer:
                 continue
             threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
 
-    def _handle(self, conn: socket.socket) -> None:
+    def _handle(self, conn: socket.socket) -> None:  # auronlint: thread-root(foreign) -- per-connection RSS service thread: no task conf_scope installed
         try:
             while True:
                 hdr = read_exact(conn, 4, eof_ok=True)

@@ -153,16 +153,20 @@ class _ShuffleStaging:
         if not self.staged[pid]:
             return
         with self.ctx.metrics.timer("compress_time"):
-            blk = encode_block(pa.Table.from_batches(align_dict_batches(self.staged[pid])))
+            # conf threaded: spill() runs on the requesting task's thread
+            blk = encode_block(
+                pa.Table.from_batches(align_dict_batches(self.staged[pid])),
+                conf=self.ctx.conf,
+            )
         self.regions[pid].append(blk)
-        self._region_bytes += len(blk)
+        self._region_bytes += len(blk)  # auronlint: guarded-by(self._lock) -- every _flush caller (add_all, spill, blocks_of) holds the staging lock
         self.staged[pid], self.staged_bytes[pid] = [], 0
 
     def mem_used(self) -> int:
         with self._lock:
             return sum(self.staged_bytes) + self._region_bytes
 
-    def spill(self) -> int:
+    def spill(self) -> int:  # auronlint: thread-root(foreign) -- MemManager dispatches spills on the requesting task's thread, not ours
         """Compress all staged buffers, park every in-RAM region on disk."""
         import tempfile
 
@@ -279,7 +283,10 @@ class RssShuffleWriterExec(ExecOperator):
         def flush(pid: int):
             if staged[pid]:
                 with ctx.metrics.timer("compress_time"):
-                    blk = encode_block(pa.Table.from_batches(align_dict_batches(staged[pid])))
+                    blk = encode_block(
+                        pa.Table.from_batches(align_dict_batches(staged[pid])),
+                        conf=ctx.conf,
+                    )
                 with ctx.metrics.timer("push_time"):
                     push(pid, blk)
                 ctx.metrics.add("data_size", len(blk))
@@ -316,7 +323,7 @@ def stage_partition_batch(
 
     pids = partitioning.partition_ids(b, ctx)
     n_out = partitioning.num_partitions
-    if hostsort.use_host_sort():
+    if hostsort.use_host_sort(ctx.conf):
         dev = b.device
         start_host_transfer(pids, dev.sel, *dev.values, *dev.validity)
         return (b, pids, None, None)

@@ -208,6 +208,6 @@ class IpcWriterExec(ExecOperator):
             if rb.num_rows == 0:
                 continue
             with ctx.metrics.timer("encode_time"):
-                push(encode_block(rb))
+                push(encode_block(rb, conf=ctx.conf))
         return
         yield  # pragma: no cover

@@ -109,7 +109,13 @@ class SortExec(ExecOperator):
 
     def _sort_run(self, batches: list[Batch], ctx: ExecutionContext) -> "_SortedRun":
         big = device_concat(batches)
-        ev = Evaluator(self.schema)
+        # context threaded explicitly: a cross-thread spill runs this on
+        # the requesting task's thread, where current_context() (the
+        # Evaluator default) would resolve a FOREIGN task's partition id
+        # and resource map (R7)
+        ev = Evaluator(
+            self.schema, partition_id=ctx.partition_id, resources=ctx.resources
+        )
         keys = ev.evaluate(big, self.sort_exprs)
         ops = sort_operands(keys, self.specs)
         cap = big.capacity
@@ -118,7 +124,7 @@ class SortExec(ExecOperator):
         from auron_tpu.ops import hostsort
 
         with ctx.metrics.timer("sort_time"):
-            if hostsort.use_host_sort():
+            if hostsort.use_host_sort(ctx.conf):
                 order = hostsort.order_by_words((live, *ops))
                 sorted_ops = (None, *(o[order] for o in ops), order)
             else:
@@ -127,6 +133,7 @@ class SortExec(ExecOperator):
                 sorted_ops = bitonic.ordered_sort(
                     tuple([live, *ops, iota]),
                     word_narrow=sortkeys.narrow_flags(len(self.specs)),
+                    conf=ctx.conf,
                 )
                 order = sorted_ops[-1]
         dev = big.device
@@ -231,7 +238,7 @@ class _SorterConsumer:
         with self._lock:
             return self._bytes
 
-    def spill(self) -> int:
+    def spill(self) -> int:  # auronlint: thread-root(foreign) -- MemManager dispatches spills on the requesting task's thread, not ours
         with self._lock:
             if not self.pending:
                 return 0
@@ -252,6 +259,7 @@ class _SortedRun:
 
     def to_host(self) -> "_HostRun":
         # auronlint: sync-point(call) -- spill tier: device->host is the operation itself; one batched transfer
+        # auronlint: disable=R9 -- spill-tier boundary: rate owned by memory pressure (once per spilled run), amortized far below per-batch
         dev, words = jax.device_get((self.batch.device, self.key_words))
         n = int(np.sum(np.asarray(dev.sel)))
         return _HostRun(

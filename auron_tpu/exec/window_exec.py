@@ -151,6 +151,7 @@ class WindowExec(ExecOperator):
         sorted_ops = bitonic.ordered_sort(
             tuple(ops),
             word_narrow=p_narrow + sortkeys.narrow_flags(len(owords) // 2),
+            conf=ctx.conf,
         )
         order = sorted_ops[-1]
         sel_sorted = sorted_ops[0] == 0

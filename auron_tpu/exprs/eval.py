@@ -54,7 +54,10 @@ class Evaluator:
             # partition-context expressions work at every evaluation site
             from auron_tpu.exec.base import current_context
 
-            ctx = current_context()
+            # cross-thread callers (the sort-spill run path) pass
+            # partition_id + resources explicitly, so this thread-local
+            # fallback only ever runs on the operator's own pump thread
+            ctx = current_context()  # auronlint: disable=R7 -- guarded fallback: spill-reachable callers (sort_exec._sort_run) thread ctx explicitly
             if partition_id is None:
                 partition_id = ctx.partition_id if ctx is not None else 0
             if resources is None and ctx is not None:
@@ -363,6 +366,7 @@ class Evaluator:
                 return None
             import jax
 
+            # auronlint: disable=R9 -- constant probe memoized per plan node: re-evaluations hit the cached literal, not this read
             host = np.asarray(jax.device_get(cv.values))  # auronlint: sync-point(2/task) -- scalar-subquery constant probe, once per plan
             if host.size == 0 or not (host == host.flat[0]).all():
                 return None

@@ -84,7 +84,11 @@ class MemManager:
     _instance: "MemManager | None" = None
 
     def __init__(self, budget_bytes: int | None = None):
-        conf = active_conf()
+        # the process-wide singleton is DELIBERATELY built from the
+        # ambient conf: init() runs at session setup under the session's
+        # scope, and a lazy get() from a service thread sees the global —
+        # both are the intended process-level budget source
+        conf = active_conf()  # auronlint: disable=R7 -- process singleton: session-setup scope or the global conf IS the budget source
         # 0 = auto applies to the CONF default only; an explicit
         # budget_bytes=0 is an intentional always-spill manager
         total = (
@@ -192,7 +196,10 @@ class MemManager:
         # ordered manager -> consumer; spill takes the consumer lock)
         freed = consumer.spill()
         if freed:
-            self.num_spills += 1
+            with self._lock:
+                # R8: concurrent growers from different task threads race
+                # on this counter (the acquire() path already locks it)
+                self.num_spills += 1
             self.notify_released()
 
     def acquire(self, consumer: MemConsumer, additional: int) -> None:
@@ -247,19 +254,24 @@ class MemManager:
 
 class DiskSpill:
     """Disk tier: zstd-compressed Arrow IPC blocks in a temp file (analog of
-    the reference's compressed file spills, spill.rs:40-56)."""
+    the reference's compressed file spills, spill.rs:40-56).
 
-    def __init__(self, spill_dir: str | None = None):
+    ``conf``: the owning task's Configuration — spills run on whichever
+    thread the memory manager dispatches, so the compression codec must
+    be threaded, not read from the spilling thread's active_conf() (R7)."""
+
+    def __init__(self, spill_dir: str | None = None, *, conf):
         fd, self.path = tempfile.mkstemp(
             suffix=".spill", dir=spill_dir or tempfile.gettempdir()
         )
         os.close(fd)
         self._offsets: list[int] = [0]
+        self._conf = conf
 
     def write_table(self, tbl) -> None:
         from auron_tpu.exec.shuffle.format import encode_block
 
-        blk = encode_block(tbl)
+        blk = encode_block(tbl, conf=self._conf)
         with open(self.path, "ab") as f:
             f.write(blk)
         self._offsets.append(self._offsets[-1] + len(blk))
@@ -290,12 +302,17 @@ class _HostLedger:
         self._resident: list["HostSpill"] = []
         self._bytes = 0
 
-    def admit(self, spill: "HostSpill", nbytes: int) -> list["HostSpill"]:
+    def admit(self, spill: "HostSpill", nbytes: int, conf=None) -> list["HostSpill"]:
         """Record bytes; returns the demotion victims WITHOUT demoting —
         the caller runs them after releasing its own spill lock (admission
         happens under the admitting spill's lock so it can never interleave
-        with a concurrent demotion of that same spill, ADVICE r4)."""
-        budget = int(active_conf().get(HOST_SPILL_BUDGET_BYTES))
+        with a concurrent demotion of that same spill, ADVICE r4).
+
+        ``conf``: threaded from the admitting spill — admissions happen on
+        spill-dispatch threads where active_conf() is a foreign task's."""
+        budget = int(
+            (conf if conf is not None else active_conf()).get(HOST_SPILL_BUDGET_BYTES)
+        )
         to_demote: list[HostSpill] = []
         with self._lock:
             self._bytes += nbytes
@@ -332,18 +349,19 @@ class HostSpill:
     DiskSpill when the process host ledger fills. Interface-compatible
     with DiskSpill (write_table / read_tables / release)."""
 
-    def __init__(self, spill_dir: str | None = None):
+    def __init__(self, spill_dir: str | None = None, *, conf):
         self._blocks: list[bytes] | None = []
         self._nbytes = 0
         self._admitted = 0  # bytes this spill currently holds in the ledger
         self._disk: DiskSpill | None = None
         self._spill_dir = spill_dir
+        self._conf = conf  # owning task's conf (codec + ledger budget, R7)
         self._lock = threading.Lock()
 
     def write_table(self, tbl) -> None:
         from auron_tpu.exec.shuffle.format import encode_block
 
-        blk = encode_block(tbl)
+        blk = encode_block(tbl, conf=self._conf)
         with self._lock:
             if self._disk is not None:
                 with open(self._disk.path, "ab") as f:
@@ -357,16 +375,16 @@ class HostSpill:
             # forgets exactly _admitted — the ledger can't drift (ADVICE r4:
             # the post-release admit re-added bytes a demotion had already
             # forgotten and re-inserted a demoted spill as resident)
-            victims = _host_ledger.admit(self, len(blk))
+            victims = _host_ledger.admit(self, len(blk), conf=self._conf)
         for v in victims:  # demote OUTSIDE our lock (lock order spill->ledger)
             v._demote()
 
-    def _demote(self) -> None:
+    def _demote(self) -> None:  # auronlint: thread-root(foreign) -- ledger pressure demotes victims on whichever thread admitted the last block
         """Move resident blocks to disk (ledger pressure)."""
         with self._lock:
             if self._disk is not None or self._blocks is None:
                 return
-            disk = DiskSpill(self._spill_dir)
+            disk = DiskSpill(self._spill_dir, conf=self._conf)
             with open(disk.path, "ab") as f:
                 for blk in self._blocks:
                     f.write(blk)
@@ -401,8 +419,13 @@ class HostSpill:
             _host_ledger.forget(self, freed)
 
 
-def make_spill(spill_dir: str | None = None):
+def make_spill(spill_dir: str | None = None, *, conf):
     """Spill container for operator state: host-RAM tier first, demoting
     to disk under ledger pressure (the promised HBM -> host RAM -> disk
-    cascade)."""
-    return HostSpill(spill_dir)
+    cascade). ``conf``: REQUIRED — the OWNING task's Configuration.
+    Spill writes and ledger demotions run on memory-manager dispatch
+    threads, where the ambient active_conf() is a FOREIGN task's;
+    keyword-only with no default so a forgotten conf is a TypeError at
+    construction, not a silent cross-thread codec/budget leak (R7).
+    Pass None deliberately only for conf-independent scratch (tests)."""
+    return HostSpill(spill_dir, conf=conf)

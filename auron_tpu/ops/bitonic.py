@@ -337,6 +337,7 @@ def ordered_sort(
     operands: tuple,
     word_narrow: tuple | None = None,
     impl: str | None = None,
+    conf=None,
 ) -> tuple:
     """ORDER-BY path dispatch: drop-in for
     ``lax.sort(operands, num_keys=len(operands)-1)`` over
@@ -352,7 +353,8 @@ def ordered_sort(
     assert len(word_narrow) == n_words, (len(word_narrow), n_words)
     if impl is None:
         impl = sort_impl_for(  # auronlint: sort-payload -- generic ORDER BY: the operand planes ARE the user's sort keys, all must participate
-            n_words, operands[0].shape[0], n_narrow_words=sum(word_narrow)
+            n_words, operands[0].shape[0], n_narrow_words=sum(word_narrow),
+            conf=conf,
         )
     if impl in ("jnp", "pallas"):
         narrow = (True, *word_narrow, False)
@@ -360,14 +362,16 @@ def ordered_sort(
     return lax.sort(operands, num_keys=len(operands) - 1)
 
 
-def sort_impl_for(n_words: int, cap: int, n_narrow_words: int = 1) -> str:
+def sort_impl_for(n_words: int, cap: int, n_narrow_words: int = 1, conf=None) -> str:
     """Trace-time choice of the cluster-sort implementation for a
     (dead_key, *words, iota) operand tuple: 'lax' | 'jnp' | 'pallas'.
     Resolved from config OUTSIDE jit (like hostsort.use_host_sort) —
     callers must thread it as a static argument. n_narrow_words = how many
     of the words ride as single planes (segment_by_keys narrows the
-    null-bits word for <= 32 key columns)."""
-    mode = active_conf().get(DEVICE_SORT_IMPL)
+    null-bits word for <= 32 key columns). ``conf``: REQUIRED on any path
+    a cross-thread spill merge can reach — active_conf() is thread-local
+    and would resolve a foreign task's sort impl there (R7)."""
+    mode = (conf if conf is not None else active_conf()).get(DEVICE_SORT_IMPL)
     if mode in ("lax", "jnp", "pallas"):
         return mode
     # auto: the network pays off on accelerators where lax.sort's

@@ -89,7 +89,8 @@ def _dict_rank(d) -> np.ndarray:
     for r, i in enumerate(order):
         rank[i] = r
     if len(_RANK_CACHE) >= _RANK_CACHE_MAX:
-        _RANK_CACHE.pop(next(iter(_RANK_CACHE)))
+        _RANK_CACHE.pop(next(iter(_RANK_CACHE)))  # auronlint: disable=R10 -- deliberate trace-time memo eviction: bounded cache of deterministic values, replay-safe
+    # auronlint: disable=R10 -- deliberate trace-time memo: ranks are a pure function of the dictionary object, replay-safe on cache hits
     _RANK_CACHE[id(d)] = (d, rank)
     return rank
 

@@ -31,7 +31,7 @@ def collect_ipc(op: ExecOperator, partitions: list[int] | None = None) -> list[b
         for b in op.execute(p, ctx):
             rb = b.to_arrow(preserve_dicts=True)
             if rb.num_rows:
-                blocks.append(encode_block(rb))
+                blocks.append(encode_block(rb, conf=ctx.conf))
     return blocks
 
 

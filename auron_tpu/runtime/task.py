@@ -66,7 +66,7 @@ class TaskRuntime:
 
     # ------------------------------------------------------------------
 
-    def _pump(self) -> None:
+    def _pump(self) -> None:  # auronlint: thread-root(conf-scoped) -- task pump thread; installs conf_scope(self.ctx.conf) before touching engine code
         from auron_tpu.utils.logging import clear_task_context, set_task_context
 
         set_task_context(self.ctx.stage_id, self.ctx.partition_id)

@@ -44,7 +44,7 @@ def start_host_transfer(*arrays) -> None:
                 pass  # unsupported backend/layout: harvest pays instead
 
 
-def harvest(*arrays) -> tuple[np.ndarray, ...]:
+def harvest(*arrays) -> tuple[np.ndarray, ...]:  # auronlint: thread-root(foreign) -- window harvests run on whichever thread drains (incl. cross-thread spill drains)
     """Resolve previously started transfers to host numpy values,
     accounted as async reads (see module docstring). Goes through
     jax.device_get (not np.asarray) so the read is visible to the

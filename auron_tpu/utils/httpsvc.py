@@ -38,6 +38,10 @@ HTTP_SERVICE_PORT = int_conf(
 _lock = threading.Lock()
 _server: ThreadingHTTPServer | None = None
 _port: int | None = None
+#: Configuration snapshotted at start(): handler threads must not read
+#: the thread-local active_conf() — they'd see whatever conf the SERVING
+#: thread happens to carry, not the conf the service was started under (R7)
+_conf = None
 
 
 def _metrics_payload() -> dict:
@@ -90,7 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def do_GET(self):  # noqa: N802 — http.server API
+    def do_GET(self):  # noqa: N802 — http.server API  # auronlint: thread-root(foreign) -- ThreadingHTTPServer handler thread: no task conf_scope installed
         try:
             if self.path == "/healthz":
                 self._send(b"ok\n", "text/plain")
@@ -102,9 +106,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/stacks":
                 self._send(_stacks_payload().encode(), "text/plain")
             elif self.path == "/conf":
-                from auron_tpu.utils.config import _REGISTRY, active_conf
+                from auron_tpu.utils.config import _REGISTRY, Configuration
 
-                conf = active_conf()
+                conf = _conf if _conf is not None else Configuration()
                 payload = {
                     k: repr(conf.get(o)) for k, o in sorted(_REGISTRY.items())
                 }
@@ -117,10 +121,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(f"error: {e}\n".encode(), "text/plain", 500)
 
 
-def start(port: int = 0) -> int:
-    """Start (or return) the service; returns the bound port."""
-    global _server, _port
+def start(port: int = 0, conf=None) -> int:
+    """Start (or return) the service; returns the bound port. ``conf`` is
+    snapshotted for the handler threads (/conf endpoint)."""
+    global _server, _port, _conf
     with _lock:
+        # record the conf even when the server is already running: a
+        # conf-less start() (tests, manual bring-up) followed by the
+        # bridge's maybe_start_from_conf must not leave /conf serving
+        # defaults for the rest of the process
+        if conf is not None and _conf is None:
+            _conf = conf
         if _server is not None:
             return _port
         _server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
@@ -133,17 +144,18 @@ def start(port: int = 0) -> int:
 
 
 def stop() -> None:
-    global _server, _port
+    global _server, _port, _conf
     with _lock:
         if _server is not None:
             _server.shutdown()
             _server.server_close()
             _server = None
             _port = None
+            _conf = None
 
 
 def maybe_start_from_conf(conf) -> int | None:
     """Lazy conf-gated start (called by the bridge on task entry)."""
     if not conf.get(HTTP_SERVICE_ENABLE):
         return None
-    return start(conf.get(HTTP_SERVICE_PORT))
+    return start(conf.get(HTTP_SERVICE_PORT), conf=conf)

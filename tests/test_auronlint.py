@@ -714,3 +714,617 @@ def test_syncbudget_collects_engine_declarations():
     assert hit is not None and hit.unit == "task"
     assert site_allowlisted("exec/shuffle/writer.py:330")
     assert not site_allowlisted("exec/joins/chain.py:1")
+
+
+# ---------------------------------------------------------------------------
+# interprocedural substrate (callgraph + summaries)
+# ---------------------------------------------------------------------------
+
+
+def _graph(sources: dict):
+    from tools.auronlint.callgraph import build_graph_from_sources
+
+    return build_graph_from_sources(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()}
+    )
+
+
+def test_callgraph_cycle_and_recursion_guard():
+    """Recursion, mutual recursion and a base-class cycle must not hang
+    any traversal (the R6 resolver-cycle lesson, applied to the graph)."""
+    g = _graph({
+        "pkg/a.py": """
+        class A(object):
+            def ping(self):
+                self.pong()
+
+            def pong(self):
+                self.ping()
+
+        def rec(n):  # auronlint: thread-root(foreign) -- test fixture
+            from auron_tpu.utils.config import active_conf
+            rec(n - 1)
+            return active_conf()
+        """,
+        "pkg/b.py": """
+        from pkg.a import A
+
+        class B(A):
+            pass
+
+        class C(B):
+            def ping(self):
+                super().ping()
+        """,
+    })
+    # every analysis terminates and the recursive root sees itself
+    states = g.foreign_conf_states()
+    assert any(q.endswith("::rec") for q in states)
+    g.roots_reaching()
+    g.batch_depths()
+    g.jit_reachable()
+
+
+def test_summaries_batch_loop_and_iter_attribution():
+    """`for b in child_stream(...)`: the stream-constructing call sits at
+    the surrounding depth, the body runs per batch."""
+    from tools.auronlint.core import SourceModule
+    from tools.auronlint.summaries import summarize_module
+
+    src = textwrap.dedent("""
+    def run(self, ctx):
+        prelude()
+        for b in self.child_stream(0, 0, ctx):
+            body(b)
+        for x in range(10):
+            bounded(x)
+    """)
+    ms = summarize_module(SourceModule("m.py", "m.py", src))
+    fs = ms.functions["m.py::run"]
+    depths = {c.name: c.batch_depth for c in fs.calls}
+    assert depths["prelude"] == 0
+    assert depths["child_stream"] == 0      # iter position: evaluated once
+    assert depths["body"] == 1              # per pumped batch
+    assert depths["bounded"] == 0           # plain bounded loop
+
+
+# ---------------------------------------------------------------------------
+# R7 thread-context escape
+# ---------------------------------------------------------------------------
+
+
+def _r7(sources: dict):
+    from tools.auronlint.rules.threadctx import analyze
+
+    return list(analyze(_graph(sources)))
+
+
+def test_r7_fires_on_bare_active_conf_from_foreign_root():
+    hits = _r7({
+        "pkg/spill.py": """
+        from pkg.conf import codec
+
+        class Staging:
+            def spill(self):  # auronlint: thread-root(foreign) -- test fixture
+                return codec()
+        """,
+        "pkg/conf.py": """
+        from auron_tpu.utils.config import active_conf
+
+        def codec():
+            return active_conf().get("spill.codec")
+        """,
+    })
+    assert len(hits) == 1
+    rel, line, msg = hits[0]
+    assert rel == "pkg/conf.py" and "Staging.spill" in msg
+
+
+def test_r7_quiet_when_conf_threaded_and_guarded():
+    hits = _r7({
+        "pkg/spill.py": """
+        from pkg.conf import codec
+
+        class Staging:
+            def __init__(self, ctx):
+                self.ctx = ctx
+
+            def spill(self):  # auronlint: thread-root(foreign) -- test fixture
+                return codec(conf=self.ctx.conf)
+        """,
+        "pkg/conf.py": """
+        from auron_tpu.utils.config import active_conf
+
+        def codec(conf=None):
+            return (conf if conf is not None else active_conf()).get("x")
+        """,
+    })
+    assert hits == []
+
+
+def test_r7_guarded_fallback_fires_when_a_path_drops_conf():
+    hits = _r7({
+        "pkg/spill.py": """
+        from pkg.conf import codec
+
+        class Staging:
+            def spill(self):  # auronlint: thread-root(foreign) -- test fixture
+                return codec()
+        """,
+        "pkg/conf.py": """
+        from auron_tpu.utils.config import active_conf
+
+        def codec(conf=None):
+            return (conf if conf is not None else active_conf()).get("x")
+        """,
+    })
+    assert len(hits) == 1
+    assert "WITHOUT passing conf" in hits[0][2]
+
+
+def test_r7_conf_scoped_root_is_exempt():
+    hits = _r7({
+        "pkg/pump.py": """
+        from auron_tpu.utils.config import active_conf
+
+        def pump():  # auronlint: thread-root(conf-scoped) -- installs scope
+            return active_conf()
+        """,
+    })
+    assert hits == []
+
+
+def test_r7_conf_scope_block_neutralizes_downstream():
+    hits = _r7({
+        "pkg/svc.py": """
+        from auron_tpu.utils.config import active_conf, conf_scope
+
+        def helper():
+            return active_conf()
+
+        def handle(conf):  # auronlint: thread-root(foreign) -- test fixture
+            with conf_scope(conf):
+                return helper()
+        """,
+    })
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# R8 lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _r8(sources: dict):
+    from tools.auronlint.rules.lockguard import analyze
+
+    return list(analyze(_graph(sources)))
+
+
+_R8_SHARED = """
+import threading
+
+class Mgr:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        {write}
+
+class Consumer:
+    def spill(self):  # auronlint: thread-root(foreign) -- test fixture
+        shrink()
+
+def shrink():
+    m = Mgr()
+    m.bump()
+
+def pump():  # auronlint: thread-root(conf-scoped) -- test fixture
+    m = Mgr()
+    m.bump()
+
+_GLOBAL_MGR = Mgr()
+"""
+
+
+def test_r8_fires_on_unlocked_cross_root_write():
+    hits = _r8({"pkg/m.py": _R8_SHARED.format(write="self.n += 1")})
+    assert len(hits) == 1
+    assert "Mgr.n" in hits[0][2] and "2 thread roots" in hits[0][2]
+
+
+def test_r8_quiet_under_lock_and_with_guarded_by():
+    hits = _r8({"pkg/m.py": _R8_SHARED.format(
+        write="with self._lock:\n            self.n += 1"
+    )})
+    assert hits == []
+    # guarded-by declaration: the lock is held by the caller
+    hits = _r8({"pkg/m.py": _R8_SHARED.format(
+        write="self.n += 1  # auronlint: guarded-by(self._lock) -- callers hold it"
+    )})
+    assert hits == []
+
+
+def test_r8_single_root_and_local_objects_are_quiet():
+    # single root: per-task state needs no lock
+    src = _R8_SHARED.format(write="self.n += 1").replace(
+        "def spill(self):  # auronlint: thread-root(foreign) -- test fixture",
+        "def spill(self):",
+    )
+    assert _r8({"pkg/m.py": src}) == []
+    # function-local parser objects never escape -> never shared
+    hits = _r8({"pkg/p.py": """
+    class Cursor:
+        def __init__(self, buf):
+            self.pos = 0
+
+        def take(self):
+            self.pos += 1
+
+    class Consumer:
+        def spill(self):  # auronlint: thread-root(foreign) -- test fixture
+            c = Cursor(b"x")
+            c.take()
+
+    def pump():  # auronlint: thread-root(conf-scoped) -- test fixture
+        c = Cursor(b"y")
+        c.take()
+    """})
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# R9 static sync-budget verification
+# ---------------------------------------------------------------------------
+
+
+def _r9(sources: dict):
+    from tools.auronlint.rules.budgetproof import analyze
+
+    return list(analyze(_graph(sources)))
+
+
+def test_r9_fires_on_call_budget_inside_batch_loop():
+    hits = _r9({"pkg/op.py": """
+    import jax
+
+    def read(b):
+        return jax.device_get(b)  # auronlint: sync-point(call) -- caller-owned
+
+    class Op:
+        def pump(self, ctx):  # auronlint: thread-root(conf-scoped) -- test fixture
+            for b in self.child_stream(0, 0, ctx):
+                read(b)
+    """})
+    assert len(hits) == 1
+    assert "caller-owned" in hits[0][2]
+
+
+def test_r9_fires_on_task_budget_in_local_batch_loop():
+    hits = _r9({"pkg/op.py": """
+    import jax
+
+    class Op:
+        def pump(self, ctx):  # auronlint: thread-root(conf-scoped) -- test fixture
+            for b in self.child_stream(0, 0, ctx):
+                n = jax.device_get(b)  # auronlint: sync-point(2/task) -- wrongly task-budgeted
+    """})
+    assert len(hits) == 1
+    assert "task-bounded" in hits[0][2]
+
+
+def test_r9_batch_budget_in_batch_loop_is_proven():
+    hits = _r9({"pkg/op.py": """
+    import jax
+
+    class Op:
+        def pump(self, ctx):  # auronlint: thread-root(conf-scoped) -- test fixture
+            prep = jax.device_get(0)  # auronlint: sync-point(4/task) -- once per task
+            for b in self.child_stream(0, 0, ctx):
+                n = jax.device_get(b)  # auronlint: sync-point(1/batch) -- per batch by design
+    """})
+    assert hits == []
+
+
+def test_r9_batch_budget_squared_fires():
+    hits = _r9({"pkg/op.py": """
+    import jax
+
+    class Op:
+        def pump(self, ctx):  # auronlint: thread-root(conf-scoped) -- test fixture
+            for b in self.child_stream(0, 0, ctx):
+                for c in self.child_stream(1, 0, ctx):
+                    n = jax.device_get(c)  # auronlint: sync-point(1/batch) -- nested!
+    """})
+    assert len(hits) == 1
+    assert "SQUARED" in hits[0][2]
+
+
+# ---------------------------------------------------------------------------
+# R10 jit-boundary purity
+# ---------------------------------------------------------------------------
+
+
+def _r10(sources: dict):
+    from tools.auronlint.rules.jitpurity import analyze
+
+    return list(analyze(_graph(sources)))
+
+
+def test_r10_fires_on_conf_read_and_transfer_inside_jit():
+    hits = _r10({"pkg/k.py": """
+    import jax
+    from auron_tpu.utils.config import active_conf
+
+    @jax.jit
+    def kernel(x):
+        mode = active_conf().get("exec.mode")
+        n = x.item()
+        return x + 1
+    """})
+    msgs = " | ".join(h[2] for h in hits)
+    assert len(hits) == 2
+    assert "active_conf" in msgs and ".item()" in msgs
+
+
+def test_r10_traced_helper_and_captured_mutation():
+    hits = _r10({"pkg/k.py": """
+    import jax
+    from functools import partial
+
+    _CACHE = {}
+
+    def helper(x):
+        _CACHE[1] = x
+        return x
+
+    @partial(jax.jit, static_argnames=("n",))
+    def kernel(x, *, n):
+        return helper(x) + n
+    """})
+    assert len(hits) == 1
+    assert "subscript write to captured '_CACHE'" in hits[0][2]
+    assert "traced via" in hits[0][2]
+
+
+def test_r10_pure_callback_target_not_traced_and_pure_fn_quiet():
+    hits = _r10({"pkg/k.py": """
+    import jax
+    import numpy as np
+
+    def _host_sort(x):
+        out = []
+        out.append(1)   # local list: fine
+        return np.lexsort(x)
+
+    @jax.jit
+    def kernel(x):
+        order = jax.pure_callback(_host_sort, x, x)
+        return x[order]
+    """})
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# annotation grammar: thread-root / guarded-by
+# ---------------------------------------------------------------------------
+
+
+def test_thread_root_grammar_validation():
+    rep = _lint(
+        """
+        def ok():  # auronlint: thread-root(foreign) -- net thread
+            pass
+
+        def bad_kind():  # auronlint: thread-root(weekly) -- nonsense
+            pass
+
+        def no_reason():  # auronlint: thread-root(foreign)
+            pass
+        """,
+        HostSyncRule(),
+    )
+    sup = [f for f in rep.findings if f.rule == "lint.suppression"]
+    # bad kind -> malformed argument; missing reason -> reasonless finding
+    assert len(sup) == 2
+
+
+def test_guarded_by_grammar_requires_lock_and_reason():
+    rep = _lint(
+        """
+        class C:
+            def f(self):
+                self.n = 1  # auronlint: guarded-by(self._lock) -- caller holds
+                self.m = 2  # auronlint: guarded-by -- no lock named
+        """,
+        HostSyncRule(),
+    )
+    sup = [f for f in rep.findings if f.rule == "lint.suppression"]
+    assert len(sup) == 1  # the lockless guarded-by
+
+
+def test_standalone_annotations_stack_to_next_code_line():
+    """Two standalone declarations above one statement both anchor to the
+    statement (the R9-over-sync-point interplay regression)."""
+    from tools.auronlint.core import SourceModule
+
+    src = textwrap.dedent("""
+    import jax
+
+    def f(xs):
+        # auronlint: sync-point(call) -- declared boundary
+        # auronlint: disable=R9 -- bounded by spill pressure
+        return jax.device_get(xs)
+    """)
+    mod = SourceModule("m.py", "m.py", src)
+    sync = [s for s in mod.suppressions if s.kind == "sync-point"][0]
+    assert mod.anchor_line(sync) == 7  # the return line, not the comment
+    assert mod.is_sync_point(7)
+    assert mod.suppression_for("R9", 7) is not None
+
+
+# ---------------------------------------------------------------------------
+# lint ratchet
+# ---------------------------------------------------------------------------
+
+
+def test_lint_ratchet_seed_improve_regress(tmp_path):
+    from tools.auronlint.ratchet import check_and_update, load, save
+    from tools.auronlint.report import Finding, Report
+
+    root = str(tmp_path)
+    (tmp_path / "auron_tpu").mkdir()
+
+    def report_with(n_suppressed):
+        rep = Report(tool="auronlint")
+        for i in range(n_suppressed):
+            rep.findings.append(Finding(
+                "auronlint", "R7", "auron_tpu/x.py", i + 1, "m",
+                suppressed=True, reason="r",
+            ))
+        return rep
+
+    # seed: first sighting records current debt
+    assert check_and_update(report_with(3), root) == []
+    assert load(root)["R7"] == 3
+    # improvement: ratchet tightens automatically
+    assert check_and_update(report_with(2), root) == []
+    assert load(root)["R7"] == 2
+    # regression: fails, file unchanged
+    problems = check_and_update(report_with(5), root)
+    assert problems and "R7" in problems[0]
+    assert load(root)["R7"] == 2
+    # explicit conscious raise is honored
+    counts = load(root)
+    counts["R7"] = 5
+    save(root, counts)
+    assert check_and_update(report_with(5), root) == []
+
+
+def test_live_tree_ratchet_matches_current_debt():
+    """LINT_RATCHET.json is committed and must match (or exceed) the
+    tree's actual suppression counts — `make lint` enforces it."""
+    from tools.auronlint.ratchet import current_counts, load
+    from tools.auronlint import run_tree
+
+    ratchet = load(REPO_ROOT)
+    assert ratchet.get("sync-point", 0) > 20
+    rep = run_tree()
+    counts = current_counts(rep, REPO_ROOT)
+    for key, n in counts.items():
+        assert n <= ratchet.get(key, 0), (
+            f"{key} debt {n} exceeds LINT_RATCHET.json "
+            f"{ratchet.get(key, 0)} — make lint would fail"
+        )
+
+
+# ---------------------------------------------------------------------------
+# SARIF emitter (shared by auronlint and jvm_lint)
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_schema_shape():
+    from tools.auronlint.report import Finding, Report
+
+    rep = Report(tool="auronlint")
+    rep.findings.append(Finding("auronlint", "R7", "a.py", 3, "boom"))
+    rep.findings.append(Finding(
+        "auronlint", "R9", "b.py", 0, "waived", suppressed=True, reason="why",
+    ))
+    doc = json.loads(rep.to_sarif())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "auronlint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {"R7", "R9"}
+    res = run["results"]
+    assert res[0]["locations"][0]["physicalLocation"]["region"]["startLine"] == 3
+    # line 0 (file-level) clamps to 1 for SARIF validity
+    assert res[1]["locations"][0]["physicalLocation"]["region"]["startLine"] == 1
+    assert res[1]["suppressions"][0]["justification"] == "why"
+
+
+def test_engine_thread_roots_are_declared():
+    """The known thread entry points carry thread-root declarations — the
+    interprocedural rules are only as good as their roots."""
+    from tools.auronlint.callgraph import build_graph
+
+    g = build_graph(REPO_ROOT)
+    roots = {q.split("::", 1)[1]: k for q, k in g.roots.items()}
+    assert roots.get("TaskRuntime._pump") == "conf-scoped"
+    assert roots.get("_Handler.do_GET") == "foreign"
+    assert roots.get("RssNetServer._handle") == "foreign"
+    assert roots.get("_ShuffleStaging.spill") == "foreign"
+    assert roots.get("_AggTableConsumer.spill") == "foreign"
+    assert roots.get("_SorterConsumer.spill") == "foreign"
+    assert roots.get("harvest") == "foreign"
+
+
+def test_thread_root_standalone_above_decorated_def_registers():
+    """The anchor of a standalone root above a decorated def is the
+    decorator line — the root must still register (a silently-dropped
+    root would disable reachability)."""
+    hits = _r7({"pkg/svc.py": """
+    from auron_tpu.utils.config import active_conf
+
+    def deco(f):
+        return f
+
+    # auronlint: thread-root(foreign) -- handler thread
+    @deco
+    def handler():
+        return worker()
+
+    def worker():
+        return active_conf()
+    """})
+    assert len(hits) == 1 and "handler" in hits[0][2]
+
+
+def test_unanchored_thread_root_is_a_loud_finding():
+    hits = _r7({"pkg/svc.py": """
+    # auronlint: thread-root(foreign) -- floats above nothing
+    X = 1
+    """})
+    assert len(hits) == 1
+    assert "does not anchor to a function definition" in hits[0][2]
+
+
+def test_lint_ratchet_failing_run_does_not_tighten(tmp_path):
+    """A transiently-broken tree (suppressions detached -> unsuppressed
+    findings) must not lower the debt ceiling."""
+    from tools.auronlint.ratchet import check_and_update, load
+    from tools.auronlint.report import Finding, Report
+
+    root = str(tmp_path)
+    (tmp_path / "auron_tpu").mkdir()
+
+    def report(n_sup, n_unsup=0):
+        rep = Report(tool="auronlint")
+        for i in range(n_sup):
+            rep.findings.append(Finding(
+                "auronlint", "R7", "auron_tpu/x.py", i + 1, "m",
+                suppressed=True, reason="r"))
+        for i in range(n_unsup):
+            rep.findings.append(Finding(
+                "auronlint", "R7", "auron_tpu/x.py", 100 + i, "loose"))
+        return rep
+
+    check_and_update(report(5), root)
+    assert load(root)["R7"] == 5
+    # 3 suppressions detach: run FAILS (2 unsuppressed) — ceiling stays
+    check_and_update(report(2, n_unsup=3), root)
+    assert load(root)["R7"] == 5
+    # restoring the suppressions is NOT a regression
+    assert check_and_update(report(5), root) == []
+
+
+def test_changed_mode_rejects_vacuous_and_ambiguous_invocations(capsys):
+    from tools.auronlint.__main__ import main
+
+    # tree-only rule selection under --changed would run zero rules
+    assert main(["--changed", "--rules", "R7"]) == 2
+    assert "vacuous" in capsys.readouterr().err
+    # explicit paths would be silently ignored
+    assert main(["--changed", "auron_tpu/exec"]) == 2
+    assert "picks its own files" in capsys.readouterr().err

@@ -99,9 +99,9 @@ def test_non_unique_build_falls_back_without_rebuilding():
     prepares = {"n": 0}
     orig_prepare = EquiJoinDriver.prepare
 
-    def counting_prepare(self, batches):
+    def counting_prepare(self, batches, conf=None):
         prepares["n"] += 1
-        return orig_prepare(self, batches)
+        return orig_prepare(self, batches, conf=conf)
 
     EquiJoinDriver.prepare = counting_prepare
     try:

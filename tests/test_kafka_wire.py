@@ -196,6 +196,12 @@ def test_crc32c_vector():
 
 @pytest.mark.parametrize("codec", [KW.CODEC_NONE, KW.CODEC_GZIP, KW.CODEC_ZSTD])
 def test_record_batch_roundtrip(codec):
+    if codec == KW.CODEC_ZSTD:
+        # env-dependent: the wire codec needs the zstandard package, which
+        # CI images may not ship — skip loudly instead of failing tier-1
+        # (a real regression in the zstd path still fails wherever the
+        # module exists)
+        pytest.importorskip("zstandard")
     values = [f"record-{i}".encode() for i in range(37)]
     buf = KW.encode_record_batch(1000, values, codec)
     got = KW.decode_record_batches(buf)

@@ -185,7 +185,7 @@ def test_host_spill_ledger_demotes_to_disk():
     tbl = pa.Table.from_pandas(df, preserve_index=False)
     conf = Configuration().set(HOST_SPILL_BUDGET_BYTES, 1)  # everything demotes
     with conf_scope(conf):
-        hs = M.HostSpill()
+        hs = M.HostSpill(conf=None)  # deliberate: conf-independent scratch
         hs.write_table(tbl)
         assert hs.demoted  # ledger pressure pushed it to disk
         back = list(hs.read_tables())
@@ -195,7 +195,7 @@ def test_host_spill_ledger_demotes_to_disk():
     # roomy ledger: stays in RAM
     conf2 = Configuration().set(HOST_SPILL_BUDGET_BYTES, 1 << 30)
     with conf_scope(conf2):
-        hs2 = M.HostSpill()
+        hs2 = M.HostSpill(conf=None)  # deliberate: conf-independent scratch
         hs2.write_table(tbl)
         assert not hs2.demoted
         back2 = list(hs2.read_tables())

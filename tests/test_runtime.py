@@ -106,7 +106,7 @@ def test_memmgr_spill_ordering():
 
 
 def test_disk_spill_roundtrip(tmp_path):
-    ds = DiskSpill(str(tmp_path))
+    ds = DiskSpill(str(tmp_path), conf=None)  # deliberate: conf-independent scratch
     t1 = pa.table({"x": [1, 2]})
     t2 = pa.table({"x": [3]})
     ds.write_table(t1)

@@ -1,6 +1,6 @@
 """auronlint — engine-invariant static analysis for the JAX/TPU side.
 
-Six rule families over ``auron_tpu/`` (see docs/auronlint.md):
+Ten rule families over ``auron_tpu/`` (see docs/auronlint.md):
 
   R1  host-sync hygiene      implicit device->host transfers
   R2  retrace discipline     bounded jit compile cache
@@ -8,10 +8,19 @@ Six rule families over ``auron_tpu/`` (see docs/auronlint.md):
   R4  registry lockstep      proto <-> convert <-> exec <-> explain
   R5  vectorization ban      no per-row python loops in hot paths
   R6  sort-payload           sort operand lists must stay fixed-arity
+  R7  thread-context escape  no thread-local reads on foreign threads
+  R8  lock discipline        cross-root shared writes must hold a lock
+  R9  sync-budget proof      declared budgets vs static multiplicity
+  R10 jit purity             no effects/context reads inside traces
 
-Run as ``make lint`` / ``python -m tools.auronlint``; gated in tier-1 by
-``tests/test_auronlint.py``. Shares its finding/report schema with
-``tools/jvm_lint.py`` (tools/auronlint/report.py).
+R7-R10 are interprocedural: a package-wide call graph + per-function
+summaries (tools/auronlint/callgraph.py, summaries.py) with reachability
+from in-source ``thread-root`` declarations. Run as ``make lint`` /
+``python -m tools.auronlint`` (``make lint-changed`` for the per-file
+fast mode); gated in tier-1 by ``tests/test_auronlint.py`` with
+suppression counts ratcheted via LINT_RATCHET.json (ratchet.py). Shares
+its finding/report schema — JSON and SARIF — with ``tools/jvm_lint.py``
+(tools/auronlint/report.py).
 """
 
 from __future__ import annotations

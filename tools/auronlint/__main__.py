@@ -1,43 +1,122 @@
-"""CLI: python -m tools.auronlint [paths...] [--json] [--show-suppressed]
+"""CLI: python -m tools.auronlint [paths...] [--json|--sarif] [--changed]
 
-Exit status 0 = zero unsuppressed findings (the `make lint` contract).
+Exit status 0 = zero unsuppressed findings AND no lint-ratchet regression
+(the `make lint` contract). Full-tree runs (no paths, no --changed)
+enforce LINT_RATCHET.json: per-rule suppressed-finding counts and the
+sync-point/guarded-by declaration counts may only shrink; improvements
+are persisted automatically, regressions fail the run.
+
+--changed lints only files touched per `git status` (the `make
+lint-changed` inner loop): per-file rules only — the interprocedural
+rules (R7-R10) and the registry cross-check (R4) need the whole package
+and stay in `make lint` / tier-1. No ratchet in this mode (counts are
+only comparable tree-wide).
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+
+
+def _changed_paths(root: str) -> list[str] | None:
+    """Tracked-modified + staged + untracked .py files under auron_tpu/;
+    None when git itself failed (distinct from a clean tree — a broken
+    git must fail `make lint-changed`, not green-light it)."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root,
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"auronlint --changed: git status failed: {e}", file=sys.stderr)
+        return None
+    paths = []
+    for line in out.splitlines():
+        rel = line[3:].split(" -> ")[-1].strip().strip('"')
+        if rel.endswith(".py") and rel.startswith("auron_tpu/"):
+            p = os.path.join(root, rel)
+            if os.path.exists(p):
+                paths.append(p)
+    return paths
 
 
 def main(argv: list[str] | None = None) -> int:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
     from tools.auronlint import ALL_RULES, REPO_ROOT, lint_paths, run_tree
+    from tools.auronlint.core import Rule
+    from tools.auronlint.ratchet import check_and_update
 
     p = argparse.ArgumentParser(prog="auronlint", description=__doc__)
     p.add_argument("paths", nargs="*", help="files/dirs (default: auron_tpu/)")
     p.add_argument("--json", action="store_true", help="machine-readable report")
+    p.add_argument("--sarif", action="store_true",
+                   help="SARIF 2.1.0 report (CI annotations)")
     p.add_argument("--show-suppressed", action="store_true")
     p.add_argument("--rules", help="comma-separated rule ids (default: all)")
+    p.add_argument("--changed", action="store_true",
+                   help="fast mode: lint only git-touched files with "
+                        "per-file rules (interprocedural rules skipped)")
+    p.add_argument("--no-ratchet", action="store_true",
+                   help="skip LINT_RATCHET.json enforcement on a full run")
     args = p.parse_args(argv)
 
     rules = ALL_RULES
     if args.rules:
         wanted = {r.strip() for r in args.rules.split(",")}
         rules = tuple(r for r in ALL_RULES if r.name in wanted)
-    if args.paths:
+    ratchet_eligible = False
+    if args.changed:
+        if args.paths:
+            print("auronlint: --changed picks its own files; explicit "
+                  "paths would be silently ignored — drop one or the "
+                  "other", file=sys.stderr)
+            return 2
+        # per-file rules only: tree rules (R4, R7-R10) need every module
+        dropped = [r.name for r in rules
+                   if type(r).check_module is Rule.check_module]
+        rules = tuple(
+            r for r in rules
+            if type(r).check_module is not Rule.check_module
+        )
+        if not rules:
+            print(f"auronlint: --changed runs per-file rules only and "
+                  f"--rules left none ({', '.join(dropped)} are "
+                  "tree-wide) — a zero-rule pass would be vacuous",
+                  file=sys.stderr)
+            return 2
+        paths = _changed_paths(REPO_ROOT)
+        if paths is None:
+            return 1
+        if not paths:
+            print("auronlint --changed: no touched engine files")
+            return 0
+        report = lint_paths(paths, REPO_ROOT, rules)
+    elif args.paths:
         report = lint_paths(
             [os.path.abspath(x) for x in args.paths], REPO_ROOT, rules
         )
     else:
         report = run_tree(rules=rules)
+        # the ratchet only means something for the full tree + full rules
+        ratchet_eligible = not args.rules
 
-    if args.json:
+    ratchet_problems: list[str] = []
+    if ratchet_eligible and not args.no_ratchet:
+        ratchet_problems = check_and_update(report, REPO_ROOT)
+
+    if args.sarif:
+        print(report.to_sarif())
+    elif args.json:
         print(report.to_json())
     else:
         print(report.render(show_suppressed=args.show_suppressed))
-    return 0 if report.ok() else 1
+    for prob in ratchet_problems:
+        print(prob, file=sys.stderr)
+    return 0 if report.ok() and not ratchet_problems else 1
 
 
 if __name__ == "__main__":

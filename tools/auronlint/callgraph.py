@@ -1,0 +1,427 @@
+"""Package-wide call graph + interprocedural analyses for R7-R10.
+
+Built from the per-function summaries (tools/auronlint/summaries.py) over
+every module in ``auron_tpu/``. Resolution is *name-based and deliberately
+over-approximate* — lint wants "could this run there", not "does it":
+
+- bare names resolve through the enclosing nested-def chain, the module's
+  own functions/classes, then ``from``-imports;
+- ``self.m()`` resolves within the class, then its same-namespace bases;
+- ``alias.f()`` resolves through module imports;
+- ``obj.m()`` (unknown receiver) resolves to EVERY method named ``m`` in
+  the package — capped (``METHOD_FANOUT_CAP``) and stoplisted
+  (``GENERIC_NAME_STOPLIST``) so container/stdlib method names don't glue
+  the whole graph together. The cap matters for precision, the dispatchy
+  names we *want* (``spill``, ``execute``, ``harvest``) are defined a
+  handful of times.
+
+Every traversal carries a visited set — recursion and mutual recursion in
+the engine tree (and in crafted test fixtures) must terminate, the same
+lesson as R6's resolver cycle guard.
+
+Analyses exported to the rules:
+
+- ``foreign_conf_states`` (R7): which functions are reachable from a
+  ``thread-root(foreign)`` declaration, and whether every such path hands
+  them a threaded ``conf`` (PARAM_CONF) or some path arrives bare
+  (NO_CONF). Edges made under an installed ``conf_scope`` don't count —
+  the scope neutralizes thread-locality.
+- ``roots_reaching`` (R8): the set of declared roots (foreign AND
+  conf-scoped) that can reach each function — two roots on one mutable
+  attribute means two threads can race on it.
+- ``batch_depths`` (R9): the maximum number of per-batch loops on any
+  root-to-function path, capped at 2 (beyond that the verdict is the
+  same), composed with each sync site's local loop nesting.
+- ``jit_reachable`` (R10): functions traced by ``jax.jit`` — entries plus
+  their call-graph closure.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+from tools.auronlint.core import EXCLUDED_RELS, SourceModule, iter_py_files
+from tools.auronlint.summaries import (
+    FunctionSummary, ModuleSummary, summarize_module,
+)
+
+#: an unknown-receiver method name resolves only when the package defines
+#: it in at most this many places (precision guard for `obj.m()` edges)
+METHOD_FANOUT_CAP = 10
+
+#: container/stdlib method names that would glue unrelated classes into
+#: one component; calls to these through unknown receivers get no edge
+GENERIC_NAME_STOPLIST = {
+    "get", "set", "add", "put", "pop", "items", "keys", "values", "copy",
+    "join", "split", "strip", "close", "open", "read", "write", "next",
+    "send", "clear", "remove", "insert", "index", "sort", "format",
+    "encode", "decode", "replace", "append", "extend", "update",
+    "setdefault", "wait", "wait_for", "notify", "notify_all", "cancel",
+    "is_set", "result", "done", "to_arrow", "to_numpy", "to_pandas",
+    "astype", "reshape", "item", "tolist", "name", "group", "match",
+    "search", "findall", "sub", "total_seconds", "timer", "seek", "tell",
+}
+
+#: conf-state lattice for R7 (bigger = worse)
+PARAM_CONF = 1   # every foreign path hands the function a threaded conf
+NO_CONF = 2      # some foreign path arrives without one
+
+
+@dataclass
+class Edge:
+    caller: str
+    callee: str
+    line: int
+    batch_depth: int        # per-batch loops enclosing the call site
+    passes_conf: str | None  # None | "definite" | "caller-conf"
+    in_conf_scope: bool
+    generic: bool = False   # resolved through the unknown-receiver
+                            # method-name index (weakest evidence; R10's
+                            # traced closure skips these edges)
+
+
+class CallGraph:
+    def __init__(self):
+        self.modules: dict[str, ModuleSummary] = {}
+        self.functions: dict[str, FunctionSummary] = {}
+        self.edges_out: dict[str, list[Edge]] = {}
+        self.roots: dict[str, str] = {}          # qualname -> kind
+        #: dotted module path -> rel ("auron_tpu.ops.hostsort" -> rel)
+        self._dotted_to_rel: dict[str, str] = {}
+        #: method name -> [qualnames] across the package
+        self._method_index: dict[str, list[str]] = {}
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    def add_module(self, ms: ModuleSummary) -> None:
+        self.modules[ms.rel] = ms
+        dotted = ms.rel[:-3].replace("/", ".").replace("\\", ".")
+        self._dotted_to_rel[dotted] = ms.rel
+        if dotted.endswith(".__init__"):
+            self._dotted_to_rel[dotted[: -len(".__init__")]] = ms.rel
+        for q, fs in ms.functions.items():
+            self.functions[q] = fs
+            if fs.root_kind:
+                self.roots[q] = fs.root_kind
+            if fs.cls and "<locals>" not in q:
+                self._method_index.setdefault(fs.name, []).append(q)
+
+    def finalize(self) -> None:
+        self._build_hierarchy()
+        for ms in self.modules.values():
+            for fs in ms.functions.values():
+                self.edges_out[fs.qualname] = [
+                    e for c in fs.calls for e in self._resolve(ms, fs, c)
+                ]
+
+    def _build_hierarchy(self) -> None:
+        """(rel, class) -> transitive subclasses, resolved by name through
+        each module's imports — ``self.m()`` then dispatches to every
+        override below the lexical class (the ExecOperator._execute stub
+        must not swallow the operator bodies)."""
+        children: dict[tuple, set] = {}
+        for ms in self.modules.values():
+            for cls, bases in ms.class_bases.items():
+                for b in bases:
+                    key = None
+                    if b in ms.class_bases:
+                        key = (ms.rel, b)
+                    elif b in ms.name_imports:
+                        dotted, orig = ms.name_imports[b]
+                        rel2 = self._dotted_to_rel.get(dotted)
+                        if rel2:
+                            key = (rel2, orig)
+                    if key is not None:
+                        children.setdefault(key, set()).add((ms.rel, cls))
+        self._descendants: dict[tuple, set] = {}
+        for key in children:
+            seen: set = set()
+            stack = list(children.get(key, ()))
+            while stack:
+                k = stack.pop()
+                if k in seen:
+                    continue  # cycle guard (self-referential bases)
+                seen.add(k)
+                stack += list(children.get(k, ()))
+            self._descendants[key] = seen
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+
+    def _fn(self, rel: str, qual: str) -> str | None:
+        q = f"{rel}::{qual}"
+        return q if q in self.functions else None
+
+    def _module_target(self, rel2: str, name: str) -> str | None:
+        """Function ``name`` or class ``name``'s __init__ in module rel2."""
+        return self._fn(rel2, name) or self._fn(rel2, f"{name}.__init__")
+
+    def _resolve(self, ms: ModuleSummary, fs: FunctionSummary, c) -> list[Edge]:
+        targets: list[str] = []
+        generic: set[str] = set()
+        name, recv = c.name, c.recv
+
+        if recv is None:
+            # enclosing nested-def chain, innermost first
+            qual = fs.qualname.split("::", 1)[1]
+            parts = qual.split(".<locals>.")
+            for i in range(len(parts), 0, -1):
+                prefix = ".<locals>.".join(parts[:i])
+                t = self._fn(ms.rel, f"{prefix}.<locals>.{name}")
+                if t:
+                    targets.append(t)
+                    break
+            if not targets:
+                t = self._fn(ms.rel, name) or self._fn(ms.rel, f"{name}.__init__")
+                if t:
+                    targets.append(t)
+            if not targets and name in ms.name_imports:
+                dotted, orig = ms.name_imports[name]
+                rel2 = self._dotted_to_rel.get(dotted)
+                if rel2:
+                    t = self._module_target(rel2, orig)
+                    if t:
+                        targets.append(t)
+        elif recv == "self" and fs.cls:
+            # the lexical class, every transitive subclass override (a
+            # base-class stub must not swallow the real bodies), then the
+            # same-namespace bases upward
+            for rel2, cls2 in [(ms.rel, fs.cls)] + sorted(
+                self._descendants.get((ms.rel, fs.cls), ())
+            ):
+                t = self._fn(rel2, f"{cls2}.{name}")
+                if t:
+                    targets.append(t)
+            if not targets:
+                for base in ms.class_bases.get(fs.cls, ()):  # noqa: B007
+                    t = self._fn(ms.rel, f"{base}.{name}")
+                    if not t and base in ms.name_imports:
+                        dotted, orig = ms.name_imports[base]
+                        rel2 = self._dotted_to_rel.get(dotted)
+                        if rel2:
+                            t = self._fn(rel2, f"{orig}.{name}")
+                    if t:
+                        targets.append(t)
+            if not targets:
+                cands = self._generic(name)
+                targets += cands
+                generic.update(cands)
+        elif recv in ms.mod_imports:
+            rel2 = self._dotted_to_rel.get(ms.mod_imports[recv])
+            if rel2:
+                t = self._module_target(rel2, name)
+                if t:
+                    targets.append(t)
+        elif recv in ms.name_imports:
+            # `from x import Cls` + Cls.method(...), or `from pkg import
+            # submodule` + submodule.func(...) — try both readings
+            dotted, orig = ms.name_imports[recv]
+            rel2 = self._dotted_to_rel.get(dotted)
+            if rel2:
+                t = self._fn(rel2, f"{orig}.{name}")
+                if t:
+                    targets.append(t)
+            if not targets:
+                rel2 = self._dotted_to_rel.get(f"{dotted}.{orig}")
+                if rel2:
+                    t = self._module_target(rel2, name)
+                    if t:
+                        targets.append(t)
+        elif recv is not None and self._fn(ms.rel, f"{recv}.{name}"):
+            # ClassName.method(...) within the same module
+            targets.append(self._fn(ms.rel, f"{recv}.{name}"))
+        else:
+            cands = self._generic(name)
+            targets += cands
+            generic.update(cands)
+
+        return [
+            Edge(fs.qualname, t, c.line, c.batch_depth,
+                 _passes_conf(c.node, fs, self.functions[t]),
+                 c.in_conf_scope, generic=t in generic)
+            for t in targets
+        ]
+
+    def _generic(self, name: str) -> list[str]:
+        if name in GENERIC_NAME_STOPLIST or name.startswith("__"):
+            return []
+        cands = self._method_index.get(name, ())
+        return list(cands) if 0 < len(cands) <= METHOD_FANOUT_CAP else []
+
+    # ------------------------------------------------------------------
+    # analyses (every traversal cycle-guarded)
+    # ------------------------------------------------------------------
+
+    def foreign_conf_states(self) -> dict[str, int]:
+        """qualname -> PARAM_CONF | NO_CONF for functions reachable from a
+        foreign thread root without an intervening conf_scope."""
+        state: dict[str, int] = {}
+        work = []
+        for q, kind in self.roots.items():
+            if kind == "foreign":
+                state[q] = NO_CONF
+                work.append(q)
+        while work:
+            u = work.pop()
+            s = state[u]
+            for e in self.edges_out.get(u, ()):  # noqa: B007
+                if e.in_conf_scope:
+                    continue  # callee runs under an installed conf_scope
+                if e.passes_conf == "definite":
+                    ns = PARAM_CONF
+                elif e.passes_conf == "caller-conf":
+                    ns = s
+                else:
+                    ns = NO_CONF
+                if ns > state.get(e.callee, 0):
+                    state[e.callee] = ns
+                    work.append(e.callee)
+        return state
+
+    def roots_reaching(self) -> dict[str, set]:
+        """qualname -> set of declared roots (any kind) that reach it."""
+        out: dict[str, set] = {}
+        for root in self.roots:
+            seen = {root}
+            stack = [root]
+            while stack:
+                u = stack.pop()
+                out.setdefault(u, set()).add(root)
+                for e in self.edges_out.get(u, ()):
+                    if e.callee not in seen:
+                        seen.add(e.callee)
+                        stack.append(e.callee)
+        return out
+
+    def batch_depths(self) -> dict[str, int]:
+        """qualname -> max per-batch loop multiplicity on any path from a
+        declared root (capped at 2; absent = not reachable from a root).
+
+        Streaming composition does not multiply: summaries.py attributes
+        a for-loop's ITER expression to the surrounding depth (stream
+        creation happens once), so `for b in child_stream(...)` gives the
+        stream-constructing call depth 0 and only the loop body +1 — the
+        batch unit keeps meaning "per batch pumped through this stream"."""
+        depth: dict[str, int] = {}
+        work = []
+        for q in self.roots:
+            depth[q] = 0
+            work.append(q)
+        while work:
+            u = work.pop()
+            d = depth[u]
+            for e in self.edges_out.get(u, ()):
+                nd = min(d + e.batch_depth, 2)
+                if nd > depth.get(e.callee, -1):
+                    depth[e.callee] = nd
+                    work.append(e.callee)
+        return depth
+
+    def jit_reachable(self) -> dict[str, str]:
+        """qualname -> why ("entry" or the entry qualname that traces it)
+        for every function inside a jit boundary."""
+        out: dict[str, str] = {}
+        stack = []
+        for q, fs in self.functions.items():
+            if fs.is_jit:
+                out[q] = "entry"
+                stack.append((q, q))
+        while stack:
+            u, entry = stack.pop()
+            for e in self.edges_out.get(u, ()):
+                # generic (unknown-receiver) edges are too weak to claim a
+                # function is traced — purity findings need tight evidence
+                if e.generic or e.callee in out:
+                    continue
+                out[e.callee] = entry
+                stack.append((e.callee, entry))
+        return out
+
+
+def _passes_conf(call: ast.Call, caller: FunctionSummary,
+                 callee: FunctionSummary) -> str | None:
+    """Does this call site hand the callee a threaded conf? ``definite`` =
+    a concrete Configuration expression (ctx.conf, self._conf, a call),
+    ``caller-conf`` = the caller forwards its own (possibly-None) ``conf``
+    parameter, None = no conf argument (or literal None)."""
+    if callee.conf_param is None:
+        return None
+    expr = None
+    for kw in call.keywords:
+        if kw.arg == "conf":
+            expr = kw.value
+            break
+    if expr is None:
+        idx = callee.conf_param
+        if callee.cls is not None and callee.params[:1] == ("self",):
+            idx -= 1  # bound method call: self is not in the arg list
+        if 0 <= idx < len(call.args) and not any(
+            isinstance(a, ast.Starred) for a in call.args[: idx + 1]
+        ):
+            expr = call.args[idx]
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return None
+    text = ast.unparse(expr) if hasattr(ast, "unparse") else ""
+    if caller.conf_param is not None and (
+        (isinstance(expr, ast.Name) and expr.id == "conf")
+        or text.startswith("conf if ")
+        or text.startswith("conf or ")
+    ):
+        return "caller-conf"
+    return "definite"
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+_cache: dict[str, tuple] = {}
+
+
+def build_graph(root: str, subdir: str = "auron_tpu") -> CallGraph:
+    """Build (memoized on file mtimes) the call graph for the package
+    tree under ``root``."""
+    base = os.path.join(root, subdir)
+    files = iter_py_files(base)
+    sig = tuple(
+        (p, os.stat(p).st_mtime_ns, os.stat(p).st_size) for p in files
+    )
+    hit = _cache.get(base)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    mods = []
+    for path in files:
+        rel = os.path.relpath(path, root).replace("\\", "/")
+        if rel in EXCLUDED_RELS:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                mods.append(SourceModule(path, rel, f.read()))
+        except (OSError, SyntaxError):
+            continue  # lint.parse finding comes from the runner
+    g = build_graph_from_modules(mods)
+    _cache[base] = (sig, g)
+    return g
+
+
+def build_graph_from_modules(mods: list[SourceModule]) -> CallGraph:
+    """Graph over explicit SourceModules (test fixtures use this)."""
+    g = CallGraph()
+    for mod in mods:
+        g.add_module(summarize_module(mod))
+    g.finalize()
+    return g
+
+
+def build_graph_from_sources(sources: dict[str, str]) -> CallGraph:
+    """Graph from {rel: source} in-memory fixtures."""
+    return build_graph_from_modules(
+        [SourceModule(rel, rel, src) for rel, src in sources.items()]
+    )

@@ -25,6 +25,20 @@ suppression is itself a finding)::
 ``sync-point`` is not a suppression: it *declares* an allowed device->host
 boundary (the blocking-boundary contract), and R1 treats the line exactly
 like the runtime/task.py / exec/shuffle/ allowlist.
+
+Declarations consumed by the interprocedural rules (R7-R10, see
+docs/auronlint.md)::
+
+    def _pump(self):        # auronlint: thread-root(conf-scoped) -- task pump installs conf_scope
+    def spill(self) -> int: # auronlint: thread-root(foreign) -- MemManager dispatches cross-thread
+    self.n += 1             # auronlint: guarded-by(self._lock) -- caller holds the table lock
+
+``thread-root`` marks a function as a thread entry point the call-graph
+reachability (tools/auronlint/callgraph.py) starts from: ``foreign`` =
+runs WITHOUT the task's conf_scope installed (spill dispatch, HTTP
+handlers, net threads), ``conf-scoped`` = installs its own scope before
+touching engine code. ``guarded-by`` declares which lock protects a
+shared write R8 cannot see lexically (the lock is taken by a caller).
 """
 
 from __future__ import annotations
@@ -59,11 +73,15 @@ _HOST_RETURNING = {
 }
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*auronlint:\s*(disable|disable-function|sync-point|sort-payload)"
+    r"#\s*auronlint:\s*"
+    r"(disable|disable-function|sync-point|sort-payload|thread-root|guarded-by)"
     r"(?:\((?P<budget>[^)]*)\))?"
     r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
     r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
 )
+
+#: valid thread-root kinds (the parenthesized argument of ``thread-root``)
+THREAD_ROOT_KINDS = ("foreign", "conf-scoped")
 
 #: sync-point multiplicity budget: ``<count>/batch`` (scales with batches —
 #: the per-batch sync tax the runtime budget gate polices), ``<count>/task``
@@ -88,11 +106,14 @@ def parse_sync_budget(budget: str) -> tuple[int, str] | None:
 @dataclass
 class Suppression:
     kind: str            # "disable" | "disable-function" | "sync-point"
+                         # | "sort-payload" | "thread-root" | "guarded-by"
     rules: frozenset     # rule ids; empty = all rules
     reason: str
     line: int            # line the comment sits on
     standalone: bool     # comment-only line (applies to the next code line)
-    budget: str = ""     # sync-point multiplicity, e.g. "1/batch" (optional)
+    budget: str = ""     # parenthesized argument: sync-point multiplicity
+                         # ("1/batch"), thread-root kind ("foreign"), or
+                         # guarded-by lock name ("self._lock")
 
     def covers_rule(self, rule: str) -> bool:
         return not self.rules or rule in self.rules
@@ -139,6 +160,7 @@ class SourceModule:
 
     def _parse_comments(self, src: str) -> None:
         code_lines = set()
+        self._code_lines: set[int] = code_lines
         try:
             toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
         except (tokenize.TokenError, IndentationError):
@@ -163,8 +185,17 @@ class SourceModule:
             line = t.start[0]
             if not reason:
                 self.bad_suppressions.append(line)
-            if budget and (
-                m.group(1) != "sync-point" or parse_sync_budget(budget) is None
+            kind = m.group(1)
+            if kind == "thread-root":
+                # the parenthesized argument is the root kind and is required
+                if budget not in THREAD_ROOT_KINDS:
+                    self.bad_budgets.append(line)
+            elif kind == "guarded-by":
+                # the argument names the protecting lock and is required
+                if not budget:
+                    self.bad_budgets.append(line)
+            elif budget and (
+                kind != "sync-point" or parse_sync_budget(budget) is None
             ):
                 # a budget only means something on a sync-point, and must
                 # parse as <count>/batch | <count>/task | call
@@ -181,6 +212,20 @@ class SourceModule:
                 spans.append((node.lineno, node.end_lineno or node.lineno))
         return spans
 
+    def anchor_line(self, sup: Suppression) -> int:
+        """The code line a declaration anchors to: its own line, or — for
+        a standalone comment — the next CODE line, skipping any further
+        annotation/comment lines stacked between it and the code (two
+        standalone declarations may cover one statement)."""
+        if not sup.standalone:
+            return sup.line
+        code = getattr(self, "_code_lines", None) or set()
+        line = sup.line + 1
+        limit = sup.line + 10
+        while line not in code and line <= limit:
+            line += 1
+        return line if line <= limit else sup.line + 1
+
     def _lines_covered(self, sup: Suppression) -> set[int]:
         if sup.kind == "disable-function":
             for lo, hi in sorted(self.func_spans):
@@ -189,13 +234,13 @@ class SourceModule:
             return {sup.line}
         covered = {sup.line}
         if sup.standalone:
-            covered.add(sup.line + 1)
+            covered.add(self.anchor_line(sup))
         return covered
 
     def suppression_for(self, rule: str, line: int) -> Suppression | None:
         for sup in self.suppressions:
-            if sup.kind == "sync-point":
-                continue
+            if sup.kind in ("sync-point", "thread-root", "guarded-by"):
+                continue  # declarations, not suppressions (rules read them)
             if sup.kind == "sort-payload":
                 # a dedicated keyword (like sync-point) declaring a sort
                 # that MUST carry every column — suppresses R6 only
@@ -211,6 +256,20 @@ class SourceModule:
             s.kind == "sync-point" and line in self._lines_covered(s)
             for s in self.suppressions
         )
+
+    def thread_roots(self) -> list[Suppression]:
+        """thread-root declarations (kind in ``budget``: foreign |
+        conf-scoped). The declared line (or the next, when standalone)
+        is expected to be a ``def`` — callgraph.py anchors roots there."""
+        return [s for s in self.suppressions
+                if s.kind == "thread-root" and s.budget in THREAD_ROOT_KINDS]
+
+    def guard_for(self, line: int) -> Suppression | None:
+        """The guarded-by declaration covering a write site, if any."""
+        for s in self.suppressions:
+            if s.kind == "guarded-by" and line in self._lines_covered(s):
+                return s
+        return None
 
     # -- scope / taint analysis --------------------------------------------
 
@@ -458,8 +517,9 @@ def lint_paths(paths: list[str], root: str, rules) -> Report:
         for line in mod.bad_budgets:
             report.findings.append(Finding(
                 TOOL, "lint.suppression", rel, line,
-                "malformed sync-point budget (write `# auronlint: "
-                "sync-point(<count>/batch|<count>/task|call) -- <why>`)",
+                "malformed annotation argument (sync-point(<count>/batch|"
+                "<count>/task|call), thread-root(foreign|conf-scoped) or "
+                "guarded-by(<lock>) -- <why>)",
             ))
         for rule in rules:
             for line, message in rule.check_module(mod):
@@ -520,7 +580,7 @@ def lint_source(src: str, rel: str, rules) -> Report:
     for line in mod.bad_budgets:
         report.findings.append(Finding(
             TOOL, "lint.suppression", rel, line,
-            "malformed sync-point budget",
+            "malformed annotation argument",
         ))
     for rule in rules:
         for line, message in rule.check_module(mod):

@@ -1,0 +1,116 @@
+"""Lint ratchet: per-rule declared-debt counts may only shrink.
+
+``make lint`` already fails on any *unsuppressed* finding; what it could
+not see until now is suppression creep — every new ``disable=R7`` or
+``sync-point`` is a waived check, and a tree that stays "clean" while its
+waiver count doubles has regressed. ``LINT_RATCHET.json`` (mirroring
+``PERF_RATCHET.json``) pins the current debt:
+
+- one counter per rule id = suppressed findings carrying that rule;
+- ``sync-point`` = declared device->host boundaries (not findings, but
+  the engine's sync surface — it must not grow silently);
+- ``guarded-by`` = lock checks waived because a caller holds the lock.
+
+On a full-tree run the counts are compared against the file: a count
+ABOVE its ratchet fails the build (add the annotation AND consciously
+raise the ratchet in the same commit, with review); a count below it
+rewrites the file downward (atomically: temp + ``os.replace``, the
+``_save_ratchet`` lesson — a kill mid-write must not reset the debt
+ceiling). New keys seed at their current value.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+SCHEMA = 1
+
+
+def ratchet_path(root: str) -> str:
+    return os.path.join(root, "LINT_RATCHET.json")
+
+
+def current_counts(report, root: str) -> dict[str, int]:
+    """Debt counters for a full-tree report. Declaration counts come from
+    the mtime-memoized call graph (tools/auronlint/callgraph.py) — the
+    tree rules already built it this run, so no re-parse of the package."""
+    from tools.auronlint.callgraph import build_graph
+
+    counts: dict[str, int] = {}
+    for f in report.suppressed:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    decls = {"sync-point": 0, "guarded-by": 0}
+    for ms in build_graph(root).modules.values():
+        for s in ms.mod.suppressions:
+            if s.kind in decls:
+                decls[s.kind] += 1
+    counts.update(decls)
+    return counts
+
+
+def load(root: str) -> dict[str, int]:
+    try:
+        with open(ratchet_path(root), encoding="utf-8") as f:
+            data = json.load(f)
+        return {k: int(v) for k, v in data.get("counts", {}).items()}
+    except (OSError, ValueError):
+        return {}
+
+
+def save(root: str, counts: dict[str, int]) -> None:
+    """Atomic write (temp + os.replace): a kill mid-write must never
+    leave a truncated file that resets every ceiling."""
+    path = ratchet_path(root)
+    payload = json.dumps(
+        {"schema": SCHEMA, "counts": dict(sorted(counts.items()))}, indent=2
+    ) + "\n"
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".lint_ratchet_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def check_and_update(report, root: str) -> list[str]:
+    """Compare a full-tree report against the ratchet. Returns regression
+    messages (nonempty = the build must fail); improvements and new keys
+    are persisted — but only from a PASSING run: a transiently-broken
+    tree (detached suppressions surfacing as unsuppressed findings) must
+    not lower the debt ceiling and then flag the restoring fix as a
+    regression."""
+    counts = current_counts(report, root)
+    ratchet = load(root)
+    problems: list[str] = []
+    changed = False
+    merged = dict(ratchet)
+    for key, n in sorted(counts.items()):
+        allowed = ratchet.get(key)
+        if allowed is None:
+            merged[key] = n      # first sighting: seed at current debt
+            changed = True
+        elif n > allowed:
+            problems.append(
+                f"lint ratchet: {key} debt grew {allowed} -> {n} "
+                f"(new suppressions/declarations need a conscious ratchet "
+                f"raise in LINT_RATCHET.json, reviewed with the code)"
+            )
+        elif n < allowed:
+            merged[key] = n      # debt shrank: pin the better number
+            changed = True
+    # keys that vanished entirely ratchet to zero
+    for key in ratchet:
+        if key not in counts and ratchet[key] != 0:
+            merged[key] = 0
+            changed = True
+    if changed and not problems and report.ok():
+        save(root, merged)
+    return problems

@@ -95,6 +95,50 @@ class Report:
             indent=indent,
         )
 
+    def to_sarif(self, indent: int | None = 2) -> str:
+        """SARIF 2.1.0 — the CI-annotation lingua franca (GitHub code
+        scanning et al.). Unsuppressed findings become level=error
+        results; suppressed ones are carried with a suppression record so
+        dashboards can graph declared debt. One emitter for both gates
+        (auronlint and jvm_lint) through this shared Report."""
+        rules_seen: dict[str, dict] = {}
+        results = []
+        for f in self.findings:
+            if f.rule not in rules_seen:
+                rules_seen[f.rule] = {"id": f.rule}
+            res = {
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(f.line, 1)},
+                    },
+                }],
+            }
+            if f.suppressed:
+                res["suppressions"] = [{
+                    "kind": "inSource",
+                    "justification": f.reason or "no reason given",
+                }]
+            results.append(res)
+        doc = {
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": self.tool,
+                    "rules": [rules_seen[k] for k in sorted(rules_seen)],
+                }},
+                "results": results,
+            }],
+        }
+        return json.dumps(doc, indent=indent)
+
     def render(self, show_suppressed: bool = False) -> str:
         lines = [f.render() for f in self.unsuppressed]
         if show_suppressed:

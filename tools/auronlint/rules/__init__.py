@@ -1,11 +1,15 @@
 """Rule plugin registry. Adding a rule = one module with a Rule subclass,
 one entry here, one section in docs/auronlint.md."""
 
+from tools.auronlint.rules.budgetproof import BudgetProofRule
 from tools.auronlint.rules.host_sync import HostSyncRule
+from tools.auronlint.rules.jitpurity import JitPurityRule
+from tools.auronlint.rules.lockguard import LockGuardRule
 from tools.auronlint.rules.registry_sync import RegistrySyncRule
 from tools.auronlint.rules.retrace import RetraceRule
 from tools.auronlint.rules.shapes import ShapeBucketRule
 from tools.auronlint.rules.sortpayload import SortPayloadRule
+from tools.auronlint.rules.threadctx import ThreadContextRule
 from tools.auronlint.rules.vectorize import VectorizeRule
 
 ALL_RULES = (
@@ -15,14 +19,22 @@ ALL_RULES = (
     RegistrySyncRule(),
     VectorizeRule(),
     SortPayloadRule(),
+    ThreadContextRule(),
+    LockGuardRule(),
+    BudgetProofRule(),
+    JitPurityRule(),
 )
 
 __all__ = [
     "ALL_RULES",
+    "BudgetProofRule",
     "HostSyncRule",
+    "JitPurityRule",
+    "LockGuardRule",
     "RegistrySyncRule",
     "RetraceRule",
     "ShapeBucketRule",
     "SortPayloadRule",
+    "ThreadContextRule",
     "VectorizeRule",
 ]

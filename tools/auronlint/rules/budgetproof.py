@@ -1,0 +1,88 @@
+"""R9 — static sync-budget verification.
+
+Every declared device->host sync point carries a multiplicity budget
+(``sync-point(N/batch|N/task|call)``, core.py) that the *runtime* gate
+(``make perfcheck``) verifies by replaying a tiny workload. R9 proves the
+budgets *statically*, so a budget breach is a lint failure the moment the
+code moves — not a perfcheck regression two rounds later:
+
+- the call graph gives each function the maximum number of per-batch
+  loops on any path from a declared thread root (``batch_depths``), and
+  each sync site its local batch-loop nesting within its function;
+- a ``N/task`` or ``call`` site whose total per-batch multiplicity is
+  >= 1 is a finding: the declaration promises task-bounded (or
+  caller-owned) rate, but the engine statically reaches it once per
+  pumped batch;
+- a ``N/batch`` site at total depth >= 2 is a finding: it would scale
+  with batches *squared*.
+
+Loops are classified by idiom (``child_stream(...)``, ``.execute(...)``,
+``next_batch()`` — summaries.py); loops over columns, partitions, spill
+runs or retries don't count, matching the budget units. Sites that are
+genuinely rarer than their lexical position suggests (first-batch-only
+branches, cached probes) keep their tight budget and declare the proof
+the analysis can't see: ``# auronlint: disable=R9 -- <why the branch is
+bounded>``.
+"""
+
+from __future__ import annotations
+
+from tools.auronlint.core import Rule
+
+
+class BudgetProofRule(Rule):
+    name = "R9"
+    doc = "sync-point budgets must match static loop/call multiplicity"
+
+    def check_tree(self, root: str):
+        from tools.auronlint.callgraph import build_graph
+
+        yield from analyze(build_graph(root))
+
+
+def analyze(g):
+    depths = g.batch_depths()
+    for q, fs in sorted(g.functions.items()):
+        if not fs.sync_sites:
+            continue
+        call_depth = depths.get(q, 0)
+        for s in fs.sync_sites:
+            total = min(call_depth + s.batch_depth, 2)
+            where = _explain(call_depth, s.batch_depth)
+            if s.unit in ("task", "call") and total >= 1:
+                promise = (
+                    f"{s.count}/task" if s.unit == "task" else "call"
+                )
+                owner = (
+                    "task-bounded" if s.unit == "task"
+                    else "caller-owned (`call`)"
+                )
+                yield fs.rel, s.line, (
+                    f"sync-point({promise}) in '{_short(q)}' is {owner}, "
+                    f"but the site is statically reachable {where} — "
+                    "that is a per-batch sync tax; re-budget it as "
+                    "N/batch, hoist it out of the loop, or declare the "
+                    "bounding branch (`# auronlint: disable=R9 -- <why>`)"
+                )
+            elif s.unit == "batch" and total >= 2:
+                yield fs.rel, s.line, (
+                    f"sync-point({s.count}/batch) in '{_short(q)}' sits "
+                    f"{where} — it would scale with batches SQUARED; "
+                    "hoist the inner read or prove the outer loop is not "
+                    "per-batch (`# auronlint: disable=R9 -- <why>`)"
+                )
+
+
+def _explain(call_depth: int, local_depth: int) -> str:
+    bits = []
+    if local_depth:
+        bits.append(f"inside {local_depth} per-batch loop(s) locally")
+    if call_depth:
+        bits.append(
+            f"through call paths crossing {call_depth} per-batch loop(s)"
+        )
+    return " and ".join(bits) or "outside any per-batch loop"
+
+
+def _short(q: str) -> str:
+    return q.split("::", 1)[-1]

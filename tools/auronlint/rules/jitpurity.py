@@ -1,0 +1,99 @@
+"""R10 — jit-boundary purity.
+
+Whole-stage fusion (ROADMAP item 2) will pull ever more Python code
+inside ``jax.jit`` boundaries. Code inside a jit traces ONCE per
+(shape, static-args) key and replays as a compiled program — any
+Python-side effect in there is a landmine: it fires at trace time only
+(silently stale on cache hits), or worse, bakes a thread-local value into
+a program other tasks reuse. This mirrors the reference's strict JNI
+ownership discipline at its native boundary (PAPER.md, JniBridge): what
+crosses the boundary is data, never ambient context.
+
+Traced region = functions decorated/wrapped with ``jax.jit`` plus their
+call-graph closure over *tight* edges (unknown-receiver method matches
+are too weak to claim "this is traced" — see callgraph.py). Findings
+inside it:
+
+- ``active_conf()`` / ``current_context()`` / thread-local reads — the
+  resolved value is frozen into the compiled program (retrace hazard AND
+  a cross-task context leak); resolve the knob OUTSIDE the jit and pass
+  it as a static argument (the ``_sort_flags`` pattern);
+- host transfers (``.item()``, ``.tolist()``, ``device_get``) — a
+  transfer inside a trace forces concretization;
+- mutation of captured state: ``self.<attr>`` writes, ``global`` /
+  ``nonlocal`` rebinding, mutating calls or subscript writes on closure/
+  module names — trace-time-only effects that vanish on cache hits.
+
+``jax.pure_callback`` is the sanctioned escape hatch (host sorts) and is
+not flagged — its *target* runs on host and is excluded from the traced
+closure. Deliberate trace-time effects (e.g. a compile-cache insert)
+declare themselves: ``# auronlint: disable=R10 -- <why>``.
+"""
+
+from __future__ import annotations
+
+from tools.auronlint.core import Rule
+
+
+class JitPurityRule(Rule):
+    name = "R10"
+    doc = "jit purity: no side effects or context reads inside traces"
+
+    def check_tree(self, root: str):
+        from tools.auronlint.callgraph import build_graph
+
+        yield from analyze(build_graph(root))
+
+
+def analyze(g):
+    traced = g.jit_reachable()
+    for q in sorted(traced):
+        fs = g.functions.get(q)
+        if fs is None:
+            continue
+        how = (
+            "a jit entry" if traced[q] == "entry"
+            else f"traced via '{_short(traced[q])}'"
+        )
+        for cr in fs.conf_reads:
+            yield fs.rel, cr.line, (
+                f"active_conf() inside '{_short(q)}' ({how}) bakes the "
+                "resolved value into the compiled program — resolve the "
+                "knob outside the jit and pass it as a static argument"
+            )
+        for line in fs.tlocal_reads:
+            yield fs.rel, line, (
+                f"thread-local context read inside '{_short(q)}' ({how}) "
+                "freezes one thread's context into a shared compiled "
+                "program — pass the value in as an argument"
+            )
+        for line, what in fs.host_transfers:
+            yield fs.rel, line, (
+                f"{what} inside '{_short(q)}' ({how}) forces host "
+                "concretization during tracing — keep the value on "
+                "device or move the read outside the jit boundary"
+            )
+        for w in fs.attr_writes:
+            if w.in_init:
+                continue
+            yield fs.rel, w.line, (
+                f"write to self.{w.attr} inside '{_short(q)}' ({how}) is "
+                "a trace-time-only effect — it happens once per compile, "
+                "not once per call; return the value instead"
+            )
+        for line, name in fs.global_writes:
+            yield fs.rel, line, (
+                f"global/nonlocal rebinding of '{name}' inside "
+                f"'{_short(q)}' ({how}) is a trace-time-only effect — "
+                "return the value instead"
+            )
+        for line, desc in fs.captured_mutations:
+            yield fs.rel, line, (
+                f"{desc} inside '{_short(q)}' ({how}) mutates captured "
+                "state at trace time only — it will not replay on cache "
+                "hits; return the value instead"
+            )
+
+
+def _short(q: str) -> str:
+    return q.split("::", 1)[-1]

@@ -1,0 +1,90 @@
+"""R8 — lock discipline for cross-root shared state.
+
+PR 3's second hand-found concurrency bug was an unlocked check-and-set:
+the operator thread and a cross-thread spill merge both saw a Batch's
+collision flag unset and double-counted ``fp_collision_batches`` (now
+``_FP_FLAG_LOCK``). The generalization: an instance attribute *written*
+by code reachable from two different declared thread roots is a shared
+variable two threads can race on, and every such write must visibly hold
+a lock.
+
+Mechanics:
+
+- roots are the ``thread-root`` declarations (BOTH kinds — the pump and a
+  spill are different threads even though the pump installs conf_scope);
+- writes are ``self.<attr> = / += ...`` outside ``__init__`` (object
+  construction happens-before publication);
+- a write is *guarded* when it sits lexically inside ``with <lock-like>:``
+  (anything whose expression reads as a lock/condition/guard), or when it
+  carries the declaration ``# auronlint: guarded-by(<lock>) -- <why>``
+  for locks taken by a caller (the reason documents the protocol, the
+  same stance as ``sync-point``).
+
+Findings name the racing roots so the reader knows which two threads
+collide. Attributes written from a single root stay silent — per-task
+state touched only by its own pump needs no lock.
+"""
+
+from __future__ import annotations
+
+from tools.auronlint.core import Rule
+from tools.auronlint.summaries import escaping_class_names
+
+
+class LockGuardRule(Rule):
+    name = "R8"
+    doc = "lock discipline: cross-root attribute writes must hold a lock"
+
+    def check_tree(self, root: str):
+        from tools.auronlint.callgraph import build_graph
+
+        yield from analyze(build_graph(root))
+
+
+def analyze(g):
+    rr = g.roots_reaching()
+    # a class whose instances never escape one function's locals anywhere
+    # in the package cannot be shared between roots (the Cursor/Decoder
+    # per-call parser pattern) — code reachability is not object sharing
+    class_names = {fs.cls for fs in g.functions.values() if fs.cls}
+    shared_classes: set = set()
+    for ms in g.modules.values():
+        shared_classes |= escaping_class_names(ms, class_names)
+    # (rel, class, attr) -> [(qualname, AttrWrite, roots)]
+    groups: dict[tuple, list] = {}
+    for q, fs in g.functions.items():
+        if fs.cls is None or not fs.attr_writes:
+            continue
+        if fs.cls not in shared_classes:
+            continue
+        roots = rr.get(q, set())
+        if not roots:
+            continue
+        for w in fs.attr_writes:
+            if w.in_init:
+                continue
+            groups.setdefault((fs.rel, fs.cls, w.attr), []).append(
+                (q, w, roots)
+            )
+    for (rel, cls, attr), sites in sorted(groups.items()):
+        all_roots = set()
+        for _, _, roots in sites:
+            all_roots |= roots
+        if len(all_roots) < 2:
+            continue
+        root_names = ", ".join(
+            sorted(r.split("::", 1)[-1] for r in all_roots)
+        )
+        for q, w, _ in sites:
+            if w.in_lock:
+                continue
+            ms = g.modules.get(rel)
+            if ms is not None and ms.mod.guard_for(w.line) is not None:
+                continue
+            yield rel, w.line, (
+                f"{cls}.{attr} is written from {len(all_roots)} thread "
+                f"roots ({root_names}) but this write holds no visible "
+                "lock — wrap it in `with <lock>:` or declare "
+                "`# auronlint: guarded-by(<lock>) -- <why>` if a caller "
+                "holds it"
+            )

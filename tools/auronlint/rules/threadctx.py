@@ -1,0 +1,140 @@
+"""R7 — thread-context escape.
+
+``active_conf()`` (utils/config.py) and its siblings are *thread-local*:
+they resolve the Configuration installed by the current thread's
+``conf_scope``. The task pump installs its task's conf; a spill dispatched
+by the MemManager, an HTTP handler, an RSS net thread, or an async-window
+harvest callback runs on a thread that did NOT — so a thread-local read
+there resolves a FOREIGN task's knobs (or the process global). PR 3's
+post-review found exactly this twice by hand: a cross-thread spill merge
+resolving another task's ``fp.bits``, and a spill-thread host-sort fork
+reading the wrong substrate. R7 finds the pattern by machine:
+
+- roots are declared in-source: ``# auronlint: thread-root(foreign)`` on
+  the entry ``def`` (spill impls, handlers, net serve loops);
+  ``thread-root(conf-scoped)`` marks entries that install their own
+  ``conf_scope`` (the task pump) and is exempt here;
+- the call graph (tools/auronlint/callgraph.py) propagates *conf state*
+  from foreign roots: a function is fine when EVERY foreign path hands it
+  a threaded ``conf`` argument, suspect when some path arrives bare;
+- findings: any bare ``active_conf()`` / ``current_context()`` /
+  thread-local attribute read in a foreign-reachable function, and any
+  *guarded* read (``conf if conf is not None else active_conf()``) in a
+  function some foreign path reaches without passing ``conf``.
+
+The fix is the PR 3 idiom: take ``conf`` as a parameter, default None,
+resolve ``conf if conf is not None else active_conf()``, and make every
+cross-thread caller pass the task's ``ctx.conf``. Residual sites that are
+*deliberately* process-global (e.g. a singleton built once from the
+global conf) carry ``# auronlint: disable=R7 -- <why>``.
+
+KNOWN LIMIT: an attribute-forwarded conf argument (``conf=self._conf``)
+is trusted as definite — the analysis cannot prove the attribute is
+non-None. Keep that trust honest structurally: objects that carry a conf
+across threads take it as a REQUIRED keyword at construction (the spill
+containers, memory/memmgr.py), so a dropped conf is a TypeError at the
+owning call site, not a silent foreign-thread fallback.
+"""
+
+from __future__ import annotations
+
+from tools.auronlint.core import Rule
+from tools.auronlint.summaries import tlocal_attr_reads
+
+#: the thread-local mechanism itself — reading the thread-local IS the
+#: semantics there: config.py defines active_conf/conf_scope, and
+#: profiling.py's per-thread async-read marker deliberately tags
+#: whichever thread performs the harvest
+MECHANISM_RELS = (
+    "auron_tpu/utils/config.py",
+    "auron_tpu/utils/profiling.py",
+)
+
+
+class ThreadContextRule(Rule):
+    name = "R7"
+    doc = "thread-context escape: thread-local reads on foreign threads"
+
+    def check_tree(self, root: str):
+        from tools.auronlint.callgraph import build_graph
+
+        yield from analyze(build_graph(root))
+
+
+def analyze(g):
+    """(rel, line, message) findings over a built CallGraph."""
+    from tools.auronlint.callgraph import NO_CONF
+
+    # a declaration that anchored to something other than a def (or its
+    # decorators) would silently disable reachability from that root —
+    # the opposite of fail-loud; report it even with zero other findings
+    for ms in g.modules.values():
+        for line in ms.unanchored_roots:
+            yield ms.rel, line, (
+                "thread-root declaration does not anchor to a function "
+                "definition — the root is silently dropped; put the "
+                "comment on (or directly above) the `def` line"
+            )
+
+    states = g.foreign_conf_states()
+    if not states:
+        return
+    # a foreign root reaching each function, for the message
+    witness: dict[str, str] = {}
+    rr = g.roots_reaching()
+    for q in states:
+        for r in sorted(rr.get(q, ())):
+            if g.roots.get(r) == "foreign":
+                witness[q] = r
+                break
+
+    for q, s in sorted(states.items()):
+        fs = g.functions.get(q)
+        if fs is None or fs.rel in MECHANISM_RELS:
+            continue
+        via = witness.get(q, "a foreign thread root")
+        via_name = via.split("::", 1)[-1] if "::" in via else via
+        for cr in fs.conf_reads:
+            if cr.in_conf_scope:
+                continue
+            if not cr.guarded:
+                yield fs.rel, cr.line, (
+                    f"active_conf() in '{_short(q)}' is reachable from "
+                    f"foreign thread root '{via_name}' — it would resolve "
+                    "another task's conf there; take a threaded `conf` "
+                    "parameter and resolve `conf if conf is not None else "
+                    "active_conf()` (the PR 3 fp.bits lesson)"
+                )
+            elif s == NO_CONF:
+                yield fs.rel, cr.line, (
+                    f"'{_short(q)}' guards active_conf() behind a `conf` "
+                    f"parameter, but the path from foreign root "
+                    f"'{via_name}' reaches it WITHOUT passing conf — the "
+                    "fallback fires on the wrong thread; thread ctx.conf "
+                    "through that call chain"
+                )
+        for line in fs.tlocal_reads:
+            yield fs.rel, line, (
+                f"thread-local context read in '{_short(q)}' is reachable "
+                f"from foreign thread root '{via_name}' — the value "
+                "belongs to whichever thread runs the code, not to the "
+                "task; plumb the context explicitly"
+            )
+
+    # direct attribute reads of module-level threading.local() objects
+    for ms in g.modules.values():
+        if ms.rel in MECHANISM_RELS:
+            continue
+        for q, line in tlocal_attr_reads(ms):
+            if q in states:
+                via = witness.get(q, "a foreign thread root")
+                via_name = via.split("::", 1)[-1] if "::" in via else via
+                yield ms.rel, line, (
+                    f"threading.local attribute read in '{_short(q)}' is "
+                    f"reachable from foreign thread root '{via_name}' — "
+                    "thread the value through instead"
+                )
+
+
+def _short(q: str) -> str:
+    return q.split("::", 1)[-1]

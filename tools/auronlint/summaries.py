@@ -1,0 +1,599 @@
+"""Per-function summaries for the interprocedural rules (R7-R10).
+
+``SourceModule`` (core.py) models one file; the interprocedural rules need
+per-*function* facts cheap enough to compute for every function in the
+package and small enough to propagate over the call graph
+(tools/auronlint/callgraph.py):
+
+- call sites, each with its enclosing-loop context (how many of the loops
+  around it iterate a *batch stream* — the multiplicity R9 proves sync
+  budgets against) and whether it happens under an installed
+  ``conf_scope`` (which neutralizes thread-locality, R7);
+- thread-local reads: ``active_conf()`` / ``current_context()`` calls —
+  split into *guarded* (the ``conf if conf is not None else active_conf()``
+  threading idiom) and bare — plus attribute reads of module-level
+  ``threading.local()`` objects;
+- ``self.<attr>`` writes outside ``__init__`` with their lexical lock
+  context (inside ``with <something lock-like>:`` or not) — R8's input;
+- declared sync points mapped into their enclosing function with their
+  local batch-loop depth — R9's input;
+- jit-entry detection (decorated or wrapped) and the effect sets R10
+  flags inside traced code: host transfers, global/nonlocal writes,
+  mutation of captured (closure/module) state.
+
+Everything here is a *syntactic over-approximation*: names are not
+type-resolved and loops are classified by idiom (``child_stream(...)``,
+``.execute(...)``, ``next_batch()``). That is the deal the whole linter
+makes — conservative, annotation-escapable, zero-dependency.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.auronlint.core import SourceModule, parse_sync_budget
+
+#: thread-local accessor functions whose *call* is a thread-context read
+TLOCAL_CALLEES = {"active_conf", "current_context"}
+
+#: iteration expressions that denote a per-batch stream. ``child_stream``
+#: and ``execute``/``_execute`` are the operator protocol (exec/base.py);
+#: ``next_batch``/``next_arrow`` the runtime pull; ``partitioned_stream``
+#: the shuffle writer's repartition pump; ``__iter__`` of TaskRuntime.
+_BATCH_ITER_RE = re.compile(
+    r"child_stream\(|\.execute\(|\b_execute\(|next_batch\(|next_arrow\("
+    r"|partitioned_stream\(|\.batch_stream\b"
+)
+
+#: with-items that read as a lock acquisition for R8's lexical check
+_LOCK_TEXT_RE = re.compile(r"lock|mutex|guard|_cv\b|cond", re.IGNORECASE)
+
+#: receiver methods that mutate their receiver (captured-state mutation
+#: detection for R10)
+_MUTATOR_METHODS = {
+    "append", "extend", "add", "update", "setdefault", "insert", "remove",
+    "discard", "clear", "pop", "popleft", "appendleft",
+}
+
+
+@dataclass
+class CallSite:
+    name: str              # rightmost callee name ("spill", "encode_block")
+    recv: str | None       # receiver root: None (bare name), "self", or the
+                           # root Name of the attribute chain ("mod", "obj")
+    line: int
+    node: ast.Call
+    batch_depth: int       # enclosing batch-stream loops in this function
+    loop_depth: int        # enclosing loops of any kind
+    in_conf_scope: bool    # lexically under `with conf_scope(...):`
+
+
+@dataclass
+class ConfRead:
+    line: int
+    guarded: bool          # fallback arm of a conf-parameter default
+    in_conf_scope: bool
+
+
+@dataclass
+class AttrWrite:
+    attr: str
+    line: int
+    in_lock: bool          # lexically inside a with-lock block
+    lock_text: str         # innermost lock-like with-item ("self._lock")
+    in_init: bool          # inside __init__/__new__/__post_init__
+
+
+@dataclass
+class SyncSite:
+    line: int
+    batch_depth: int       # enclosing batch loops in this function
+    count: int
+    unit: str              # "batch" | "task" | "call"
+    reason: str
+
+
+@dataclass
+class FunctionSummary:
+    qualname: str          # "rel::Class.method" / "rel::func" /
+                           # "rel::outer.<locals>.inner"
+    rel: str
+    name: str
+    cls: str | None
+    lineno: int
+    end_lineno: int
+    params: tuple = ()
+    conf_param: int | None = None     # index of a parameter literally
+                                      # named "conf" (the threading idiom)
+    root_kind: str | None = None      # "foreign" | "conf-scoped" | None
+    is_jit: bool = False
+    calls: list = field(default_factory=list)           # [CallSite]
+    conf_reads: list = field(default_factory=list)      # [ConfRead]
+    tlocal_reads: list = field(default_factory=list)    # [int]
+    attr_writes: list = field(default_factory=list)     # [AttrWrite]
+    sync_sites: list = field(default_factory=list)      # [SyncSite]
+    host_transfers: list = field(default_factory=list)  # [(line, what)]
+    global_writes: list = field(default_factory=list)   # [(line, name)]
+    captured_mutations: list = field(default_factory=list)  # [(line, desc)]
+    local_names: set = field(default_factory=set)
+
+
+@dataclass
+class ModuleSummary:
+    rel: str
+    mod: SourceModule
+    functions: dict = field(default_factory=dict)   # qualname -> summary
+    #: thread-root declarations whose anchor line is not a def (or its
+    #: decorator) — a silently-dropped root would disable reachability,
+    #: so R7 reports these loudly
+    unanchored_roots: list = field(default_factory=list)  # [line]
+    #: import alias -> dotted module ("hostsort" -> "auron_tpu.ops.hostsort")
+    mod_imports: dict = field(default_factory=dict)
+    #: from-imported name -> (dotted module, original name)
+    name_imports: dict = field(default_factory=dict)
+    #: class name -> [base class names in this module's namespace]
+    class_bases: dict = field(default_factory=dict)
+    #: names bound to threading.local() at module level
+    tlocal_names: set = field(default_factory=set)
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _is_batch_iter(expr: ast.AST, assigns: dict, _seen: frozenset = frozenset()) -> bool:
+    """Does this for-loop iterable denote a per-batch stream? Follows one
+    level of cheap name assignment with a cycle guard (the R6 lesson:
+    self-referential reassignment must not recurse forever)."""
+    if isinstance(expr, ast.Name):
+        if expr.id in _seen:
+            return False
+        src = assigns.get(expr.id)
+        if src is not None:
+            return _is_batch_iter(src, assigns, _seen | {expr.id})
+        return False
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in ("enumerate", "zip", "reversed", "iter"):
+            return any(_is_batch_iter(a, assigns, _seen) for a in expr.args)
+    return bool(_BATCH_ITER_RE.search(_unparse(expr)))
+
+
+def _guarded_conf_call(call: ast.Call, parents: dict) -> bool:
+    """Is this ``active_conf()`` call the fallback arm of the threading
+    idiom — ``conf if conf is not None else active_conf()`` or
+    ``conf or active_conf()``? (R7 then only complains when some foreign
+    path can reach the function without passing ``conf``.)"""
+    p = parents.get(id(call))
+    if isinstance(p, ast.Attribute):  # (... else active_conf()).get(opt)
+        p = parents.get(id(p))
+    if isinstance(p, ast.IfExp) and p.orelse is not None:
+        # the call must be the orelse arm (possibly through the Attribute)
+        node = p.orelse
+        return node is call or (
+            isinstance(node, ast.Attribute) and node.value is call
+        ) or _contains(node, call)
+    if isinstance(p, ast.BoolOp) and isinstance(p.op, ast.Or):
+        return p.values and _contains(p.values[-1], call)
+    return False
+
+
+def _contains(tree: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(tree))
+
+
+def _jit_decorated(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", []) or []:
+        if re.search(r"\bjit\b", _unparse(dec)):
+            return True
+    return False
+
+
+def _receiver(func: ast.AST) -> tuple[str, str | None]:
+    """(callee name, receiver) for a call's func expression. Only a
+    DIRECT Name receiver is meaningful (``self.m()``, ``alias.f()``);
+    chained receivers (``self.plan.execute()``) are ``<expr>`` — the
+    object's type is unknown, resolution must go through the package
+    method index, not the lexical class."""
+    if isinstance(func, ast.Name):
+        return func.id, None
+    if isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Name):
+            return func.attr, func.value.id
+        return func.attr, "<expr>"
+    return "", None
+
+
+def summarize_module(mod: SourceModule) -> ModuleSummary:
+    ms = ModuleSummary(rel=mod.rel, mod=mod)
+    tree = mod.tree
+
+    # ---- module-level facts -------------------------------------------
+    # imports are collected from the WHOLE tree: this codebase leans on
+    # function-local imports (cycle avoidance), and a call through a
+    # locally-imported alias must still resolve (`from ops import bitonic`
+    # inside _sort_flags feeds bitonic.sort_impl_for's edge)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                ms.mod_imports[a.asname or a.name.split(".")[-1]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                ms.name_imports[a.asname or a.name] = (node.module, a.name)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _unparse(node.value.func).endswith("threading.local") or \
+                    _unparse(node.value.func) == "local":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        ms.tlocal_names.add(t.id)
+
+    # thread-root declarations: line -> kind (the def sits on the declared
+    # line, or the next code line when the comment stands alone; a
+    # standalone above a DECORATED def anchors on the decorator line, so
+    # functions also claim their decorator lines below)
+    root_lines: dict[int, str] = {}
+    claimed_roots: set[int] = set()
+    for sup in mod.thread_roots():
+        root_lines[mod.anchor_line(sup)] = sup.budget
+
+    # sync points: line -> (count, unit, reason)
+    sync_lines: dict[int, tuple[int, str, str]] = {}
+    for sup in mod.suppressions:
+        if sup.kind != "sync-point":
+            continue
+        parsed = parse_sync_budget(sup.budget) if sup.budget else (1, "batch")
+        if parsed is None:
+            parsed = (1, "batch")
+        sync_lines[mod.anchor_line(sup)] = (parsed[0], parsed[1], sup.reason)
+
+    # functions wrapped as `g = jax.jit(f)` at any level
+    jit_wrapped: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and re.search(r"\bjit\b", _unparse(node.func)):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    jit_wrapped.add(a.id)
+
+    # ---- per-function walk --------------------------------------------
+
+    def walk_function(fn, qual: str, cls: str | None) -> None:
+        fs = FunctionSummary(
+            qualname=f"{mod.rel}::{qual}", rel=mod.rel, name=fn.name, cls=cls,
+            lineno=fn.lineno, end_lineno=fn.end_lineno or fn.lineno,
+        )
+        a = fn.args
+        params = [p.arg for p in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        )]
+        fs.params = tuple(params)
+        if "conf" in params:
+            fs.conf_param = params.index("conf")
+        for anchor in [fn.lineno] + [d.lineno for d in fn.decorator_list]:
+            if anchor in root_lines:
+                fs.root_kind = root_lines[anchor]
+                claimed_roots.add(anchor)
+                break
+        fs.is_jit = _jit_decorated(fn) or fn.name in jit_wrapped
+        in_init = fn.name in ("__init__", "__new__", "__post_init__")
+        ms.functions[fs.qualname] = fs
+
+        # one-pass assign map for batch-iter name following
+        assigns: dict[str, ast.AST] = {}
+        parents: dict[int, ast.AST] = {}
+        local_names = set(params)
+
+        def process(child, parent, batch_depth, loop_depth, lock_stack,
+                    conf_scoped):
+            """Classify ONE node in context, then recurse into it."""
+            parents[id(child)] = parent
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_names.add(child.name)
+                walk_function(child, f"{qual}.<locals>.{child.name}", cls)
+                return
+            if isinstance(child, ast.ClassDef):
+                # rare nested class: treat its methods as nested funcs
+                for sub in child.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        walk_function(
+                            sub, f"{qual}.<locals>.{child.name}.{sub.name}", cls
+                        )
+                return
+            b, l, locks, scoped = batch_depth, loop_depth, lock_stack, conf_scoped
+            if isinstance(child, ast.Assign):
+                if len(child.targets) == 1 and isinstance(child.targets[0], ast.Name):
+                    assigns[child.targets[0].id] = child.value
+                for t in child.targets:
+                    _collect_write(fs, t, child.lineno, lock_stack,
+                                   in_init, local_names)
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                if child.value is not None or isinstance(child, ast.AugAssign):
+                    _collect_write(fs, child.target, child.lineno,
+                                   lock_stack, in_init, local_names)
+            elif isinstance(child, ast.Global):
+                for n in child.names:
+                    fs.global_writes.append((child.lineno, n))
+            elif isinstance(child, ast.Nonlocal):
+                for n in child.names:
+                    fs.global_writes.append((child.lineno, n))
+            elif isinstance(child, (ast.For, ast.AsyncFor)):
+                # the ITERABLE is evaluated ONCE at the surrounding depth
+                # (stream creation); only the body runs per iteration — a
+                # `for b in child_stream(...)` loop must not attribute its
+                # own multiplicity to the stream-constructing call
+                process(child.iter, child, batch_depth, loop_depth,
+                        lock_stack, conf_scoped)
+                for t in _names_of(child.target):
+                    local_names.add(t)
+                l = loop_depth + 1
+                b = batch_depth + (
+                    1 if _is_batch_iter(child.iter, assigns) else 0
+                )
+                for part in ("body", "orelse"):
+                    for s in getattr(child, part, []) or []:
+                        process(s, child, b, l, locks, scoped)
+                return
+            elif isinstance(child, ast.While):
+                l = loop_depth + 1
+                body_text = _unparse(child)
+                b = batch_depth + (1 if "next_batch(" in body_text
+                                   or "next_arrow(" in body_text else 0)
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    text = _unparse(item.context_expr)
+                    if "conf_scope(" in text:
+                        scoped = True
+                    if _LOCK_TEXT_RE.search(text):
+                        locks = lock_stack + [text]
+                    if item.optional_vars is not None:
+                        for t in _names_of(item.optional_vars):
+                            local_names.add(t)
+            elif isinstance(child, ast.Call):
+                _collect_call(fs, ms, child, b, l, scoped, local_names,
+                              parents)
+            elif isinstance(child, ast.comprehension):
+                for t in _names_of(child.target):
+                    local_names.add(t)
+            for sub in ast.iter_child_nodes(child):
+                process(sub, child, b, l, locks, scoped)
+
+        def scan(node, batch_depth, loop_depth, lock_stack, conf_scoped):
+            for child in ast.iter_child_nodes(node):
+                process(child, node, batch_depth, loop_depth, lock_stack,
+                        conf_scoped)
+
+        scan(fn, 0, 0, [], False)
+        fs.local_names = local_names
+
+        # map declared sync points into this function by line coverage;
+        # innermost function wins (nested defs are walked separately and
+        # claim their own lines first — handled by the caller pass below)
+        for line, (count, unit, reason) in sync_lines.items():
+            if fn.lineno <= line <= (fn.end_lineno or fn.lineno):
+                fs.sync_sites.append(SyncSite(line, 0, count, unit, reason))
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_function(node, node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            ms.class_bases[node.name] = [
+                _unparse(b).split("[")[0] for b in node.bases
+            ]
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_function(sub, f"{node.name}.{sub.name}", node.name)
+
+    ms.unanchored_roots = sorted(set(root_lines) - claimed_roots)
+    _fix_sync_ownership(ms)
+    _fix_sync_loop_depth(ms)
+    return ms
+
+
+def _names_of(t: ast.AST) -> list[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out += _names_of(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _names_of(t.value)
+    return []
+
+
+def _collect_write(fs, target, line, lock_stack, in_init, local_names):
+    for t in ([target] if not isinstance(target, (ast.Tuple, ast.List))
+              else target.elts):
+        if isinstance(t, ast.Starred):
+            t = t.value
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+            if t.value.id == "self":
+                fs.attr_writes.append(AttrWrite(
+                    t.attr, line, bool(lock_stack),
+                    lock_stack[-1] if lock_stack else "", in_init,
+                ))
+        elif isinstance(t, ast.Subscript):
+            base = t.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id not in local_names \
+                    and base.id != "self":
+                fs.captured_mutations.append(
+                    (line, f"subscript write to captured '{base.id}'")
+                )
+        elif isinstance(t, ast.Name):
+            local_names.add(t.id)
+
+
+def _collect_call(fs, ms, call, batch_depth, loop_depth, conf_scoped,
+                  local_names, parents):
+    name, recv = _receiver(call.func)
+    if not name:
+        return
+    # thread-local reads -------------------------------------------------
+    if name in TLOCAL_CALLEES and recv in (None, "config", "base"):
+        if name == "active_conf":
+            fs.conf_reads.append(ConfRead(
+                call.lineno, _guarded_conf_call(call, parents), conf_scoped,
+            ))
+        else:
+            fs.tlocal_reads.append(call.lineno)
+        return
+    # host transfers (R10's traced-effect set) ---------------------------
+    if name in ("item", "tolist") and not call.args and not call.keywords:
+        fs.host_transfers.append((call.lineno, f".{name}()"))
+    elif name == "device_get":
+        fs.host_transfers.append((call.lineno, "device_get"))
+    # captured-state mutation (R10) --------------------------------------
+    # only a DIRECT name receiver counts as captured-state mutation —
+    # chained receivers are mostly the functional `.at[i].add()` idiom
+    # (pure, returns a new array), not python-side mutation
+    if name in _MUTATOR_METHODS and recv is not None and \
+            recv not in local_names and recv not in ("<call>", "<expr>", "self"):
+        fs.captured_mutations.append(
+            (call.lineno, f".{name}() on captured '{recv}'")
+        )
+    fs.calls.append(CallSite(
+        name, recv, call.lineno, call, batch_depth, loop_depth, conf_scoped,
+    ))
+
+
+def _fix_sync_ownership(ms: ModuleSummary) -> None:
+    """A sync-point line inside a nested function was claimed by every
+    enclosing def; keep only the innermost (smallest span) owner."""
+    by_line: dict[int, list] = {}
+    for fs in ms.functions.values():
+        for s in fs.sync_sites:
+            by_line.setdefault(s.line, []).append((fs, s))
+    for line, owners in by_line.items():
+        if len(owners) <= 1:
+            continue
+        owners.sort(key=lambda p: p[0].end_lineno - p[0].lineno)
+        for fs, s in owners[1:]:
+            fs.sync_sites.remove(s)
+
+
+def _fix_sync_loop_depth(ms: ModuleSummary) -> None:
+    """Batch-loop depth of each sync site = depth of the nearest call
+    site on the same line, else the nearest preceding call in the same
+    function (the declaration anchors a transfer expression, which the
+    call walk has already contextualized)."""
+    for fs in ms.functions.values():
+        for s in fs.sync_sites:
+            best = None
+            for c in fs.calls:
+                d = abs(c.line - s.line)
+                if best is None or d < best[0]:
+                    best = (d, c.batch_depth)
+            if best is not None and best[0] <= 3:
+                s.batch_depth = best[1]
+
+
+#: thread-local attribute reads (``_local.conf``) are handled per module:
+def tlocal_attr_reads(ms: ModuleSummary) -> list[tuple[str, int]]:
+    """(qualname, line) for reads of module-level threading.local()
+    objects inside functions (``getattr(_local, ...)`` included)."""
+    out = []
+    if not ms.tlocal_names:
+        return out
+    for fs in ms.functions.values():
+        node = _find_def(ms.mod.tree, fs)
+        if node is None:
+            continue
+        for n in ast.walk(node):
+            hit = None
+            if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                    and n.value.id in ms.tlocal_names:
+                hit = n.lineno
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in ("getattr", "setattr") and n.args \
+                    and isinstance(n.args[0], ast.Name) \
+                    and n.args[0].id in ms.tlocal_names:
+                hit = n.lineno
+            if hit is not None:
+                out.append((fs.qualname, hit))
+    return out
+
+
+def _find_def(tree: ast.AST, fs: FunctionSummary):
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.lineno == fs.lineno and n.name == fs.name:
+            return n
+    return None
+
+
+def escaping_class_names(ms: ModuleSummary, class_names: set) -> set:
+    """Class names (from ``class_names``) whose instances ESCAPE a single
+    function invocation in this module: stored into an attribute/
+    subscript/module global, passed as a call argument, returned or
+    yielded — directly or through a local name. A class that never
+    escapes anywhere in the package is function-local by construction;
+    its instances cannot be shared between two thread roots, so R8
+    excludes it (the Cursor/Decoder parser-object pattern)."""
+    escaped: set = set()
+
+    def inst_name(node) -> str | None:
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        return name if name in class_names else None
+
+    def scan_scope(stmts, module_level: bool):
+        # local name -> class name bound from an instantiation (this scope)
+        bound: dict[str, str] = {}
+
+        def esc_value(expr) -> None:
+            """The expression's value escapes: instantiations and bound
+            instance names inside it escape with it. Attribute reads do
+            NOT escape the object (`f(c.pos)` passes a field's value)."""
+            if isinstance(expr, ast.Attribute):
+                return
+            cn = inst_name(expr)
+            if cn:
+                escaped.add(cn)
+            elif isinstance(expr, ast.Name) and expr.id in bound:
+                escaped.add(bound[expr.id])
+            for child in ast.iter_child_nodes(expr):
+                esc_value(child)
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_scope(node.body, False)
+                return
+            if isinstance(node, ast.ClassDef):
+                scan_scope(node.body, False)
+                return
+            if isinstance(node, ast.Assign):
+                cn = inst_name(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and cn:
+                        if module_level:
+                            escaped.add(cn)  # module-global instance
+                        else:
+                            bound[t.id] = cn
+                    elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                        esc_value(node.value)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None:
+                    esc_value(node.value)
+            elif isinstance(node, ast.Call):
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    esc_value(a)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for s in stmts:
+            visit(s)
+
+    scan_scope(ms.mod.tree.body, True)
+    return escaped

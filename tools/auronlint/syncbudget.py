@@ -61,9 +61,10 @@ def collect_sync_points(root: str, subdir: str = "auron_tpu") -> list[SyncPoint]
             if parsed is None:
                 parsed = (1, "batch")  # malformed: worst case (also a finding)
             count, unit = parsed
-            # a standalone comment declares the NEXT line (the call site
-            # the runtime frame will report)
-            line = sup.line + 1 if sup.standalone else sup.line
+            # a standalone comment declares the next CODE line (the call
+            # site the runtime frame will report; stacked annotation
+            # comments in between are skipped)
+            line = mod.anchor_line(sup)
             out.append(SyncPoint(rel, line, count, unit, sup.reason))
     return out
 

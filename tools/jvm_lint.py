@@ -487,9 +487,10 @@ def run_all() -> list[str]:
 if __name__ == "__main__":
     import sys
 
-    if "--json" in sys.argv:
+    if "--json" in sys.argv or "--sarif" in sys.argv:
         rep = run_report()
-        print(rep.to_json())
+        # one shared emitter pair for both gates (tools/auronlint/report.py)
+        print(rep.to_sarif() if "--sarif" in sys.argv else rep.to_json())
         raise SystemExit(0 if rep.ok() else 1)
     problems = run_all()
     for p in problems:
