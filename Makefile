@@ -1,4 +1,4 @@
-.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate
+.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate obscheck
 
 # keep `make` (no target) regenerating the proto, as before the lint gate
 .DEFAULT_GOAL := proto
@@ -28,6 +28,15 @@ lint-changed:
 # (tools/perfcheck.py; budgets parsed by tools/auronlint/syncbudget.py).
 perfcheck:
 	JAX_PLATFORMS=cpu python tools/perfcheck.py
+
+# Observability overhead gate (docs/observability.md): replays the same
+# tiny q3-class pipeline in no-obs / obs-off / flight-recorder subprocess
+# configurations and fails when obs-off exceeds 2% or the always-on
+# flight recorder exceeds 5% wall over the no-obs baseline; also
+# sanity-checks a full-trace run's Perfetto artifact + the span-vs-
+# metrics op-seconds cross-check (tools/obscheck.py).
+obscheck:
+	JAX_PLATFORMS=cpu python tools/obscheck.py
 
 proto:
 	protoc --python_out=. auron_tpu/proto/plan.proto

@@ -94,6 +94,9 @@ def call_native(task_bytes: bytes) -> int:
     """Start a task from a serialized TaskDefinition; returns a handle."""
     with _lock:
         resources = dict(_resources)
+    # session-set obs knobs apply inside TaskRuntime.__init__, BEFORE its
+    # pump thread starts (a post-start apply would race the task's own
+    # span installation); only the HTTP service starts lazily here
     rt = TaskRuntime(task_bytes, resources=resources, shared=_resources)
     # conf-gated observability service (auron/src/http analog)
     from auron_tpu.utils.httpsvc import maybe_start_from_conf

@@ -12,6 +12,8 @@ from __future__ import annotations
 import time
 from contextlib import contextmanager
 
+from auron_tpu import obs
+
 
 class MetricNode:
     def __init__(self, name: str = "", children: list["MetricNode"] | None = None):
@@ -59,21 +61,50 @@ class MetricNode:
         TIME_SUFFIXES suffix); with ``count`` also bump
         ``{metric}_n`` — hot loops use it so breakdowns can express
         per-batch multiplicities (sync-budget checks divide site counts by
-        these), not just totals."""
+        these), not just totals.
+
+        The SAME dt is handed to the span timeline (obs.note_op): the
+        flight recorder's per-operator compute segments and this metric
+        tree are two renderings of one measurement, which is what lets
+        bench/perf_gate cross-check span-derived op totals against the
+        MetricNode rollup without tolerance games."""
         t0 = time.perf_counter_ns()
         try:
             yield
         finally:
-            self.add(metric, time.perf_counter_ns() - t0)
+            dt = time.perf_counter_ns() - t0
+            self.add(metric, dt)
             if count:
                 self.add(metric + "_n", 1)
+            obs.note_op(self.name, metric, dt)
 
     def snapshot(self) -> dict:
-        """Flatten to {name: {metric: value}, children: [...]} for the bridge."""
+        """Flatten to {name: {metric: value}, children: [...]} for the bridge.
+
+        Tolerant of concurrent mutation: operator threads add()/child()
+        while observers (httpsvc /metrics, /metrics.prom) snapshot a LIVE
+        task's tree. The contract is "snapshot never raises": the
+        retry-then-degrade guards the ``RuntimeError: dictionary changed
+        size during iteration`` class of failure. (On today's CPython a
+        C-level ``dict(d)`` copy of a str-keyed dict is GIL-atomic, so
+        the retry is defense-in-depth — the contract must hold on
+        interpreters/subclasses where the copy re-enters Python, not
+        just on the current fast path.)"""
+        vals = None
+        for _ in range(1000):
+            try:
+                vals = dict(self.values)
+                break
+            except RuntimeError:
+                continue
+        if vals is None:  # pragma: no cover — 1000 straight collisions
+            vals = {}
+        # (list copies don't need the retry: concurrent child() appends
+        # cannot raise during list(); the racing child is simply in or out)
         return {
             "name": self.name,
-            "values": dict(self.values),
-            "children": [c.snapshot() for c in self.children],
+            "values": vals,
+            "children": [c.snapshot() for c in list(self.children)],
         }
 
     def total(self, metric: str) -> int:

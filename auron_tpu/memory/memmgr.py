@@ -36,8 +36,10 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
+import time
 from typing import Protocol
 
+from auron_tpu import obs
 from auron_tpu.utils.config import (
     HBM_BUDGET_BYTES,
     HOST_SPILL_BUDGET_BYTES,
@@ -101,6 +103,10 @@ class MemManager:
         self._released = threading.Condition(self._lock)
         self._consumers: list[MemConsumer] = []
         self._spillable: dict[int, bool] = {}
+        # owning span captured at register(): registration happens on the
+        # owning task's thread, so a spill dispatched LATER by a foreign
+        # thread still attributes to the owner's trace (obs/span.py)
+        self._owner_spans: dict[int, object] = {}
         self.num_spills = 0
         self.num_waits = 0
         self._wait_timeout = float(conf.get(MEM_WAIT_TIMEOUT_S))
@@ -124,12 +130,14 @@ class MemManager:
         with self._lock:
             self._consumers.append(consumer)
             self._spillable[id(consumer)] = spillable
+            self._owner_spans[id(consumer)] = obs.current_span()
 
     def unregister(self, consumer: MemConsumer) -> None:
         with self._lock:
             if consumer in self._consumers:
                 self._consumers.remove(consumer)
             self._spillable.pop(id(consumer), None)
+            self._owner_spans.pop(id(consumer), None)
             # freed capacity: wake waiters blocked on the managed pool
             self._released.notify_all()
 
@@ -142,6 +150,22 @@ class MemManager:
     def total_used(self) -> int:
         with self._lock:
             return sum(c.mem_used() for c in self._consumers)
+
+    def mem_snapshot(self) -> dict:
+        """THE manager snapshot both observability surfaces render
+        (httpsvc /metrics JSON and /metrics.prom): budget, spill count,
+        per-consumer usage — taken under the lock, one definition so a
+        new field can't land on one endpoint and silently miss the
+        other."""
+        with self._lock:
+            return {
+                "budget_bytes": self.budget,
+                "num_spills": self.num_spills,
+                "consumers": [
+                    {"name": c.name, "mem_used": c.mem_used()}
+                    for c in self._consumers
+                ],
+            }
 
     def _pool_state(self) -> tuple[int, int, int]:
         """(total_used, managed_pool, num_spillables) — managed pool =
@@ -163,6 +187,26 @@ class MemManager:
         with self._lock:
             _, managed, n = self._pool_state()
             return consumer.mem_used() / max(managed / n, 1)
+
+    def _dispatch_spill(self, consumer: MemConsumer) -> int:
+        """Run ``consumer.spill()`` under the OWNING task's span (captured
+        at register()): spill enter/exit land on the owner's trace
+        timeline even when the memory manager dispatches the spill from a
+        foreign task's thread. Owner-less consumers record untraced —
+        NEVER against the executing thread's ambient span."""
+        if obs.core._mode == obs.MODE_OFF:  # keep the no-obs path bare
+            return consumer.spill()
+        sp = self._owner_spans.get(id(consumer))
+        t0 = time.perf_counter_ns()
+        with obs.use_span(sp):
+            freed = consumer.spill()
+        if freed:
+            # freed==0 attempts are not spills: num_spills skips them,
+            # and the two exported counts must agree (/metrics.prom vs
+            # /queries)
+            obs.note_spill(consumer.name, "spill",
+                           time.perf_counter_ns() - t0, freed, sp=sp)
+        return freed
 
     def update_mem_used(self, consumer: MemConsumer, old_used: int, new_used: int) -> None:
         """Reference growth protocol (lib.rs:330-410): growing past the
@@ -194,7 +238,7 @@ class MemManager:
                     return
         # self-spill without holding the manager lock (consumer locks are
         # ordered manager -> consumer; spill takes the consumer lock)
-        freed = consumer.spill()
+        freed = self._dispatch_spill(consumer)
         if freed:
             with self._lock:
                 # R8: concurrent growers from different task threads race
@@ -241,7 +285,7 @@ class MemManager:
                 break
             if gone or c.mem_used() == 0:
                 continue
-            if c.spill():
+            if self._dispatch_spill(c):
                 with self._lock:
                     self.num_spills += 1
         self.notify_released()
@@ -250,6 +294,18 @@ class MemManager:
 # ---------------------------------------------------------------------------
 # spill containers (host-RAM and disk tiers)
 # ---------------------------------------------------------------------------
+
+
+def _conf_trace_id(conf) -> int:
+    """Owning trace id carried by a spill container's conf (obs.trace.id,
+    threaded exactly like the compression codec: the executing thread may
+    be a foreign task's, its ambient context is NOT the owner's)."""
+    if conf is None:
+        return 0
+    try:
+        return int(conf.get(obs.OBS_TRACE_ID))
+    except Exception:
+        return 0
 
 
 class DiskSpill:
@@ -271,10 +327,15 @@ class DiskSpill:
     def write_table(self, tbl) -> None:
         from auron_tpu.exec.shuffle.format import encode_block
 
+        obs_on = obs.core._mode != obs.MODE_OFF
+        t0 = time.perf_counter_ns() if obs_on else 0
         blk = encode_block(tbl, conf=self._conf)
         with open(self.path, "ab") as f:
             f.write(blk)
         self._offsets.append(self._offsets[-1] + len(blk))
+        if obs_on:
+            obs.note_spill("DiskSpill", "write", time.perf_counter_ns() - t0,
+                           len(blk), trace_id=_conf_trace_id(self._conf))
 
     def read_tables(self):
         from auron_tpu.exec.shuffle.format import decode_blocks
@@ -361,6 +422,8 @@ class HostSpill:
     def write_table(self, tbl) -> None:
         from auron_tpu.exec.shuffle.format import encode_block
 
+        obs_on = obs.core._mode != obs.MODE_OFF
+        t0 = time.perf_counter_ns() if obs_on else 0
         blk = encode_block(tbl, conf=self._conf)
         with self._lock:
             if self._disk is not None:
@@ -376,11 +439,16 @@ class HostSpill:
             # the post-release admit re-added bytes a demotion had already
             # forgotten and re-inserted a demoted spill as resident)
             victims = _host_ledger.admit(self, len(blk), conf=self._conf)
+        if obs_on:
+            obs.note_spill("HostSpill", "write", time.perf_counter_ns() - t0,
+                           len(blk), trace_id=_conf_trace_id(self._conf))
         for v in victims:  # demote OUTSIDE our lock (lock order spill->ledger)
             v._demote()
 
     def _demote(self) -> None:  # auronlint: thread-root(foreign) -- ledger pressure demotes victims on whichever thread admitted the last block
         """Move resident blocks to disk (ledger pressure)."""
+        obs_on = obs.core._mode != obs.MODE_OFF
+        t0 = time.perf_counter_ns() if obs_on else 0
         with self._lock:
             if self._disk is not None or self._blocks is None:
                 return
@@ -392,6 +460,9 @@ class HostSpill:
             self._blocks, self._nbytes, self._admitted = [], 0, 0
             self._disk = disk
         _host_ledger.forget(self, freed)
+        if obs_on:
+            obs.note_spill("HostSpill", "demote", time.perf_counter_ns() - t0,
+                           freed, trace_id=_conf_trace_id(self._conf))
 
     @property
     def demoted(self) -> bool:

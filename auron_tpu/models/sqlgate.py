@@ -245,14 +245,32 @@ def run_case(case: SqlCase, frames: dict, mesh, catalog, n_parts: int,
     rec = {"query": case.name, "verbatim": case.verbatim, "ok": False,
            "error": None, "rows": None, "engine_s": None, "oracle_s": None}
     try:
-        lq = compile_text(case.sql, catalog, n_parts=n_parts)
-        drift = check_golden(case.name, plan_text(lq), update=update_goldens)
-        if drift:
-            rec["error"] = drift
-            return rec
-        t0 = time.perf_counter()
-        got = execute(lq, frames, mesh, conf=conf, cache=cache)
-        rec["engine_s"] = round(time.perf_counter() - t0, 3)
+        from auron_tpu import obs
+
+        # each corpus query runs as its own query trace: parse/bind/lower
+        # spans + the execution's task/op/sync events attribute to it, and
+        # its summary lands in the /queries ring (docs/observability.md)
+        with obs.query_trace(f"sql.{case.name}", conf=conf) as qt:
+            lq = compile_text(case.sql, catalog, n_parts=n_parts)
+            drift = check_golden(case.name, plan_text(lq),
+                                 update=update_goldens)
+            if drift:
+                rec["error"] = drift
+                # never ran: keep the aborted trace out of /queries (a
+                # clean tiny-wall summary would read as a fast success)
+                qt.keep = False
+                return rec
+            t0 = time.perf_counter()
+            got = execute(lq, frames, mesh,
+                          conf=qt.conf if qt.conf is not None else conf,
+                          cache=cache)
+            rec["engine_s"] = round(time.perf_counter() - t0, 3)
+        if qt.summary is not None:
+            rec["obs"] = {"trace_id": qt.summary["trace_id"]}
+            if obs.mode() == obs.MODE_TRACE:
+                # event counters only accumulate under full trace mode
+                rec["obs"].update({k: qt.summary[k] for k in
+                                   ("host_syncs", "compiles", "spills")})
         t0 = time.perf_counter()
         want = oracle_head(case.oracle(frames), case)
         rec["oracle_s"] = round(time.perf_counter() - t0, 3)

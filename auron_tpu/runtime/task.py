@@ -19,6 +19,7 @@ from typing import Iterator
 
 import pyarrow as pa
 
+from auron_tpu import obs
 from auron_tpu.columnar.batch import Batch
 from auron_tpu.exec.base import ExecOperator, ExecutionContext, TaskCancelled
 from auron_tpu.exec.metrics import MetricNode
@@ -50,6 +51,22 @@ class TaskRuntime:
             resources=resources or {},
             shared=shared,
         )
+        # session-set obs knobs (mode / ring size) must apply BEFORE the
+        # pump thread starts: a task that carries obs.mode=trace would
+        # otherwise race its own mode switch — the pump's span __enter__
+        # could still see mode off and the whole task would record
+        # span-less (trace_id 0), the exact misattribution this
+        # subsystem exists to prevent
+        obs.apply_conf(conf)
+        # span attribution for the pump thread (docs/observability.md):
+        # capture the CALLER's span here (call_native runs on the query's
+        # thread), and resolve the owning trace from the conf-threaded
+        # obs.trace.id — the R7 hand-off that keeps a task dispatched
+        # from a foreign thread attributed to its query
+        self._obs_parent = obs.current_span()
+        self._obs_trace = obs.get_trace(conf.get(obs.OBS_TRACE_ID))
+        if self._obs_trace is None and self._obs_parent is not None:
+            self._obs_trace = self._obs_parent.trace
         depth = conf.get(TOKIO_EQUIV_PREFETCH_DEPTH)
         self._queue: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._error: BaseException | None = None
@@ -71,7 +88,12 @@ class TaskRuntime:
 
         set_task_context(self.ctx.stage_id, self.ctx.partition_id)
         try:
-            with conf_scope(self.ctx.conf):
+            with conf_scope(self.ctx.conf), obs.span(
+                f"task s{self.ctx.stage_id}p{self.ctx.partition_id}",
+                cat="task", parent=self._obs_parent, trace=self._obs_trace,
+                arg={"stage": self.ctx.stage_id,
+                     "partition": self.ctx.partition_id},
+            ):
                 # INVARIANT: no compiled program launched from a pump may
                 # carry a host callback (pure_callback) — concurrent
                 # callback-bearing XLA:CPU computations wedge the intra-op
@@ -87,6 +109,7 @@ class TaskRuntime:
                         # per-batch denominator for sync-budget checks
                         # (tools/perfcheck.py); no-op unless profiling is on
                         counters.note_batch()
+                    obs.note_pump_batch()
                     if self._host_prefetch:
                         batch.prefetch_host()
                     self._queue.put(batch)
@@ -145,4 +168,9 @@ class TaskRuntime:
             self._thread.join(timeout=0.05)
             deadline -= 0.05
         self._check_error()
-        return self.ctx.metrics.snapshot()
+        snap = self.ctx.metrics.snapshot()
+        if self._obs_trace is not None:
+            # fold this task's metric rollup into the owning query trace
+            # (the metric half of the span-vs-metrics cross-check)
+            self._obs_trace.add_task_metrics(snap)
+        return snap

@@ -22,11 +22,13 @@ pipeline (docs/pipeline.md); the prediction half lives in
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Iterator
 
 import numpy as np
 
+from auron_tpu import obs
 from auron_tpu.utils.profiling import async_read_scope
 
 
@@ -35,6 +37,7 @@ def start_host_transfer(*arrays) -> None:
     array types without ``copy_to_host_async`` (numpy scalars, tracers in
     tests) simply skip — the later harvest then pays the transfer, which
     is exactly the pre-window behavior."""
+    obs.note_transfer_start(len(arrays))
     for a in arrays:
         copy = getattr(a, "copy_to_host_async", None)
         if copy is not None:
@@ -51,10 +54,15 @@ def harvest(*arrays) -> tuple[np.ndarray, ...]:  # auronlint: thread-root(foreig
     profiling hook — the C++ ``__array__`` fast path bypasses it."""
     import jax
 
+    obs_on = obs.core._mode != obs.MODE_OFF
+    t0 = time.perf_counter_ns() if obs_on else 0
     with async_read_scope():
-        return tuple(
+        out = tuple(
             np.asarray(x) for x in jax.device_get(arrays)  # auronlint: sync-point(1/batch) -- async-window harvest: transfer started k batches earlier, accounted as async_reads
         )
+    if obs_on:
+        obs.note_harvest(len(arrays), time.perf_counter_ns() - t0)
+    return out
 
 
 class TransferWindow:
@@ -74,15 +82,24 @@ class TransferWindow:
 
     def push(self, arrays: tuple, payload: Any) -> list[tuple[tuple, Any]]:
         start_host_transfer(*arrays)
-        self._q.append((arrays, payload))
+        # capture the pushing thread's span: harvests may run on whichever
+        # thread drains (cross-thread spill drains) and must attribute the
+        # read to the OWNING task's trace (docs/observability.md). Mode
+        # off keeps this per-batch path bare (no contextvar read).
+        sp = (obs.current_span()
+              if obs.core._mode != obs.MODE_OFF else None)
+        self._q.append((arrays, payload, sp))
         out = []
         while len(self._q) > self.depth:
             out.append(self._pop())
         return out
 
     def _pop(self) -> tuple[tuple, Any]:
-        arrays, payload = self._q.popleft()
-        return harvest(*arrays), payload
+        arrays, payload, sp = self._q.popleft()
+        if obs.core._mode == obs.MODE_OFF:
+            return harvest(*arrays), payload
+        with obs.use_span(sp):
+            return harvest(*arrays), payload
 
     def drain(self) -> Iterator[tuple[tuple, Any]]:
         while self._q:

@@ -100,7 +100,10 @@ class LoweredQuery:
 def lower(query: A.Query, catalog: Catalog, n_parts: int = 2) -> LoweredQuery:
     """Lower one parsed query against a catalog. Raises SqlUnsupported /
     SqlAnalysisError (both positioned) instead of approximating."""
-    return _Lowering(catalog, n_parts).lower_top(query)
+    from auron_tpu import obs
+
+    with obs.span("sql.lower", cat="sql"):
+        return _Lowering(catalog, n_parts).lower_top(query)
 
 
 # ---------------------------------------------------------------------------
@@ -447,20 +450,23 @@ class _Lowering:
         if not sel.from_:
             raise SqlUnsupported("select without FROM",
                                  "constant queries", sel.pos)
+        from auron_tpu import obs
+
         scope = Scope(outer=outer)
         elems: list[_Elem] = []
         items: list[list[_Elem]] = []  # per top-level FROM item
-        for item_ref in sel.from_:
-            group: list[_Elem] = []
-            for rel, kind, on in self._flatten_ref(item_ref):
-                e = self._register(rel, kind, on, scope, len(elems), ctes)
-                elems.append(e)
-                group.append(e)
-            items.append(group)
-        if est_out is not None:
-            est_out[0] = max([est_out[0]] + [e.est for e in elems])
+        with obs.span("sql.bind", cat="sql"):
+            for item_ref in sel.from_:
+                group: list[_Elem] = []
+                for rel, kind, on in self._flatten_ref(item_ref):
+                    e = self._register(rel, kind, on, scope, len(elems), ctes)
+                    elems.append(e)
+                    group.append(e)
+                items.append(group)
+            if est_out is not None:
+                est_out[0] = max([est_out[0]] + [e.est for e in elems])
 
-        binder = ExprBinder(scope)
+            binder = ExprBinder(scope)
 
         # ---- WHERE conjuncts: bind; peel off IN-subquery semi joins
         semi: list[A.InSubquery] = []

@@ -49,6 +49,14 @@ def _parse_bool(s: str) -> bool:
     return s.strip().lower() in ("1", "true", "yes", "on")
 
 
+def env_key_for(key: str) -> str:
+    """THE conf-key -> env-var derivation (``a.b.c`` ->
+    ``AURON_TPU_A_B_C``) — one definition so get()/has() (and any future
+    alt-key scheme) cannot silently disagree on which variable they
+    read."""
+    return "AURON_TPU_" + key.upper().replace(".", "_")
+
+
 def int_conf(key: str, default: int, category: str = "general", doc: str = "") -> ConfigOption[int]:
     return ConfigOption(key, default, int, category, doc)
 
@@ -80,10 +88,24 @@ class Configuration:
         if opt.key in self._values:
             v = self._values[opt.key]
             return opt.parse(v) if isinstance(v, str) else v
-        env_key = "AURON_TPU_" + opt.key.upper().replace(".", "_")
+        env_key = env_key_for(opt.key)
         if env_key in os.environ:
             return opt.parse(os.environ[env_key])
         return opt.default
+
+    def has(self, opt: ConfigOption[T] | str,
+            include_env: bool = True) -> bool:
+        """True when the option is EXPLICITLY set in this configuration
+        (session value — or process env unless ``include_env=False``),
+        i.e. get() would not return the declared default. Lets appliers
+        act only on deliberate settings. ``include_env=False`` is for
+        per-task appliers of process-wide state (obs.apply_conf): an env
+        value already took effect at import, and re-asserting it on
+        every task would clobber later programmatic changes."""
+        key = opt if isinstance(opt, str) else opt.key
+        if key in self._values:
+            return True
+        return include_env and env_key_for(key) in os.environ
 
     def copy(self) -> "Configuration":
         return Configuration(self._values)

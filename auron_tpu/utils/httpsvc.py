@@ -4,16 +4,24 @@ Analog of the reference's feature-gated HTTP service exposing CPU pprof
 and heap profiles (auron/src/http/mod.rs:10-95, http/pprof.rs,
 http/memory_profiling.rs). The TPU engine's equivalents:
 
-- /metrics   — JSON metric trees of every live task runtime plus the
-               memory manager's budget/consumer state
-- /stacks    — all-thread python stack dump (the flamegraph source: feed
-               repeated samples to any folded-stack tool)
-- /conf      — the resolved configuration registry
-- /healthz   — liveness
+- /metrics      — JSON metric trees of every live task runtime plus the
+                  memory manager's budget/consumer state
+- /metrics.prom — the same state as Prometheus 0.0.4 text exposition
+                  (MetricNode.flat_totals + EngineCounters with
+                  task/stage/partition/operator labels; obs/export.py)
+- /trace        — the flight recorder's rings as Chrome/Perfetto
+                  trace-event JSON; ``?last=<seconds>`` limits to the
+                  recent window, ``?trace=<id>`` to one query trace
+- /queries      — recent finished query-trace summaries (newest first)
+- /stacks       — all-thread python stack dump (the flamegraph source:
+                  feed repeated samples to any folded-stack tool)
+- /conf         — the resolved configuration registry
+- /healthz      — liveness
 
 Gated by ``http.service.enable`` (off by default, like the reference's
 feature flag); the bridge starts it lazily on the first task when
-enabled.
+enabled. A handler exception answers 500 and never propagates into task
+threads — observability must not fail queries.
 """
 
 from __future__ import annotations
@@ -57,18 +65,9 @@ def _metrics_payload() -> dict:
             "partition": rt.ctx.partition_id,
             "metrics": rt.ctx.metrics.snapshot(),
         }
-    mm = MemManager.get()
-    with mm._lock:
-        consumers = [
-            {"name": c.name, "mem_used": c.mem_used()} for c in mm._consumers
-        ]
     return {
         "tasks": tasks,
-        "memory": {
-            "budget_bytes": mm.budget,
-            "num_spills": mm.num_spills,
-            "consumers": consumers,
-        },
+        "memory": MemManager.get().mem_snapshot(),
     }
 
 
@@ -96,16 +95,44 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — http.server API  # auronlint: thread-root(foreign) -- ThreadingHTTPServer handler thread: no task conf_scope installed
         try:
-            if self.path == "/healthz":
+            from urllib.parse import parse_qs, urlsplit
+
+            parts = urlsplit(self.path)
+            path, qs = parts.path, parse_qs(parts.query)
+            if path == "/healthz":
                 self._send(b"ok\n", "text/plain")
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 self._send(
                     json.dumps(_metrics_payload(), indent=2).encode(),
                     "application/json",
                 )
-            elif self.path == "/stacks":
+            elif path == "/metrics.prom":
+                from auron_tpu.obs import export
+
+                self._send(
+                    export.prometheus_text().encode(),
+                    "text/plain; version=0.0.4",
+                )
+            elif path == "/trace":
+                from auron_tpu.obs import export
+
+                last = qs.get("last", [None])[0]
+                trace = qs.get("trace", [None])[0]
+                payload = export.chrome_trace(
+                    last_s=float(last) if last is not None else None,
+                    trace_id=int(trace) if trace is not None else None,
+                )
+                self._send(json.dumps(payload).encode(), "application/json")
+            elif path == "/queries":
+                from auron_tpu import obs
+
+                self._send(
+                    json.dumps(obs.recent_queries(), indent=2).encode(),
+                    "application/json",
+                )
+            elif path == "/stacks":
                 self._send(_stacks_payload().encode(), "text/plain")
-            elif self.path == "/conf":
+            elif path == "/conf":
                 from auron_tpu.utils.config import _REGISTRY, Configuration
 
                 conf = _conf if _conf is not None else Configuration()

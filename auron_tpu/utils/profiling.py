@@ -8,6 +8,16 @@ new program shapes and (b) device->host syncs (every ``device_get`` /
 ``EngineCounters`` taps both, best-effort: the jaxlib internals it wraps are
 version-dependent, so every hook degrades to "counter absent" rather than
 failing the run.
+
+Every observed compile/sync also lands in the active span's trace and the
+flight recorder (auron_tpu/obs) — the time-correlated record that turns
+"host_sync_s grew" into "the syncs happened HERE, during THAT query".
+
+Thread safety: syncs arrive from task pumps, spill threads and transfer
+harvests concurrently. All counter state is guarded by one lock — the
+previous lock-free read-modify-write of ``sync_sites`` lost counts when
+two spill threads raced, and ``snapshot()`` could observe a half-updated
+``[n, secs]`` pair.
 """
 
 from __future__ import annotations
@@ -15,6 +25,8 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+
+from auron_tpu import obs
 
 # thread-local marker set by the async-transfer window while it harvests a
 # read whose device->host copy was STARTED batches ago (runtime/transfer.py):
@@ -45,6 +57,9 @@ class EngineCounters:
     _installed: "EngineCounters | None" = None
 
     def __init__(self) -> None:
+        # one lock for ALL mutable counter state: increments arrive from
+        # any thread that syncs (pumps, spill dispatch, harvest drains)
+        self._lock = threading.Lock()
         self.compiles = 0
         self.compile_s = 0.0
         self.syncs = 0
@@ -63,20 +78,24 @@ class EngineCounters:
         # sync-budget gate counts multiplicities, not just stalls)
         self.record_all_sites = False
 
-    def _record_site(self, dt: float) -> None:
+    def _find_site(self) -> str:
+        """Nearest engine frame (outside the lock: it walks the stack)."""
         import sys as _sys
 
         f = _sys._getframe(2)
-        site = "?"
         while f is not None:
             fn = f.f_code.co_filename
             if "auron_tpu" in fn and "utils/profiling" not in fn:
-                site = f"{fn.rsplit('auron_tpu/', 1)[-1]}:{f.f_lineno}"
-                break
+                return f"{fn.rsplit('auron_tpu/', 1)[-1]}:{f.f_lineno}"
             f = f.f_back
-        ent = self.sync_sites.setdefault(site, [0, 0.0])
-        ent[0] += 1
-        ent[1] += dt
+        return "?"
+
+    def _record_site(self, dt: float) -> None:
+        site = self._find_site()
+        with self._lock:
+            ent = self.sync_sites.setdefault(site, [0, 0.0])
+            ent[0] += 1
+            ent[1] += dt
 
     @classmethod
     def install(cls) -> "EngineCounters":
@@ -86,17 +105,26 @@ class EngineCounters:
         try:
             from jax._src import compiler as _jc
 
-            orig_compile = _jc.backend_compile_and_load
+            # the module-level entry every compile goes through; renamed
+            # across jax versions (0.4.x: backend_compile) — hook the
+            # first one present, degrade to "counter absent" otherwise
+            for fn_name in ("backend_compile_and_load", "backend_compile"):
+                orig_compile = getattr(_jc, fn_name, None)
+                if orig_compile is not None:
+                    break
+            if orig_compile is not None:
+                def counted_compile(*a, **kw):
+                    t0 = time.perf_counter()
+                    try:
+                        return orig_compile(*a, **kw)
+                    finally:
+                        dt = time.perf_counter() - t0
+                        with self._lock:
+                            self.compiles += 1
+                            self.compile_s += dt
+                        obs.note_compile(int(dt * 1e9))
 
-            def counted_compile(*a, **kw):
-                t0 = time.perf_counter()
-                try:
-                    return orig_compile(*a, **kw)
-                finally:
-                    self.compiles += 1
-                    self.compile_s += time.perf_counter() - t0
-
-            _jc.backend_compile_and_load = counted_compile
+                setattr(_jc, fn_name, counted_compile)
         except Exception:
             pass
         try:
@@ -111,18 +139,23 @@ class EngineCounters:
                     return orig_value.fget(arr)
                 finally:
                     dt = time.perf_counter() - t0
-                    if getattr(_async_ctx, "on", False):
-                        self.async_reads += 1
-                        self.async_read_s += dt
+                    is_async = getattr(_async_ctx, "on", False)
+                    with self._lock:
+                        if is_async:
+                            self.async_reads += 1
+                            self.async_read_s += dt
+                        else:
+                            self.syncs += 1
+                            self.sync_s += dt
+                        all_sites = self.record_all_sites
+                    if is_async:
                         if dt > _STALL_S:
                             # the window was too shallow: the harvest still
                             # blocked — keep it visible in the site table
                             self._record_site(dt)
-                    else:
-                        self.syncs += 1
-                        self.sync_s += dt
-                        if dt > _STALL_S or self.record_all_sites:
-                            self._record_site(dt)
+                    elif dt > _STALL_S or all_sites:
+                        self._record_site(dt)
+                    obs.note_sync(int(dt * 1e9), is_async)
 
             _ja.ArrayImpl._value = counted_value
         except Exception:
@@ -131,28 +164,33 @@ class EngineCounters:
         return self
 
     def note_batch(self) -> None:
-        self.batches += 1
+        with self._lock:
+            self.batches += 1
 
     def reset(self) -> None:
         """Zero all counters (e.g. after an untimed warmup run)."""
-        self.compiles = 0
-        self.compile_s = 0.0
-        self.syncs = 0
-        self.sync_s = 0.0
-        self.async_reads = 0
-        self.async_read_s = 0.0
-        self.batches = 0
-        self.sync_sites.clear()
+        with self._lock:
+            self.compiles = 0
+            self.compile_s = 0.0
+            self.syncs = 0
+            self.sync_s = 0.0
+            self.async_reads = 0
+            self.async_read_s = 0.0
+            self.batches = 0
+            self.sync_sites.clear()
 
     def snapshot(self) -> dict:
-        top = sorted(self.sync_sites.items(), key=lambda kv: -kv[1][1])[:10]
-        return {
-            "compiles": self.compiles,
-            "compile_s": round(self.compile_s, 3),
-            "host_syncs": self.syncs,
-            "host_sync_s": round(self.sync_s, 3),
-            "async_reads": self.async_reads,
-            "async_read_s": round(self.async_read_s, 3),
-            "batches": self.batches,
-            "sync_sites": {k: [v[0], round(v[1], 3)] for k, v in top},
-        }
+        with self._lock:
+            sites = {k: [v[0], v[1]] for k, v in self.sync_sites.items()}
+            out = {
+                "compiles": self.compiles,
+                "compile_s": round(self.compile_s, 3),
+                "host_syncs": self.syncs,
+                "host_sync_s": round(self.sync_s, 3),
+                "async_reads": self.async_reads,
+                "async_read_s": round(self.async_read_s, 3),
+                "batches": self.batches,
+            }
+        top = sorted(sites.items(), key=lambda kv: -kv[1][1])[:10]
+        out["sync_sites"] = {k: [v[0], round(v[1], 3)] for k, v in top}
+        return out

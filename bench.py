@@ -27,6 +27,16 @@ accelerator device — the bench box has one chip, and on the CPU fallback
 extra partitions only add task/shuffle overhead),
 BENCH_TPU_PROBE_TIMEOUT (seconds per probe attempt, default 240),
 BENCH_TPU_PROBE_TRIES (default 3).
+
+``--trace-out=PATH`` (or AURON_TRACE_OUT) raises obs to full-trace mode
+and writes the timed runs' span timeline as Chrome/Perfetto JSON; the
+record then also carries ``top_ops_span`` (per-op seconds re-derived
+from span events) and ``span_check`` — the cross-check that the span
+timeline and the MetricNode rollup tell the same per-operator story
+(docs/observability.md). Without the flag the runs still execute under
+a query trace (ring attribution + /queries summary), but span-event
+accumulation — and therefore the cross-check — exists only in full
+trace mode.
 """
 
 import json
@@ -147,6 +157,7 @@ def main() -> None:
     import threading
 
     import auron_tpu  # noqa: F401
+    from auron_tpu import obs
     from auron_tpu.bridge import api
     from auron_tpu.exec.metrics import MetricNode
     from auron_tpu.models import tpcds
@@ -213,14 +224,20 @@ def main() -> None:
     counters.reset()  # attribute syncs to the timed runs only, not warmup
     with sink_lock:
         op_totals.clear()  # attribute top_ops to the timed runs only
+    from auron_tpu.obs.export import trace_out_arg
+
+    trace_out = trace_out_arg(sys.argv[1:], "AURON_TRACE_OUT")
+    if trace_out:
+        obs.set_mode("trace")
     engine_s = float("inf")
-    for _ in range(2):
-        with tempfile.TemporaryDirectory(prefix="auron_bench_") as wd:
-            t0 = time.perf_counter()
-            got = tpcds.run_q3_class(
-                data, n_map=n_parts, n_reduce=n_parts, work_dir=wd, ingested=ingested
-            )
-            engine_s = min(engine_s, time.perf_counter() - t0)
+    with obs.query_trace("bench.q3class") as qt:
+        for _ in range(2):
+            with tempfile.TemporaryDirectory(prefix="auron_bench_") as wd:
+                t0 = time.perf_counter()
+                got = tpcds.run_q3_class(
+                    data, n_map=n_parts, n_reduce=n_parts, work_dir=wd, ingested=ingested
+                )
+                engine_s = min(engine_s, time.perf_counter() - t0)
     sync_snap = counters.snapshot()  # covers BOTH timed runs
 
     # result check (differential gate, tolerance like the reference's
@@ -267,6 +284,28 @@ def main() -> None:
             )[:5]
         },
     }
+    if qt.trace is not None and qt.trace.span_op_ns:
+        # the SAME ranking re-derived from span-timeline events, plus the
+        # agreement check — the two accountings can't silently diverge.
+        # Span data exists only under full trace mode (--trace-out).
+        span_ops = qt.trace.span_op_seconds()
+        record["top_ops_span"] = {
+            k: round(v, 3)
+            for k, v in sorted(span_ops.items(), key=lambda kv: -kv[1])[:5]
+        }
+        record["span_check"] = qt.trace.op_seconds_skew()
+    if trace_out:
+        if qt.trace is not None:
+            from auron_tpu.obs import export
+
+            export.write_chrome_trace(trace_out, trace_id=qt.trace.id)
+            record["trace_out"] = trace_out
+        else:
+            # an explicitly requested artifact must never vanish silently
+            sys.stderr.write(
+                "bench.py: --trace-out requested but obs recording is "
+                "disabled (AURON_TPU_OBS_KILL?); no trace written\n"
+            )
     if backend in ("tpu", "axon"):
         # settle the cluster-sort verdict on real hardware while we have
         # the chip: lax.sort vs bitonic network (jnp + pallas kernel).

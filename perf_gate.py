@@ -30,6 +30,18 @@ the heavy subset), BENCH_PARTS (default 2), PERF_GATE_CLASS_TIMEOUT
 per class, default 900 — a correct-but-slow class fails), and
 PERF_GATE_MIN_SPEEDUP (default 0.5; q3/q18/q93/q14 default 1.0).
 
+``--trace-out=DIR`` (or PERF_GATE_TRACE_OUT=DIR) raises children to
+full-trace mode and writes one Chrome/Perfetto span-timeline artifact
+per class (``trace_<class>_sf<N>.json``); under it the breakdown line
+also carries ``top_ops_span`` (per-op seconds re-derived from span
+events) and ``span_check`` — the agreement gate between the span
+timeline and the MetricNode rollup (docs/observability.md). Without
+the flag each class still runs under a query trace (ring attribution),
+but span-event accumulation is trace-mode only, so those keys are
+absent. Trace-mode runs skip the ratchet (enforcement AND persistence):
+the accounting overhead inside the timed dispatch must neither fail a
+class hovering at 0.9×best nor pollute the recorded bests.
+
 The floor RATCHETS (PERF_GATE_RATCHET=0 disables): PERF_RATCHET.json
 records each class's best passing speedup per scale factor, and a later
 run fails below max(class_floor, 0.9 * best) — the discounted 0.5x tiers
@@ -187,9 +199,31 @@ def run_one(name: str, ws: str) -> None:
             op_totals.clear()
         counters.reset()
 
+    from auron_tpu import obs
+
+    trace_dir = os.environ.get("PERF_GATE_TRACE_OUT") or None
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        obs.set_mode("trace")
     t0 = time.perf_counter()
-    res = dispatch(data, work)
+    with obs.query_trace(f"perf_gate.{name}") as qt:
+        res = dispatch(data, work)
     eng = time.perf_counter() - t0
+    if trace_dir:
+        if qt.trace is not None:
+            from auron_tpu.obs import export
+
+            export.write_chrome_trace(
+                os.path.join(trace_dir, f"trace_{name}_sf{int(sf)}.json"),
+                trace_id=qt.trace.id,
+            )
+        else:
+            # an explicitly requested artifact must never vanish silently
+            sys.stderr.write(
+                f"perf_gate[{name}]: --trace-out requested but obs "
+                "recording is disabled (AURON_TPU_OBS_KILL?); no trace "
+                "written\n"
+            )
     t0 = time.perf_counter()
     if name == "q72":
         got, sr = res
@@ -214,7 +248,7 @@ def run_one(name: str, ws: str) -> None:
     # second line: where the time went (op rollup sorted by compute time)
     op_seconds = MetricNode.op_seconds
     ranked = sorted(op_totals.items(), key=lambda kv: -op_seconds(kv[1]))
-    print(json.dumps({
+    brk = {
         "breakdown": name, "sf": sf, "tasks": len(trees),
         "counters": counters.snapshot(),
         # op -> elapsed compute seconds, top 5: the trajectory-diffable
@@ -223,7 +257,19 @@ def run_one(name: str, ws: str) -> None:
         "top_ops": {k: round(op_seconds(v), 3) for k, v in ranked[:5]},
         "flat": {k: flat_totals[k] for k in sorted(flat_totals)},
         "ops": {k: v for k, v in ranked},
-    }), flush=True)
+    }
+    if qt.trace is not None and qt.trace.span_op_ns:
+        # the same top_ops re-derived from the span timeline, and the
+        # agreement check against the metric rollup above — a hop that
+        # lost its span (misattribution!) shows here, not rounds later.
+        # Span data exists only under full trace mode (--trace-out).
+        span_ops = qt.trace.span_op_seconds()
+        brk["top_ops_span"] = {
+            k: round(v, 3)
+            for k, v in sorted(span_ops.items(), key=lambda kv: -kv[1])[:5]
+        }
+        brk["span_check"] = qt.trace.op_seconds_skew()
+    print(json.dumps(brk), flush=True)
 
 
 RATCHET_PATH = os.path.join(ROOT, "PERF_RATCHET.json")
@@ -291,6 +337,12 @@ def _merge_breakdowns(out_path: str, breakdowns: dict) -> None:
 
 
 def main() -> None:
+    from auron_tpu.obs.export import trace_out_arg
+
+    trace_dir = trace_out_arg(sys.argv[1:], "PERF_GATE_TRACE_OUT")
+    if trace_dir:
+        # children read it from the env (each class runs in a subprocess)
+        os.environ["PERF_GATE_TRACE_OUT"] = trace_dir
     sf = float(os.environ.get("PERF_GATE_SF", "100"))
     names = [n.strip() for n in
              os.environ.get("PERF_GATE_CLASSES", ",".join(HEAVY)).split(",")
@@ -300,7 +352,12 @@ def main() -> None:
     if resume == "auto":
         resume = os.path.join(ROOT, f"PERF_GATE_SF{int(sf)}.out")
     resumed = _load_resume(resume, sf) if resume else {}
-    ratchet_on = os.environ.get("PERF_GATE_RATCHET", "1") != "0"
+    # a --trace-out run carries full-trace accounting overhead inside the
+    # timed dispatch: a diagnostic rerun must neither fail a class on the
+    # tight ratcheted floor (0.9 x best) nor RECORD its slowed speedup as
+    # a best — static class floors still apply
+    ratchet_on = (os.environ.get("PERF_GATE_RATCHET", "1") != "0"
+                  and not trace_dir)
     ratchet = _load_ratchet()
     ws = tempfile.mkdtemp(prefix="auron_perf_gate_")
     results = []

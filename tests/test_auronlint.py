@@ -1088,6 +1088,52 @@ def test_r10_traced_helper_and_captured_mutation():
     assert "traced via" in hits[0][2]
 
 
+def test_r10_fires_on_obs_recorder_call_inside_jit():
+    """Span-recording calls are host-side only: inside a jit they fire at
+    trace time and never replay — every import shape must be caught."""
+    hits = _r10({"pkg/k.py": """
+    import jax
+    from auron_tpu import obs
+    from auron_tpu.obs import note_sync
+
+    @jax.jit
+    def kernel(x):
+        obs.note_op("FilterExec", "elapsed_compute", 1)
+        note_sync(1, False)
+        return x + 1
+
+    def helper(y):
+        with obs.span("inner"):
+            return y
+
+    @jax.jit
+    def kernel2(x):
+        return helper(x)
+    """})
+    msgs = [h[2] for h in hits]
+    assert len(hits) == 3, msgs
+    assert all("host-side only" in m for m in msgs)
+    assert any("'note_op'" in m for m in msgs)
+    assert any("'note_sync'" in m for m in msgs)
+    assert any("'span'" in m and "traced via" in m for m in msgs)
+
+
+def test_r10_obs_call_outside_jit_quiet():
+    hits = _r10({"pkg/k.py": """
+    import jax
+    from auron_tpu import obs
+
+    @jax.jit
+    def kernel(x):
+        return x + 1
+
+    def pump(x):
+        with obs.span("task"):
+            return kernel(x)
+    """})
+    assert not hits
+
+
 def test_r10_pure_callback_target_not_traced_and_pure_fn_quiet():
     hits = _r10({"pkg/k.py": """
     import jax

@@ -1,6 +1,8 @@
 """Observability HTTP service (auron/src/http/mod.rs analog)."""
 
 import json
+import re
+import urllib.error
 import urllib.request
 
 import pytest
@@ -58,6 +60,167 @@ def test_stacks_dump(svc):
     code, body = _get(svc, "/stacks")
     assert code == 200
     assert "--- thread" in body and "MainThread" in body
+
+
+# ---------------------------------------------------------------------------
+# full endpoint sweep during a LIVE query (old and new endpoints)
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? -?[0-9.eE+-]+(?:nan|inf)?)$"
+)
+
+
+def _parse_prom(body: str) -> dict[str, list[str]]:
+    """Validate Prometheus 0.0.4 text exposition; returns family->lines.
+    Catches the two classic emitter pitfalls: a family declared twice
+    (duplicate # TYPE blocks) and unescaped label values."""
+    families: dict[str, list[str]] = {}
+    declared: list[str] = []
+    for ln in body.splitlines():
+        if not ln.strip():
+            continue
+        assert _PROM_LINE.match(ln), f"bad exposition line: {ln!r}"
+        if ln.startswith("# TYPE "):
+            name = ln.split()[2]
+            assert name not in declared, f"duplicate family {name}"
+            declared.append(name)
+        elif not ln.startswith("#"):
+            name = ln.split("{")[0].split()[0]
+            families.setdefault(name, []).append(ln)
+    for name in families:
+        assert name in declared, f"sample without TYPE: {name}"
+    # series uniqueness within each family (duplicate-metric pitfall)
+    for name, lines in families.items():
+        series = [ln.rsplit(" ", 1)[0] for ln in lines]
+        assert len(series) == len(set(series)), f"duplicate series in {name}"
+    return families
+
+
+def test_every_endpoint_during_live_query(svc):
+    from auron_tpu import obs
+    from auron_tpu.utils.profiling import EngineCounters
+
+    EngineCounters.install()  # idempotent; /metrics.prom renders it
+    b = Batch.from_pydict({"v": list(range(5000))},
+                          schema=T.Schema.of(T.Field("v", T.INT64)))
+    api.put_resource("http_live", [[b] * 4])
+    try:
+        with obs.query_trace("http_live_query") as qt:
+            plan = B.hash_agg(B.memory_scan(b.schema, "http_live"), [],
+                              [("sum", col(0), "s")], "partial")
+            h = api.call_native(B.task(plan).SerializeToString())
+            # hit EVERY endpoint while the task is live
+            for path in ("/healthz", "/metrics", "/metrics.prom", "/stacks",
+                         "/conf", "/trace", "/trace?last=60", "/queries"):
+                code, body = _get(svc, path)
+                assert code == 200, (path, body[:200])
+            code, prom = _get(svc, "/metrics.prom")
+            fams = _parse_prom(prom)
+            assert "auron_engine_batches_total" in prom
+            while api.next_batch(h) is not None:
+                pass
+            api.finalize_native(h)
+        # after the trace closes: /queries serves its summary,
+        # /trace?trace=<id> filters to it
+        code, body = _get(svc, "/queries")
+        assert code == 200
+        qs = json.loads(body)
+        assert any(q["trace_id"] == qt.trace.id for q in qs)
+        code, body = _get(svc, f"/trace?trace={qt.trace.id}")
+        ct = json.loads(body)
+        xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+        assert xs and all(e["pid"] == qt.trace.id for e in xs)
+        assert fams  # the live-query exposition had samples
+    finally:
+        api.remove_resource("http_live")
+
+
+def test_prom_label_escaping_and_single_family():
+    """Renderer-level exposition checks with hostile label values."""
+    from auron_tpu.obs.export import render_prometheus
+
+    body = render_prometheus(
+        tasks={
+            "1": {"stage": 0, "partition": 0,
+                  "ops": {'We"ird\\Op\n': {"elapsed_compute": 5}}},
+            "2": {"stage": 1, "partition": 1,
+                  "ops": {'We"ird\\Op\n': {"elapsed_compute": 7}}},
+        },
+        counters={"compiles": 1, "host_syncs": 2},
+        memory={"budget_bytes": 10, "num_spills": 0,
+                "consumers": [{"name": "dup", "mem_used": 3},
+                              {"name": "dup", "mem_used": 4}]},
+        queries=0,
+    )
+    fams = _parse_prom(body)
+    assert len(fams["auron_op_metric"]) == 2
+    # duplicate consumer names collapse to one summed series
+    assert fams["auron_memory_consumer_bytes"] == [
+        'auron_memory_consumer_bytes{consumer="dup"} 7'
+    ]
+    assert '\\"' in body and "\\\\" in body and "\\n" in body
+
+
+def test_handler_exception_500s_but_never_kills_service_or_task(
+    svc, monkeypatch
+):
+    def boom() -> dict:
+        raise RuntimeError("kaboom")
+
+    monkeypatch.setattr(httpsvc, "_metrics_payload", boom)
+    b = Batch.from_pydict({"v": list(range(100))},
+                          schema=T.Schema.of(T.Field("v", T.INT64)))
+    api.put_resource("http_boom", [[b]])
+    try:
+        plan = B.hash_agg(B.memory_scan(b.schema, "http_boom"), [],
+                          [("sum", col(0), "s")], "partial")
+        h = api.call_native(B.task(plan).SerializeToString())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(svc, "/metrics")
+        assert ei.value.code == 500
+        # the service survives ...
+        code, _ = _get(svc, "/healthz")
+        assert code == 200
+        # ... and so does the live task
+        out = []
+        while (rb := api.next_batch(h)) is not None:
+            out.append(rb)
+        assert sum(rb.column(0)[0].as_py() for rb in out) == sum(range(100))
+        api.finalize_native(h)
+    finally:
+        api.remove_resource("http_boom")
+
+
+def test_metrics_snapshot_hammer_under_mutation(svc):
+    """Satellite: /metrics (and MetricNode.snapshot underneath) must
+    tolerate operator threads mutating the tree mid-snapshot — the old
+    dict() copy could raise 'dictionary changed size during iteration'
+    and 500 the endpoint mid-query."""
+    import threading
+
+    from auron_tpu.exec.metrics import MetricNode
+
+    node = MetricNode("root")
+    stop = threading.Event()
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            node.add(f"m{i % 997}", 1)
+            node.child(i % 7).add("elapsed_compute", 1)
+            i += 1
+
+    t = threading.Thread(target=mutate, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            snap = node.snapshot()  # must never raise
+            assert "values" in snap
+    finally:
+        stop.set()
+        t.join()
 
 
 def test_conf_gated_autostart():

@@ -1,0 +1,324 @@
+"""Query-scoped structured tracing (auron_tpu/obs, docs/observability.md).
+
+The acceptance teeth live in test_gate_class_trace_is_complete_and_agrees:
+a gate-class replay under full tracing must export a Perfetto-loadable
+trace whose per-operator span totals agree with MetricNode.op_seconds
+within 5%, and whose event stream carries compile, host-sync, spill and
+async-harvest events — with a FORCED spill and a FORCED sync performed
+by foreign threads still attributed to the owning task's trace.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from auron_tpu import obs
+from auron_tpu import types as T
+from auron_tpu.bridge import api
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.metrics import MetricNode
+from auron_tpu.exprs.ir import col
+from auron_tpu.obs import core, export
+from auron_tpu.plan import builders as B
+from auron_tpu.utils.profiling import EngineCounters
+
+
+@pytest.fixture(autouse=True)
+def _restore_mode():
+    prev = obs.mode()
+    yield
+    obs.set_mode(prev)
+
+
+def _events(trace_id=None, kind=None):
+    out = []
+    for _ring, evs in core.snapshot_events(trace_id=trace_id):
+        for ev in evs:
+            if kind is None or ev[2] == kind:
+                out.append(ev)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# span model
+# ---------------------------------------------------------------------------
+
+
+def test_mode_off_short_circuits_everything():
+    obs.set_mode("off")
+    with obs.query_trace("off_query") as qt:
+        assert qt.trace is None
+        with obs.span("x") as sp:
+            assert sp is None
+        obs.note_op("Op", "elapsed_compute", 123)
+    assert qt.summary is None
+
+
+def test_span_nesting_and_contextvar():
+    obs.set_mode("trace")
+    with obs.query_trace("nest") as qt:
+        root = obs.current_span()
+        assert root is not None and root.trace is qt.trace
+        with obs.span("child") as c:
+            assert c.parent_id == root.span_id
+            assert obs.current_span() is c
+        assert obs.current_span() is root
+    assert obs.current_span() is None
+    evs = _events(trace_id=qt.trace.id, kind="span")
+    assert {e[3] for e in evs} >= {"child", "nest"}
+
+
+def test_use_span_hands_off_across_threads_and_none_clears():
+    obs.set_mode("trace")
+    seen = {}
+    with obs.query_trace("hop") as qt:
+        sp = obs.current_span()
+
+        def foreign():
+            with obs.use_span(sp):
+                seen["inside"] = obs.current_span()
+                obs.note_op("ForeignOp", "elapsed_compute", 1000)
+            seen["after"] = obs.current_span()
+
+        t = threading.Thread(target=foreign)
+        t.start()
+        t.join()
+    assert seen["inside"] is sp and seen["after"] is None
+    assert qt.trace.span_op_seconds().get("ForeignOp") == pytest.approx(1e-6)
+    # use_span(None) CLEARS: an untraced producer must not inherit the
+    # executing thread's foreign span
+    with obs.span("ambient"):
+        with obs.use_span(None):
+            assert obs.current_span() is None
+
+
+def test_ring_is_bounded_and_wraps():
+    obs.set_mode("recorder")
+    core.set_ring_capacity(256)
+    try:
+        done = []
+
+        def burst():
+            for i in range(1000):
+                core.record("t", f"e{i}", 0, 0, 0, 0, None)
+            r = core._tls.ring
+            done.append((r.idx, r.cap, sum(1 for x in r.buf if x)))
+
+        t = threading.Thread(target=burst)  # fresh thread -> fresh ring
+        t.start()
+        t.join()
+        idx, cap, filled = done[0]
+        assert cap == 256 and idx == 1000 and filled == 256
+    finally:
+        core.set_ring_capacity(32768)
+
+
+def test_recorder_mode_rings_only_no_per_event_lock():
+    """recorder vs trace distinction: recorder records ring events and
+    publishes per-task summaries, but never takes the per-event Trace
+    lock (span_op_ns / sync counters stay empty); trace accumulates."""
+    obs.set_mode("recorder")
+    with obs.query_trace("rec_mode") as qt:
+        obs.note_op("SomeExec", "elapsed_compute", 5_000_000)
+        obs.note_sync(100_000, False)
+    assert _events(trace_id=qt.trace.id, kind="op")      # rings: yes
+    assert qt.trace.span_op_ns == {}                     # accumulators: no
+    assert qt.summary["host_syncs"] == 0
+    assert qt.summary["trace_id"] == qt.trace.id         # /queries: yes
+    obs.set_mode("trace")
+    with obs.query_trace("trace_mode") as qt2:
+        obs.note_op("SomeExec", "elapsed_compute", 5_000_000)
+    assert qt2.trace.span_op_seconds()["SomeExec"] == pytest.approx(0.005)
+
+
+def test_apply_conf_ignores_env_only_mode(monkeypatch):
+    """An env-set obs.mode must not be re-asserted per task: it already
+    took effect at import, and re-applying would clobber a later
+    programmatic set_mode (bench --trace-out under env off)."""
+    from auron_tpu.utils.config import Configuration
+
+    monkeypatch.setenv("AURON_TPU_OBS_MODE", "off")
+    obs.set_mode("trace")
+    obs.apply_conf(Configuration())          # env-only: no-op
+    assert obs.mode() == obs.MODE_TRACE
+    obs.apply_conf(Configuration().set(obs.OBS_MODE, "recorder"))
+    assert obs.mode() == obs.MODE_RECORDER   # session-set: applies
+
+
+def test_query_trace_summary_lands_in_recent_ring():
+    obs.set_mode("trace")
+    with obs.query_trace("ringed") as qt:
+        obs.note_op("AggExec", "elapsed_compute", 2_000_000)
+        obs.note_sync(500_000, False)
+    recent = obs.recent_queries()
+    assert recent and recent[0]["trace_id"] == qt.trace.id
+    assert recent[0]["host_syncs"] == 1
+    assert recent[0]["name"] == "ringed"
+
+
+def test_sql_compile_emits_parse_bind_lower_spans():
+    from auron_tpu.sql import compile_text
+
+    obs.set_mode("trace")
+    with obs.query_trace("sqlspans") as qt:
+        compile_text(
+            "select ss_item_sk, sum(ss_ext_sales_price) s from store_sales "
+            "group by ss_item_sk"
+        )
+    names = {e[3] for e in _events(trace_id=qt.trace.id, kind="span")}
+    assert {"sql.parse", "sql.bind", "sql.lower"} <= names
+
+
+# ---------------------------------------------------------------------------
+# the acceptance teeth
+# ---------------------------------------------------------------------------
+
+
+def test_gate_class_trace_is_complete_and_agrees(tmp_path):
+    from auron_tpu.memory.memmgr import MemManager
+    from auron_tpu.models import tpcds
+    from auron_tpu.runtime.transfer import TransferWindow
+
+    EngineCounters.install()
+    obs.set_mode("trace")
+    spilled = threading.Event()
+
+    class _Consumer:
+        name = "teeth_consumer"
+
+        def mem_used(self):
+            return 0 if spilled.is_set() else (4 << 20)
+
+        def spill(self):
+            spilled.set()
+            return 4 << 20
+
+    data = tpcds.generate(sf=0.1, seed=3)
+    with obs.query_trace("gate.q3") as qt:
+        # --- the gate-class replay itself
+        tpcds.run_q3_class(data, n_map=2, n_reduce=2,
+                           work_dir=str(tmp_path / "q3"))
+        # --- forced spill: consumer registered under the OWNING trace,
+        # spill dispatched by a FOREIGN thread with no span installed
+        mm = MemManager(budget_bytes=0)
+        mm.register(_Consumer())
+        t = threading.Thread(
+            target=lambda: mm.acquire(_Consumer(), 1 << 20)
+        )
+        t.start()
+        t.join()
+        assert spilled.is_set()
+        # --- forced sync on a foreign thread, span threaded explicitly
+        # (the R7 hand-off recipe, docs/observability.md)
+        sp = obs.current_span()
+        arr = jnp.arange(1 << 16)
+
+        def foreign_sync():
+            with obs.use_span(sp):
+                jax.device_get(arr + 1)
+
+        t = threading.Thread(target=foreign_sync)
+        t.start()
+        t.join()
+        # --- a compile inside the trace (fresh persistent-cache dir so
+        # the compile can't be served from the box's warm XLA cache)
+        prev_cache = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir",
+                          str(tmp_path / "xlacache"))
+        try:
+            jax.jit(lambda x: x * 3 + 1)(jnp.arange(12347))
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_cache)
+        # --- an async-transfer harvest inside the trace
+        w = TransferWindow(1)
+        for i in range(3):
+            w.push((jnp.asarray([i]),), i)
+        list(w.drain())
+
+    out = str(tmp_path / "trace.json")
+    export.write_chrome_trace(out, trace_id=qt.trace.id)
+    with open(out) as f:
+        ct = json.load(f)
+
+    # Perfetto-loadable shape: X events with name/ts/dur/pid/tid
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert xs
+    for e in xs:
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["pid"] == qt.trace.id  # every event attributed
+
+    # the full event stream is present, attributed to THIS trace even for
+    # the foreign-thread spill and sync
+    kinds = {e["cat"] for e in xs}
+    assert {"op", "span", "sync", "compile", "spill", "transfer"} <= kinds
+    spill_evs = [e for e in xs if e["cat"] == "spill"
+                 and e["args"].get("consumer") == "teeth_consumer"]
+    assert spill_evs, "forced foreign-thread spill missing from the trace"
+    assert any(e["name"] == "harvest" for e in xs if e["cat"] == "transfer")
+    assert any(e["name"] == "host_sync" for e in xs if e["cat"] == "sync")
+
+    # per-operator span totals FROM THE EXPORTED FILE agree with the
+    # MetricNode.op_seconds rollup within 5%
+    from_file: dict[str, float] = {}
+    for e in xs:
+        if e["cat"] != "op":
+            continue
+        metric = e["args"]["metric"]
+        if metric in MetricNode.NESTED_TIMERS:
+            continue
+        op = e["args"]["op"]
+        from_file[op] = from_file.get(op, 0.0) + e["dur"] / 1e6
+    metric_ops = qt.trace.metric_op_seconds()
+    assert metric_ops, "no finalize-time metric rollup reached the trace"
+    for op, secs in metric_ops.items():
+        if secs < 0.01:
+            continue  # sub-10ms ops: rounding noise dominates percentages
+        assert from_file.get(op, 0.0) == pytest.approx(secs, rel=0.05), (
+            op, from_file.get(op), secs
+        )
+    # and the Trace's own accumulator agrees too (what perf_gate emits)
+    assert qt.trace.op_seconds_skew()["ok"]
+
+
+def test_spill_container_attributes_via_conf_trace_id():
+    """HostSpill carries the owning conf; a write on a foreign thread
+    attributes through obs.trace.id with NO live span anywhere."""
+    import pyarrow as pa
+
+    from auron_tpu.memory.memmgr import make_spill
+    from auron_tpu.utils.config import Configuration
+
+    obs.set_mode("trace")
+    with obs.query_trace("conf_attr") as qt:
+        from auron_tpu.utils.config import active_conf
+
+        conf = active_conf().copy()  # carries obs.trace.id
+    # trace CLOSED; write from a plain thread with no span: the ring event
+    # must still carry the owning trace id
+    spill = make_spill(conf=conf)
+    tbl = pa.table({"v": list(range(100))})
+
+    def foreign_write():
+        spill.write_table(tbl)
+
+    t = threading.Thread(target=foreign_write)
+    t.start()
+    t.join()
+    evs = _events(trace_id=qt.trace.id, kind="spill")
+    assert any(e[3] == "write" for e in evs)
+    spill.release()
+
+
+def test_chrome_trace_last_window_filters_old_events():
+    import time as _t
+
+    obs.set_mode("recorder")
+    core.record("t", "old_event_marker", 0, 0, 0, 0, None)
+    _t.sleep(0.05)
+    core.record("t", "new_event_marker", 0, 0, 0, 0, None)
+    ct = export.chrome_trace(last_s=0.03)
+    names = {e["name"] for e in ct["traceEvents"] if e["ph"] == "X"}
+    assert "new_event_marker" in names and "old_event_marker" not in names

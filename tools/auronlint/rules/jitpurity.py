@@ -24,6 +24,11 @@ inside it:
   ``nonlocal`` rebinding, mutating calls or subscript writes on closure/
   module names — trace-time-only effects that vanish on cache hits.
 
+- span/recorder calls (``auron_tpu.obs``) — the flight recorder is
+  host-side only: a ``record``/``note_*``/``span`` inside a trace fires
+  once at trace time and never again on cache hits, producing a timeline
+  that silently lies. Record around the jit call, not inside it.
+
 ``jax.pure_callback`` is the sanctioned escape hatch (host sorts) and is
 not flagged — its *target* runs on host and is excluded from the traced
 closure. Deliberate trace-time effects (e.g. a compile-cache insert)
@@ -45,16 +50,45 @@ class JitPurityRule(Rule):
         yield from analyze(build_graph(root))
 
 
+def _is_obs_call(ms, c) -> bool:
+    """True when a CallSite resolves into ``auron_tpu.obs`` through the
+    module's imports (``obs.note_op(...)``, an aliased module, or a
+    from-imported name like ``record_event``)."""
+    if ms is None:
+        return False
+    if c.recv is not None:
+        dotted = ms.mod_imports.get(c.recv)
+        if dotted is None and c.recv in ms.name_imports:
+            mod, orig = ms.name_imports[c.recv]
+            dotted = f"{mod}.{orig}"
+        return bool(dotted) and (
+            dotted == "auron_tpu.obs" or dotted.startswith("auron_tpu.obs.")
+        )
+    if c.name in ms.name_imports:
+        mod, _ = ms.name_imports[c.name]
+        return mod == "auron_tpu.obs" or mod.startswith("auron_tpu.obs.")
+    return False
+
+
 def analyze(g):
     traced = g.jit_reachable()
     for q in sorted(traced):
         fs = g.functions.get(q)
         if fs is None:
             continue
+        ms = g.modules.get(fs.rel)
         how = (
             "a jit entry" if traced[q] == "entry"
             else f"traced via '{_short(traced[q])}'"
         )
+        for c in fs.calls:
+            if _is_obs_call(ms, c):
+                yield fs.rel, c.line, (
+                    f"span/recorder call '{c.name}' inside '{_short(q)}' "
+                    f"({how}) — obs recording is host-side only: inside a "
+                    "trace it fires once at compile time and never on "
+                    "cache hits; record around the jit boundary instead"
+                )
         for cr in fs.conf_reads:
             yield fs.rel, cr.line, (
                 f"active_conf() inside '{_short(q)}' ({how}) bakes the "
