@@ -2017,6 +2017,13 @@ class _DenseAggState:
         # sum/avg/count/count_star/min/max — all of which the host fold
         # implements — so backend policy is the only remaining question
         self._host = hostscatter.use_host_scatter()
+        # whole-stage fusion hand-off (plan/fusion.py DensePrepLink): when
+        # the child is a fused stage built by agg-input prefusion, publish
+        # the anchored table's geometry there so the stage compiles the
+        # fold's guard/index/mask prep into ITS program; epoch stamps
+        # every publication so stale-prepped batches fold via the raw path
+        self._link = getattr(exec_, "_dense_prep_link", None)
+        self._epoch = 0
 
     def reset(self) -> None:
         """Forget the table (after a drain) so the next update re-anchors.
@@ -2036,6 +2043,9 @@ class _DenseAggState:
         self.dims = None
         self.size = 0
         self.vals = self.valids = self.present = None
+        self._epoch += 1
+        if self._link is not None:
+            self._link.clear()
 
     # -- input extraction lives on the exec (_keys_and_inputs): shared with
     # the probe/scatter path so column alignment can't diverge -----------
@@ -2236,6 +2246,33 @@ class _DenseAggState:
 
     # -- host-scatter fold (CPU backend: np.bincount beats XLA scatters) --
 
+    def _publish_prep(self) -> None:
+        """Publish the freshly anchored table geometry to the fused stage
+        feeding this aggregate (plan/fusion.py DensePrepLink), so its NEXT
+        batches arrive with the fold's guard/index/mask prep computed
+        inside the stage program. Host-scatter substrate only — the device
+        fold is already one fused scatter program. Per-key stride 0 marks
+        a NULL-lane-only key (dims==1): its offset never contributes, and
+        a real value there surfaces through the guard as a restart."""
+        if self._link is None or not self._host:
+            return
+        self._epoch += 1
+        strides, st = [], 1
+        for d in self.dims:
+            strides.append(st if d > 1 else 0)
+            st *= d
+        self._link.publish(
+            epoch=self._epoch,
+            bases=tuple(self.bases),
+            his=tuple(self._his),
+            dims=tuple(self.dims),
+            size=self.size,
+            bases_dev=jnp.asarray(self.bases, jnp.int64),
+            his_dev=jnp.asarray(self._his, jnp.int64),
+            strides_dev=jnp.asarray(strides, jnp.int64),
+            size_dev=jnp.int64(self.size),
+        )
+
     def _update_host(self, b: Batch, defer: bool = True):
         """Host-scatter fold with the SAME k-deep deferred protocol as the
         device path: the batch's key/input columns start their device->host
@@ -2265,6 +2302,25 @@ class _DenseAggState:
             if failed:
                 self._retry.extend(failed)
                 return "restart"
+        # stage-prepped fold (plan/fusion.py): the fused stage already
+        # computed guard stats, slot index and masked planes on device in
+        # ITS program — transfer those instead of the raw columns and keep
+        # only the bincount scatter-reduces on host. Stale-epoch payloads
+        # (prepped under a pre-restart anchor) fall through to the raw path.
+        prep = getattr(b, "_dense_prep", None)
+        if prep is not None and self.bases is not None and prep.epoch == self._epoch:
+            leaves, treedef = jax.tree_util.tree_flatten(prep.tree())
+            if not defer:
+                # same synchronous end-of-stream/retry contract as the raw
+                # branch below (one budget, one reason)
+                got = jax.device_get(tuple(leaves))  # auronlint: sync-point(8/task) -- host-scatter end-of-stream/retry fold (prepped planes): same bound as the raw branch
+                return self._fold_prepped_arrays(
+                    prep, jax.tree_util.tree_unflatten(treedef, got)
+                )
+            start_host_transfer(*leaves)
+            with self._pending_lock:
+                self._pending.append((b, ("prep", prep, leaves, treedef)))
+            return True
         keys, per_agg = self._keys_and_inputs(b)
         pytree = (
             b.device.sel,
@@ -2286,17 +2342,104 @@ class _DenseAggState:
             )
         start_host_transfer(*leaves)
         with self._pending_lock:
-            self._pending.append((b, (leaves, treedef)))
+            self._pending.append((b, ("raw", leaves, treedef)))
         return True
 
     def _fold_host(self, payload):
         """Resolve one deferred entry: harvest the landed arrays and fold."""
         from auron_tpu.runtime.transfer import harvest
 
-        leaves, treedef = payload
+        if payload[0] == "prep":
+            _, prep, leaves, treedef = payload
+            return self._fold_prepped_arrays(
+                prep, jax.tree_util.tree_unflatten(treedef, harvest(*leaves))
+            )
+        _, leaves, treedef = payload
         return self._fold_host_arrays(
             *jax.tree_util.tree_unflatten(treedef, harvest(*leaves))
         )
+
+    def _fold_prepped_arrays(self, prep, tree):
+        """Fold one STAGE-PREPPED batch: the fused stage program computed
+        the guard statistics, the packed slot index and the per-agg masked
+        planes (mirroring _fold_host_arrays' arithmetic bit-for-bit); this
+        keeps only the range-guard comparison and the bincount
+        scatter-reduces. Guard bounds come from the payload's OWN anchor
+        copy — the one its planes were computed under."""
+        sel_d, idx_d, guards, planes = tree
+        sel = np.asarray(sel_d)
+        if not sel.any():
+            return True
+        any_ok, mns, mxs = (np.asarray(g) for g in guards)
+        for i in range(len(prep.dims)):
+            if not bool(any_ok[i]):
+                continue
+            if prep.dims[i] == 1:
+                return "restart"  # NULL-lane-only key saw a real value
+            if int(mns[i]) < prep.bases[i] or int(mxs[i]) > prep.his[i]:
+                return "restart"
+        if prep.epoch != self._epoch or prep.size != self.size:
+            # defensive: submission-time checks make this unreachable (a
+            # restart resolves every pending fold before re-anchoring)
+            return "restart"
+        size = self.size
+        idx = np.asarray(idx_d)
+
+        def bc(weights=None):
+            return np.bincount(idx, weights=weights, minlength=size + 1)[:size]
+
+        live_cnt = bc(sel.astype(np.float64))
+        self.present |= live_cnt > 0
+        fi = 0
+        for (a, _), plane in zip(self.exec.aggs, planes):
+            func = a.func
+            if func in ("count", "count_star"):
+                if func == "count_star":
+                    contrib = live_cnt.astype(np.int64)
+                else:
+                    ok = np.asarray(plane[0])
+                    contrib = bc(ok.astype(np.float64)).astype(np.int64)
+                self.vals[fi] += contrib
+                fi += 1
+                continue
+            if func in ("min", "max"):
+                vm = np.asarray(plane[0])
+                ok = np.asarray(plane[1])
+                old = self.vals[fi]
+                if func == "min":
+                    ident = S._max_identity(old.dtype)
+                    contrib = np.full(size + 1, ident, old.dtype)
+                    np.minimum.at(contrib, idx, vm)
+                    both = np.minimum(old, contrib[:size])
+                else:
+                    ident = S._min_identity(old.dtype)
+                    contrib = np.full(size + 1, ident, old.dtype)
+                    np.maximum.at(contrib, idx, vm)
+                    both = np.maximum(old, contrib[:size])
+                cv_valid = bc(ok.astype(np.float64)) > 0
+                old_valid = self.valids[fi]
+                self.vals[fi] = np.where(
+                    old_valid & cv_valid, both,
+                    np.where(cv_valid, contrib[:size], old),
+                )
+                self.valids[fi] = old_valid | cv_valid
+                fi += 1
+                continue
+            # sum / avg: vm is where(ok, cast(v), 0) computed on device
+            vm = np.asarray(plane[0])
+            ok = np.asarray(plane[1])
+            ok_cnt = bc(ok.astype(np.float64))
+            if self.vals[fi].dtype.kind == "f":
+                s = bc(vm)
+            else:
+                s = _bincount_i64(idx, vm, size)
+            self.vals[fi] += s.astype(self.vals[fi].dtype)
+            self.valids[fi] |= ok_cnt > 0
+            fi += 1
+            if func == "avg":
+                self.vals[fi] += ok_cnt.astype(np.int64)
+                fi += 1
+        return True
 
     def _fold_host_arrays(self, sel_d, kv_d, km_d, agg_d):
         sel = np.asarray(sel_d)
@@ -2320,6 +2463,7 @@ class _DenseAggState:
             if not self._anchor_from_stats(mins, maxs):
                 return False
             self._alloc_host(bucket_capacity(self.size_hint))
+            self._publish_prep()
         # range guard, same semantics as the fused device guard
         for i, (v, m) in enumerate(zip(kvs, kms)):
             ok = sel & m
@@ -2501,6 +2645,8 @@ class _DenseAggState:
 
     def release(self, mm) -> None:
         self.vals = self.valids = self.present = None
+        if self._link is not None:
+            self._link.clear()  # permanent fallback: stage stops prepping
         with self._pending_lock:
             self._pending.clear()  # drop in-flight fold refs (cancel path)
 

@@ -1,0 +1,719 @@
+"""Whole-stage fusion: pipeline segments -> single XLA programs.
+
+PR 3 proved the thesis at operator scope (``exec.filter.fuse``: one jitted
+program per predicate chain). This pass generalizes it Flare-style (PAPERS
+1703.08219): a **segment finder** walks the instantiated exec tree and
+identifies maximal scan->filter->project(->partial-agg-input) pipeline
+segments between blocking boundaries (sort, agg state, join build, shuffle,
+collect — every operator that is not a stateless row-pipeline stage), a
+**stage compiler** traces each segment's per-batch work into ONE jitted XLA
+program keyed on ``(schema, segment signature, compaction bucket)``, and a
+**cost model** chooses fuse vs. materialize per segment (SystemML-style
+selection, PAPERS 1801.00829): operator cost = estimated eager dispatches
+(expression DAG nodes + per-operator overhead), substrate-resolved through
+``utils.config.resolve_tri`` — accelerators always fuse, XLA:CPU fuses only
+segments whose eager cost reaches ``exec.fuse.min.ops`` (the PR-3-measured
+CPU exception: fused chains beat eager dispatch there too).
+
+Fusion is an EXEC-TREE rewrite (``task_from_proto`` applies it after column
+pruning): the protobuf plan, plan goldens and ``plan/explain`` output are
+untouched, and results are bit-identical with the pass off
+(``exec.fuse.enable=off`` — the A/B lever the fuzz suite and the perf gate
+exercise).
+
+Invariants the fused stage preserves (docs/fusion.md):
+
+- R10 jit-boundary purity: the traced region is the same trace-safe
+  expression machinery behind ``exec.filter.fuse`` (``exprs/eval.py``
+  evaluated over a dict-less device batch); no conf reads, host transfers
+  or captured-state mutation inside the trace (auronlint R10 checks the
+  closure, R2 the cache-key discipline).
+- Dictionary passthrough: a dict-encoded column may ride THROUGH a fused
+  segment only as a bare column reference — its codes flow through the
+  program, the host-side dictionary re-attaches on emission. Expressions
+  that *transform* dictionaries (string compare/LIKE/casts) stay eager.
+- Batch protocol: fused stages refine the selection mask exactly like
+  FilterExec (no compaction inside the stage), so downstream compaction
+  boundaries — including the selectivity predictor's mispredict repair —
+  see the same batches they would without fusion, and emitted batches
+  remain prefetchable through the async transfer window.
+- Metric attribution: fused-program wall time is split back into
+  per-operator MetricNode children (proportional to the cost model's
+  per-operator weights), and the SAME split nanos are handed to the obs
+  span timeline — ``top_ops`` and the <=5% span/metric cross-check see
+  FilterExec/ProjectExec/HashAggExec, never one opaque stage.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial as _partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu import obs
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import Batch, DeviceBatch
+from auron_tpu.exec.base import ExecOperator, ExecutionContext
+from auron_tpu.exprs import Evaluator, ir
+from auron_tpu.utils.config import (
+    FUSE_AGG_INPUTS,
+    FUSE_ENABLE,
+    FUSE_MIN_OPS,
+    Configuration,
+    resolve_tri,
+)
+
+# ---------------------------------------------------------------------------
+# trace safety
+# ---------------------------------------------------------------------------
+
+#: expression nodes whose evaluation is a pure jnp program over dict-free
+#: operands — the exec.filter.fuse whitelist plus In (numeric membership is
+#: a pure compare/or chain). Everything else (scalar funcs, host UDFs,
+#: row-offset context, LIKE, subqueries) stays eager.
+_FUSABLE_NODES = (
+    ir.Literal, ir.Cast, ir.BinaryOp, ir.Not, ir.IsNull, ir.IsNotNull,
+    ir.If, ir.Case, ir.Coalesce, ir.In,
+)
+
+_NESTED_KINDS = (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT)
+
+
+def expr_trace_safe(e: ir.Expr, schema: T.Schema, allow_dict_out: bool = False) -> bool:
+    """True when evaluating ``e`` inside a jit over a dict-less batch is
+    exactly the eager evaluation. ``allow_dict_out`` permits a BARE
+    dict-encoded column reference (projection passthrough: codes flow
+    through the program, the dictionary re-attaches host-side); computed
+    dict-encoded results never fuse — their evaluation transforms host
+    dictionaries. IsNull/IsNotNull over a bare column are safe even for
+    dict columns (they read only the validity plane)."""
+    if isinstance(e, ir.Column):
+        dt = e.dtype_of(schema)
+        return allow_dict_out or not (dt.is_dict_encoded or dt.kind in _NESTED_KINDS)
+    if isinstance(e, (ir.IsNull, ir.IsNotNull)) and isinstance(e.child, ir.Column):
+        return True
+    if not isinstance(e, _FUSABLE_NODES):
+        return False
+    dt = e.dtype_of(schema)
+    if dt.is_dict_encoded or dt.kind in _NESTED_KINDS:
+        return False
+    return all(expr_trace_safe(c, schema) for c in e.children())
+
+
+def _expr_nodes(e: ir.Expr) -> int:
+    return 1 + sum(_expr_nodes(c) for c in e.children())
+
+
+# ---------------------------------------------------------------------------
+# the stage program (ONE jit; cache key = static (steps, emit) + shapes)
+# ---------------------------------------------------------------------------
+
+
+def _trace_steps(dev: DeviceBatch, steps: tuple):
+    """The shared traced step walk: apply ("filter", schema, predicates) /
+    ("project", schema, exprs) stages in order; each step carries the
+    ORIGINAL operator's input schema so expression typing is exactly the
+    eager path's. Returns (sel, values, validity, final projection's
+    ColumnVals or None). The common-subexpression memo is shared across
+    consecutive steps over the same input columns and reset at every
+    projection (which replaces the column planes)."""
+    sel = dev.sel
+    values, validity = dev.values, dev.validity
+    outs = None
+    memo: dict = {}
+    for step in steps:
+        kind, schema, exprs = step
+        b = Batch(schema, DeviceBatch(sel, values, validity),
+                  (None,) * len(schema.fields))
+        ev = Evaluator(schema, partition_id=0, row_offset=0, resources={})
+        if kind == "filter":
+            for p in exprs:
+                cv = ev._eval(p, b, memo)
+                sel = sel & cv.validity & cv.values.astype(bool)
+        else:
+            outs = [ev._eval(e, b, memo) for e in exprs]
+            values = tuple(cv.values for cv in outs)
+            validity = tuple(cv.validity for cv in outs)
+            memo = {}
+    return sel, values, validity, outs
+
+
+@_partial(jax.jit, static_argnames=("steps", "emit"))
+def _stage_program(dev: DeviceBatch, *, steps: tuple, emit: str):
+    """The whole segment's per-batch work as ONE compiled program.
+    ``emit`` is "sel" (filter-only segment: the caller reuses the input
+    columns) or "cols" (the final projection's columns are returned)."""
+    sel, values, validity, _ = _trace_steps(dev, steps)
+    if emit == "sel":
+        return sel
+    return sel, values, validity
+
+
+# 2^62 sentinels for the per-key guard min/max reductions (ignored by the
+# consumer unless the key saw a live valid row — the any_ok flag)
+_GUARD_HI = (1 << 62)
+
+
+@_partial(jax.jit, static_argnames=("steps", "prep"))
+def _stage_program_prep(dev: DeviceBatch, bases, his, strides, size, *,
+                        steps: tuple, prep: tuple):
+    """Stage program variant for segments feeding a DENSE partial
+    aggregate on the host-scatter substrate: in the SAME compiled program
+    as the filter/project work, compute the dense fold's per-batch prep —
+    the range-guard statistics, the packed slot index and the per-agg
+    masked value planes — so the host keeps only the bincount
+    scatter-reduces (the substrate choice PR 3 measured; the ~6 numpy
+    passes of guard/index/mask arithmetic move into this one XLA pass).
+
+    ``bases``/``his``/``strides``/``size`` are the anchor geometry owned
+    by the aggregate's dense table — ALL device ARGUMENTS, never statics,
+    so a re-anchor (even onto a different table size) reuses the compiled
+    program; ``prep`` is the static (n_keys, agg plane spec). Every
+    computation mirrors _DenseAggState._fold_host_arrays bit-for-bit:
+    same masks, same clip arithmetic, same identities."""
+    from auron_tpu.ops import segments as S
+
+    sel, values, validity, outs = _trace_steps(dev, steps)
+    n_keys, aggs = prep
+    idx = jnp.zeros(dev.sel.shape, jnp.int64)
+    any_l, mn_l, mx_l = [], [], []
+    for i in range(n_keys):
+        kv = outs[i]
+        v64 = kv.values.astype(jnp.int64)
+        ok = sel & kv.validity
+        off = jnp.where(
+            kv.validity, jnp.clip(v64, bases[i], his[i]) - bases[i] + 1, 0
+        )
+        idx = idx + off * strides[i]
+        any_l.append(jnp.any(ok))
+        mn_l.append(jnp.min(jnp.where(ok, v64, jnp.int64(_GUARD_HI))))
+        mx_l.append(jnp.max(jnp.where(ok, v64, jnp.int64(-_GUARD_HI))))
+    idx = jnp.where(sel, jnp.clip(idx, 0, size - 1), size).astype(jnp.int32)
+    ev = Evaluator(T.Schema())  # casts only (mirrors _keys_and_inputs)
+    planes: list[tuple] = []
+    for spec in aggs:
+        func = spec[0]
+        if func == "count_star":
+            planes.append(())
+            continue
+        cv = outs[spec[1]]
+        if func == "count":
+            planes.append((sel & cv.validity,))
+            continue
+        if func in ("sum", "avg"):
+            _, _, sum_dt, kind = spec
+            cvv = ev._cast(cv, sum_dt)
+            ok = sel & cvv.validity
+            if kind == "f":
+                vm = jnp.where(ok, cvv.values.astype(jnp.float64), 0.0)
+            else:
+                vm = jnp.where(ok, cvv.values.astype(jnp.int64), jnp.int64(0))
+            planes.append((vm, ok))
+        else:  # min / max
+            _, _, acc_name = spec
+            accdt = np.dtype(acc_name)
+            ok = sel & cv.validity
+            ident = S._max_identity(accdt) if func == "min" else S._min_identity(accdt)
+            vm = jnp.where(ok, cv.values, ident).astype(accdt)
+            planes.append((vm, ok))
+    guards = (jnp.stack(any_l), jnp.stack(mn_l), jnp.stack(mx_l))
+    return sel, values, validity, (idx, guards, tuple(planes))
+
+
+class DensePrepLink:
+    """Anchor hand-off from a dense partial aggregate to the fused stage
+    feeding it. Stage and aggregate run on the SAME task pump thread (the
+    stage generator resumes inside the aggregate's pull), so publish /
+    snapshot / clear never race; the lock is defense against foreign
+    observers (memory-manager polls) only. ``epoch`` increments on every
+    re-anchor — a payload prepped under a stale anchor is refused by the
+    aggregate at submission and its batch folds through the raw path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._anchor: dict | None = None
+
+    def publish(self, **anchor) -> None:
+        with self._lock:
+            self._anchor = anchor
+
+    def clear(self) -> None:
+        with self._lock:
+            self._anchor = None
+
+    def snapshot(self) -> dict | None:
+        with self._lock:
+            return self._anchor
+
+
+class DensePrepPayload:
+    """One batch's device-resident prep planes riding from the fused stage
+    to the dense aggregate (attached to the Batch as ``_dense_prep``).
+    Guard comparisons use the ANCHOR THE PLANES WERE COMPUTED UNDER
+    (bases/his/dims captured here), never the aggregate's current one."""
+
+    __slots__ = ("epoch", "bases", "his", "dims", "size", "sel", "idx",
+                 "guards", "planes")
+
+    def __init__(self, epoch, bases, his, dims, size, sel, idx, guards, planes):
+        self.epoch = epoch
+        self.bases = bases
+        self.his = his
+        self.dims = dims
+        self.size = size
+        self.sel = sel
+        self.idx = idx
+        self.guards = guards
+        self.planes = planes
+
+    def tree(self):
+        return (self.sel, self.idx, self.guards, self.planes)
+
+
+# -- compile accounting: the retrace guard's evidence (tools/perfcheck.py) --
+
+_FUSE_LOCK = threading.Lock()
+_SEEN_PROGRAMS: set = set()  # segment signatures
+_SEEN_TRACES: set = set()  # (segment signature, capacity bucket)
+_SEEN_BUCKETS: set = set()  # capacity buckets observed (any segment)
+_STATS = {"segments": 0, "programs": 0, "compiles": 0, "buckets": 0}
+
+
+def fusion_stats() -> dict:
+    """Snapshot of fused-segment accounting: ``segments`` = FusedStageExec
+    instances built, ``programs`` = distinct segment signatures dispatched,
+    ``buckets`` = distinct capacity buckets observed, ``compiles`` =
+    distinct (signature, capacity-bucket) traces — the number perfcheck's
+    retrace guard bounds by programs x buckets and requires FLAT across a
+    replay."""
+    with _FUSE_LOCK:
+        return dict(_STATS)
+
+
+def reset_fusion_stats() -> None:
+    with _FUSE_LOCK:
+        _SEEN_PROGRAMS.clear()
+        _SEEN_TRACES.clear()
+        _SEEN_BUCKETS.clear()
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _note_dispatch(sig, capacity: int) -> bool:
+    """Record one program dispatch; True when it is a NEW (signature,
+    bucket) trace — i.e. a compile, not a cache hit."""
+    with _FUSE_LOCK:
+        if sig not in _SEEN_PROGRAMS:
+            _SEEN_PROGRAMS.add(sig)
+            _STATS["programs"] += 1
+        if capacity not in _SEEN_BUCKETS:
+            _SEEN_BUCKETS.add(capacity)
+            _STATS["buckets"] = len(_SEEN_BUCKETS)
+        key = (sig, capacity)
+        if key in _SEEN_TRACES:
+            return False
+        _SEEN_TRACES.add(key)
+        _STATS["compiles"] += 1
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the fused operator
+# ---------------------------------------------------------------------------
+
+
+class FusedStageExec(ExecOperator):
+    """One pipeline segment compiled as a single per-batch XLA program.
+
+    Built only by ``fuse_exec_tree`` — it carries the segment's static
+    description precomputed by ``_plan_segment``:
+
+    - ``steps``: the static half of the program cache key;
+    - ``out_stamp``: schema to stamp on emitted batches (None = the input
+      batch's schema rides through, exactly like FilterExec);
+    - ``dict_src``: per-output-column input index for dictionary
+      passthrough (None = identity — all input dictionaries ride through);
+    - ``op_shares``: (operator name, cost weight) per constituent operator,
+      the proportional split of fused-program wall time back into
+      per-operator metric/span accounting.
+    """
+
+    def __init__(self, child: ExecOperator, steps: tuple, out_stamp,
+                 dict_src, op_shares: tuple, schema: T.Schema):
+        super().__init__([child], schema)
+        self.steps = steps
+        self.out_stamp = out_stamp
+        self.dict_src = dict_src
+        self.op_shares = op_shares
+        self.has_project = any(s[0] == "project" for s in steps)
+        #: set by _try_prefuse_agg when the consumer is a dense-eligible
+        #: partial aggregate: once the aggregate anchors its table, the
+        #: stage compiles the dense fold's guard/index/mask prep into the
+        #: same program (_stage_program_prep)
+        self.dense_link: DensePrepLink | None = None
+        self._prep_nkeys = 0
+        self._prep_aggs: tuple = ()
+        with _FUSE_LOCK:
+            _STATS["segments"] += 1
+
+    def attach_dense_link(self, link: DensePrepLink, n_keys: int,
+                          aggs_spec: tuple) -> None:
+        self.dense_link = link
+        self._prep_nkeys = n_keys
+        self._prep_aggs = aggs_spec
+        # the prep arithmetic is per-batch aggregate work: charge its cost
+        # share to the aggregate's name in the proportional split
+        extra = n_keys * 4 + len(aggs_spec) * 2
+        self.op_shares = tuple(
+            (nm, w + extra if nm == "HashAggExec" else w)
+            for nm, w in self.op_shares
+        )
+
+    def fused_op_names(self) -> list[str]:
+        return [nm for nm, _ in self.op_shares]
+
+    def _execute(self, partition: int, ctx: ExecutionContext):
+        node = ctx.metrics
+        emit = "cols" if self.has_project else "sel"
+        sig = (self.steps, emit)
+        shares = [(nm, w) for nm, w in self.op_shares if w > 0]
+        total_w = sum(w for _, w in shares) or 1
+        # per-constituent-operator metric nodes (index 0 is the child
+        # operator's node, claimed by child_stream)
+        attr = []
+        for k, (nm, _) in enumerate(shares):
+            c = node.child(1 + k)
+            c.name = nm
+            attr.append(c)
+        for b in self.child_stream(0, partition, ctx):
+            anchor = self.dense_link.snapshot() if self.dense_link else None
+            payload = None
+            t0 = time.perf_counter_ns()
+            if anchor is not None:
+                prep_cfg = (self._prep_nkeys, self._prep_aggs)
+                if _note_dispatch((self.steps, "prep", prep_cfg), b.capacity):
+                    node.add("stage_compiles", 1)
+                sel, values, validity, (idx, guards, planes) = _stage_program_prep(
+                    b.device, anchor["bases_dev"], anchor["his_dev"],
+                    anchor["strides_dev"], anchor["size_dev"],
+                    steps=self.steps, prep=prep_cfg,
+                )
+                out = (sel, values, validity)
+                payload = DensePrepPayload(
+                    anchor["epoch"], anchor["bases"], anchor["his"],
+                    anchor["dims"], anchor["size"], sel, idx, guards, planes,
+                )
+            else:
+                if _note_dispatch(sig, b.capacity):
+                    node.add("stage_compiles", 1)
+                out = _stage_program(b.device, steps=self.steps, emit=emit)
+            dt = time.perf_counter_ns() - t0
+            node.add("fused_batches", 1)
+            # split the stage's wall nanos back into per-operator timers,
+            # handing the SAME split to the span timeline (obs.note_op) so
+            # the <=5% span/metric cross-check holds through fusion
+            spent = 0
+            for i, ((nm, w), cnode) in enumerate(zip(shares, attr)):
+                dt_i = dt - spent if i == len(shares) - 1 else dt * w // total_w
+                spent += dt_i
+                cnode.add("elapsed_compute", dt_i)
+                obs.note_op(nm, "elapsed_compute", dt_i)
+            if self.has_project:
+                sel, values, validity = out
+                dicts = tuple(
+                    b.dicts[s] if s is not None else None for s in self.dict_src
+                )
+                nb = Batch(self.out_stamp, DeviceBatch(sel, values, validity), dicts)
+                if payload is not None:
+                    nb._dense_prep = payload
+                yield nb
+            else:
+                dev = DeviceBatch(out, b.device.values, b.device.validity)
+                yield Batch(self.out_stamp or b.schema, dev, b.dicts)
+
+
+# ---------------------------------------------------------------------------
+# segment planning
+# ---------------------------------------------------------------------------
+
+# import here (not at top) keeps plan/ free of a hard exec-module cycle
+from auron_tpu.exec.basic import (  # noqa: E402
+    FilterExec,
+    ProjectExec,
+    RenameColumnsExec,
+)
+
+_CHAIN_OPS = (FilterExec, ProjectExec, RenameColumnsExec)
+
+
+def _op_safe(op: ExecOperator) -> bool:
+    schema = op.children[0].schema
+    if isinstance(op, FilterExec):
+        return all(expr_trace_safe(p, schema) for p in op.predicates)
+    if isinstance(op, ProjectExec):
+        return all(
+            expr_trace_safe(e, schema, allow_dict_out=True) for e in op.exprs
+        )
+    return isinstance(op, RenameColumnsExec)
+
+
+def _collect_chain(op: ExecOperator):
+    """Maximal stateless pipeline chain from ``op`` downward. Returns
+    (ops top-down, source below the chain). Everything that is not a
+    filter/project/rename is a blocking boundary: sorts, aggregations,
+    join builds, shuffle writers/readers, unions, limits, generators —
+    segments NEVER cross them."""
+    ops = []
+    cur = op
+    while isinstance(cur, _CHAIN_OPS):
+        ops.append(cur)
+        cur = cur.children[0]
+    return ops, cur
+
+
+def _mirror_project_schema(exprs, names, schema: T.Schema) -> T.Schema:
+    """The schema ProjectExec's batch_from_columns stamps on emitted
+    batches (NULL-kind values surface as INT32 fields) — mirrored exactly
+    so fused and eager streams are indistinguishable downstream."""
+    fields = []
+    for e, n in zip(exprs, names):
+        dt = e.dtype_of(schema)
+        fields.append(T.Field(n, dt if dt.kind != T.TypeKind.NULL else T.INT32, True))
+    return T.Schema(tuple(fields))
+
+
+class _Segment:
+    """Static description of one fusable run, built bottom-up."""
+
+    def __init__(self):
+        self.steps: list = []
+        self.op_shares: list = []
+        self.stamp: T.Schema | None = None
+        self.src: list | None = None  # None = identity passthrough
+        self.n_ops = 0
+
+    def add_filter(self, schema: T.Schema, preds: tuple) -> None:
+        self.steps.append(("filter", schema, preds))
+        self.op_shares.append(("FilterExec", sum(_expr_nodes(p) for p in preds)))
+        self.n_ops += 1
+
+    def add_project(self, schema: T.Schema, exprs: tuple, names,
+                    op_name: str = "ProjectExec") -> None:
+        self.steps.append(("project", schema, exprs))
+        self.op_shares.append((op_name, sum(_expr_nodes(e) for e in exprs)))
+        self.stamp = _mirror_project_schema(exprs, names, schema)
+        prev = self.src
+        self.src = [
+            (e.index if prev is None else prev[e.index])
+            if isinstance(e, ir.Column) else None
+            for e in exprs
+        ]
+        self.n_ops += 1
+
+    def add_rename(self, schema: T.Schema) -> None:
+        # renames are pure schema bookkeeping: no step, no device work
+        self.stamp = schema
+        self.n_ops += 1
+
+    def cost(self) -> int:
+        """Estimated eager per-batch dispatches the fused program replaces:
+        one per expression DAG node plus one per constituent operator
+        (batch re-wrap + dispatch overhead)."""
+        return sum(w for _, w in self.op_shares) + self.n_ops
+
+    def build(self, child: ExecOperator, schema: T.Schema) -> FusedStageExec:
+        return FusedStageExec(
+            child,
+            tuple(self.steps),
+            self.stamp,
+            None if self.src is None else tuple(self.src),
+            tuple(self.op_shares),
+            schema,
+        )
+
+
+def _plan_segment(ops_top_down: list) -> _Segment:
+    seg = _Segment()
+    for o in reversed(ops_top_down):
+        schema = o.children[0].schema
+        if isinstance(o, FilterExec):
+            seg.add_filter(schema, tuple(o.predicates))
+        elif isinstance(o, ProjectExec):
+            seg.add_project(schema, tuple(o.exprs), o.names)
+        else:
+            seg.add_rename(o.schema)
+    return seg
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+
+def _should_fuse(cost: int, conf: Configuration) -> bool:
+    """The fuse-vs-materialize decision (docs/fusion.md): explicit on/off
+    win; auto fuses on accelerators always (dispatch round-trips dominate)
+    and on XLA:CPU only when the eager path's estimated dispatch count
+    reaches exec.fuse.min.ops — the substrate-dependent selection PR 3
+    measured for the operator-scope knobs."""
+    accel = jax.default_backend() != "cpu"
+    return resolve_tri(
+        conf.get(FUSE_ENABLE), accel or cost >= conf.get(FUSE_MIN_OPS)
+    )
+
+
+def _safe_runs(ops: list) -> list:
+    """Partition a chain (top-down) into maximal runs tagged fusable or
+    not: a single host-evaluated expression splits the segment around it
+    rather than killing the whole chain."""
+    runs: list[tuple[bool, list]] = []
+    for o in ops:
+        ok = _op_safe(o)
+        if runs and runs[-1][0] == ok:
+            runs[-1][1].append(o)
+        else:
+            runs.append((ok, [o]))
+    return runs
+
+
+def _rebuild_chain(runs: list, bottom: ExecOperator, conf: Configuration) -> ExecOperator:
+    """Reassemble a chain over ``bottom``, fusing each fusable run that
+    passes the cost model and keeping the others' original operators."""
+    cur = bottom
+    for ok, run in reversed(runs):
+        seg = _plan_segment(run) if ok else None
+        if seg is not None and seg.steps and _should_fuse(seg.cost(), conf):
+            cur = seg.build(cur, run[0].schema)
+        else:
+            for o in reversed(run):
+                o.children[0] = cur
+                cur = o
+    return cur
+
+
+def _try_prefuse_agg(agg, conf: Configuration):
+    """Extend the segment THROUGH a partial-mode HashAggExec: compile the
+    chain below it plus the agg's grouping/argument expressions into one
+    stage program and rewrite the aggregate over bare column refs. Returns
+    the rebuilt aggregate, or None when the shape doesn't qualify (the
+    normal chain pass then runs below the untouched aggregate)."""
+    from auron_tpu.exec.agg_exec import AggExpr, HashAggExec
+
+    in_schema = agg.children[0].schema
+    exprs = [g for g, _ in agg.groupings] + [
+        a.expr for a, _ in agg.aggs if a.expr is not None
+    ]
+    if not exprs:
+        return None
+    if not all(expr_trace_safe(e, in_schema, allow_dict_out=True) for e in exprs):
+        return None
+    ops, source = _collect_chain(agg.children[0])
+    runs = _safe_runs(ops)
+    top_run = runs[0][1] if runs and runs[0][0] else []
+    rest = runs[1:] if top_run else runs
+    names = [n for _, n in agg.groupings] + [
+        n for a, n in agg.aggs if a.expr is not None
+    ]
+    seg = _plan_segment(top_run)
+    seg.add_project(in_schema, tuple(exprs), names, op_name="HashAggExec")
+    if not _should_fuse(seg.cost(), conf):
+        return None
+
+    new_groupings = [
+        (ir.Column(i, n), n) for i, (_, n) in enumerate(agg.groupings)
+    ]
+    k = len(agg.groupings)
+    new_aggs = []
+    for a, n in agg.aggs:
+        if a.expr is None:
+            new_aggs.append((AggExpr(a.func, None, udaf=a.udaf), n))
+        else:
+            new_aggs.append((AggExpr(a.func, ir.Column(k, n), udaf=a.udaf), n))
+            k += 1
+    # validate the rewrite BEFORE any side effects (segment accounting,
+    # chain rewiring): probe the rebuilt aggregate's typing against a
+    # schema-only carrier of the stage's emitted layout
+    from auron_tpu.exec.basic import EmptyPartitionsExec
+
+    probe = HashAggExec(
+        EmptyPartitionsExec(seg.stamp, 1), new_groupings, new_aggs, agg.mode
+    )
+    if probe.schema != agg.schema or probe.inter_schema != agg.inter_schema:
+        # typing drift (e.g. a NULL-kind grouping literal surfacing as
+        # INT32 through the stage): materialize instead of fusing wrong
+        return None
+    below = _rebuild_chain(rest, _visit(source, conf), conf)
+    fused = seg.build(below, seg.stamp)
+    new_agg = HashAggExec(fused, new_groupings, new_aggs, agg.mode)
+    spec = _dense_prep_spec(new_agg)
+    if spec is not None:
+        link = DensePrepLink()
+        fused.attach_dense_link(link, new_agg.n_keys, spec)
+        new_agg._dense_prep_link = link
+    return new_agg
+
+
+def _dense_prep_spec(agg) -> tuple | None:
+    """Static per-agg plane spec for _stage_program_prep, or None when the
+    aggregate can't run its dense fold off stage-prepped planes. Column
+    indices address the stage's OUTPUT layout (keys first, then aggregate
+    arguments in declaration order). Publication stays runtime-gated: the
+    aggregate only publishes an anchor when its dense table is live AND
+    the host-scatter substrate is chosen, so attaching a link to a plan
+    that ends up on the device-scatter path costs nothing."""
+    from auron_tpu.exec.agg_exec import is_wide_sum, sum_type
+
+    if not agg._dense_eligible():
+        return None
+    spec = []
+    col = agg.n_keys
+    for (a, _), in_t in zip(agg.aggs, agg._agg_input_types):
+        if a.func == "count_star":
+            spec.append(("count_star",))
+            continue
+        if a.func == "count":
+            spec.append(("count", col))
+        elif a.func in ("sum", "avg"):
+            if is_wide_sum(in_t):
+                return None  # _dense_eligible already excludes; stay safe
+            st = sum_type(in_t)
+            kind = "f" if st.is_float else "i"
+            spec.append((a.func, col, st, kind))
+        elif a.func in ("min", "max"):
+            spec.append((a.func, col, np.dtype(in_t.physical_dtype().name).name))
+        else:
+            return None
+        col += 1
+    return tuple(spec)
+
+
+def _visit(op: ExecOperator, conf: Configuration) -> ExecOperator:
+    from auron_tpu.exec.agg_exec import HashAggExec
+
+    if (
+        isinstance(op, HashAggExec)
+        and op.mode == "partial"
+        and conf.get(FUSE_AGG_INPUTS)
+    ):
+        new = _try_prefuse_agg(op, conf)
+        if new is not None:
+            return new
+    if isinstance(op, _CHAIN_OPS):
+        ops, source = _collect_chain(op)
+        return _rebuild_chain(_safe_runs(ops), _visit(source, conf), conf)
+    for i, c in enumerate(op.children):
+        op.children[i] = _visit(c, conf)
+    return op
+
+
+def fuse_exec_tree(plan: ExecOperator, conf: Configuration) -> ExecOperator:
+    """Apply whole-stage fusion to an instantiated exec tree. A no-op when
+    ``exec.fuse.enable`` resolves off for every segment; bit-identical
+    results either way (tests/test_fusion.py fuzzes the equivalence)."""
+    if conf.get(FUSE_ENABLE) == "off":
+        return plan
+    return _visit(plan, conf)

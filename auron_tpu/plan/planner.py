@@ -519,6 +519,7 @@ def plan_from_proto(p: pb.PhysicalPlanNode):
 
 def task_from_proto(task: pb.TaskDefinition):
     """Returns (root exec, stage_id, partition_id, Configuration)."""
+    from auron_tpu.plan.fusion import fuse_exec_tree
     from auron_tpu.plan.optimizer import elide_smj_input_sorts, prune_columns
 
     _resolve_shuffle_templates(task)
@@ -527,7 +528,10 @@ def task_from_proto(task: pb.TaskDefinition):
     # column pruning runs on EVERY task (idempotent): join pair-gather
     # bytes scale with emitted column count, the dominant join cost
     proto = prune_columns(elide_smj_input_sorts(task.plan, mode=mode))
-    plan = plan_from_proto(proto)
+    # whole-stage fusion rewrites the EXEC tree (protos/goldens untouched):
+    # pipeline segments between blocking boundaries compile into single
+    # XLA programs where the cost model says fusion wins (plan/fusion.py)
+    plan = fuse_exec_tree(plan_from_proto(proto), conf)
     return plan, task.stage_id, task.partition_id, conf
 
 

@@ -409,7 +409,38 @@ FILTER_FUSE = bool_conf(
     "(schema, predicate, capacity-bucket) instead of eager per-op "
     "dispatch: fuses the compare/mask chain into a single pass and stops "
     "eager dispatch from serializing against concurrent jitted programs "
-    "on the executor (the q5-class FilterExec misattribution)",
+    "on the executor (the q5-class FilterExec misattribution). Subsumed "
+    "by exec.fuse.* whole-stage fusion when a filter sits inside a fused "
+    "segment; this knob still governs standalone FilterExec batches",
+)
+FUSE_ENABLE = str_conf(
+    "exec.fuse.enable", "auto", "fusion",
+    "whole-stage fusion (plan/fusion.py, docs/fusion.md): compile each "
+    "maximal scan->filter->project->partial-agg-input pipeline segment "
+    "between blocking boundaries into ONE jitted XLA program per "
+    "(schema, segment signature, capacity bucket). on | off | auto = "
+    "fuse everywhere the per-segment cost model predicts a win — always "
+    "on accelerators, and on the CPU backend only for segments whose "
+    "estimated eager-dispatch count reaches exec.fuse.min.ops (the "
+    "PR-3-measured CPU exception: fused filter chains beat eager "
+    "dispatch there too). Results are bit-identical either way",
+)
+FUSE_MIN_OPS = int_conf(
+    "exec.fuse.min.ops", 2, "fusion",
+    "cost-model threshold for fuse-vs-materialize on the CPU backend "
+    "under exec.fuse.enable=auto: a segment fuses only when the eager "
+    "path would cost at least this many per-batch operator dispatches "
+    "(expression DAG nodes + one per constituent operator). Accelerator "
+    "backends fuse every trace-safe segment regardless — dispatch "
+    "round-trips dominate there",
+)
+FUSE_AGG_INPUTS = bool_conf(
+    "exec.fuse.agg.inputs", True, "fusion",
+    "extend fused segments THROUGH a partial-mode HashAggExec's input "
+    "evaluation: grouping and aggregate argument expressions are "
+    "compiled into the segment program and the aggregate is rewritten "
+    "to consume bare column refs — the scan->filter->project->partial-"
+    "agg stage shape of ROADMAP item 2 (gated by the same cost model)",
 )
 UDF_FALLBACK_ENABLE = bool_conf(
     "udf.fallback.enable", True, "expr",

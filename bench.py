@@ -28,6 +28,13 @@ extra partitions only add task/shuffle overhead),
 BENCH_TPU_PROBE_TIMEOUT (seconds per probe attempt, default 240),
 BENCH_TPU_PROBE_TRIES (default 3).
 
+``ingest_gb_s`` RATCHETS like the gate speedups (BENCH_RATCHET=0 opts
+out): the best value per (sf, backend) persists in PERF_RATCHET.json
+(key ``ingest_gb_s@sf<N>[:backend]``, seeded from BENCH_r05's 1.245
+GB/s at sf=8) and a correct run whose ingest throughput falls below
+0.9 x best exits nonzero — zero-copy-ingest gains (ROADMAP item 3) are
+held the same way query speedups are.
+
 ``--trace-out=PATH`` (or AURON_TRACE_OUT) raises obs to full-trace mode
 and writes the timed runs' span timeline as Chrome/Perfetto JSON; the
 record then also carries ``top_ops_span`` (per-op seconds re-derived
@@ -306,6 +313,23 @@ def main() -> None:
                 "bench.py: --trace-out requested but obs recording is "
                 "disabled (AURON_TPU_OBS_KILL?); no trace written\n"
             )
+    # ---- ingest-throughput ratchet (ROADMAP item 3): ingest_gb_s rides
+    # PERF_RATCHET.json like the gate speedups — best passing value per
+    # (scale factor, backend), and a later run fails below 0.9 x best
+    # (seeded from BENCH_r05's 1.245 GB/s). Only a CORRECT run records
+    # (the differential assert above already gated that).
+    from perf_gate import RATCHET_SLACK, _load_ratchet, _save_ratchet
+
+    # %g keeps fractional scale factors distinct (sf=0.5 -> "sf0.5";
+    # int() would collide 0.5/0.1 on "sf0" and 8.5 on "sf8")
+    ingest_key = f"ingest_gb_s@sf{sf:g}" + (
+        f":{backend}" if backend != "cpu" else ""
+    )
+    ratchet = _load_ratchet()
+    ingest_best = ratchet.get(ingest_key)
+    ratchet_ok = os.environ.get("BENCH_RATCHET", "1") != "0"
+    if ratchet_ok and ingest_best is not None:
+        record["ingest_floor"] = round(RATCHET_SLACK * ingest_best, 3)
     if backend in ("tpu", "axon"):
         # settle the cluster-sort verdict on real hardware while we have
         # the chip: lax.sort vs bitonic network (jnp + pallas kernel).
@@ -329,6 +353,17 @@ def main() -> None:
         except Exception as e:
             record["sort_bench_error"] = repr(e)[-200:]
     print(json.dumps(record))
+    if ratchet_ok:
+        gbs = record["ingest_gb_s"]
+        if ingest_best is not None and gbs < RATCHET_SLACK * ingest_best:
+            sys.stderr.write(
+                f"bench.py: ingest throughput {gbs} GB/s regressed below "
+                f"{RATCHET_SLACK} x best {ingest_best} ({ingest_key})\n"
+            )
+            sys.exit(1)
+        if gbs > (ingest_best or 0.0):
+            ratchet[ingest_key] = gbs
+            _save_ratchet(ratchet)
 
 
 if __name__ == "__main__":

@@ -1374,3 +1374,151 @@ def test_changed_mode_rejects_vacuous_and_ambiguous_invocations(capsys):
     # explicit paths would be silently ignored
     assert main(["--changed", "auron_tpu/exec"]) == 2
     assert "picks its own files" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# R2 fused-segment cache-key discipline (whole-stage fusion, docs/fusion.md)
+# ---------------------------------------------------------------------------
+
+
+def test_r2_fires_on_jit_wrapper_built_in_batch_loop():
+    """A jit wrapper constructed per batch (or per segment instance inside
+    the batch loop) starts an empty compile cache each iteration — the
+    fused-segment retrace explosion the stage-program cache key exists to
+    prevent."""
+    rep = _lint(
+        """
+        import jax
+
+        def drive(stream, fn):
+            for b in stream:
+                prog = jax.jit(fn)
+                yield prog(b)
+        """,
+        RetraceRule(),
+    )
+    hits = _hits(rep, "R2")
+    assert len(hits) == 1
+    assert "inside a loop" in hits[0].message
+
+
+def test_r2_fires_on_jit_decorated_def_in_loop():
+    rep = _lint(
+        """
+        import jax
+
+        def build(segments):
+            out = []
+            for seg in segments:
+                @jax.jit
+                def prog(dev):
+                    return dev
+                out.append(prog)
+            return out
+        """,
+        RetraceRule(),
+    )
+    hits = _hits(rep, "R2")
+    assert len(hits) == 1
+    assert "defined inside a loop" in hits[0].message
+
+
+def test_r2_module_level_stage_program_quiet():
+    """The sanctioned pattern (plan/fusion.py): ONE module-level jit whose
+    cache keys on static (schema, segment signature) args, dispatched from
+    the batch loop — a call inside the loop is fine, construction is not."""
+    rep = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("steps",))
+        def _stage_program(dev, *, steps):
+            return dev
+
+        def drive(stream, steps):
+            for b in stream:
+                yield _stage_program(b, steps=steps)
+        """,
+        RetraceRule(),
+    )
+    assert not _hits(rep, "R2")
+
+
+# ---------------------------------------------------------------------------
+# R10 teeth for fused-stage closures: the trace-safe machinery the stage
+# compiler reuses must keep being checked for conf reads, host transfers
+# and captured-state mutation through the whole traced closure
+# ---------------------------------------------------------------------------
+
+
+def test_r10_fused_stage_shaped_closure_conf_read():
+    """A helper reachable from a stage-program-shaped jit entry reading
+    active_conf(): the resolved knob would be baked into every cached
+    (schema, signature, bucket) program."""
+    hits = _r10({"pkg/stage.py": """
+    import jax
+    from functools import partial
+    from auron_tpu.utils.config import active_conf
+
+    def _eval_step(dev, steps):
+        if active_conf().get("exec.fuse.enable") == "off":
+            return dev
+        return dev
+
+    @partial(jax.jit, static_argnames=("steps",))
+    def stage_program(dev, *, steps):
+        return _eval_step(dev, steps)
+    """})
+    assert len(hits) == 1
+    assert "active_conf" in hits[0][2] and "traced via" in hits[0][2]
+
+
+def test_r10_fused_stage_shaped_closure_host_transfer_and_mutation():
+    """Host transfers and compile-counter mutation inside the traced
+    closure: both fire once at trace time only — the exact hazards the
+    fusion pass keeps OUTSIDE the program (_note_dispatch runs host-side
+    before dispatch)."""
+    hits = _r10({"pkg/stage.py": """
+    import jax
+    from functools import partial
+
+    _COMPILES = {}
+
+    def _count_and_read(dev, sig):
+        _COMPILES[sig] = _COMPILES.get(sig, 0) + 1
+        return int(dev.sum().item())
+
+    @partial(jax.jit, static_argnames=("sig",))
+    def stage_program(dev, *, sig):
+        n = _count_and_read(dev, sig)
+        return dev[:n]
+    """})
+    msgs = " | ".join(h[2] for h in hits)
+    assert len(hits) == 2
+    assert ".item()" in msgs and "_COMPILES" in msgs
+
+
+def test_r2_call_form_decorator_in_loop_reports_once():
+    """@partial(jax.jit, ...) decorators are ast.Call nodes too — the
+    loop scan must report the site exactly once (decorator branch), not
+    double-count it through the bare-call branch."""
+    rep = _lint(
+        """
+        import jax
+        from functools import partial
+
+        def build(segments):
+            out = []
+            for seg in segments:
+                @partial(jax.jit, static_argnames=("n",))
+                def prog(dev, *, n):
+                    return dev
+                out.append(prog)
+            return out
+        """,
+        RetraceRule(),
+    )
+    hits = _hits(rep, "R2")
+    assert len(hits) == 1
+    assert "defined inside a loop" in hits[0].message

@@ -1,0 +1,371 @@
+"""Whole-stage fusion gate (plan/fusion.py; docs/fusion.md).
+
+Bit-identity is the contract: every pipeline the pass rewrites must
+produce byte-for-byte the batches the eager operators produce, across
+schemas, NULL patterns, capacity buckets, dictionary passthrough, the
+partial-agg input rewrite, the dense-prep hand-off (including forced
+re-anchors and a forced compaction-bucket mispredict downstream), and
+the blocking-boundary rules. The retrace guard's accounting
+(fusion_stats) is pinned here too: replaying a stream must not add
+compiles, and compile count is bounded by programs x capacity buckets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exec.agg_exec import AggExpr, HashAggExec
+from auron_tpu.exec.basic import (
+    FilterExec,
+    LimitExec,
+    MemoryScanExec,
+    ProjectExec,
+    RenameColumnsExec,
+)
+from auron_tpu.exec.joins import BroadcastHashJoinExec
+from auron_tpu.exec.sort_exec import SortExec
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.ir import BinaryOp, Case, Column, If, In, IsNull, Literal, Not
+from auron_tpu.ops.sortkeys import SortSpec
+from auron_tpu.plan import fusion
+from auron_tpu.plan.fusion import (
+    FusedStageExec,
+    expr_trace_safe,
+    fuse_exec_tree,
+    fusion_stats,
+    reset_fusion_stats,
+)
+from auron_tpu.utils.config import Configuration
+
+ON = Configuration({"exec.fuse.enable": "on"})
+
+
+def _walk(op):
+    yield op
+    for c in op.children:
+        yield from _walk(c)
+
+
+def _types(op):
+    return [type(o).__name__ for o in _walk(op)]
+
+
+def _frame(n, seed, nulls=False):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 50, n).astype(np.int64)
+    v = rng.normal(size=n)
+    q = rng.integers(0, 100, n).astype(np.int32)
+    s = [f"s{int(x) % 9}" for x in rng.integers(0, 40, n)]
+    d = {
+        "k": k.tolist(), "v": v.tolist(), "q": q.tolist(), "s": s,
+    }
+    if nulls:
+        d["k"] = [None if i % 7 == 0 else x for i, x in enumerate(d["k"])]
+        d["v"] = [None if i % 5 == 0 else x for i, x in enumerate(d["v"])]
+        d["s"] = [None if i % 11 == 0 else x for i, x in enumerate(d["s"])]
+    schema = T.Schema((
+        T.Field("k", T.INT64, True), T.Field("v", T.FLOAT64, True),
+        T.Field("q", T.INT32, True), T.Field("s", T.STRING, True),
+    ))
+    return Batch.from_pydict(d, schema)
+
+
+def _ab(build, sort_cols=None):
+    """Collect the tree eager vs fused; assert identical; return fused."""
+    plain = build().collect().to_pandas()
+    fused_tree = fuse_exec_tree(build(), ON)
+    fused = fused_tree.collect().to_pandas()
+    if sort_cols:
+        plain = plain.sort_values(sort_cols).reset_index(drop=True)
+        fused = fused.sort_values(sort_cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(plain, fused)
+    return fused_tree
+
+
+# ---------------------------------------------------------------------------
+# bit-identity fuzz
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("nulls", [False, True])
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chain_bit_identity_fuzz(seed, nulls):
+    """filter->project->filter->rename chains over varying capacity
+    buckets and NULL patterns: fused output is bit-identical, including a
+    dictionary-encoded passthrough column riding through the segment."""
+    rng = np.random.default_rng(seed * 101)
+    batches = [
+        _frame(int(rng.integers(100, 3000)), seed * 10 + i, nulls)
+        for i in range(4)
+    ]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        f1 = FilterExec(scan, [
+            BinaryOp("gt", Column(1, "v"), Literal(-0.5, T.FLOAT64)),
+            In(Column(2, "q"), tuple(range(0, 90)), False),
+        ])
+        p = ProjectExec(f1, [
+            BinaryOp("add", Column(0, "k"), Literal(1, T.INT64)),
+            Case(((BinaryOp("lt", Column(2, "q"), Literal(10, T.INT32)),
+                   Literal(0.0, T.FLOAT64)),), Column(1, "v")),
+            Column(3, "s"),          # dict passthrough
+            Not(IsNull(Column(0, "k"))),
+        ], ["k1", "vc", "s", "kn"])
+        f2 = FilterExec(p, [Column(3, "kn")])
+        return RenameColumnsExec(f2, ["K", "V", "S", "KN"])
+
+    tree = _ab(build)
+    assert isinstance(tree, FusedStageExec), _types(tree)
+    assert tree.fused_op_names() == ["FilterExec", "ProjectExec", "FilterExec"]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_agg_prefusion_bit_identity(seed):
+    """scan->filter->partial agg->final agg with the grouping/argument
+    expressions compiled into the stage (incl. dense prep on the CPU
+    host-scatter substrate): identical to the eager pipeline."""
+    batches = [_frame(1500, seed * 7 + i, nulls=True) for i in range(5)]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        f = FilterExec(scan, [BinaryOp("gt", Column(2, "q"), Literal(20, T.INT32))])
+        key = If(BinaryOp("lt", Column(2, "q"), Literal(60, T.INT32)),
+                 Literal(None, T.INT64), Column(0, "k"))
+        p = HashAggExec(f, [(key, "g")], [
+            (AggExpr("sum", Column(1, "v")), "s"),
+            (AggExpr("count_star", None), "c"),
+            (AggExpr("min", Column(2, "q")), "lo"),
+            (AggExpr("max", Column(1, "v")), "hi"),
+            (AggExpr("avg", Column(1, "v")), "a"),
+            (AggExpr("count", Column(1, "v")), "cv"),
+        ], "partial")
+        return HashAggExec(p, [(Column(0, "g"), "g")], [
+            (AggExpr("sum", Column(1, "s")), "s"),
+            (AggExpr("count_star", None), "c"),
+            (AggExpr("min", Column(2, "lo")), "lo"),
+            (AggExpr("max", Column(3, "hi")), "hi"),
+            (AggExpr("avg", Column(4, "a")), "a"),
+            (AggExpr("count", Column(6, "cv")), "cv"),
+        ], "final")
+
+    tree = _ab(build, sort_cols=["g"])
+    partial = tree.children[0]
+    assert isinstance(partial, HashAggExec)
+    assert isinstance(partial.children[0], FusedStageExec)
+    # the rewritten aggregate consumes bare column refs
+    assert all(isinstance(g, Column) for g, _ in partial.groupings)
+    assert partial.children[0].dense_link is not None
+
+
+def test_dense_reanchor_under_prefusion():
+    """Key range explodes mid-stream: the dense table drains, re-anchors
+    and re-publishes; stale-epoch prepped batches refold via the raw path.
+    Results stay identical to the eager pipeline."""
+    frames = []
+    for i in range(6):
+        lo = 0 if i < 2 else 10_000_000 * i  # range jumps force restarts
+        k = (np.arange(800) % 37 + lo).astype(np.int64)
+        frames.append(Batch.from_pydict({
+            "k": k.tolist(),
+            "v": np.linspace(0, 1, 800).tolist(),
+        }))
+
+    def build():
+        scan = MemoryScanExec([list(frames)], frames[0].schema)
+        p = HashAggExec(scan, [(Column(0, "k"), "k")], [
+            (AggExpr("sum", Column(1, "v")), "s"),
+            (AggExpr("count_star", None), "c"),
+        ], "partial")
+        return HashAggExec(p, [(Column(0, "k"), "k")], [
+            (AggExpr("sum", Column(1, "s")), "s"),
+            (AggExpr("count_star", None), "c"),
+        ], "final")
+
+    _ab(build, sort_cols=["k"])
+
+
+def test_fused_stage_feeding_join_chain_mispredict(monkeypatch):
+    """A fused filter below a BHJ whose selectivity jumps ~0 -> ~100%
+    mid-stream: the downstream compaction-bucket mispredict repair sees
+    exactly the batches the eager filter would emit (bit-identical end
+    result) — fusion must not disturb the predictor protocol."""
+    n = 6000
+    k0 = np.where(np.arange(n) < 1000, 999, np.arange(n) % 8).astype(np.int64)
+    fact = pd.DataFrame({"k0": k0, "amt": np.arange(n, dtype=np.int64)})
+    dim = pd.DataFrame({"id": np.arange(8, dtype=np.int64),
+                        "dv": np.arange(8, dtype=np.int64) * 10})
+    fact_b = [Batch.from_pandas(fact.iloc[i:i + 1000])
+              for i in range(0, n, 1000)]
+    dim_b = [Batch.from_pandas(dim)]
+
+    def build():
+        scan = MemoryScanExec([list(fact_b)], fact_b[0].schema)
+        flt = FilterExec(scan, [BinaryOp(
+            "gteq", Column(1, "amt"), Literal(0, T.INT64))])
+        return BroadcastHashJoinExec(
+            flt, MemoryScanExec([list(dim_b)], dim_b[0].schema),
+            [Column(0, "k0")], [Column(0, "id")], "inner",
+            build_side="right",
+        )
+
+    from auron_tpu.utils.config import JOIN_COMPACT_OUTPUT, active_conf
+    conf = active_conf()
+    saved = conf.get(JOIN_COMPACT_OUTPUT)
+    conf.set(JOIN_COMPACT_OUTPUT, "on")
+    try:
+        tree = _ab(build, sort_cols=None)
+    finally:
+        conf.set(JOIN_COMPACT_OUTPUT, saved)
+    assert "FusedStageExec" in _types(tree)
+
+
+# ---------------------------------------------------------------------------
+# blocking boundaries & trace safety
+# ---------------------------------------------------------------------------
+
+
+def test_segments_never_cross_blocking_boundaries():
+    """Sort, join build and limit are boundaries: chains above and below
+    fuse separately, never THROUGH the boundary operator."""
+    batches = [_frame(500, 3)]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        f1 = FilterExec(scan, [BinaryOp("gt", Column(1, "v"), Literal(0.0, T.FLOAT64))])
+        srt = SortExec(f1, [Column(0, "k")], [SortSpec(True, True)])
+        f2 = FilterExec(srt, [BinaryOp("lt", Column(2, "q"), Literal(90, T.INT32))])
+        lim = LimitExec(f2, 100)
+        p = ProjectExec(lim, [Column(0, "k"), Column(1, "v")], ["k", "v"])
+        return p
+
+    tree = fuse_exec_tree(build(), ON)
+    names = _types(tree)
+    # project above limit fused alone; filter between sort and limit fused
+    # alone; filter below sort fused alone — boundaries intact in between
+    assert names.count("FusedStageExec") == 3
+    i_sort = names.index("SortExec")
+    i_lim = names.index("LimitExec")
+    assert i_lim < i_sort  # limit sits above sort in this walk order
+    for seg in (s for s in _walk(tree) if isinstance(s, FusedStageExec)):
+        assert len(seg.fused_op_names()) == 1  # nothing fused ACROSS
+
+
+def test_unsafe_exprs_split_segments():
+    """A host-evaluated expression (LIKE over a dict column) splits the
+    chain: safe runs around it fuse, the unsafe operator stays eager."""
+    batches = [_frame(400, 4)]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        f1 = FilterExec(scan, [BinaryOp("gt", Column(1, "v"), Literal(-9.0, T.FLOAT64))])
+        f2 = FilterExec(f1, [ir.Like(Column(3, "s"), "s1%", False, "\\")])
+        f3 = FilterExec(f2, [BinaryOp("lt", Column(2, "q"), Literal(95, T.INT32))])
+        return f3
+
+    tree = _ab(build)
+    names = _types(tree)
+    assert names[:4] == ["FusedStageExec", "FilterExec", "FusedStageExec",
+                         "MemoryScanExec"]
+
+
+def test_trace_safety_rules():
+    schema = _frame(10, 0).schema
+    assert expr_trace_safe(BinaryOp("gt", Column(1, "v"), Literal(0.0, T.FLOAT64)), schema)
+    assert expr_trace_safe(In(Column(2, "q"), (1, 2, 3), True), schema)
+    # dict-encoded column: bare ref only with allow_dict_out
+    assert not expr_trace_safe(Column(3, "s"), schema)
+    assert expr_trace_safe(Column(3, "s"), schema, allow_dict_out=True)
+    # IsNull over a dict column reads only validity — safe
+    assert expr_trace_safe(IsNull(Column(3, "s")), schema)
+    # string compare transforms dictionaries — not fusable
+    assert not expr_trace_safe(
+        BinaryOp("eq", Column(3, "s"), Literal("s1", T.STRING)), schema)
+    # host UDFs never fuse
+    assert not expr_trace_safe(
+        ir.HostUDF("f", (Column(0, "k"),), T.INT64), schema)
+    # row-offset context never fuses
+    assert not expr_trace_safe(ir.RowNum(), schema)
+
+
+def test_cost_model_substrate_selection():
+    """auto on XLA:CPU fuses only segments whose eager dispatch estimate
+    reaches exec.fuse.min.ops; on/off override unconditionally."""
+    batches = [_frame(200, 5)]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        return ProjectExec(scan, [Column(0, "k")], ["k"])
+
+    # 1 op + 1 expr node = cost 2; min.ops 50 rejects, 1 accepts (CPU auto)
+    t1 = fuse_exec_tree(build(), Configuration(
+        {"exec.fuse.enable": "auto", "exec.fuse.min.ops": 50}))
+    assert not isinstance(t1, FusedStageExec)
+    t2 = fuse_exec_tree(build(), Configuration(
+        {"exec.fuse.enable": "auto", "exec.fuse.min.ops": 1}))
+    assert isinstance(t2, FusedStageExec)
+    t3 = fuse_exec_tree(build(), Configuration({"exec.fuse.enable": "off"}))
+    assert not isinstance(t3, FusedStageExec)
+
+
+# ---------------------------------------------------------------------------
+# retrace discipline & metric attribution
+# ---------------------------------------------------------------------------
+
+
+def test_replay_adds_no_compiles():
+    """The (schema, segment signature, capacity bucket) cache key is
+    stable: replaying the same stream adds ZERO fused-segment compiles,
+    and compile count stays bounded by programs x distinct buckets —
+    the tools/perfcheck.py retrace guard's invariant."""
+    batches = [_frame(100, 6), _frame(1000, 7), _frame(100, 8)]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        return FilterExec(scan, [BinaryOp("gt", Column(1, "v"), Literal(0.0, T.FLOAT64))])
+
+    reset_fusion_stats()
+    tree = fuse_exec_tree(build(), ON)
+    tree.collect()
+    s1 = fusion_stats()
+    assert s1["programs"] == 1
+    assert s1["compiles"] == 2  # two distinct capacity buckets
+    tree.collect()  # replay: same signatures, same buckets
+    tree2 = fuse_exec_tree(build(), ON)  # same segment, fresh tree
+    tree2.collect()
+    s2 = fusion_stats()
+    assert s2["compiles"] == s1["compiles"], "replay must not retrace"
+    assert s2["compiles"] <= s2["programs"] * 2
+
+
+def test_metric_attribution_splits_per_operator():
+    """Fused-program time lands on the CONSTITUENT operators' metric
+    nodes (top_ops must see FilterExec/ProjectExec, not one opaque
+    stage), and the span timeline receives the same nanos (the <=5%
+    span/metric cross-check relies on it)."""
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.metrics import MetricNode
+
+    batches = [_frame(2000, 9)]
+
+    def build():
+        scan = MemoryScanExec([list(batches)], batches[0].schema)
+        f = FilterExec(scan, [BinaryOp("gt", Column(1, "v"), Literal(0.0, T.FLOAT64))])
+        return ProjectExec(f, [BinaryOp("add", Column(0, "k"), Literal(1, T.INT64))], ["k1"])
+
+    tree = fuse_exec_tree(build(), ON)
+    ctx = ExecutionContext()
+    ctx.metrics.name = tree.name
+    list(tree.execute(0, ctx))
+    per_op: dict = {}
+    MetricNode.accumulate_op_totals(ctx.metrics.snapshot(), per_op)
+    assert "FilterExec" in per_op and "ProjectExec" in per_op
+    total = per_op["FilterExec"].get("elapsed_compute", 0) + \
+        per_op["ProjectExec"].get("elapsed_compute", 0)
+    assert total > 0
+    assert per_op["FusedStageExec"].get("fused_batches") == 1
+    assert "elapsed_compute" not in per_op["FusedStageExec"]

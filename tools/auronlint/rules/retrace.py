@@ -14,7 +14,14 @@ batch object. R2 flags, per ``jax.jit`` site:
 - unhashable parameter defaults (list/dict/set) — jit static args must
   hash;
 - a nested jitted function closing over a device array bound in the
-  enclosing function (pass it as an argument instead).
+  enclosing function (pass it as an argument instead);
+- a ``jax.jit`` application (call or decorated def) lexically inside a
+  ``for``/``while`` body — every iteration builds a FRESH wrapper with an
+  empty compile cache, so per-batch work retraces per batch. This is the
+  fused-segment failure mode: stage programs must be module-level jits
+  keyed on (schema, segment signature, capacity bucket) — one cached
+  wrapper, per-signature cache entries (plan/fusion.py) — never wrappers
+  built per segment instance or per batch inside the batch loop.
 """
 
 from __future__ import annotations
@@ -129,6 +136,53 @@ class RetraceRule(Rule):
                 seen.add(key)
                 return [(line, msg)]
             return []
+
+        # jit wrappers constructed inside loop bodies: an empty compile
+        # cache per iteration — the per-batch/per-segment retrace explosion
+        loop_spans = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.For, ast.While, ast.AsyncFor))
+        ]
+
+        def in_loop(line: int) -> bool:
+            return any(lo < line <= hi for lo, hi in loop_spans)
+
+        # call-form decorators (@jax.jit(...) / @partial(jax.jit, ...)) are
+        # ast.Call nodes too — claim them for the decorator branch below so
+        # one site can't report twice
+        decorator_calls = {
+            id(dec)
+            for node in ast.walk(mod.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for dec in node.decorator_list
+            if isinstance(dec, ast.Call)
+        }
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and id(node) not in decorator_calls \
+                    and _jit_call_kwargs(node) is not None \
+                    and in_loop(node.lineno):
+                yield from emit(node.lineno, (
+                    "jax.jit wrapper constructed inside a loop — each "
+                    "iteration starts an EMPTY compile cache, retracing "
+                    "per iteration; hoist the jit to module level and key "
+                    "its cache on static args (the plan/fusion.py stage-"
+                    "program pattern: one wrapper, per-signature entries)"
+                ))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    is_jit = _is_jit_ref(dec) or (
+                        isinstance(dec, ast.Call)
+                        and _jit_call_kwargs(dec) is not None
+                    )
+                    if is_jit and in_loop(node.lineno):
+                        yield from emit(dec.lineno, (
+                            f"jit-decorated '{node.name}' defined inside a "
+                            "loop — a fresh wrapper (and empty compile "
+                            "cache) per iteration; define it once at "
+                            "module level"
+                        ))
 
         for fn, kw, site_line in _jit_sites(mod):
             has_static = "static_argnames" in kw or "static_argnums" in kw

@@ -17,6 +17,17 @@ Async-window harvests (runtime/transfer.py) are NOT syncs and do not
 count; a harvest that stalls >1ms still shows in the site table, so a
 window regression surfaces here as a budget breach at the harvest site.
 
+Also the fused-segment RETRACE guard (docs/fusion.md): the budgeted run
+replays the same class a SECOND time (pinning exec.fuse.enable=on so the
+CPU cost model can't silently skip the machinery) and fails when the
+replay adds ANY fused-segment program signature or compile — the
+(schema, segment signature, compaction bucket) cache key must be
+replay-stable: a key leaking per-task or per-batch state (an object id,
+a batch array, a fresh wrapper per segment instance) mints new
+signatures/compiles on every replayed task and fails exactly here. A
+run that builds zero fused segments fails too: the guard must never
+pass vacuously.
+
 Env: PERFCHECK_SF (default 0.5), PERFCHECK_PARTS (default 2). Exits
 nonzero on any breach and prints one JSON line per site plus a summary.
 """
@@ -34,6 +45,10 @@ sys.path.insert(0, ROOT)
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # pin whole-stage fusion ON: the retrace guard below must exercise the
+    # fused-segment cache key on the CPU gate box even where the auto cost
+    # model would materialize
+    os.environ.setdefault("AURON_TPU_EXEC_FUSE_ENABLE", "on")
 
     from auron_tpu.utils.profiling import EngineCounters
 
@@ -82,6 +97,31 @@ def main() -> int:
     tpcds.run_q3_class(data, n_map=n_parts, n_reduce=n_parts,
                        work_dir=os.path.join(ws, "run"))
 
+    # ---- fused-segment retrace guard: replay the SAME class and require
+    # zero new program signatures AND zero new compiles (cache-key
+    # stability across fresh per-task operator instances — a per-instance
+    # or per-batch key component mints new entries on every replayed task)
+    from auron_tpu.plan.fusion import fusion_stats
+
+    fs1 = fusion_stats()
+    tpcds.run_q3_class(data, n_map=n_parts, n_reduce=n_parts,
+                       work_dir=os.path.join(ws, "replay"))
+    fs2 = fusion_stats()
+    retrace_failures = 0
+    if fs1["segments"] == 0:
+        retrace_failures += 1  # vacuous guard = broken guard
+    if fs2["programs"] != fs1["programs"]:
+        retrace_failures += 1
+    if fs2["compiles"] != fs1["compiles"]:
+        retrace_failures += 1
+    print(json.dumps({
+        "check": "fusion_retrace", "segments": fs2["segments"],
+        "programs_run1": fs1["programs"], "programs_run2": fs2["programs"],
+        "buckets": fs2["buckets"],
+        "compiles_run1": fs1["compiles"], "compiles_run2": fs2["compiles"],
+        "ok": retrace_failures == 0,
+    }))
+
     points = collect_sync_points(ROOT)
     # N/batch budgets are declared against OPERATOR input batches; the
     # pump count is a floor (a stream the sink never times still pumps)
@@ -108,11 +148,13 @@ def main() -> int:
             "site": site, "syncs": count, "sync_s": round(secs, 3),
             "status": status, "limit": limit,
         }))
+    failures += retrace_failures
     print(json.dumps({
         "metric": "perfcheck", "sf": sf, "batches": batches,
         "tasks": n_tasks, "host_syncs": counters.syncs,
         "async_reads": counters.async_reads,
         "sites": len(counters.sync_sites), "failures": failures,
+        "retrace_failures": retrace_failures,
     }))
     return 1 if failures else 0
 
