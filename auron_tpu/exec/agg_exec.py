@@ -62,6 +62,7 @@ from auron_tpu.utils.config import (
     AGG_INCREMENTAL_FP_BITS,
     AGG_INCREMENTAL_MERGEPATH,
     AGG_INCREMENTAL_PROBE,
+    AGG_PARTIAL_DEFER,
     PARTIAL_AGG_SKIPPING_ENABLE,
     PARTIAL_AGG_SKIPPING_MIN_ROWS,
     PARTIAL_AGG_SKIPPING_RATIO,
@@ -348,9 +349,15 @@ class HashAggExec(ExecOperator):
             return False
         for i in range(self.n_keys):
             kt = self.inter_schema[i].dtype
+            # BOOL is the densest possible key (2 value lanes + NULL):
+            # its exclusion kept q93-class IsNull-keyed aggregates on the
+            # per-batch sort-segmentation path — every fold path casts
+            # keys through int64 and reconstructs through the field's
+            # physical dtype, so 0/1 round-trips exactly
             if kt.is_dict_encoded or kt.kind not in (
                 T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32,
                 T.TypeKind.INT64, T.TypeKind.DATE32, T.TypeKind.TIMESTAMP,
+                T.TypeKind.BOOL,
             ):
                 return False
         for (a, _), in_t in zip(self.aggs, self._agg_input_types):
@@ -547,6 +554,131 @@ class HashAggExec(ExecOperator):
         if probe is not None:
             mm.register(probe, spillable=False)
 
+        # deferred PARTIAL counts (exec.agg.partial.defer, docs/fusion.md):
+        # the generic path's steady-state "ONE round-trip per batch" read
+        # (the device_get below at the sync-point(1/batch) site) becomes a
+        # k-deep read through the async transfer window — the upstream
+        # probe/stage pipeline dispatches ahead instead of blocking per
+        # batch (q93-class: 227 blocking syncs / 38s of drain). Compaction
+        # buckets come from the selectivity predictor; a truncating
+        # mispredict recomputes the reduce from the still-held batch (bit-
+        # identical, rare: the predictor grows immediately). Gated off when
+        # host aggregates sync internally anyway, or when the sorted-state
+        # probe is active (its direct state folds must not overtake
+        # window-pending batches — the first/first_ignores_null stream-
+        # order contract its spill-park test pins).
+        defer_win = None
+        defer_pred = None
+        if (
+            self.mode == PARTIAL
+            and not self._has_host_aggs
+            and probe is None
+            and resolve_tri(conf.get(AGG_PARTIAL_DEFER), True)
+        ):
+            from auron_tpu.exec.selectivity import (
+                SelectivityPredictor, predictor_enabled,
+            )
+            from auron_tpu.runtime.transfer import TransferWindow
+
+            defer_win = TransferWindow(conf.get(TRANSFER_WINDOW_DEPTH))
+            defer_pred = (
+                SelectivityPredictor(conf) if predictor_enabled(conf) else None
+            )
+
+        def dispatch_deferred(b):
+            """Dispatch half: device work only — predicted compaction +
+            the grouped reduce; the (live count, group count, collision
+            flag) scalars ride the window host-ward."""
+            from auron_tpu.columnar.batch import compact_batch, compaction_bucket
+
+            pred_cap = (
+                defer_pred.predict(b.capacity)
+                if defer_pred is not None else None
+            )
+            used_cap = None
+            bb = b
+            if pred_cap is not None:
+                out_cap = compaction_bucket(pred_cap, b.capacity)
+                if out_cap is not None:
+                    # may truncate on a mispredict — resolve_deferred
+                    # detects n > used_cap and recomputes from ``b``
+                    bb = compact_batch(b, out_cap)
+                    used_cap = out_cap
+            with ctx.metrics.timer("elapsed_compute"):
+                inter = self._to_intermediate(bb, ctx)
+            coll = getattr(inter, "_fp_collision", None)
+            scalars = [b.device.num_rows(), inter.device.num_rows()]
+            if coll is not None:
+                scalars.append(coll)
+            return tuple(scalars), (b, inter, used_cap, coll is not None)
+
+        def resolve_deferred(resolved, state):
+            """Harvest half, k batches behind dispatch: exact (n, g) land
+            together — no pending_g carry — and the intermediate stages at
+            its exact group bucket."""
+            nonlocal seen_rows, seen_groups, skipping
+            b, inter, used_cap, has_coll = state
+            n, g = int(resolved[0]), int(resolved[1])
+            if defer_pred is not None:
+                defer_pred.observe(n, predicted=used_cap)
+            if n == 0:
+                return
+            if used_cap is not None and n > used_cap:
+                # predicted bucket truncated live rows: recompute from the
+                # still-held original batch at the exact bucket
+                from auron_tpu.columnar.batch import compact_batch
+
+                ctx.metrics.add("sel_mispredicts", 1)
+                bb = b
+                if 4 * n <= b.capacity:
+                    bb = compact_batch(b, bucket_capacity(n))
+                with ctx.metrics.timer("elapsed_compute"):
+                    inter = self._to_intermediate(bb, ctx)
+                coll = getattr(inter, "_fp_collision", None)
+                scalars = [inter.device.num_rows()]
+                if coll is not None:
+                    scalars.append(coll)
+                # auronlint: disable=R9 -- mispredict repair only: fires when the predictor under-sized a bucket; growth-on-mispredict bounds it per stream
+                got = [int(x) for x in jax.device_get(tuple(scalars))]  # auronlint: sync-point(4/task) -- deferred-agg mispredict repair: exact group-count re-read after a truncating bucket miss
+                g = got[0]
+                if coll is not None:
+                    _note_collision(inter, got[1], ctx.metrics)
+            elif has_coll:
+                _note_collision(inter, int(resolved[2]), ctx.metrics)
+            seen_rows += n
+            seen_groups += g
+            inter = self._prefix_slice_meta(inter, bucket_capacity(max(g, 1)))
+            if skipping:
+                yield inter
+                return
+            if (
+                skipping_enabled
+                and seen_rows >= skip_min_rows
+                and seen_groups >= skip_ratio * seen_rows
+                and not table.parked
+            ):
+                ctx.metrics.add("partial_agg_skipped", 1)
+                skipping = True
+                yield from table.drain()
+                yield inter
+                return
+            mm.acquire(table, batch_nbytes(inter))
+            table.add(inter, g)
+            if table.staged_rows >= max(merge_threshold, table.state_capacity()):
+                with ctx.metrics.timer("merge_time"):
+                    table.compact()
+                ctx.metrics.add("num_merges", 1)
+
+        def feed_generic(b):
+            """Route one batch to the generic path: through the deferred
+            window when armed, else the classic blocking protocol."""
+            if defer_win is not None:
+                arrays, state = dispatch_deferred(b)
+                for resolved, st in defer_win.push(arrays, state):
+                    yield from resolve_deferred(resolved, st)
+            else:
+                yield from process_generic(b)
+
         try:
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
@@ -556,7 +688,7 @@ class HashAggExec(ExecOperator):
                     if leftovers is None:
                         continue
                     for nb in leftovers:
-                        yield from process_generic(nb)
+                        yield from feed_generic(nb)
                     continue
                 if probe is not None and not skipping:
                     with ctx.metrics.timer("elapsed_compute", count=True):
@@ -569,7 +701,9 @@ class HashAggExec(ExecOperator):
                         yield from process_generic(mb)
                     if folded:
                         continue
-                yield from process_generic(b)
+                    yield from process_generic(b)
+                    continue
+                yield from feed_generic(b)
             # end of stream: resolve the in-flight deferred dense folds
             # (up to window-depth of them) via the same protocol,
             # synchronously (there is no next batch to piggyback on)
@@ -577,15 +711,22 @@ class HashAggExec(ExecOperator):
                 for nb in dense.finish_pending():
                     if dense is None:
                         # a prior retry forced permanent fallback
-                        yield from process_generic(nb)
+                        yield from feed_generic(nb)
                         continue
                     with ctx.metrics.timer("elapsed_compute"):
                         leftovers = fold_dense(nb, defer=False)
                     for gb in leftovers or ():
-                        yield from process_generic(gb)
+                        yield from feed_generic(gb)
             if probe is not None:
                 for mb in probe.finish():
                     yield from process_generic(mb)
+            # drain the deferred-count window: entries resolve in FIFO
+            # order with the same exactly-once staging as the in-stream
+            # harvests (a cancellation skips this — the finally below
+            # drops in-flight intermediates with the table)
+            if defer_win is not None:
+                for resolved, st in defer_win.drain():
+                    yield from resolve_deferred(resolved, st)
         finally:
             if dense is not None:
                 drain_dense_into_table()

@@ -139,6 +139,10 @@ class BroadcastHashJoinExec(ExecOperator):
             return
         mm = MemManager.get()
         guard = None
+        # fused probe stage hand-off (plan/fusion.py): the probe child may
+        # be a FusedStageExec carrying our ProbePrepLink — publishing the
+        # prepared build arms it to run the probe prologue in-program
+        link = getattr(self, "_probe_prep_link", None)
         try:
             build = self._build(partition, ctx)
             # the build must stay resident for probing: register it as an
@@ -155,6 +159,8 @@ class BroadcastHashJoinExec(ExecOperator):
             from auron_tpu.exec.joins.driver import UniqueProbePipeline
 
             pipe = UniqueProbePipeline(ctx.conf)
+            if link is not None:
+                self.driver.publish_probe_prep(link, build, pipe, ctx.conf)
             for pb in self.child_stream(probe_child, partition, ctx):
                 ctx.check_cancelled()
                 # no empty-batch pre-check: it costs a host sync per batch,
@@ -165,6 +171,8 @@ class BroadcastHashJoinExec(ExecOperator):
                 yield from self.driver.finish_probe(pipe)
             yield from self.driver.finish(build)
         finally:
+            if link is not None:
+                link.clear()
             if guard is not None:
                 mm.unregister(guard)
             # fallback memos scope to this attempt (ADVICE r3): entries for

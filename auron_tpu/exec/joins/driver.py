@@ -123,6 +123,96 @@ class EquiJoinDriver:
 
     # ------------------------------------------------------------------
 
+    def _unique_probe_cfg(self) -> tuple[list[int], list[int], list[int]]:
+        """(proj, pcol_ids, bcol_ids) of the unique-build probe — THE one
+        definition shared by _probe_batch_unique, _emit_unique_compacted
+        AND the fused probe stage's plan-time config (plan/fusion.py), so
+        the stage-gathered columns can never diverge from the eager
+        twin's."""
+        nl = len(self.left_schema)
+        full_n = nl + len(self.right_schema)
+        needs_all_pairs = self.condition is not None
+        proj = (
+            list(range(full_n))
+            if (self.projection is None or not self.wants_pairs or needs_all_pairs)
+            else self.projection
+        )
+        if self.wants_pairs or needs_all_pairs:
+            bcol_ids = [
+                (oi if oi < nl else oi - nl)
+                for oi in proj
+                if (oi < nl) != self.probe_is_left
+            ]
+        else:
+            bcol_ids = []
+        pcol_ids = [
+            (oi if oi < nl else oi - nl)
+            for oi in proj
+            if (oi < nl) == self.probe_is_left
+        ]
+        return proj, pcol_ids, bcol_ids
+
+    def publish_probe_prep(self, link, build: PreparedBuild, pipe, conf) -> bool:
+        """Publish the runtime probe anchor into a fused stage's
+        ProbePrepLink (plan/fusion.py). Returns False — with the link
+        cleared — when this build's shape can't run off stage-prepped
+        probes (dict keys, duplicate build without an existence LUT): the
+        stage then passes batches through and the eager prologue runs."""
+        import jax.numpy as _jnp
+
+        probe_keys = self.left_keys if self.probe_is_left else self.right_keys
+        key_schema = (
+            self.left_schema if self.probe_is_left else self.right_schema
+        )
+        if any(
+            k.dtype_of(key_schema).is_dict_encoded for k in probe_keys
+        ):
+            link.clear()  # per-batch vocabulary unification: eager only
+            return False
+        need_pairs = self.wants_pairs or self.condition is not None
+        if build.unique:
+            kind = "unique"
+            compact = (
+                self.wants_pairs
+                and self.condition is None
+                and _compact_join_output_enabled()
+            )
+        elif build.exists_lut is not None and not need_pairs:
+            kind = "exists"
+            compact = False
+        else:
+            link.clear()  # general ragged probe: eager only
+            return False
+        _, _, bcol_ids = self._unique_probe_cfg()
+        bb = build.batch
+        if build.pack is not None:
+            spec = build.pack
+            pack_args = (
+                _jnp.asarray(spec.mins, _jnp.int64),
+                _jnp.asarray(spec.maxs, _jnp.int64),
+                _jnp.asarray(spec.shifts, _jnp.uint64),
+            )
+        else:
+            pack_args = None
+        link.publish(
+            build=build,
+            kind=kind,
+            compact=compact,
+            pipe=pipe,
+            bcap=bb.capacity,
+            use_lut=build.lut is not None,
+            lut=build.lut,
+            lut_base=_jnp.int64(build.lut_base),
+            words=tuple(build.words),
+            n_live=_jnp.int32(build.n_live),
+            packed=build.pack is not None,
+            pack_args=pack_args,
+            exists_lut=build.exists_lut,
+            bvals=tuple(bb.col_values(c) for c in bcol_ids),
+            bmasks=tuple(bb.col_validity(c) for c in bcol_ids),
+        )
+        return True
+
     def prepare(self, build_batches: list[Batch], conf=None) -> PreparedBuild:
         schema = self.left_schema if self.build_side == "left" else self.right_schema
         keys = self.left_keys if self.build_side == "left" else self.right_keys
@@ -146,7 +236,35 @@ class EquiJoinDriver:
         """Probe one batch; updates build.matched in place. ``pipe``
         (optional) enables the sync-free pipelined compaction path on the
         unique-build fast path — emissions then lag dispatch by up to the
-        window depth, and the caller must drain via ``finish_probe``."""
+        window depth, and the caller must drain via ``finish_probe``.
+
+        A batch arriving from a fused probe stage carries a
+        ``_probe_prep`` payload (plan/fusion.py): the prologue — key eval,
+        packing, lookup, gather/compact-take — already ran inside the
+        stage program under the build THIS driver published. A payload
+        computed under any other build is refused (identity check) and
+        the eager prologue runs instead, bit-identically."""
+        prep = getattr(pb, "_probe_prep", None)
+        if prep is not None and prep.build is not build:
+            prep = None  # stale/foreign anchor: eager prologue
+        if prep is not None and prep.kind == "unique" and build.unique:
+            yield from self._probe_batch_unique(build, pb, None, pipe, prep)
+            return
+        if (
+            prep is not None
+            and prep.kind == "exists"
+            and build.exists_lut is not None
+            and not (self.wants_pairs or self.condition is not None)
+        ):
+            probe_matched = prep.probe_matched
+            if self.probe_mark:
+                if self.join_type == LEFT_SEMI:
+                    yield self._emit_probe_only(pb, pb.device.sel & probe_matched)
+                elif self.join_type == LEFT_ANTI:
+                    yield self._emit_probe_only(pb, pb.device.sel & ~probe_matched)
+                else:  # existence
+                    yield self._emit_probe_exists(pb, probe_matched)
+            return
         probe_keys = self.left_keys if self.probe_is_left else self.right_keys
         pvals = _key_columns(pb, probe_keys)
         if build.pack is not None:
@@ -235,29 +353,19 @@ class EquiJoinDriver:
     def _probe_batch_unique(
         self, build: PreparedBuild, pb: Batch, pvals,
         pipe: "UniqueProbePipeline | None" = None,
+        prep=None,
     ) -> Iterator[Batch]:
         """Unique-build probe: each probe row has <=1 match, so one batch at
         probe capacity covers every join type — probe columns stay as views
         (zero gather), only projected build columns are gathered at ``bi``.
-        No ragged expansion and no host sync on the match count."""
+        No ragged expansion and no host sync on the match count. ``prep``
+        (a fused-stage ProbePrepPayload) supplies the lookup/gather results
+        the stage program already computed — the per-op jits below are then
+        skipped, everything else is identical."""
         bb = build.batch
-        needs_all_pairs = self.condition is not None
         nl = len(self.left_schema)
         full_n = nl + len(self.right_schema)
-        proj = (
-            list(range(full_n))
-            if (self.projection is None or not self.wants_pairs or needs_all_pairs)
-            else self.projection
-        )
-        # build-side columns the fused program must gather
-        if self.wants_pairs or needs_all_pairs:
-            bcol_ids = [
-                (oi if oi < nl else oi - nl)
-                for oi in proj
-                if (oi < nl) != self.probe_is_left
-            ]
-        else:
-            bcol_ids = []
+        proj, _, bcol_ids = self._unique_probe_cfg()
         import jax.numpy as _jnp
 
         # sparse-output compaction: densify BEFORE gathering build columns
@@ -270,25 +378,29 @@ class EquiJoinDriver:
         )
         if compact_ok:
             yield from self._emit_unique_compacted(
-                build, pb, pvals, bcol_ids, proj, pipe
+                build, pb, pvals, bcol_ids, proj, pipe, prep
             )
             return
 
-        bi, ok, bvals, bmasks, sel_out = core._unique_join_emit_jit(
-            tuple(cv.values for cv in pvals),
-            tuple(cv.validity for cv in pvals),
-            pb.device.sel,
-            build.lut,
-            _jnp.int64(build.lut_base) if build.lut is not None else None,
-            build.words,
-            _jnp.int32(build.n_live),
-            tuple(bb.col_values(c) for c in bcol_ids),
-            tuple(bb.col_validity(c) for c in bcol_ids),
-            bcap=bb.capacity,
-            use_lut=build.lut is not None,
-            probe_outer=self.probe_outer,
-            key_kinds=tuple(core.key_kind(cv.dtype) for cv in pvals),
-        )
+        if prep is not None and prep.take == "gather":
+            bi, ok, sel_out = prep.bi, prep.ok, prep.sel_out
+            bvals, bmasks = prep.bvals, prep.bmasks
+        else:
+            bi, ok, bvals, bmasks, sel_out = core._unique_join_emit_jit(
+                tuple(cv.values for cv in pvals),
+                tuple(cv.validity for cv in pvals),
+                pb.device.sel,
+                build.lut,
+                _jnp.int64(build.lut_base) if build.lut is not None else None,
+                build.words,
+                _jnp.int32(build.n_live),
+                tuple(bb.col_values(c) for c in bcol_ids),
+                tuple(bb.col_validity(c) for c in bcol_ids),
+                bcap=bb.capacity,
+                use_lut=build.lut is not None,
+                probe_outer=self.probe_outer,
+                key_kinds=tuple(core.key_kind(cv.dtype) for cv in pvals),
+            )
         b_at = {c: k for k, c in enumerate(bcol_ids)}
 
         def build_col(ci: int) -> ColumnVal:
@@ -337,6 +449,7 @@ class EquiJoinDriver:
     def _emit_unique_compacted(
         self, build: PreparedBuild, pb: Batch, pvals, bcol_ids, proj,
         pipe: "UniqueProbePipeline | None" = None,
+        prep=None,
     ) -> Iterator[Batch]:
         import jax
 
@@ -344,18 +457,21 @@ class EquiJoinDriver:
 
         bb = build.batch
         nl = len(self.left_schema)
-        bi, ok, sel_out, n_live_dev = core._unique_probe_jit(
-            tuple(cv.values for cv in pvals),
-            tuple(cv.validity for cv in pvals),
-            pb.device.sel,
-            build.lut,
-            jnp.int64(build.lut_base) if build.lut is not None else None,
-            build.words, jnp.int32(build.n_live),
-            bcap=bb.capacity,
-            use_lut=build.lut is not None,
-            probe_outer=self.probe_outer,
-            key_kinds=tuple(core.key_kind(cv.dtype) for cv in pvals),
-        )
+        if prep is not None:
+            bi, ok, sel_out, n_live_dev = prep.bi, prep.ok, prep.sel_out, prep.live
+        else:
+            bi, ok, sel_out, n_live_dev = core._unique_probe_jit(
+                tuple(cv.values for cv in pvals),
+                tuple(cv.validity for cv in pvals),
+                pb.device.sel,
+                build.lut,
+                jnp.int64(build.lut_base) if build.lut is not None else None,
+                build.words, jnp.int32(build.n_live),
+                bcap=bb.capacity,
+                use_lut=build.lut is not None,
+                probe_outer=self.probe_outer,
+                key_kinds=tuple(core.key_kind(cv.dtype) for cv in pvals),
+            )
         if self.build_mark or self.build_outer:
             build.matched = build.matched.at[bi].max(ok, mode="drop")
         pcol_ids = [
@@ -364,7 +480,13 @@ class EquiJoinDriver:
             if (oi < nl) == self.probe_is_left
         ]
         pred = pipe.pred if pipe is not None else None
-        pred_cap = pred.predict(pb.capacity) if pred is not None else None
+        # a fused-stage payload already made this batch's predict call (the
+        # SAME predictor instance, at dispatch time — observation order is
+        # identical); calling again would double-count and could disagree
+        pred_cap = (
+            prep.pred_cap if prep is not None
+            else (pred.predict(pb.capacity) if pred is not None else None)
+        )
         if pred_cap is None:
             # seed/fallback path: ONE transfer — the selection mask itself
             # (it was going to sync for the live count anyway; the mask is
@@ -406,25 +528,33 @@ class EquiJoinDriver:
         # predicted path: compaction index computed ON DEVICE at the
         # predicted bucket (or dense when prediction says compaction won't
         # pay) — no host sync; the actual live count is harvested from the
-        # transfer window k batches later and mispredicts repair there
+        # transfer window k batches later and mispredicts repair there.
+        # With a stage payload the gather/take already happened inside the
+        # fused program — reuse its outputs, push the same window state.
         if compaction_bucket(pred_cap, pb.capacity) is None:
-            bvals, bmasks = core._gather_build_jit(
-                tuple(bb.col_values(c) for c in bcol_ids),
-                tuple(bb.col_validity(c) for c in bcol_ids),
-                bi, ok,
-            )
+            if prep is not None and prep.take == "gather_pred":
+                bvals, bmasks = prep.bvals, prep.bmasks
+            else:
+                bvals, bmasks = core._gather_build_jit(
+                    tuple(bb.col_values(c) for c in bcol_ids),
+                    tuple(bb.col_validity(c) for c in bcol_ids),
+                    bi, ok,
+                )
             taken = (None, None, bvals, bmasks, sel_out)
             state = (pb, bb, proj, pcol_ids, bcol_ids, taken,
                      None, bi, ok, sel_out)
         else:
-            taken = core._unique_compact_take_pred_jit(
-                tuple(pb.col_values(c) for c in pcol_ids),
-                tuple(pb.col_validity(c) for c in pcol_ids),
-                bi, ok,
-                tuple(bb.col_values(c) for c in bcol_ids),
-                tuple(bb.col_validity(c) for c in bcol_ids),
-                sel_out, out_cap=pred_cap,
-            )
+            if prep is not None and prep.take == "compact":
+                taken = prep.taken
+            else:
+                taken = core._unique_compact_take_pred_jit(
+                    tuple(pb.col_values(c) for c in pcol_ids),
+                    tuple(pb.col_validity(c) for c in pcol_ids),
+                    bi, ok,
+                    tuple(bb.col_values(c) for c in bcol_ids),
+                    tuple(bb.col_validity(c) for c in bcol_ids),
+                    sel_out, out_cap=pred_cap,
+                )
             state = (pb, bb, proj, pcol_ids, bcol_ids, taken,
                      pred_cap, bi, ok, sel_out)
         for resolved, st in pipe.window.push((n_live_dev,), state):

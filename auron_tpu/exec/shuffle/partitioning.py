@@ -27,6 +27,68 @@ class Partitioning:
     def partition_ids(self, batch: Batch, ctx) -> jnp.ndarray:
         raise NotImplementedError
 
+    def fuse_spec(self, schema) -> tuple | None:
+        """Static hashable description for whole-stage shuffle fusion
+        (plan/fusion.py `_stage_program_shuffle`), or None when this
+        partitioning can't ride a fused stage program. The traced twin is
+        ``partition_ids_traced`` below — BOTH must compute bit-identical
+        pids (the fused writer's repartition may never diverge from the
+        eager one)."""
+        return None
+
+
+def _hash_pids(vals, sel, n_out: int, traced: bool) -> jnp.ndarray:
+    """THE Spark-exact (murmur3 + Pmod) pid computation shared by the
+    eager HashPartitioning and the fused stage program. The pallas fast
+    path (single int64 key on TPU, bit-identical by the kernel's contract)
+    is eager-only — inside a fused trace the jnp path fuses anyway; the
+    traced entry also restricts to fixed-width keys (hash_batch_fixed:
+    fuse_spec guarantees it, and the dict byte-matrix host cache must
+    never run at trace time)."""
+    cap = sel.shape[0]
+    if (
+        not traced
+        and len(vals) == 1
+        and vals[0].dict is None
+        and str(vals[0].values.dtype) == "int64"
+    ):
+        from auron_tpu.ops.pallas_kernels import (
+            partition_ids_pallas,
+            use_pallas,
+        )
+
+        if use_pallas():
+            pids = partition_ids_pallas(vals[0].values, n_out)
+            null_pid = pmod(
+                jnp.full(cap, jnp.uint32(42)).view(jnp.int32), n_out
+            )
+            return jnp.where(vals[0].validity, pids, null_pid)
+    from auron_tpu.exec.basic import batch_from_columns
+    from auron_tpu.ops.hash_dispatch import hash_batch_fixed
+
+    kb = batch_from_columns(vals, [f"k{i}" for i in range(len(vals))], sel)
+    hasher = hash_batch_fixed if traced else hash_batch
+    h = hasher(kb, list(range(len(vals))), "murmur3", seed=42)
+    return pmod(h, n_out)
+
+
+def _roundrobin_pids(sel, start, n_out: int) -> jnp.ndarray:
+    """Deterministic per-task round-robin cursor (reference:
+    shuffle/mod.rs RoundRobin) — the one definition behind the eager and
+    traced paths. ``start`` may be a host int or a traced scalar."""
+    ordinal = jnp.cumsum(sel.astype(jnp.int32)) - 1
+    return ((ordinal + start) % n_out).astype(jnp.int32)
+
+
+#: dtypes the murmur3 device dispatch hashes WITHOUT host dictionary
+#: expansion — the fused stage's key-type gate (dict-encoded strings hash
+#: through a per-vocabulary byte matrix whose trace-time caching is
+#: per-object: eager only)
+_FUSE_HASHABLE_KINDS = frozenset({
+    "INT8", "INT16", "INT32", "INT64", "DATE32", "TIMESTAMP", "BOOL",
+    "FLOAT32", "FLOAT64", "DECIMAL",
+})
+
 
 @dataclass
 class SinglePartitioning(Partitioning):
@@ -34,6 +96,9 @@ class SinglePartitioning(Partitioning):
 
     def partition_ids(self, batch: Batch, ctx) -> jnp.ndarray:
         return jnp.zeros(batch.capacity, jnp.int32)
+
+    def fuse_spec(self, schema) -> tuple | None:
+        return ("single",)
 
 
 @dataclass
@@ -48,28 +113,19 @@ class HashPartitioning(Partitioning):
         # (identical spark-exact bits; jnp path everywhere else). NULL keys
         # leave the running hash at the seed, so their pid is the constant
         # pmod(seed) — blended on device, no host sync, no fallback
-        if (
-            len(vals) == 1
-            and vals[0].dict is None
-            and str(vals[0].values.dtype) == "int64"
-        ):
-            from auron_tpu.ops.pallas_kernels import (
-                partition_ids_pallas,
-                use_pallas,
-            )
+        return _hash_pids(
+            vals, batch.device.sel, self.num_partitions, traced=False
+        )
 
-            if use_pallas():
-                pids = partition_ids_pallas(vals[0].values, self.num_partitions)
-                null_pid = pmod(
-                    jnp.full(batch.capacity, jnp.uint32(42)).view(jnp.int32),
-                    self.num_partitions,
-                )
-                return jnp.where(vals[0].validity, pids, null_pid)
-        from auron_tpu.exec.basic import batch_from_columns
-
-        kb = batch_from_columns(vals, [f"k{i}" for i in range(len(vals))], batch.device.sel)
-        h = hash_batch(kb, list(range(len(vals))), "murmur3", seed=42)
-        return pmod(h, self.num_partitions)
+    def fuse_spec(self, schema) -> tuple | None:
+        for e in self.exprs:
+            try:
+                dt = e.dtype_of(schema)
+            except Exception:
+                return None
+            if dt.is_dict_encoded or dt.kind.name not in _FUSE_HASHABLE_KINDS:
+                return None
+        return ("hash", tuple(self.exprs))
 
 
 @dataclass
@@ -80,9 +136,31 @@ class RoundRobinPartitioning(Partitioning):
         # deterministic start per (task partition), matching the reference's
         # per-task round-robin cursor (shuffle/mod.rs RoundRobin)
         start = (ctx.partition_id if ctx is not None else 0) % self.num_partitions
-        sel = batch.device.sel
-        ordinal = jnp.cumsum(sel.astype(jnp.int32)) - 1
-        return ((ordinal + start) % self.num_partitions).astype(jnp.int32)
+        return _roundrobin_pids(batch.device.sel, start, self.num_partitions)
+
+    def fuse_spec(self, schema) -> tuple | None:
+        return ("roundrobin",)
+
+
+def partition_ids_traced(spec, schema, n_out: int, sel, values, validity,
+                         rr_start) -> jnp.ndarray:
+    """Traceable twin of ``Partitioning.partition_ids`` for fused stage
+    programs: same Evaluator key evaluation, same ``_hash_pids`` /
+    ``_roundrobin_pids`` policies (minus the eager-only pallas branch,
+    whose bits are identical by contract). ``rr_start`` arrives as a
+    DEVICE scalar so one compiled program serves every task partition."""
+    kind = spec[0]
+    cap = sel.shape[0]
+    if kind == "single":
+        return jnp.zeros(cap, jnp.int32)
+    if kind == "roundrobin":
+        return _roundrobin_pids(sel, rr_start, n_out)
+    from auron_tpu.columnar.batch import Batch as _B
+    from auron_tpu.columnar.batch import DeviceBatch as _DB
+
+    b = _B(schema, _DB(sel, values, validity), (None,) * len(schema.fields))
+    vals = Evaluator(schema).evaluate(b, list(spec[1]))
+    return _hash_pids(vals, sel, n_out, traced=True)
 
 
 @dataclass

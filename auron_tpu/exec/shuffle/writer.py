@@ -231,9 +231,19 @@ class _ShuffleStaging:
 
 from functools import partial
 
+# ---------------------------------------------------------------------------
+# THE pid-clustering policy: stable sort by partition id, dead rows (pid ==
+# n_out) last. ONE policy, three consumers — the eager device path
+# (_cluster_by_pid), the fused stage program (plan/fusion.py
+# _stage_program_shuffle via cluster_rows) and the host numpy fallback
+# (cluster_rows_host) — with a bit-identity test (tests/test_shuffle.py)
+# pinning that fused repartition can never diverge from the fallback.
+# ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_out",))
-def _cluster_by_pid(dev: DeviceBatch, pids: jnp.ndarray, n_out: int):
+
+def cluster_rows(dev: DeviceBatch, pids: jnp.ndarray, n_out: int):
+    """Traceable clustering body shared by the eager jit wrapper and the
+    fused stage program: (pid-clustered DeviceBatch, counts[n_out+1])."""
     sel = dev.sel
     cap = sel.shape[0]
     sort_pid = jnp.where(sel, pids, n_out).astype(jnp.int32)
@@ -246,6 +256,31 @@ def _cluster_by_pid(dev: DeviceBatch, pids: jnp.ndarray, n_out: int):
         validity=tuple(m[order] for m in dev.validity),
     )
     return out, counts
+
+
+def cluster_rows_host(pids_np: np.ndarray, sel_np: np.ndarray, n_out: int):
+    """Host twin of ``cluster_rows``: (live-row order, per-partition
+    counts[n_out]) via the same stable-sort-by-pid policy (numpy's stable
+    argsort == lax.sort's (pid, iota) tiebreak), dead rows sorted last and
+    excluded from the returned order."""
+    sort_pid = np.where(sel_np, pids_np.astype(np.int32), n_out)
+    counts = np.bincount(sort_pid, minlength=n_out + 1)[:n_out]
+    order_live = np.argsort(sort_pid, kind="stable")[: int(counts.sum())]
+    return order_live, counts
+
+
+def repartition_substrate(conf) -> str:
+    """"host" (numpy argsort + host arrow slicing) or "device" (lax.sort
+    clustering) — THE substrate decision shared by the eager writer and
+    the fused stage so the two repartition paths cannot diverge."""
+    from auron_tpu.ops import hostsort
+
+    return "host" if hostsort.use_host_sort(conf) else "device"
+
+
+@partial(jax.jit, static_argnames=("n_out",))
+def _cluster_by_pid(dev: DeviceBatch, pids: jnp.ndarray, n_out: int):
+    return cluster_rows(dev, pids, n_out)
 
 
 
@@ -317,17 +352,30 @@ def stage_partition_batch(
     device->host copies — the writer loops finish one batch behind, so
     the transfer overlaps the child's next batch of compute
     (docs/pipeline.md; this is the spill/shuffle-count member of the
-    async transfer window)."""
-    from auron_tpu.ops import hostsort
+    async transfer window).
+
+    A batch arriving from a fused writer stage carries a ``_shuffle_prep``
+    payload (plan/fusion.py): pids — and on the device substrate the
+    clustered batch + counts — already rode the stage program. The payload
+    is consumed only when its n_out and substrate match what the eager
+    path would compute (repartition_substrate), else ignored."""
     from auron_tpu.runtime.transfer import start_host_transfer
 
-    pids = partitioning.partition_ids(b, ctx)
     n_out = partitioning.num_partitions
-    if hostsort.use_host_sort(ctx.conf):
+    substrate = repartition_substrate(ctx.conf)
+    sp = getattr(b, "_shuffle_prep", None)
+    if sp is not None and (sp.n_out != n_out or sp.mode != substrate):
+        sp = None  # stale/foreign payload: recompute eagerly
+    if substrate == "host":
+        pids = sp.pids if sp is not None else partitioning.partition_ids(b, ctx)
         dev = b.device
         start_host_transfer(pids, dev.sel, *dev.values, *dev.validity)
         return (b, pids, None, None)
-    clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
+    if sp is not None:
+        clustered_dev, counts = sp.clustered_dev, sp.counts
+    else:
+        pids = partitioning.partition_ids(b, ctx)
+        clustered_dev, counts = _cluster_by_pid(b.device, pids, n_out)
     start_host_transfer(counts)
     return (b, None, clustered_dev, counts)
 
@@ -346,18 +394,17 @@ def finish_partition_batch(
         # CPU host: the clustered rows are headed to HOST Arrow blocks
         # anyway, so pull the WHOLE batch once and do everything — stable
         # integer argsort (numpy radix), live-prefix slicing, per-column
-        # gathers — in numpy. The previous split (host argsort, device
-        # gather, second full transfer via to_arrow) paid two round trips
-        # and a capacity-sized gather program per batch; this is one
+        # gathers — in numpy (cluster_rows_host: the SAME clustering
+        # policy as the device path). The previous split (host argsort,
+        # device gather, second full transfer via to_arrow) paid two round
+        # trips and a capacity-sized gather program per batch; this is one
         # transfer and live-row-count work. The device path below stays
         # for accelerators, where the gather belongs on-device.
         from auron_tpu.columnar.batch import host_rows_to_arrow
 
         with async_read_scope():  # copies started at stage time
             pids_np, dev = jax.device_get((pids, b.device))  # numpy leaves
-        sort_pid = np.where(dev.sel, pids_np.astype(np.int32), n_out)
-        counts_np = np.bincount(sort_pid, minlength=n_out + 1)[:n_out]
-        order_live = np.argsort(sort_pid, kind="stable")[: int(counts_np.sum())]
+        order_live, counts_np = cluster_rows_host(pids_np, dev.sel, n_out)
         rb = host_rows_to_arrow(b.schema, b.dicts, dev.values, dev.validity,
                                 order_live, preserve_dicts=True)
         out = []

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax.numpy as jnp
+import numpy as np
 
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch
@@ -114,10 +115,14 @@ def _hash_columns_jit(values, validity, dict_mats, dtypes, algo, seed):
     dict_mats: per-column (bytes_mat, lens) or None for fixed types.
     """
     n = values[0].shape[0]
+    # numpy scalars on purpose: jnp.uint32(seed) eagerly mints a DEVICE
+    # scalar that outlives the trace as a closure constant — embedding it
+    # into MLIR reads it back (a spurious "sync" at every enclosing
+    # stage-program compile); a numpy seed lowers as a pure literal
     if algo == "murmur3":
-        h = jnp.full((n,), jnp.uint32(seed))
+        h = jnp.full((n,), np.uint32(seed))
     else:
-        h = jnp.full((n,), jnp.int64(seed).view(jnp.uint64))
+        h = jnp.full((n,), np.int64(seed).view(np.uint64))
     for v, valid, dm, dtype in zip(values, validity, dict_mats, dtypes):
         if dtype.kind == T.TypeKind.NULL:
             continue
@@ -137,6 +142,36 @@ def _hash_columns_jit(values, validity, dict_mats, dtypes, algo, seed):
     if algo == "murmur3":
         return h.view(jnp.int32)
     return h.view(jnp.int64)
+
+
+def hash_batch_fixed(
+    batch: Batch,
+    cols: list[int],
+    algo: str = "murmur3",
+    seed: int = 42,
+) -> jnp.ndarray:
+    """``hash_batch`` restricted to fixed-width columns: NO dictionary
+    byte-matrix preparation (whose per-object host cache is trace-unsafe),
+    so fused stage programs (plan/fusion.py `_stage_program_shuffle`) may
+    call it inside a trace. Same chained-hash policy — both entries funnel
+    into `_hash_columns_jit` with identical inputs for fixed types."""
+    assert algo in ("murmur3", "xxhash64")
+    dev = batch.device
+    values, validity, dtypes = [], [], []
+    for ci in cols:
+        dtype = batch.schema[ci].dtype
+        if dtype.is_string_like or dtype.is_wide_decimal:
+            raise TypeError(
+                f"hash_batch_fixed: column {ci} ({dtype}) needs host "
+                "dictionary expansion — use hash_batch outside a trace"
+            )
+        values.append(dev.values[ci])
+        validity.append(dev.validity[ci])
+        dtypes.append(dtype)
+    return _hash_columns_jit(
+        tuple(values), tuple(validity), (None,) * len(values), tuple(dtypes),
+        algo, seed,
+    )
 
 
 def hash_batch(

@@ -63,6 +63,8 @@ from auron_tpu.utils.config import (
     FUSE_AGG_INPUTS,
     FUSE_ENABLE,
     FUSE_MIN_OPS,
+    FUSE_PROBE,
+    FUSE_SHUFFLE,
     Configuration,
     resolve_tri,
 )
@@ -224,6 +226,198 @@ def _stage_program_prep(dev: DeviceBatch, bases, his, strides, size, *,
     return sel, values, validity, (idx, guards, tuple(planes))
 
 
+@_partial(jax.jit, static_argnames=("steps", "emit", "probe"))
+def _stage_program_probe(dev, lut, lut_base, bwords, n_live, pack_args,
+                         exists_lut, bvals, bmasks, *, steps: tuple,
+                         emit: str, probe: tuple):
+    """Stage program variant for segments feeding a hash-join probe: in the
+    SAME compiled program as the filter/project work, run the probe
+    prologue — key evaluation, canonical-word packing, the unique/existence
+    hash-map lookup and (per ``take``) the build-row gather or the
+    predicted compact-take — mirroring ``exec/joins/driver.py``'s eager
+    chain (``_pack_probe_jit`` -> ``_unique_probe_jit`` ->
+    ``_gather_build_jit`` / ``_unique_compact_take_pred_jit``) bit-for-bit.
+
+    Build-side state (``lut``/``bwords``/``n_live``/pack ranges/build
+    columns) arrives as DEVICE ARGUMENTS published at runtime by the join
+    exec (ProbePrepLink), so a fresh build — even a different one — reuses
+    the compiled program; ``probe`` is the static half:
+    (key_exprs, key_schema, key_kinds, use_lut, probe_outer, bcap, packed,
+    pcol_ids, take) with take one of ("probe",) | ("gather",) |
+    ("compact", out_cap) | ("exists",)."""
+    from auron_tpu.columnar.batch import compaction_index
+    from auron_tpu.exec.joins import core as jcore
+
+    sel, values, validity, _ = _trace_steps(dev, steps)
+    (key_exprs, key_schema, kinds, use_lut, probe_outer, bcap, packed,
+     pcol_ids, take) = probe
+    b = Batch(key_schema, DeviceBatch(sel, values, validity),
+              (None,) * len(key_schema.fields))
+    ev = Evaluator(key_schema, partition_id=0, row_offset=0, resources={})
+    memo: dict = {}
+    kcvs = [ev._eval(e, b, memo) for e in key_exprs]
+    if packed:
+        # multi-key packing with the build's ranges (driver: _pack_probe_jit
+        # then a single synthetic INT64 key column)
+        w0, v0 = jcore._canon_words(kcvs)
+        mins, maxs, shifts = pack_args
+        pw, pv = jcore._pack_probe_words_jit(tuple(w0), v0, mins, maxs, shifts)
+        probe_words = [jnp.where(pv, pw, jnp.uint64(0))]
+        pvalid = pv
+    else:
+        probe_words, pvalid = jcore._canon_words_traced(
+            tuple(cv.values for cv in kcvs),
+            tuple(cv.validity for cv in kcvs), kinds,
+        )
+    ok_base = sel & (pvalid if pvalid is not None else jnp.ones_like(sel))
+    if take[0] == "exists":
+        # duplicate-tolerant existence LUT (driver: _probe_exists_jit)
+        size = exists_lut.shape[0]
+        eidx = probe_words[0].view(jnp.int64) - lut_base
+        in_range = (eidx >= 0) & (eidx < size)
+        hit = exists_lut[jnp.clip(eidx, 0, size - 1).astype(jnp.int32)]
+        out = (ok_base & in_range & hit,)
+        if emit == "cols":
+            return sel, values, validity, out
+        return sel, out
+    bi, ok = jcore._probe_unique_ops(
+        probe_words, ok_base, lut if use_lut else None, lut_base,
+        list(bwords), n_live, bcap,
+    )
+    sel_out = sel if probe_outer else (sel & ok)
+    live = jnp.sum(sel_out.astype(jnp.int32))
+    if take[0] == "probe":
+        out = (bi, ok, sel_out, live)
+    elif take[0] == "gather":
+        bv = tuple(v[bi] for v in bvals)
+        bm = tuple(m[bi] & ok for m in bmasks)
+        out = (bi, ok, sel_out, live, bv, bm)
+    else:  # ("compact", out_cap) — the predicted sync-free take
+        out_cap = take[1]
+        idx, new_sel = compaction_index(sel_out, out_cap)
+        c_pvals = tuple(values[c][idx] for c in pcol_ids)
+        c_pmasks = tuple(validity[c][idx] & new_sel for c in pcol_ids)
+        c_bi = bi[idx]
+        c_ok = ok[idx] & new_sel
+        out_bvals = tuple(v[c_bi] for v in bvals)
+        out_bmasks = tuple(m[c_bi] & c_ok for m in bmasks)
+        out = (bi, ok, sel_out, live,
+               (c_pvals, c_pmasks, out_bvals, out_bmasks, new_sel))
+    if emit == "cols":
+        return sel, values, validity, out
+    return sel, out
+
+
+@_partial(jax.jit, static_argnames=("steps", "emit", "shuffle"))
+def _stage_program_shuffle(dev, rr_start, *, steps: tuple, emit: str,
+                           shuffle: tuple):
+    """Stage program variant for segments feeding a shuffle writer: in the
+    SAME compiled program as the filter/project work, compute the per-row
+    partition ids (partitioning.partition_ids_traced — the eager policy
+    minus the pallas fast path, bit-identical ids) and, on the device
+    clustering substrate, the pid-clustered gather + per-partition counts
+    (writer.cluster_rows — the one clustering policy the host fallback
+    shares). ``shuffle`` is the static (spec, schema, n_out, mode) with
+    mode "device" (clustered batch + counts ride the payload) or "host"
+    (only the pids ride; the writer's numpy path clusters host-side)."""
+    from auron_tpu.exec.shuffle.partitioning import partition_ids_traced
+    from auron_tpu.exec.shuffle.writer import cluster_rows
+
+    sel, values, validity, _ = _trace_steps(dev, steps)
+    spec, schema, n_out, mode = shuffle
+    pids = partition_ids_traced(
+        spec, schema, n_out, sel, values, validity, rr_start
+    )
+    if mode == "host":
+        extra = (pids,)
+    else:
+        out_dev, counts = cluster_rows(
+            DeviceBatch(sel, values, validity), pids, n_out
+        )
+        extra = (out_dev, counts)
+    if emit == "cols":
+        return sel, values, validity, extra
+    return sel, extra
+
+
+class ProbePrepLink:
+    """Anchor hand-off from a hash-join exec to the fused stage feeding its
+    probe side. The join publishes once its build is prepared (device
+    arrays + host ints of the build layout, the per-stream
+    UniqueProbePipeline, and the compact-vs-dense choice); the stage then
+    runs the probe prologue inside its program and attaches a
+    ProbePrepPayload to each emitted batch. Same thread-model as
+    DensePrepLink: stage and join share the task pump thread, the lock
+    guards foreign observers only. The payload carries the BUILD IT WAS
+    COMPUTED UNDER — the driver refuses a payload whose build is not the
+    one it is probing (identity check), falling back to the eager
+    prologue bit-identically."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._anchor: dict | None = None
+
+    def publish(self, **anchor) -> None:
+        with self._lock:
+            self._anchor = anchor
+
+    def clear(self) -> None:
+        with self._lock:
+            self._anchor = None
+
+    def snapshot(self) -> dict | None:
+        with self._lock:
+            return self._anchor
+
+
+class ProbePrepPayload:
+    """One probe batch's stage-computed prologue results riding to the join
+    driver (attached to the Batch as ``_probe_prep``). ``take`` names the
+    eager twin the stage replaced: "probe" (lookup only — the driver's
+    blocking seed path finishes), "gather" / "gather_pred" (build columns
+    gathered at probe width; non-compact emit vs predicted-dense window
+    push), "compact" (the predicted compact-take, ``taken`` =
+    _unique_compact_take_pred_jit's output tuple), "exists"
+    (existence-LUT probe flags)."""
+
+    __slots__ = ("build", "kind", "take", "pred_cap", "bi", "ok", "sel_out",
+                 "live", "bvals", "bmasks", "taken", "probe_matched")
+
+    def __init__(self, build, kind, take, pred_cap=None, bi=None, ok=None,
+                 sel_out=None, live=None, bvals=None, bmasks=None,
+                 taken=None, probe_matched=None):
+        self.build = build
+        self.kind = kind
+        self.take = take
+        self.pred_cap = pred_cap
+        self.bi = bi
+        self.ok = ok
+        self.sel_out = sel_out
+        self.live = live
+        self.bvals = bvals
+        self.bmasks = bmasks
+        self.taken = taken
+        self.probe_matched = probe_matched
+
+
+class ShufflePrepPayload:
+    """One batch's stage-computed repartition riding to the shuffle writer
+    (attached as ``_shuffle_prep``): mode "device" carries the
+    pid-clustered DeviceBatch + per-partition counts, mode "host" carries
+    the partition ids (the writer's numpy path clusters host-side). The
+    writer validates n_out and the substrate policy before consuming —
+    a mismatch falls back to the eager repartition bit-identically."""
+
+    __slots__ = ("n_out", "mode", "pids", "clustered_dev", "counts")
+
+    def __init__(self, n_out, mode, pids=None, clustered_dev=None, counts=None):
+        self.n_out = n_out
+        self.mode = mode
+        self.pids = pids
+        self.clustered_dev = clustered_dev
+        self.counts = counts
+
+
 class DensePrepLink:
     """Anchor hand-off from a dense partial aggregate to the fused stage
     feeding it. Stage and aggregate run on the SAME task pump thread (the
@@ -280,16 +474,18 @@ _FUSE_LOCK = threading.Lock()
 _SEEN_PROGRAMS: set = set()  # segment signatures
 _SEEN_TRACES: set = set()  # (segment signature, capacity bucket)
 _SEEN_BUCKETS: set = set()  # capacity buckets observed (any segment)
-_STATS = {"segments": 0, "programs": 0, "compiles": 0, "buckets": 0}
+_STATS = {"segments": 0, "programs": 0, "compiles": 0, "buckets": 0,
+          "probe_segments": 0, "writer_segments": 0}
 
 
 def fusion_stats() -> dict:
     """Snapshot of fused-segment accounting: ``segments`` = FusedStageExec
-    instances built, ``programs`` = distinct segment signatures dispatched,
-    ``buckets`` = distinct capacity buckets observed, ``compiles`` =
-    distinct (signature, capacity-bucket) traces — the number perfcheck's
-    retrace guard bounds by programs x buckets and requires FLAT across a
-    replay."""
+    instances built (``probe_segments`` / ``writer_segments`` = the subset
+    carrying a join-probe / shuffle-repartition extension), ``programs`` =
+    distinct segment signatures dispatched, ``buckets`` = distinct
+    capacity buckets observed, ``compiles`` = distinct (signature,
+    capacity-bucket) traces — the number perfcheck's retrace guard bounds
+    by programs x buckets and requires FLAT across a replay."""
     with _FUSE_LOCK:
         return dict(_STATS)
 
@@ -357,6 +553,17 @@ class FusedStageExec(ExecOperator):
         self.dense_link: DensePrepLink | None = None
         self._prep_nkeys = 0
         self._prep_aggs: tuple = ()
+        #: set by the probe-side rewrite when the consumer is a hash join:
+        #: once the join publishes its prepared build, the stage compiles
+        #: the probe prologue into the same program (_stage_program_probe)
+        self.probe_link: ProbePrepLink | None = None
+        self._probe_keys: tuple = ()
+        self._probe_kinds: tuple = ()
+        self._probe_outer = False
+        self._probe_pcols: tuple = ()
+        #: set by the writer-side rewrite: (spec, schema, n_out) — the
+        #: repartition rides the stage program (_stage_program_shuffle)
+        self.shuffle: tuple | None = None
         with _FUSE_LOCK:
             _STATS["segments"] += 1
 
@@ -373,8 +580,129 @@ class FusedStageExec(ExecOperator):
             for nm, w in self.op_shares
         )
 
+    def attach_probe_link(self, link: ProbePrepLink, key_exprs: tuple,
+                          key_kinds: tuple, probe_outer: bool,
+                          pcol_ids: tuple, op_name: str, cost: int) -> None:
+        """Arm the stage as a join-probe prologue carrier. The probe work's
+        cost share is charged to the JOIN's operator name — fused-program
+        wall nanos spent on the lookup/gather surface under the join in
+        top_ops, exactly where the eager prologue books them."""
+        self.probe_link = link
+        self._probe_keys = key_exprs
+        self._probe_kinds = key_kinds
+        self._probe_outer = probe_outer
+        self._probe_pcols = pcol_ids
+        self.op_shares = tuple(self.op_shares) + ((op_name, cost),)
+        with _FUSE_LOCK:
+            _STATS["probe_segments"] += 1
+
+    def attach_shuffle(self, spec: tuple, schema, n_out: int,
+                       cost: int) -> None:
+        """Arm the stage as a shuffle-repartition carrier; the repartition
+        cost share is charged to ShuffleWriterExec's name (the eager twin
+        books it under the writer's repart_time)."""
+        self.shuffle = (spec, schema, n_out)
+        self.op_shares = tuple(self.op_shares) + (("ShuffleWriterExec", cost),)
+        with _FUSE_LOCK:
+            _STATS["writer_segments"] += 1
+
     def fused_op_names(self) -> list[str]:
         return [nm for nm, _ in self.op_shares]
+
+    def _dispatch_probe(self, b: Batch, anchor: dict, node):
+        """One probe-extended program dispatch: resolve the per-batch take
+        mode from the pipeline's predictor (the SAME predict call the eager
+        driver would make), run _stage_program_probe, and wrap the results
+        as a ProbePrepPayload for the join driver."""
+        from auron_tpu.columnar.batch import compaction_bucket
+
+        kind = anchor["kind"]
+        pred_cap = None
+        take_tag = None
+        if kind == "exists":
+            take_prog = ("exists",)
+        elif not anchor["compact"]:
+            take_prog, take_tag = ("gather",), "gather"
+        else:
+            pipe = anchor["pipe"]
+            pred = pipe.pred if pipe is not None else None
+            pred_cap = pred.predict(b.capacity) if pred is not None else None
+            if pred_cap is None:
+                # seed/fallback: lookup only — the driver's blocking seed
+                # read finishes the batch exactly as the eager path does
+                take_prog, take_tag = ("probe",), "probe"
+            elif compaction_bucket(pred_cap, b.capacity) is None:
+                take_prog, take_tag = ("gather",), "gather_pred"
+            else:
+                take_prog, take_tag = ("compact", pred_cap), "compact"
+        key_schema = self.out_stamp or self.children[0].schema
+        cfg = (self._probe_keys, key_schema, self._probe_kinds,
+               anchor["use_lut"], self._probe_outer, anchor["bcap"],
+               anchor["packed"], self._probe_pcols, take_prog)
+        emit = "cols" if self.has_project else "sel"
+        if _note_dispatch((self.steps, "probe", cfg), b.capacity):
+            node.add("stage_compiles", 1)
+        res = _stage_program_probe(
+            b.device, anchor["lut"], anchor["lut_base"], anchor["words"],
+            anchor["n_live"], anchor["pack_args"], anchor["exists_lut"],
+            anchor["bvals"], anchor["bmasks"],
+            steps=self.steps, emit=emit, probe=cfg,
+        )
+        if emit == "cols":
+            sel, values, validity, extra = res
+            out = (sel, values, validity)
+        else:
+            sel, extra = res
+            out = sel
+        build = anchor["build"]
+        if kind == "exists":
+            payload = ProbePrepPayload(
+                build, kind, "exists", probe_matched=extra[0]
+            )
+        elif take_prog[0] == "probe":
+            bi, ok, sel_out, live = extra
+            payload = ProbePrepPayload(
+                build, kind, take_tag, pred_cap=None,
+                bi=bi, ok=ok, sel_out=sel_out, live=live,
+            )
+        elif take_prog[0] == "gather":
+            bi, ok, sel_out, live, bv, bm = extra
+            payload = ProbePrepPayload(
+                build, kind, take_tag, pred_cap=pred_cap,
+                bi=bi, ok=ok, sel_out=sel_out, live=live, bvals=bv, bmasks=bm,
+            )
+        else:
+            # taken mirrors _unique_compact_take_pred_jit's output layout:
+            # (c_pvals, c_pmasks, bvals, bmasks, new_sel)
+            bi, ok, sel_out, live, taken = extra
+            payload = ProbePrepPayload(
+                build, kind, take_tag, pred_cap=pred_cap,
+                bi=bi, ok=ok, sel_out=sel_out, live=live, taken=taken,
+            )
+        return out, payload
+
+    def _dispatch_shuffle(self, b: Batch, mode: str, rr_start, node):
+        spec, schema, n_out = self.shuffle
+        cfg = (spec, schema, n_out, mode)
+        emit = "cols" if self.has_project else "sel"
+        if _note_dispatch((self.steps, "shuffle", cfg), b.capacity):
+            node.add("stage_compiles", 1)
+        res = _stage_program_shuffle(
+            b.device, rr_start, steps=self.steps, emit=emit, shuffle=cfg
+        )
+        if emit == "cols":
+            sel, values, validity, extra = res
+            out = (sel, values, validity)
+        else:
+            sel, extra = res
+            out = sel
+        if mode == "host":
+            payload = ShufflePrepPayload(n_out, mode, pids=extra[0])
+        else:
+            payload = ShufflePrepPayload(
+                n_out, mode, clustered_dev=extra[0], counts=extra[1]
+            )
+        return out, payload
 
     def _execute(self, partition: int, ctx: ExecutionContext):
         node = ctx.metrics
@@ -389,9 +717,24 @@ class FusedStageExec(ExecOperator):
             c = node.child(1 + k)
             c.name = nm
             attr.append(c)
+        rr_start = None
+        shuffle_mode = None
+        if self.shuffle is not None:
+            from auron_tpu.exec.shuffle.writer import repartition_substrate
+
+            # conf-stable per task: the SAME policy the eager writer
+            # resolves, so fused and fallback repartition cannot diverge
+            shuffle_mode = repartition_substrate(ctx.conf)
+            rr_start = jnp.int32(ctx.partition_id % self.shuffle[2])
         for b in self.child_stream(0, partition, ctx):
+            t_all = time.perf_counter_ns()
             anchor = self.dense_link.snapshot() if self.dense_link else None
+            probe_anchor = (
+                self.probe_link.snapshot() if self.probe_link else None
+            )
             payload = None
+            probe_payload = None
+            shuffle_payload = None
             t0 = time.perf_counter_ns()
             if anchor is not None:
                 prep_cfg = (self._prep_nkeys, self._prep_aggs)
@@ -407,6 +750,18 @@ class FusedStageExec(ExecOperator):
                     anchor["epoch"], anchor["bases"], anchor["his"],
                     anchor["dims"], anchor["size"], sel, idx, guards, planes,
                 )
+            elif probe_anchor is not None:
+                out, probe_payload = self._dispatch_probe(b, probe_anchor, node)
+            elif self.shuffle is not None:
+                out, shuffle_payload = self._dispatch_shuffle(
+                    b, shuffle_mode, rr_start, node
+                )
+            elif not self.steps:
+                # bare prologue carrier with nothing published (e.g. the
+                # join fell back to a build shape the stage can't serve):
+                # pure passthrough, no program dispatch
+                yield b
+                continue
             else:
                 if _note_dispatch(sig, b.capacity):
                     node.add("stage_compiles", 1)
@@ -428,12 +783,26 @@ class FusedStageExec(ExecOperator):
                     b.dicts[s] if s is not None else None for s in self.dict_src
                 )
                 nb = Batch(self.out_stamp, DeviceBatch(sel, values, validity), dicts)
-                if payload is not None:
-                    nb._dense_prep = payload
-                yield nb
             else:
                 dev = DeviceBatch(out, b.device.values, b.device.validity)
-                yield Batch(self.out_stamp or b.schema, dev, b.dicts)
+                nb = Batch(self.out_stamp or b.schema, dev, b.dicts)
+            if payload is not None:
+                nb._dense_prep = payload
+            if probe_payload is not None:
+                nb._probe_prep = probe_payload
+            if shuffle_payload is not None:
+                nb._shuffle_prep = shuffle_payload
+            # residual stage overhead (batch re-wrap, anchor snapshot,
+            # payload assembly) not covered by the per-constituent split is
+            # attributed to the STAGE node — top_ops must conserve nanos
+            # (sum of splits + residual == stage wall; test_fusion pins it)
+            total = time.perf_counter_ns() - t_all
+            residual = max(total - dt, 0)
+            node.add("stage_wall", total)
+            node.add("elapsed_compute", residual)
+            obs.note_op(node.name or "FusedStageExec", "elapsed_compute",
+                        residual)
+            yield nb
 
 
 # ---------------------------------------------------------------------------
@@ -446,6 +815,7 @@ from auron_tpu.exec.basic import (  # noqa: E402
     ProjectExec,
     RenameColumnsExec,
 )
+from auron_tpu.exec.joins.core import key_kind as core_key_kind  # noqa: E402
 
 _CHAIN_OPS = (FilterExec, ProjectExec, RenameColumnsExec)
 
@@ -554,15 +924,16 @@ def _plan_segment(ops_top_down: list) -> _Segment:
 # ---------------------------------------------------------------------------
 
 
-def _should_fuse(cost: int, conf: Configuration) -> bool:
+def _should_fuse(cost: int, conf: Configuration, knob=FUSE_ENABLE) -> bool:
     """The fuse-vs-materialize decision (docs/fusion.md): explicit on/off
     win; auto fuses on accelerators always (dispatch round-trips dominate)
     and on XLA:CPU only when the eager path's estimated dispatch count
     reaches exec.fuse.min.ops — the substrate-dependent selection PR 3
-    measured for the operator-scope knobs."""
+    measured for the operator-scope knobs. ``knob`` selects the tri-state
+    governing a stage extension (exec.fuse.probe / exec.fuse.shuffle)."""
     accel = jax.default_backend() != "cpu"
     return resolve_tri(
-        conf.get(FUSE_ENABLE), accel or cost >= conf.get(FUSE_MIN_OPS)
+        conf.get(knob), accel or cost >= conf.get(FUSE_MIN_OPS)
     )
 
 
@@ -691,8 +1062,114 @@ def _dense_prep_spec(agg) -> tuple | None:
     return tuple(spec)
 
 
+def _fallback_chain(child: ExecOperator, conf: Configuration) -> ExecOperator:
+    """The ordinary chain-fusion pass over a prologue-stage candidate that
+    didn't qualify — the SAME step `_visit` takes for a bare chain, kept
+    in one place so the probe/writer fallbacks can't diverge from it."""
+    if isinstance(child, _CHAIN_OPS):
+        ops, source = _collect_chain(child)
+        return _rebuild_chain(_safe_runs(ops), _visit(source, conf), conf)
+    return _visit(child, conf)
+
+
+def _chain_segment_below(child: ExecOperator, conf: Configuration):
+    """Shared prologue-stage planning: split the chain under ``child`` into
+    (segment for the TOP fusable run, remaining runs, source below) — the
+    same top-run carve-out _try_prefuse_agg performs. The top segment may
+    be EMPTY (child is not a chain op, or its top run is unsafe): the
+    extension then rides a bare carrier stage with steps=()."""
+    ops, source = _collect_chain(child)
+    runs = _safe_runs(ops)
+    top_run = runs[0][1] if runs and runs[0][0] else []
+    rest = runs[1:] if top_run else runs
+    seg = _plan_segment(top_run)
+    out_schema = top_run[0].schema if top_run else child.schema
+    return seg, rest, source, out_schema
+
+
+def _probe_side_rewrite(join, child: ExecOperator,
+                        conf: Configuration) -> ExecOperator:
+    """Extend the fused stage feeding ``join``'s probe side through the
+    probe prologue (docs/fusion.md): the stage carries a ProbePrepLink the
+    join publishes its prepared build into at run time; until (or unless)
+    a publishable build exists the stage is a plain segment (or a zero-
+    cost passthrough). Falls back to the ordinary chain pass when the
+    join's shape can't run off stage-prepped probes."""
+    from auron_tpu.exec.joins.bhj import BroadcastHashJoinExec
+
+    def fallback():
+        return _fallback_chain(child, conf)
+
+    d = join.driver
+    # a probe child that is itself a BHJ is (potentially) a fused-chain
+    # stack member (exec/joins/chain.py): never wedge a stage between
+    # stacked joins — the chain's own fused probe already covers them
+    if isinstance(child, BroadcastHashJoinExec):
+        return fallback()
+    if d.condition is not None:
+        return fallback()  # residual conditions assemble pair batches
+    probe_keys = d.left_keys if d.probe_is_left else d.right_keys
+    seg, rest, source, out_schema = _chain_segment_below(child, conf)
+    # keys must evaluate inside the program over the stage's emitted
+    # layout: trace-safe, no dict-encoded or nested operands
+    if not probe_keys or not all(
+        expr_trace_safe(k, out_schema) for k in probe_keys
+    ):
+        return fallback()
+    proj, pcol_ids, bcol_ids = d._unique_probe_cfg()
+    probe_cost = (
+        sum(_expr_nodes(k) for k in probe_keys) + 6 + len(bcol_ids)
+    )
+    if not _should_fuse(seg.cost() + probe_cost, conf, knob=FUSE_PROBE):
+        return fallback()
+    below = _rebuild_chain(rest, _visit(source, conf), conf)
+    fused = seg.build(below, out_schema)
+    link = ProbePrepLink()
+    kinds = tuple(
+        core_key_kind(k.dtype_of(out_schema)) for k in probe_keys
+    )
+    fused.attach_probe_link(
+        link, tuple(probe_keys), kinds, d.probe_outer, tuple(pcol_ids),
+        type(join).__name__, probe_cost,
+    )
+    join._probe_prep_link = link
+    return fused
+
+
+def _writer_side_rewrite(writer, child: ExecOperator,
+                         conf: Configuration) -> ExecOperator:
+    """Extend the fused stage feeding a shuffle writer through the
+    repartition prologue: partition-id hashing (and device pid-clustering)
+    ride the stage program; the writer consumes the ShufflePrepPayload
+    instead of re-deriving both (docs/fusion.md)."""
+
+    def fallback():
+        return _fallback_chain(child, conf)
+
+    spec = writer.partitioning.fuse_spec(child.schema)
+    if spec is None:
+        return fallback()
+    seg, rest, source, out_schema = _chain_segment_below(child, conf)
+    key_exprs = spec[1] if spec[0] == "hash" else ()
+    if not all(expr_trace_safe(e, out_schema) for e in key_exprs):
+        return fallback()
+    n_out = writer.partitioning.num_partitions
+    shuffle_cost = sum(_expr_nodes(e) for e in key_exprs) + 4 + len(out_schema)
+    if not _should_fuse(seg.cost() + shuffle_cost, conf, knob=FUSE_SHUFFLE):
+        return fallback()
+    below = _rebuild_chain(rest, _visit(source, conf), conf)
+    fused = seg.build(below, out_schema)
+    fused.attach_shuffle(spec, out_schema, n_out, shuffle_cost)
+    return fused
+
+
 def _visit(op: ExecOperator, conf: Configuration) -> ExecOperator:
     from auron_tpu.exec.agg_exec import HashAggExec
+    from auron_tpu.exec.joins.bhj import BroadcastHashJoinExec
+    from auron_tpu.exec.shuffle.writer import (
+        RssShuffleWriterExec,
+        ShuffleWriterExec,
+    )
 
     if (
         isinstance(op, HashAggExec)
@@ -702,6 +1179,14 @@ def _visit(op: ExecOperator, conf: Configuration) -> ExecOperator:
         new = _try_prefuse_agg(op, conf)
         if new is not None:
             return new
+    if isinstance(op, BroadcastHashJoinExec):
+        pc = 1 if op.build_side == "left" else 0
+        op.children[1 - pc] = _visit(op.children[1 - pc], conf)
+        op.children[pc] = _probe_side_rewrite(op, op.children[pc], conf)
+        return op
+    if isinstance(op, (ShuffleWriterExec, RssShuffleWriterExec)):
+        op.children[0] = _writer_side_rewrite(op, op.children[0], conf)
+        return op
     if isinstance(op, _CHAIN_OPS):
         ops, source = _collect_chain(op)
         return _rebuild_chain(_safe_runs(ops), _visit(source, conf), conf)

@@ -442,6 +442,49 @@ FUSE_AGG_INPUTS = bool_conf(
     "to consume bare column refs — the scan->filter->project->partial-"
     "agg stage shape of ROADMAP item 2 (gated by the same cost model)",
 )
+FUSE_PROBE = str_conf(
+    "exec.fuse.probe", "auto", "fusion",
+    "extend the fused stage feeding a hash join's probe side THROUGH the "
+    "probe prologue: key evaluation, canonical-word packing, the unique/"
+    "existence hash-map lookup and the build-row pair-gather (incl. the "
+    "predicted compact-take) compile into the SAME stage program, so a "
+    "probe batch costs one dispatch instead of a chain of eager per-op "
+    "jits. The build side, the UniqueProbePipeline mispredict-repair "
+    "protocol and finish_probe semantics are unchanged. on | off | auto "
+    "= accelerators always, CPU when the segment cost model fuses "
+    "(exec.fuse.min.ops). off restores the eager probe bit-identically",
+)
+FUSE_SHUFFLE = str_conf(
+    "exec.fuse.shuffle", "auto", "fusion",
+    "extend the fused stage feeding a ShuffleWriterExec THROUGH the "
+    "repartition prologue: partition-id hashing and (on the device "
+    "substrate) pid-clustering ride the stage program, so the writer "
+    "receives already-clustered device batches. The host/device "
+    "clustering substrate follows the SAME policy as the eager writer "
+    "(writer.repartition_substrate), so fused and fallback repartition "
+    "cannot diverge. on | off | auto = same cost-model split as "
+    "exec.fuse.enable. off restores the eager repartition bit-identically",
+)
+AGG_PARTIAL_DEFER = str_conf(
+    "exec.agg.partial.defer", "auto", "agg",
+    "defer the PARTIAL generic path's per-batch (live count, group "
+    "count, collision flag) read through the k-deep async transfer "
+    "window (runtime.transfer.window.depth) instead of blocking one "
+    "device_get per batch: the upstream probe/stage pipeline dispatches "
+    "ahead while counts ride host-ward, compaction buckets are chosen "
+    "by the selectivity predictor and a truncating mispredict recomputes "
+    "the reduce from the still-held batch (row-exact and count-exact; "
+    "float accumulations may re-associate across the re-bucketed "
+    "reduces, the same class of difference as any merge-boundary "
+    "shift). Applies only "
+    "when no host-side aggregates and no sorted-state probe are active "
+    "(the probe path owns its own window and stream-order contract). "
+    "Up to k batches' intermediates ride outside the memory-manager "
+    "accounting while in flight. on | off | auto = on (the stall, not "
+    "the transfer, is the cost on every substrate — the q93-class 38s "
+    "drain at agg_exec.py:427). off restores the eager one-read-per-"
+    "batch protocol bit-identically",
+)
 UDF_FALLBACK_ENABLE = bool_conf(
     "udf.fallback.enable", True, "expr",
     "evaluate unconvertible expressions via host callback (SparkUDFWrapper analog)",

@@ -74,28 +74,57 @@ class EngineCounters:
         # per-call-site sync attribution (engine frame nearest the sync);
         # cheap enough to keep always-on: one stack walk per *blocking* sync
         self.sync_sites: dict[str, list] = {}
+        # per-OPERATOR sync-wait attribution: the innermost live ExecOperator
+        # frame at the moment of the stall. Generator suspension makes this
+        # the honest attribution — a producer suspended at `yield` inside an
+        # open timer is NOT on the stack, so a consumer's sync can never book
+        # under the producer's operator (the q93 misattribution: 38s of
+        # agg_exec.py:427 stalls rode BroadcastHashJoinExec's probe_time
+        # because the timer's wall clock kept ticking across the yield)
+        self.op_sync: dict[str, list] = {}
         # record every blocking sync's site regardless of duration (the
         # sync-budget gate counts multiplicities, not just stalls)
         self.record_all_sites = False
 
-    def _find_site(self) -> str:
-        """Nearest engine frame (outside the lock: it walks the stack)."""
+    def _find_site(self) -> tuple[str, str | None]:
+        """(nearest engine frame, innermost ExecOperator class name) —
+        one stack walk, outside the lock. The operator is found by the
+        first live frame whose ``self`` (locals or closure) is an
+        ExecOperator; suspended generator frames are not on the stack, so
+        attribution follows the operator actually doing the waiting."""
         import sys as _sys
 
+        try:
+            from auron_tpu.exec.base import ExecOperator as _EO
+        except Exception:  # pragma: no cover — partial-import windows
+            _EO = None
+        site = None
+        op = None
         f = _sys._getframe(2)
         while f is not None:
             fn = f.f_code.co_filename
             if "auron_tpu" in fn and "utils/profiling" not in fn:
-                return f"{fn.rsplit('auron_tpu/', 1)[-1]}:{f.f_lineno}"
+                if site is None:
+                    site = f"{fn.rsplit('auron_tpu/', 1)[-1]}:{f.f_lineno}"
+                if op is None and _EO is not None:
+                    slf = f.f_locals.get("self")
+                    if isinstance(slf, _EO):
+                        op = type(slf).__name__
+                if site is not None and op is not None:
+                    break
             f = f.f_back
-        return "?"
+        return site or "?", op
 
     def _record_site(self, dt: float) -> None:
-        site = self._find_site()
+        site, op = self._find_site()
         with self._lock:
             ent = self.sync_sites.setdefault(site, [0, 0.0])
             ent[0] += 1
             ent[1] += dt
+            if op is not None:
+                oent = self.op_sync.setdefault(op, [0, 0.0])
+                oent[0] += 1
+                oent[1] += dt
 
     @classmethod
     def install(cls) -> "EngineCounters":
@@ -178,10 +207,12 @@ class EngineCounters:
             self.async_read_s = 0.0
             self.batches = 0
             self.sync_sites.clear()
+            self.op_sync.clear()
 
     def snapshot(self) -> dict:
         with self._lock:
             sites = {k: [v[0], v[1]] for k, v in self.sync_sites.items()}
+            ops = {k: [v[0], v[1]] for k, v in self.op_sync.items()}
             out = {
                 "compiles": self.compiles,
                 "compile_s": round(self.compile_s, 3),
@@ -193,4 +224,9 @@ class EngineCounters:
             }
         top = sorted(sites.items(), key=lambda kv: -kv[1][1])[:10]
         out["sync_sites"] = {k: [v[0], round(v[1], 3)] for k, v in top}
+        # per-operator stall seconds, ranked: the breakdown column that
+        # keeps a downstream consumer's sync waits from being read as the
+        # producer's compute (reported as top_ops_sync by bench/perf_gate)
+        otop = sorted(ops.items(), key=lambda kv: -kv[1][1])[:10]
+        out["op_sync"] = {k: [v[0], round(v[1], 3)] for k, v in otop}
         return out

@@ -290,6 +290,12 @@ def main() -> None:
                 key=lambda kv: -MetricNode.op_seconds(kv[1]),
             )[:5]
         },
+        # op -> blocking sync-wait seconds (stall attribution to the
+        # operator actually waiting — a consumer's stalls can't masquerade
+        # as a producer's compute; see profiling.EngineCounters.op_sync)
+        "top_ops_sync": {
+            k: [v[0], v[1]] for k, v in sync_snap.get("op_sync", {}).items()
+        },
     }
     if qt.trace is not None and qt.trace.span_op_ns:
         # the SAME ranking re-derived from span-timeline events, plus the

@@ -248,13 +248,20 @@ def run_one(name: str, ws: str) -> None:
     # second line: where the time went (op rollup sorted by compute time)
     op_seconds = MetricNode.op_seconds
     ranked = sorted(op_totals.items(), key=lambda kv: -op_seconds(kv[1]))
+    counter_snap = counters.snapshot()
     brk = {
         "breakdown": name, "sf": sf, "tasks": len(trees),
-        "counters": counters.snapshot(),
+        "counters": counter_snap,
         # op -> elapsed compute seconds, top 5: the trajectory-diffable
         # shape (BENCH_r*/PERF_BREAKDOWN_*) that catches an op-level
         # regression even when the end-to-end speedup still passes
         "top_ops": {k: round(op_seconds(v), 3) for k, v in ranked[:5]},
+        # op -> [stalls, blocking sync-wait seconds]: attribution to the
+        # operator actually waiting, so a downstream sync drain can never
+        # read as upstream compute again (the PR-3/PR-10 q93 hunt:
+        # probe_time absorbed agg_exec.py:427's 38s across a suspended
+        # generator's open timer)
+        "top_ops_sync": counter_snap.get("op_sync", {}),
         "flat": {k: flat_totals[k] for k in sorted(flat_totals)},
         "ops": {k: v for k, v in ranked},
     }

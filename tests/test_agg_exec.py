@@ -834,3 +834,91 @@ def test_probe_scatter_spill_park_preserves_first_stream_order(monkeypatch):
     # stream-first value is the PENDING miss batch's 1.0, not the
     # post-park batch's 2.0
     assert got_band["f"].tolist() == [1.0] * len(band)
+
+
+def test_deferred_partial_counts_k_deep_interleaved_mispredicts():
+    """exec.agg.partial.defer: the PARTIAL generic path's (live count,
+    group count) read rides the k-deep transfer window (mirroring the
+    dense-flag deque of PR 2); interleaved selectivity jumps mean MULTIPLE
+    in-flight batches can be truncated by an under-sized predicted bucket
+    and each must recompute exactly once — counts stay exact vs pandas and
+    vs the blocking protocol at every window depth."""
+    import pandas as pd
+
+    from auron_tpu.utils.config import (
+        AGG_PARTIAL_DEFER, TRANSFER_WINDOW_DEPTH, active_conf,
+    )
+
+    rng = np.random.default_rng(17)
+    key_batches = []
+    for i in range(14):
+        if i % 3 == 2:
+            # dense batch right after sparse ones: the EWMA's bucket is
+            # tiny, so this batch truncates and must repair mid-window
+            ks = rng.integers(0, 40, 1200)
+        else:
+            ks = rng.integers(0, 40, 1200)
+            ks[120:] = -1  # dead marker: filtered below
+        key_batches.append(ks)
+    frames = []
+    schema = None
+    for ks in key_batches:
+        live = [int(k) if k >= 0 else None for k in ks]
+        b = Batch.from_pydict({"k": live, "v": [1.0] * len(live)})
+        schema = b.schema
+        frames.append(b)
+
+    # IsNotNull filter upstream keeps dead rows out; keys 0..39 force the
+    # bool/dense-ineligible... (int key IS dense-eligible — widen the range)
+    from auron_tpu.exec.basic import FilterExec
+    from auron_tpu.exprs.ir import IsNotNull
+
+    conf = active_conf()
+    saved_depth = conf.get(TRANSFER_WINDOW_DEPTH)
+    saved_defer = conf.get(AGG_PARTIAL_DEFER)
+
+    def run(defer, depth):
+        conf.set(TRANSFER_WINDOW_DEPTH, depth)
+        conf.set(AGG_PARTIAL_DEFER, defer)
+        # spread keys so the dense direct-address table refuses and the
+        # GENERIC sort-segmentation path (the deferred read's home) runs
+        from auron_tpu.exprs.ir import BinaryOp, Literal
+
+        wide = BinaryOp("mul", col(0), Literal(1_000_003, T.INT64))
+        scan = MemoryScanExec.single([Batch(b.schema, b.device, b.dicts) for b in frames])
+        flt = FilterExec(scan, [IsNotNull(col(0))])
+        p = HashAggExec(flt, [(wide, "k")],
+                        [(AggExpr("count_star", None), "c"),
+                         (AggExpr("sum", col(1)), "s")], "partial")
+        f = HashAggExec(p, [(col(0), "k")],
+                        [(AggExpr("count_star", None), "c"),
+                         (AggExpr("sum", col(1)), "s")], "final")
+        from auron_tpu.exec.base import ExecutionContext
+
+        ctx = ExecutionContext()
+        ctx.metrics.name = f.name
+        out = f.collect(ctx=ctx).to_pandas().sort_values("k").reset_index(drop=True)
+        return out, ctx.metrics.total("sel_mispredicts")
+
+    all_k = [int(k) * 1_000_003 for ks in key_batches for k in ks if k >= 0]
+    want = (
+        pd.DataFrame({"k": all_k, "v": 1.0})
+        .groupby("k").agg(c=("v", "size"), s=("v", "sum")).reset_index()
+        .sort_values("k").reset_index(drop=True)
+    )
+    try:
+        mispredicted = 0
+        for depth in (1, 3, 6):
+            got, mis = run("on", depth)
+            mispredicted += mis
+            assert got["k"].tolist() == want["k"].tolist(), f"depth={depth}"
+            assert got["c"].tolist() == want["c"].tolist(), f"depth={depth}"
+            assert got["s"].tolist() == [
+                pytest.approx(float(x)) for x in want["s"]], f"depth={depth}"
+        # teeth: the sparse->dense jumps actually exercised the repair
+        assert mispredicted > 0
+        off, _ = run("off", 3)
+        assert off["c"].tolist() == want["c"].tolist()
+    finally:
+        conf.set(TRANSFER_WINDOW_DEPTH, saved_depth)
+        conf.set(AGG_PARTIAL_DEFER, saved_defer)

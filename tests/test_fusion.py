@@ -345,8 +345,12 @@ def test_replay_adds_no_compiles():
 def test_metric_attribution_splits_per_operator():
     """Fused-program time lands on the CONSTITUENT operators' metric
     nodes (top_ops must see FilterExec/ProjectExec, not one opaque
-    stage), and the span timeline receives the same nanos (the <=5%
-    span/metric cross-check relies on it)."""
+    stage), the span timeline receives the same nanos (the <=5%
+    span/metric cross-check relies on it), and the residual stage
+    overhead NOT covered by the per-constituent split lands on the STAGE
+    node — metric conservation: program splits + stage residual ==
+    measured stage wall, exactly (a stage that reports 0.0 in top_ops
+    while carrying fused_batches was dropping its residual)."""
     from auron_tpu.exec.base import ExecutionContext
     from auron_tpu.exec.metrics import MetricNode
 
@@ -367,5 +371,214 @@ def test_metric_attribution_splits_per_operator():
     total = per_op["FilterExec"].get("elapsed_compute", 0) + \
         per_op["ProjectExec"].get("elapsed_compute", 0)
     assert total > 0
-    assert per_op["FusedStageExec"].get("fused_batches") == 1
-    assert "elapsed_compute" not in per_op["FusedStageExec"]
+    stage = per_op["FusedStageExec"]
+    assert stage.get("fused_batches") == 1
+    # conservation: sum of per-constituent splits + the stage's residual
+    # equals the measured wall nanos of the stage's per-batch work
+    assert stage.get("elapsed_compute", 0) > 0
+    assert total + stage["elapsed_compute"] == stage["stage_wall"]
+
+
+# ---------------------------------------------------------------------------
+# probe-prologue & writer-repartition stage extensions (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _probe_frame(seed, n=6000, jump=False):
+    """Probe side with NULL keys; ``jump`` flips selectivity ~0 -> ~50%
+    mid-stream so the compaction predictor under-sizes a bucket (forced
+    mispredict repair)."""
+    rng = np.random.default_rng(seed)
+    k = rng.integers(1, 200, n).astype(object)
+    if jump:
+        k[: n // 3] = 10_000  # out of the build's key range: no matches
+    probe = pd.DataFrame({"k": k, "v": rng.normal(size=n)})
+    probe.loc[probe.index % 7 == 0, "k"] = None  # NULL keys never join
+    schema = T.Schema((T.Field("k", T.INT64, True), T.Field("v", T.FLOAT64, True)))
+    return [
+        Batch.from_pydict(
+            {"k": probe.k.iloc[i:i + 1000].tolist(),
+             "v": probe.v.iloc[i:i + 1000].tolist()}, schema)
+        for i in range(0, n, 1000)
+    ]
+
+
+def _assert_rows_equal(a, b):
+    assert len(a) == len(b)
+    cols = list(a.columns)
+    a = a.sort_values(cols, na_position="first").reset_index(drop=True)
+    b = b.sort_values(cols, na_position="first").reset_index(drop=True)
+    for c in cols:
+        assert ((a[c].isna() & b[c].isna()) | (a[c] == b[c])).all(), c
+
+
+@pytest.mark.parametrize("jump", [False, True], ids=["steady", "mispredict"])
+@pytest.mark.parametrize(
+    "join_type", ["inner", "left", "left_semi", "left_anti", "existence"]
+)
+def test_probe_prologue_bit_identity(join_type, jump):
+    """The fused probe prologue (key eval + canon + unique lookup +
+    gather/compact-take inside ONE stage program) is bit-identical to the
+    eager per-op jit chain across join types, through the predicted-
+    compaction window and its forced-mispredict repair."""
+    dim = pd.DataFrame({"id": np.arange(1, 101, dtype=np.int64),
+                        "b": np.arange(1, 101) * 2.0})
+    dim_b = [Batch.from_pandas(dim)]
+
+    def build():
+        pb = _probe_frame(3, jump=jump)
+        scan = MemoryScanExec([pb], pb[0].schema)
+        flt = FilterExec(scan, [BinaryOp(
+            "gt", Column(1, "v"), Literal(-10.0, T.FLOAT64))])
+        return BroadcastHashJoinExec(
+            flt, MemoryScanExec([list(dim_b)], dim_b[0].schema),
+            [Column(0, "k")], [Column(0, "id")], join_type,
+            build_side="right",
+        )
+
+    from auron_tpu.exec.base import ExecutionContext
+
+    eager = build().collect().to_pandas()
+    reset_fusion_stats()
+    tree = fuse_exec_tree(build(), ON)
+    ctx = ExecutionContext()
+    ctx.metrics.name = tree.name
+    out = list(tree.execute(0, ctx))
+    fused = (
+        pd.concat([b.to_pandas() for b in out], ignore_index=True)
+        if out else eager.iloc[:0]
+    )
+    _assert_rows_equal(eager, fused)
+    st = fusion_stats()
+    assert st["probe_segments"] >= 1
+    # teeth: the stage program actually dispatched (a silent publish
+    # failure would pass bit-identity via the eager fallback)
+    assert ctx.metrics.total("fused_batches") > 0
+    if jump and join_type == "inner":
+        # the selectivity jump must exercise the repair protocol
+        assert ctx.metrics.total("sel_mispredicts") > 0
+
+
+def test_probe_prologue_exists_lut_bit_identity():
+    """Duplicate-keyed build probed by semi/anti: the existence-LUT probe
+    rides the stage program (payload kind "exists")."""
+    dup = pd.DataFrame({"id": np.tile(np.arange(1, 51, dtype=np.int64), 3),
+                        "b": np.arange(150) * 1.0})
+    dim_b = [Batch.from_pandas(dup)]
+
+    for join_type in ("left_semi", "left_anti"):
+        def build():
+            pb = _probe_frame(5)
+            scan = MemoryScanExec([pb], pb[0].schema)
+            flt = FilterExec(scan, [BinaryOp(
+                "gt", Column(1, "v"), Literal(-10.0, T.FLOAT64))])
+            return BroadcastHashJoinExec(
+                flt, MemoryScanExec([list(dim_b)], dim_b[0].schema),
+                [Column(0, "k")], [Column(0, "id")], join_type,
+                build_side="right",
+            )
+
+        from auron_tpu.exec.base import ExecutionContext
+
+        eager = build().collect().to_pandas()
+        reset_fusion_stats()
+        tree = fuse_exec_tree(build(), ON)
+        ctx = ExecutionContext()
+        ctx.metrics.name = tree.name
+        out = list(tree.execute(0, ctx))
+        fused = pd.concat([b.to_pandas() for b in out], ignore_index=True)
+        _assert_rows_equal(eager, fused)
+        assert fusion_stats()["probe_segments"] >= 1
+        assert ctx.metrics.total("fused_batches") > 0, join_type
+
+
+def test_fused_probe_deferred_agg_spill_midstream():
+    """End-to-end q93 shape under memory pressure: fused probe prologue
+    (LEFT join, null-heavy keys) feeding a bool-key partial aggregate on
+    the DEFERRED count path, with a tiny MemManager budget forcing table
+    spills mid-stream — fusion + deferral off/on agree row-exactly
+    (counts bit-equal; float sums compared at 1e-9 — predictive
+    compaction re-buckets the reduces, re-associating float adds the
+    same way any merge-boundary shift does). The exactly-once staging
+    contract through spill parks is the teeth here."""
+    from auron_tpu.exec.agg_exec import AggExpr, HashAggExec
+    from auron_tpu.memory.memmgr import MemManager
+
+    dim = pd.DataFrame({"id": np.arange(1, 101, dtype=np.int64),
+                        "b": np.arange(1, 101) * 2.0})
+    dim_b = [Batch.from_pandas(dim)]
+
+    def build():
+        pb = _probe_frame(11, n=12000, jump=True)
+        scan = MemoryScanExec([pb], pb[0].schema)
+        j = BroadcastHashJoinExec(
+            scan, MemoryScanExec([list(dim_b)], dim_b[0].schema),
+            [Column(0, "k")], [Column(0, "id")], "left", build_side="right",
+        )
+        p = HashAggExec(
+            j, [(IsNull(Column(0, "k")), "k_null")],
+            [(AggExpr("count_star", None), "rows"),
+             (AggExpr("sum", Column(1, "v")), "s")], "partial")
+        return HashAggExec(
+            p, [(Column(0, "k_null"), "k_null")],
+            [(AggExpr("count_star", None), "rows"),
+             (AggExpr("sum", Column(1, "s")), "s")], "final")
+
+    from auron_tpu.utils.config import AGG_PARTIAL_DEFER, active_conf
+
+    conf = active_conf()
+    saved = conf.get(AGG_PARTIAL_DEFER)
+    MemManager.init(budget_bytes=64 << 10)  # forces mid-stream spills
+    try:
+        conf.set(AGG_PARTIAL_DEFER, "off")
+        eager = build().collect().to_pandas()
+        conf.set(AGG_PARTIAL_DEFER, "on")
+        fused = fuse_exec_tree(build(), ON).collect().to_pandas()
+    finally:
+        conf.set(AGG_PARTIAL_DEFER, saved)
+        MemManager.init()
+    eager = eager.sort_values("k_null").reset_index(drop=True)
+    fused = fused.sort_values("k_null").reset_index(drop=True)
+    assert eager["k_null"].tolist() == fused["k_null"].tolist()
+    assert eager["rows"].tolist() == fused["rows"].tolist()  # exactly-once
+    for a, b in zip(eager["s"], fused["s"]):
+        assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_writer_stage_counted_and_byte_identical(tmp_path):
+    """Fused repartition (pids + clustering inside the stage program)
+    produces byte-identical shuffle files to the eager writer, for hash
+    and round-robin partitionings."""
+    import os
+
+    from auron_tpu.exec.base import ExecutionContext
+    from auron_tpu.exec.shuffle.partitioning import (
+        HashPartitioning, RoundRobinPartitioning,
+    )
+    from auron_tpu.exec.shuffle.writer import ShuffleWriterExec
+
+    frames = [_frame(2000, s) for s in (1, 2, 3)]
+
+    def run(conf, part, d):
+        scan = MemoryScanExec([list(frames)], frames[0].schema)
+        prj = ProjectExec(scan, [Column(0, "k"), Column(1, "v")], ["k", "v"])
+        w = ShuffleWriterExec(prj, part, str(d / "x.data"), str(d / "x.index"))
+        tree = fuse_exec_tree(w, conf)
+        list(tree.execute(0, ExecutionContext()))
+        return (d / "x.data").read_bytes(), (d / "x.index").read_bytes()
+
+    from auron_tpu.utils.config import Configuration
+
+    OFF = Configuration({"exec.fuse.enable": "off"})
+    for name, mk in (("hash", lambda: HashPartitioning([Column(0, "k")], 3)),
+                     ("rr", lambda: RoundRobinPartitioning(3))):
+        d_on = tmp_path / f"{name}_on"
+        d_off = tmp_path / f"{name}_off"
+        d_on.mkdir(), d_off.mkdir()
+        reset_fusion_stats()
+        on_data, on_idx = run(ON, mk(), d_on)
+        assert fusion_stats()["writer_segments"] >= 1, name
+        off_data, off_idx = run(OFF, mk(), d_off)
+        # the trailing 16 bytes carry a random attempt pair tag
+        assert on_data[:-16] == off_data[:-16], name
+        assert len(on_idx) == len(off_idx), name

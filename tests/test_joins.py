@@ -307,3 +307,31 @@ def test_condition_with_case_remaps_columns():
     rows = set(zip(out["a"], out["b"]))
     # a=10 (else: b>a): (10,25); a=20 (then: b<a): (20,5); a=30: b=40 not <30
     assert rows == {(10, 25), (20, 5)}
+
+
+def test_unique_build_residual_condition_noncompact_emit():
+    """Unique build + residual condition: needs_all_pairs forces the
+    NON-compacted unique emit path with proj = full output (regression:
+    the _unique_probe_cfg refactor once dropped the local full_n this
+    branch sizes its projection with)."""
+    import pandas as pd
+
+    from auron_tpu.exec.basic import MemoryScanExec
+    from auron_tpu.exec.joins import BroadcastHashJoinExec
+    from auron_tpu.exprs.ir import BinaryOp, Column, Literal
+
+    left = pd.DataFrame({"k": np.arange(8, dtype=np.int64),
+                         "lv": np.arange(8, dtype=np.int64) * 10})
+    right = pd.DataFrame({"rk": np.arange(8, dtype=np.int64),
+                          "rv": np.arange(8, dtype=np.int64) * 5})
+    j = BroadcastHashJoinExec(
+        MemoryScanExec.single([Batch.from_pandas(left)]),
+        MemoryScanExec.single([Batch.from_pandas(right)]),
+        [Column(0, "k")], [Column(0, "rk")], "inner", build_side="right",
+        condition=BinaryOp("gt", Column(3, "rv"), Literal(14, T.INT64)),
+    )
+    got = j.collect().to_pandas().sort_values("k").reset_index(drop=True)
+    want = left.merge(right, left_on="k", right_on="rk")
+    want = want[want.rv > 14].sort_values("k").reset_index(drop=True)
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["rv"].tolist() == want["rv"].tolist()

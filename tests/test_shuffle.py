@@ -309,3 +309,76 @@ def test_align_dict_batches_mixed_schema():
     m = pa.RecordBatch.from_arrays([pa.array(["c", "a"])], names=["s"])
     tbl = pa.Table.from_batches(align_dict_batches([d, m]))
     assert tbl.column("s").to_pylist() == ["a", "b", "a", "c", "a"]
+
+
+def test_cluster_rows_device_host_bit_identity():
+    """ONE clustering policy (writer.cluster_rows / cluster_rows_host):
+    the device lax.sort path and the host numpy-argsort fallback produce
+    the same per-partition counts AND the same row order (stable sort by
+    pid, dead rows last) — the fused repartition can never diverge from
+    the host fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    from auron_tpu.exec.shuffle.writer import (
+        _cluster_by_pid, cluster_rows_host,
+    )
+
+    rng = np.random.default_rng(23)
+    for trial in range(5):
+        cap = int(rng.integers(64, 1024))
+        n_out = int(rng.integers(1, 9))
+        sel = rng.random(cap) < 0.8
+        pids = rng.integers(0, n_out, cap).astype(np.int32)
+        vals = rng.integers(0, 1 << 40, cap).astype(np.int64)
+        from auron_tpu.columnar.batch import DeviceBatch
+
+        dev = DeviceBatch(
+            jnp.asarray(sel), (jnp.asarray(vals),),
+            (jnp.ones(cap, bool),),
+        )
+        out_dev, counts_dev = _cluster_by_pid(dev, jnp.asarray(pids), n_out)
+        counts_np = np.asarray(jax.device_get(counts_dev))[:n_out]
+        order_host, counts_host = cluster_rows_host(pids, sel, n_out)
+        assert counts_np.tolist() == counts_host.tolist(), trial
+        live = int(counts_host.sum())
+        dev_vals = np.asarray(jax.device_get(out_dev.values[0]))[:live]
+        host_vals = vals[order_host]
+        assert dev_vals.tolist() == host_vals.tolist(), trial
+
+
+def test_op_sync_attribution_follows_the_waiting_operator():
+    """profiling.EngineCounters.op_sync books a blocking sync under the
+    operator actually waiting (innermost LIVE ExecOperator frame) — a
+    producer suspended at yield inside an open timer can no longer absorb
+    a consumer's stall (the q93 probe_time misattribution)."""
+    from auron_tpu.exec.agg_exec import AggExpr, HashAggExec
+    from auron_tpu.utils.config import AGG_PARTIAL_DEFER, active_conf
+    from auron_tpu.utils.profiling import EngineCounters
+
+    counters = EngineCounters.install()
+    conf = active_conf()
+    saved = conf.get(AGG_PARTIAL_DEFER)
+    saved_all = counters.record_all_sites
+    counters.record_all_sites = True
+    try:
+        conf.set(AGG_PARTIAL_DEFER, "off")  # force the blocking 1/batch read
+        rng = np.random.default_rng(3)
+        frames = [
+            Batch.from_pydict({
+                "k": (rng.integers(0, 50, 800) * 1_000_003).tolist(),
+                "v": [1.0] * 800,
+            })
+            for _ in range(6)
+        ]
+        agg = HashAggExec(
+            MemoryScanExec.single(frames), [(col(0), "k")],
+            [(AggExpr("count_star", None), "c")], "partial")
+        counters.reset()
+        agg.collect()
+        snap = counters.snapshot()
+        assert "HashAggExec" in snap["op_sync"], snap["op_sync"]
+        assert snap["op_sync"]["HashAggExec"][0] > 0
+    finally:
+        counters.record_all_sites = saved_all
+        conf.set(AGG_PARTIAL_DEFER, saved)
