@@ -46,6 +46,30 @@ def put_resource_ipc(key: str, payload: bytes) -> None:
     put_resource(key, batches)
 
 
+def put_resource_c_stream(key: str, stream_ptr: int) -> None:
+    """Arrow C-FFI batch-resource entry (auron_put_resource_arrow): the
+    host hands an ``ArrowArrayStream*`` and batches cross the boundary by
+    POINTER — no IPC serialization, no copy (the reference's L4 boundary
+    design: JNI hands Arrow C-data structs, not bytes). The stream is
+    imported lazily; the registered provider is one-shot, like a host
+    engine's per-task scan handoff."""
+    reader = pa.RecordBatchReader._import_from_c(int(stream_ptr))
+    put_resource(key, reader)
+
+
+def next_batch_c(handle: int, array_ptr: int, schema_ptr: int) -> int:
+    """Arrow C-FFI batch export (auron_next_batch_arrow): writes the next
+    batch into host-allocated ``ArrowArray*`` / ``ArrowSchema*`` structs
+    (release callbacks transfer ownership per the C data interface spec).
+    Returns 1 on a batch, 0 at end of stream. The batch's buffers are
+    handed off by reference — the serde-free twin of next_batch_ipc."""
+    rb = next_batch(handle)
+    if rb is None:
+        return 0
+    rb._export_to_c(int(array_ptr), int(schema_ptr))
+    return 1
+
+
 def put_resource_shuffle(key: str, manifest: bytes) -> None:
     """C-ABI shuffle-fetch entry: the payload is a ShuffleManager JSON
     manifest ([{data,index},...]); it registers as a reduce-side block

@@ -37,6 +37,75 @@ from auron_tpu import types as T
 
 MIN_CAPACITY = 128
 
+# XLA:CPU aliases (zero-copy) host buffers handed to device_put when they
+# are aligned to this boundary; unaligned buffers pay a full copy. Arrow
+# allocates 64-aligned, numpy only 16 — so ingestion staging allocates
+# deliberately aligned buffers and eligible Arrow/numpy views upload by
+# reference (docs/shuffle.md, the Zerrow zero-copy playbook).
+ZERO_COPY_ALIGN = 64
+
+
+def aligned_empty(n: int, dtype) -> np.ndarray:
+    """Uninitialized 1-D array whose data pointer is 64-byte aligned (the
+    XLA:CPU zero-copy alias requirement; harmless elsewhere)."""
+    dt = np.dtype(dtype)
+    raw = np.empty(n * dt.itemsize + ZERO_COPY_ALIGN, dtype=np.uint8)
+    ofs = (-raw.ctypes.data) % ZERO_COPY_ALIGN
+    return raw[ofs : ofs + n * dt.itemsize].view(dt)
+
+
+def zero_copy_enabled(conf=None) -> bool:
+    """Resolve the exec.scan.zerocopy tri-state (auto = on)."""
+    from auron_tpu.utils.config import SCAN_ZEROCOPY, active_conf, resolve_tri
+
+    c = conf if conf is not None else active_conf()
+    return resolve_tri(c.get(SCAN_ZEROCOPY), True)
+
+
+import threading as _threading
+
+_plane_lock = _threading.Lock()
+# shared immutable host planes: all-true bool[cap], aliased by every clean
+# full batch's validity/sel instead of a fresh fill + device copy per
+# column. NEVER written after creation (mutating paths allocate their own).
+_TRUE_PLANES: dict[int, np.ndarray] = {}
+_INGEST_STATS = {"zerocopy_planes": 0, "copied_planes": 0}
+
+
+def _true_plane(cap: int) -> np.ndarray:
+    with _plane_lock:
+        p = _TRUE_PLANES.get(cap)
+        if p is None:
+            p = aligned_empty(cap, bool)
+            p[:] = True
+            p.setflags(write=False)
+            _TRUE_PLANES[cap] = p
+        return p
+
+
+def _count_plane(zero_copy: bool) -> None:
+    with _plane_lock:
+        _INGEST_STATS["zerocopy_planes" if zero_copy else "copied_planes"] += 1
+
+
+def ingest_stats() -> dict:
+    """Snapshot of the zero-copy ingestion counters (tests + bench)."""
+    with _plane_lock:
+        return dict(_INGEST_STATS)
+
+
+def reset_ingest_stats() -> None:
+    with _plane_lock:
+        for k in _INGEST_STATS:
+            _INGEST_STATS[k] = 0
+
+
+def _is_zero_copy_view(a: np.ndarray) -> bool:
+    """Would device_put alias this exact buffer on the CPU backend?"""
+    return bool(
+        a.flags["C_CONTIGUOUS"] and a.ctypes.data % ZERO_COPY_ALIGN == 0
+    )
+
 
 def bucket_capacity(n: int) -> int:
     """Static-shape bucket for a batch holding n rows: next power of two."""
@@ -86,29 +155,37 @@ class Batch:
     # ---- construction ----
 
     @staticmethod
-    def from_arrow(rb: pa.RecordBatch, capacity: int | None = None) -> "Batch":
+    def from_arrow(rb: pa.RecordBatch, capacity: int | None = None,
+                   conf=None) -> "Batch":
         schema = T.Schema.from_arrow(rb.schema)
         n = rb.num_rows
         cap = capacity or bucket_capacity(n)
         assert cap >= n, (cap, n)
+        zc = zero_copy_enabled(conf)
         values, validity, dicts = [], [], []
         for i, f in enumerate(schema):
             arr = rb.column(i)
-            v, m, d = _arrow_to_host(arr, f.dtype, cap)
+            v, m, d = _arrow_to_host(arr, f.dtype, cap, zc=zc)
             values.append(v)
             validity.append(m)
             dicts.append(d)
-        return _seal_batch(schema, values, validity, dicts, n, cap)
+        return _seal_batch(schema, values, validity, dicts, n, cap, zc=zc)
 
     @staticmethod
     def from_pandas(df, schema: T.Schema | None = None,
-                    capacity: int | None = None) -> "Batch":
+                    capacity: int | None = None, conf=None) -> "Batch":
         """Ingest a pandas DataFrame without the Arrow round-trip for numeric
         columns: nullable-array data/mask buffers are viewed directly and
         null lanes zeroed in one vectorized pass; strings/decimals/nested
         fall back to the per-column Arrow path. One batched device transfer.
         (The reference's scan hands the engine materialized columnar buffers
-        the same way — native-engine/datafusion-ext-plans scan path.)"""
+        the same way — native-engine/datafusion-ext-plans scan path.)
+
+        Under exec.scan.zerocopy, full clean numeric columns upload by
+        buffer ALIAS on the CPU backend (no copy at all): the caller's
+        frame must stay immutable while batches built from it are live —
+        the same contract Arrow buffers already carry. exec.scan.zerocopy
+        =off restores the copying upload."""
         from pandas.core.arrays.masked import BaseMaskedArray
 
         if schema is None:
@@ -119,6 +196,7 @@ class Batch:
         n = len(df)
         cap = capacity or bucket_capacity(n)
         assert cap >= n, (cap, n)
+        zc = zero_copy_enabled(conf)
         numeric = (T.TypeKind.BOOL, T.TypeKind.INT8, T.TypeKind.INT16,
                    T.TypeKind.INT32, T.TypeKind.INT64,
                    T.TypeKind.FLOAT32, T.TypeKind.FLOAT64)
@@ -154,21 +232,24 @@ class Batch:
                     valid = ~invalid
                     vals = np.where(valid, vals, 0)
             if vals is not None:
-                mask_np = np.empty(cap, dtype=bool)
-                if valid is None:
-                    mask_np[:n] = True
+                if zc and valid is None and n == cap:
+                    m = _true_plane(cap)
                 else:
-                    mask_np[:n] = valid
-                mask_np[n:] = False
-                v = _pad_to_cap(vals.astype(phys, copy=False), cap, phys)
-                m = mask_np
+                    mask_np = aligned_empty(cap, bool) if zc else np.empty(cap, dtype=bool)
+                    if valid is None:
+                        mask_np[:n] = True
+                    else:
+                        mask_np[:n] = valid
+                    mask_np[n:] = False
+                    m = mask_np
+                v = _pad_to_cap(vals.astype(phys, copy=False), cap, phys, zc=zc)
             else:
                 a = pa.Array.from_pandas(col)
-                v, m, d = _arrow_to_host(a, f.dtype, cap)
+                v, m, d = _arrow_to_host(a, f.dtype, cap, zc=zc)
             values.append(v)
             validity.append(m)
             dicts.append(d)
-        return _seal_batch(schema, values, validity, dicts, n, cap)
+        return _seal_batch(schema, values, validity, dicts, n, cap, zc=zc)
 
     @staticmethod
     def from_pydict(data: dict, schema: T.Schema | None = None, capacity: int | None = None) -> "Batch":
@@ -312,25 +393,41 @@ def host_rows_to_arrow(schema: T.Schema, dicts, values, validity, idx,
     return pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
 
 
-def _seal_batch(schema, values, validity, dicts, n: int, cap: int) -> "Batch":
+def _seal_batch(schema, values, validity, dicts, n: int, cap: int,
+                zc: bool = False) -> "Batch":
     """Finish ingestion: build the selection mask and ship the whole pytree
-    in one batched device transfer (not 2 dispatches per column)."""
-    sel = np.empty(cap, dtype=bool)
-    sel[:n] = True
-    sel[n:] = False
+    in one batched device transfer (not 2 dispatches per column). Under
+    zero-copy, aligned host planes in the pytree ALIAS into device arrays
+    on the CPU backend instead of copying, and a full batch's sel is the
+    shared all-true plane."""
+    if zc and n == cap:
+        sel = _true_plane(cap)
+    else:
+        sel = aligned_empty(cap, bool) if zc else np.empty(cap, dtype=bool)
+        sel[:n] = True
+        sel[n:] = False
     sel, values, validity = jax.device_put((sel, tuple(values), tuple(validity)))
     return Batch(schema, DeviceBatch(sel, values, validity), tuple(dicts))
 
 
-def _pad_to_cap(a_np: np.ndarray, cap: int, phys: np.dtype) -> np.ndarray:
-    """Pad to capacity zeroing only the dead tail (one write pass, not two)."""
+def _pad_to_cap(a_np: np.ndarray, cap: int, phys: np.dtype,
+                zc: bool = False) -> np.ndarray:
+    """Pad to capacity zeroing only the dead tail (one write pass, not two).
+    A full already-typed plane passes through as a view (zero-copy when the
+    underlying buffer is aligned); padding allocates aligned staging under
+    zero-copy so the device transfer aliases instead of copying."""
     n = len(a_np)
     if n == cap and a_np.dtype == phys:
-        return np.ascontiguousarray(a_np)
-    out = np.empty(cap, dtype=phys)
+        out = np.ascontiguousarray(a_np)
+        if zc:
+            _count_plane(_is_zero_copy_view(out))
+        return out
+    out = aligned_empty(cap, phys) if zc else np.empty(cap, dtype=phys)
     out[:n] = a_np
     if n < cap:
         out[n:] = 0
+    if zc:
+        _count_plane(False)
     return out
 
 
@@ -340,25 +437,38 @@ def _arrow_to_device(arr: pa.Array, dtype: T.DataType, cap: int):
     return jnp.asarray(v), jnp.asarray(m), d
 
 
-def _arrow_to_host(arr: pa.Array, dtype: T.DataType, cap: int):
+def _arrow_to_host(arr: pa.Array, dtype: T.DataType, cap: int,
+                   zc: bool = False):
     """Returns (values np[cap], validity np[cap] bool, dict or None) — the
-    host-side half of ingestion, so callers can batch the device transfer."""
+    host-side half of ingestion, so callers can batch the device transfer.
+
+    ``zc``: zero-copy mode (exec.scan.zerocopy). Validity-clean full
+    fixed-width planes stay VIEWS of the Arrow buffers (64-aligned by
+    Arrow's allocator, so the device transfer aliases them on CPU), their
+    validity is the shared all-true plane, and any staging this function
+    does allocate is aligned. Arrow chunking, nulls, casts and bit-packed
+    BOOL still force the copy path — exactly the cases the format forces."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     n = len(arr)
     nulls = arr.null_count if n else 0
-    mask_np = np.empty(cap, dtype=bool)
-    if nulls:
-        mask_np[:n] = pc.is_valid(arr).to_numpy(zero_copy_only=False)
+    # the DECIMAL branch below can retract validity (unscaled overflow ->
+    # NULL), so it must never write into the shared all-true plane
+    if zc and nulls == 0 and n == cap and dtype.kind != T.TypeKind.DECIMAL:
+        mask_np = _true_plane(cap)
     else:
-        mask_np[:n] = True
-    mask_np[n:] = False
+        mask_np = aligned_empty(cap, bool) if zc else np.empty(cap, dtype=bool)
+        if nulls:
+            mask_np[:n] = pc.is_valid(arr).to_numpy(zero_copy_only=False)
+        else:
+            mask_np[:n] = True
+        mask_np[n:] = False
     phys = np.dtype(dtype.physical_dtype().name)
     d: pa.Array | None = None
 
     if dtype.kind in (T.TypeKind.LIST, T.TypeKind.MAP, T.TypeKind.STRUCT):
         # nested values ride as identity codes into a per-batch dictionary
-        vals_np = _pad_to_cap(np.arange(n, dtype=phys), cap, phys)
+        vals_np = _pad_to_cap(np.arange(n, dtype=phys), cap, phys, zc=zc)
         d = arr
         if len(d) == 0:
             d = _empty_dict(dtype)
@@ -378,7 +488,7 @@ def _arrow_to_host(arr: pa.Array, dtype: T.DataType, cap: int):
         if idx.null_count:
             idx = idx.fill_null(0)
         codes = idx.to_numpy(zero_copy_only=False).astype(np.int32, copy=False)
-        vals_np = _pad_to_cap(codes, cap, phys)
+        vals_np = _pad_to_cap(codes, cap, phys, zc=zc)
         d = denc.dictionary
         if pa.types.is_large_string(d.type):
             d = d.cast(pa.string())
@@ -401,26 +511,29 @@ def _arrow_to_host(arr: pa.Array, dtype: T.DataType, cap: int):
                 ints[j] = u
             else:
                 mask_np[j] = False
-        vals_np = _pad_to_cap(ints, cap, phys)
+        vals_np = _pad_to_cap(ints, cap, phys, zc=zc)
     elif dtype.kind == T.TypeKind.TIMESTAMP:
         a = arr.cast(pa.timestamp("us"))
         if a.null_count:
             a = a.fill_null(0)
-        vals_np = _pad_to_cap(
-            a.to_numpy(zero_copy_only=False).astype("datetime64[us]").astype(np.int64),
-            cap, phys)
+        raw = a.to_numpy(zero_copy_only=False)
+        if raw.dtype != np.dtype("datetime64[us]"):
+            raw = raw.astype("datetime64[us]")
+        # same-width reinterpret, not astype: keeps the clean full-batch
+        # plane a view of the Arrow buffer (zero-copy eligible)
+        vals_np = _pad_to_cap(raw.view(np.int64), cap, phys, zc=zc)
     elif dtype.kind == T.TypeKind.DATE32:
         a = arr.cast(pa.int32())
         if a.null_count:
             a = a.fill_null(0)
-        vals_np = _pad_to_cap(a.to_numpy(zero_copy_only=False), cap, phys)
+        vals_np = _pad_to_cap(a.to_numpy(zero_copy_only=False), cap, phys, zc=zc)
     elif dtype.kind == T.TypeKind.NULL:
         vals_np = np.zeros(cap, dtype=phys)
     else:
         a = arr if arr.type == dtype.to_arrow() else arr.cast(dtype.to_arrow())
         if a.null_count:
             a = a.fill_null(T.numpy_zero(dtype))
-        vals_np = _pad_to_cap(a.to_numpy(zero_copy_only=False), cap, phys)
+        vals_np = _pad_to_cap(a.to_numpy(zero_copy_only=False), cap, phys, zc=zc)
     return vals_np, mask_np, d
 
 

@@ -371,7 +371,8 @@ class ParquetScanExec(ExecOperator):
                     chunk = tbl.slice(i, bs).combine_chunks()
                     if chunk.num_rows:
                         with ctx.metrics.timer("upload_time"):
-                            yield Batch.from_arrow(chunk.to_batches()[0])
+                            yield Batch.from_arrow(chunk.to_batches()[0],
+                                                   conf=ctx.conf)
             if isinstance(src, CoalescedReadFile):
                 ctx.metrics.add("fs_raw_reads", src.raw_reads)
                 ctx.metrics.add("fs_bytes_fetched", src.bytes_fetched)
@@ -457,7 +458,8 @@ class OrcScanExec(ExecOperator):
                     chunk = tbl.slice(i, bs).combine_chunks()
                     if chunk.num_rows:
                         with ctx.metrics.timer("upload_time"):
-                            yield Batch.from_arrow(chunk.to_batches()[0])
+                            yield Batch.from_arrow(chunk.to_batches()[0],
+                                                   conf=ctx.conf)
 
 
 class FFIReaderExec(ExecOperator):
@@ -480,4 +482,4 @@ class FFIReaderExec(ExecOperator):
             if isinstance(rb, Batch):
                 yield rb
             elif rb.num_rows:
-                yield Batch.from_arrow(rb)
+                yield Batch.from_arrow(rb, conf=ctx.conf)

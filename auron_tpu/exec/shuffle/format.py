@@ -8,23 +8,57 @@ length-prefixed compressed IPC). Format here:
     data file  := concat of per-partition regions (partition order)
     region     := block*
     block      := u64-LE payload length | payload
-    payload    := Arrow IPC stream, zstd/lz4 body compression
+    payload    := v1: Arrow IPC stream, zstd/lz4 body compression
+                | v2: "AUB2" columnar light-weight block (below)
     index file := (num_partitions + 1) u64-LE offsets into the data file
 
 The framing allows regions assembled from multiple flushes/spills to be
 concatenated byte-wise — merging spills is pure file I/O, no decode
-(same property the reference's OffsettedMergeIterator exploits).
+(same property the reference's OffsettedMergeIterator exploits). v1 and
+v2 blocks may be MIXED in one region (the sniff is per-block), so spill
+merges and old files stay readable under any conf.
+
+Block format v2 (``exec.shuffle.encoding``, docs/shuffle.md) is the
+reference's "compacted shuffle" capability done properly: per-column
+LIGHT-WEIGHT encodings (dictionary pass-through, run-length, frame-of-
+reference bitpack, packbits) chosen per block from cheap vectorized
+stats, with the general codec only as fallback for planes no structural
+encoding fits — the writer stops paying zstd/lz4 over every byte, and
+the reader can lift column planes straight into capacity-bucket device
+buffers without an intermediate Arrow table:
+
+    v2 payload := "AUB2" | u8 ver=2 | u8 pad | u16 ncols | u32 nrows
+                | u32 schema_len | Arrow IPC schema
+                | column*
+    column     := u8 enc | u8 has_validity
+                | [u32 vlen | packbits(validity, little)]
+                | u32 plen | enc payload
+
+The encoding chooser is a DETERMINISTIC function of (schema, block
+stats) — two writers over the same rows emit identical bytes, which is
+what keeps fused-vs-eager shuffle files byte-identical and lets `make
+perfcheck` replay-guard the data plane.
 """
 
 from __future__ import annotations
 
 import io
 import struct
-from typing import Iterator
+import sys
+import threading
+from typing import Iterator, NamedTuple
 
+import numpy as np
 import pyarrow as pa
 
-from auron_tpu.utils.config import SPILL_COMPRESSION_CODEC, active_conf
+from auron_tpu.utils.config import (
+    SHUFFLE_ENCODING,
+    SHUFFLE_ENCODING_DICT_MAX,
+    SHUFFLE_ENCODING_FALLBACK,
+    SPILL_COMPRESSION_CODEC,
+    active_conf,
+    resolve_tri,
+)
 
 
 def _codec(conf=None) -> str | None:
@@ -53,17 +87,36 @@ def encode_block(rb_or_table, conf=None) -> bytes:
     return struct.pack("<Q", len(payload)) + payload
 
 
-def decode_blocks(data: bytes) -> Iterator[pa.RecordBatch]:
-    """Iterate record batches from a concatenation of blocks."""
+def iter_block_payloads(data: bytes) -> Iterator[bytes]:
+    """Walk the length-prefixed framing, yielding raw block payloads (the
+    shared framing layer under both decode paths)."""
     pos = 0
     n = len(data)
     while pos + 8 <= n:
         (length,) = struct.unpack_from("<Q", data, pos)
         pos += 8
-        payload = data[pos : pos + length]
+        if pos + length > n:
+            raise ValueError(
+                f"corrupt shuffle block: length {length} at offset {pos - 8} "
+                f"overruns the region ({n} bytes)"
+            )
+        yield data[pos : pos + length]
         pos += length
-        with pa.ipc.open_stream(payload) as r:
-            yield from r
+
+
+def is_v2_payload(payload: bytes) -> bool:
+    return payload[:4] == V2_MAGIC
+
+
+def decode_blocks(data: bytes) -> Iterator[pa.RecordBatch]:
+    """Iterate record batches from a concatenation of blocks (v1 IPC and
+    v2 columnar blocks may be mixed; the sniff is per-block)."""
+    for payload in iter_block_payloads(data):
+        if is_v2_payload(payload):
+            yield block_columns_to_record_batch(decode_block_v2(payload))
+        else:
+            with pa.ipc.open_stream(payload) as r:
+                yield from r
 
 
 # trailer magic binding a (data, index) pair to ONE writer attempt: two
@@ -149,3 +202,687 @@ def align_dict_batches(batches: list) -> list:
             if changed else b
         )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Block format v2: per-column light-weight encodings (docs/shuffle.md)
+# ---------------------------------------------------------------------------
+
+V2_MAGIC = b"AUB2"
+
+ENC_RAW = 0       # plane bytes as-is
+ENC_BITPACK = 1   # frame-of-reference: i64 ref | u8 width | unsigned offsets
+ENC_RLE = 2       # run-length: lengths sub-plane + values sub-plane
+ENC_PACKBITS = 3  # bool plane packed 8x (np.packbits, little bit order)
+ENC_CODEC = 4     # general codec: u8 codec id | u64 raw_len | compressed
+ENC_ARROW = 5     # single-column Arrow IPC (strings/nested/fallback)
+ENC_DICT = 6      # dictionary column: values IPC + codes sub-plane
+ENC_DEC128 = 7    # decimal128: lo/hi int64 sub-planes
+ENC_SCALED = 8    # decimal-in-float: u8 exponent | sub-encoded int plane
+ENC_SPARSE = 9    # null-dominated plane: valid lanes' values, sub-encoded
+
+ENC_NAMES = {
+    ENC_RAW: "raw", ENC_BITPACK: "bitpack", ENC_RLE: "rle",
+    ENC_PACKBITS: "packbits", ENC_CODEC: "codec", ENC_ARROW: "arrow",
+    ENC_DICT: "dict", ENC_DEC128: "dec128", ENC_SCALED: "scaled",
+    ENC_SPARSE: "sparse",
+}
+
+_CODEC_IDS = {"lz4": 1, "zstd": 2}
+_CODEC_BY_ID = {v: k for k, v in _CODEC_IDS.items()}
+
+# one stderr warning per unavailable codec name per process (the PR-5
+# kafka importorskip treatment: an optional codec missing from the
+# runtime degrades the encoding, it must never fail the write)
+_codec_warned: set[str] = set()
+_codec_warn_lock = threading.Lock()
+
+
+def shuffle_encoding_enabled(conf=None) -> bool:
+    """Resolve the exec.shuffle.encoding tri-state (auto = on)."""
+    c = conf if conf is not None else active_conf()
+    return resolve_tri(c.get(SHUFFLE_ENCODING), True)
+
+
+def _fallback_codec(conf) -> str | None:
+    """The general codec for planes no light-weight encoding fits. A name
+    the runtime can't provide degrades (warn once) instead of failing."""
+    name = conf.get(SHUFFLE_ENCODING_FALLBACK)
+    if name == "auto":
+        name = conf.get(SPILL_COMPRESSION_CODEC)
+    if name in (None, "none"):
+        return None
+    for candidate in (name, "lz4"):
+        try:
+            if candidate in _CODEC_IDS and pa.Codec.is_available(candidate):
+                return candidate
+        except Exception:  # noqa: BLE001 — availability probe must not raise
+            pass
+        with _codec_warn_lock:
+            if candidate not in _codec_warned:
+                _codec_warned.add(candidate)
+                sys.stderr.write(
+                    f"auron-tpu: shuffle encoding fallback codec "
+                    f"'{candidate}' unavailable; degrading to light-weight "
+                    "encodings only\n"
+                )
+    return None
+
+
+def _for_width(lo: int, hi: int) -> int:
+    """Frame-of-reference byte width for the closed range [lo, hi]; 8 means
+    'no narrowing possible'."""
+    span = hi - lo  # python ints: no overflow
+    for w in (1, 2, 4):
+        if span < (1 << (8 * w)):
+            return w
+    return 8
+
+
+def _pack_for(a: np.ndarray, ref: int, width: int) -> bytes:
+    if width == 8:
+        # no narrowing: int64 passthrough (ref unused, forced 0)
+        return struct.pack("<qB", 0, 8) + a.astype(np.int64).tobytes()
+    off = (a.astype(np.int64) - np.int64(ref)).astype(
+        {1: np.uint8, 2: np.uint16, 4: np.uint32}[width]
+    )
+    return struct.pack("<qB", ref, width) + off.tobytes()
+
+
+def _unpack_for(payload: bytes, n: int, dtype: np.dtype) -> np.ndarray:
+    ref, width = struct.unpack_from("<qB", payload, 0)
+    if width == 8:
+        return np.frombuffer(payload, np.int64, count=n, offset=9).astype(
+            dtype, copy=False)
+    off = np.frombuffer(
+        payload, {1: np.uint8, 2: np.uint16, 4: np.uint32}[width], count=n,
+        offset=9,
+    )
+    return (off.astype(np.int64) + np.int64(ref)).astype(dtype, copy=False)
+
+
+def _as_bits(a: np.ndarray) -> np.ndarray:
+    if a.dtype.kind == "f":
+        return a.view(np.uint64 if a.dtype.itemsize == 8 else np.uint32)
+    return a
+
+
+def _run_stats(a: np.ndarray):
+    """(run count, boundary bool plane) — ONE comparison pass; the starts
+    only materialize (cheaply, from the cached bool plane) for columns
+    RLE actually wins."""
+    a = _as_bits(a)
+    if len(a) == 0:
+        return 0, None
+    neq = a[1:] != a[:-1]
+    return 1 + int(np.count_nonzero(neq)), neq
+
+
+def _starts_from(neq: np.ndarray | None) -> np.ndarray:
+    if neq is None:
+        return np.empty(0, dtype=np.int64)
+    return np.concatenate(([0], np.flatnonzero(neq) + 1))
+
+
+def _emit_rle(a: np.ndarray, neq, n: int, nruns: int,
+              vw: int | None) -> tuple[int, bytes] | None:
+    """THE RLE payload emitter (one definition — the chooser calls it
+    from two decision branches, and the layout must never fork): run
+    lengths FOR-packed at their true width, run values FOR-packed at
+    ``vw`` (or their own width when None). None when the run values fall
+    outside int64 (no FOR arithmetic possible)."""
+    starts = _starts_from(neq)
+    lengths = np.diff(np.concatenate((starts, [n])))
+    vals = a[starts]
+    lo, hi = int(vals.min()), int(vals.max())
+    if not (-(2**63) <= lo and hi < 2**63):
+        return None
+    lpart = _pack_for(lengths, 0, _for_width(0, int(lengths.max())))
+    vpart = _pack_for(vals, lo, vw if vw is not None else _for_width(lo, hi))
+    return ENC_RLE, struct.pack("<I", nruns) + lpart + vpart
+
+
+def _encode_int_plane(a: np.ndarray) -> tuple[int, bytes]:
+    """Deterministic chooser for integer-kind planes, ordered so the
+    cheap stat decides first: run-dominated planes take RLE on the run
+    count alone (min/max then runs over the few RUN VALUES only), others
+    compare FOR-bitpack against raw by exact predicted size. Every
+    branch is a pure function of the block's values."""
+    n = len(a)
+    raw_bytes = n * a.dtype.itemsize
+    if n == 0:
+        return ENC_RAW, a.tobytes()
+    nruns, neq = _run_stats(a)
+    # worst-case widths (lw: one run of n; vw: 8) keep this test free of
+    # full-plane reductions; run-dominated planes skip min/max entirely
+    if 4 + (9 + nruns * _for_width(0, n)) + (9 + nruns * 8) < raw_bytes // 2:
+        out = _emit_rle(a, neq, n, nruns, None)
+        if out is not None:
+            return out
+    lo, hi = int(a.min()), int(a.max())
+    if not (-(2**63) <= lo and hi < 2**63):
+        return ENC_RAW, a.tobytes()  # uint64 beyond int64: no FOR arithmetic
+    vw = _for_width(lo, hi)
+    bitpack_bytes = 9 + n * vw if vw < a.dtype.itemsize else raw_bytes + 9
+    lw = _for_width(0, n)
+    rle_bytes = 4 + (9 + nruns * lw) + (9 + nruns * vw)
+    best = min(rle_bytes, bitpack_bytes, raw_bytes)
+    if best == rle_bytes and rle_bytes < raw_bytes:
+        out = _emit_rle(a, neq, n, nruns, vw)
+        if out is not None:
+            return out
+    if best == bitpack_bytes and vw < a.dtype.itemsize:
+        return ENC_BITPACK, _pack_for(a, lo, vw)
+    return ENC_RAW, a.tobytes()
+
+
+def _decode_int_plane(enc: int, payload: bytes, n: int,
+                      dtype: np.dtype) -> np.ndarray:
+    if enc == ENC_RAW:
+        return np.frombuffer(payload, dtype, count=n)
+    if enc == ENC_BITPACK:
+        return _unpack_for(payload, n, dtype)
+    if enc == ENC_RLE:
+        (nruns,) = struct.unpack_from("<I", payload, 0)
+        pos = 4
+        lwidth = payload[pos + 8]
+        lbytes = 9 + nruns * {1: 1, 2: 2, 4: 4, 8: 8}[lwidth]
+        lengths = _unpack_for(payload[pos : pos + lbytes], nruns, np.int64)
+        pos += lbytes
+        vals = _unpack_for(payload[pos:], nruns, dtype)
+        return np.repeat(vals, lengths)
+    raise ValueError(f"bad int plane encoding {enc}")
+
+
+_SCALED_MAX_EXP = 4
+
+
+def _scaled_exponent(a: np.ndarray) -> int | None:
+    """ALP-style decimal-in-float detection: the smallest exponent e<=4
+    such that round(v * 10^e) / 10^e reproduces every value BITWISE
+    (measure columns carrying decimal data as floats — the dominant
+    shuffle shape — turn back into small ints). A cheap strided sample
+    nominates e; _scaled_pack verifies the whole plane. NaN/Inf and -0.0
+    fail the checks, so such planes fall through to RLE/codec."""
+    sample = np.ascontiguousarray(a[:: max(1, len(a) // 2048)][:2048])
+    for e in range(_SCALED_MAX_EXP + 1):
+        if _scaled_pack(sample, e) is not None:
+            return e
+    return None
+
+
+def _scaled_pack(a: np.ndarray, e: int) -> bytes | None:
+    """Fused verify + pack for the scaled plane (one temp, no int64
+    intermediate): the decode is simulated EXACTLY — round(a*s)/s must
+    reproduce ``a`` bitwise, magnitudes must stay int<->float exact
+    (<2^53), and -0.0 (which compares EQUAL to 0.0) refuses — it would
+    pack as +0.0. Returns the ENC_SCALED payload or None.
+
+    The native kernels (native.py scaled_probe_host/scaled_pack_host)
+    run verify+range and pack as ONE fused read pass each — the
+    bandwidth shape that keeps the encode under the lz4 budget; the
+    numpy twin below produces identical bytes when the library is
+    absent."""
+    from auron_tpu import native
+
+    s_py = float(10.0**e)
+    probed = native.scaled_probe_host(a, s_py)
+    if probed is None:
+        return None
+    if probed is not False:
+        lo, hi = probed
+        vw = _for_width(lo, hi)
+        packed = native.scaled_pack_host(a, s_py, lo if vw < 8 else 0, vw)
+        if packed is not None:
+            return (struct.pack("<BB", e, ENC_BITPACK)
+                    + struct.pack("<qB", lo if vw < 8 else 0, vw)
+                    + packed.tobytes())
+    s = a.dtype.type(10.0**e)
+    with np.errstate(invalid="ignore", over="ignore"):
+        t = a * s
+        np.round(t, out=t)
+        if not np.array_equal(t / s, a):  # NaN/Inf refuse here too
+            return None
+        lo_f, hi_f = t.min(), t.max()
+        if not (float(-(2**53)) < lo_f and hi_f < float(2**53)):
+            return None
+        lo, hi = int(lo_f), int(hi_f)
+        if lo <= 0 <= hi and np.any(np.signbit(a) & (t == 0)):
+            return None
+    vw = _for_width(lo, hi)
+    if vw == 8:
+        payload = struct.pack("<qB", 0, 8) + t.astype(np.int64).tobytes()
+    else:
+        # subtract in int64, NOT the float dtype: a float32 span needing
+        # >24 bits would round the offsets (silent corruption) — the
+        # native kernel subtracts in int64 and this twin must match it
+        off = (t.astype(np.int64) - np.int64(lo)).astype(
+            {1: np.uint8, 2: np.uint16, 4: np.uint32}[vw])
+        payload = struct.pack("<qB", lo, vw) + off.tobytes()
+    return struct.pack("<BB", e, ENC_BITPACK) + payload
+
+
+def _encode_float_plane(a: np.ndarray, codec: str | None) -> tuple[int, bytes]:
+    """Floats: scaled-int when the plane is decimal-in-float, RLE when
+    runs dominate (bit-pattern equality), else the general codec, else
+    raw."""
+    n = len(a)
+    if n:
+        e = _scaled_exponent(a)
+        if e is not None:
+            payload = _scaled_pack(a, e)
+            if payload is not None:
+                return ENC_SCALED, payload
+    raw = a.tobytes()
+    if n:
+        nruns, neq = _run_stats(a)
+        lw = _for_width(0, n)
+        rle_bytes = 4 + (9 + nruns * lw) + nruns * a.dtype.itemsize
+        if rle_bytes < len(raw):
+            starts = _starts_from(neq)
+            lengths = np.diff(np.concatenate((starts, [n])))
+            lpart = _pack_for(lengths, 0, _for_width(0, int(lengths.max())))
+            return ENC_RLE, (
+                struct.pack("<I", nruns) + lpart + a[starts].tobytes()
+            )
+    if codec is not None and len(raw) >= 1024:
+        comp = pa.Codec(codec).compress(raw, asbytes=True)
+        if len(comp) + 9 < len(raw):
+            return ENC_CODEC, (
+                struct.pack("<BQ", _CODEC_IDS[codec], len(raw)) + comp
+            )
+    return ENC_RAW, raw
+
+
+def _decode_float_plane(enc: int, payload: bytes, n: int,
+                        dtype: np.dtype) -> np.ndarray:
+    if enc == ENC_RAW:
+        return np.frombuffer(payload, dtype, count=n)
+    if enc == ENC_SCALED:
+        e, ienc = struct.unpack_from("<BB", payload, 0)
+        if ienc == ENC_BITPACK:
+            from auron_tpu import native
+
+            ref, width = struct.unpack_from("<qB", payload, 2)
+            out = native.scaled_unpack_host(
+                np.frombuffer(payload, np.uint8, count=n * width, offset=11),
+                n, 10.0**e, ref, width, dtype)
+            if out is not None:
+                return out
+        ints = _decode_int_plane(ienc, payload[2:], n, np.int64)
+        # ints are exact in the float type (verified at encode time: the
+        # decode simulation t / s == a held bitwise) — this division
+        # reproduces the original plane exactly
+        return (ints.astype(dtype) / dtype.type(10.0**e)).astype(
+            dtype, copy=False)
+    if enc == ENC_CODEC:
+        cid, raw_len = struct.unpack_from("<BQ", payload, 0)
+        raw = pa.Codec(_CODEC_BY_ID[cid]).decompress(
+            payload[9:], decompressed_size=raw_len, asbytes=True
+        )
+        return np.frombuffer(raw, dtype, count=n)
+    if enc == ENC_RLE:
+        (nruns,) = struct.unpack_from("<I", payload, 0)
+        pos = 4
+        lwidth = payload[pos + 8]
+        lbytes = 9 + nruns * {1: 1, 2: 2, 4: 4, 8: 8}[lwidth]
+        lengths = _unpack_for(payload[pos : pos + lbytes], nruns, np.int64)
+        pos += lbytes
+        vals = np.frombuffer(payload, dtype, count=nruns, offset=pos)
+        return np.repeat(vals, lengths)
+    raise ValueError(f"bad float plane encoding {enc}")
+
+
+_INT_NP = {
+    pa.int8(): np.int8, pa.int16(): np.int16, pa.int32(): np.int32,
+    pa.int64(): np.int64, pa.uint8(): np.uint8, pa.uint16(): np.uint16,
+    pa.uint32(): np.uint32, pa.uint64(): np.uint64, pa.date32(): np.int32,
+}
+_FLOAT_NP = {pa.float32(): np.float32, pa.float64(): np.float64}
+
+
+def _np_kind_of(t: pa.DataType):
+    """(kind, numpy dtype) for fixed-width arrow types the v2 plane
+    encoders understand; (None, None) -> ENC_ARROW fallback."""
+    if t in _INT_NP:
+        return "int", np.dtype(_INT_NP[t])
+    if t in _FLOAT_NP:
+        return "float", np.dtype(_FLOAT_NP[t])
+    if pa.types.is_timestamp(t):
+        return "int", np.dtype(np.int64)
+    if pa.types.is_boolean(t):
+        return "bool", np.dtype(bool)
+    if pa.types.is_decimal128(t):
+        return "dec128", None
+    return None, None
+
+
+def _validity_pair(arr: pa.Array):
+    """(valid bool plane | None, packed validity bytes | None) — sliced
+    straight off the Arrow validity bitmap when the offset is byte-aligned
+    (with the trailing garbage bits masked so block bytes stay
+    deterministic), one unpack pass for the bool plane."""
+    if arr.null_count == 0:
+        return None, None
+    n = len(arr)
+    buf = arr.buffers()[0]
+    off = arr.offset
+    if buf is not None and off % 8 == 0:
+        nb = (n + 7) // 8
+        bits = np.frombuffer(buf, np.uint8, count=nb, offset=off // 8)
+        valid = np.unpackbits(bits, count=n, bitorder="little").view(bool)
+        if n % 8:
+            bits = bits.copy()
+            bits[-1] &= (1 << (n % 8)) - 1
+        return valid, bits.tobytes()
+    import pyarrow.compute as pc
+
+    valid = pc.is_valid(arr).to_numpy(zero_copy_only=False)
+    return valid, np.packbits(valid, bitorder="little").tobytes()
+
+
+def _fixed_plane(arr: pa.Array, npdt: np.dtype,
+                 valid: np.ndarray | None) -> np.ndarray:
+    """View an arrow fixed-width array's value buffer as numpy, zeroing
+    null lanes so the encoded bytes are deterministic (null lanes carry
+    whatever garbage the producer left)."""
+    buf = arr.buffers()[1]
+    vals = np.frombuffer(buf, npdt, count=len(arr),
+                         offset=arr.offset * npdt.itemsize)
+    if valid is not None:
+        if npdt.kind in "iu":
+            # multiply-by-bool zeroes null lanes in one SIMD pass (exact
+            # for ints; floats keep the select — NaN * 0 is NaN)
+            vals = vals * valid
+        else:
+            vals = np.where(valid, vals, npdt.type(0))
+    return vals
+
+
+def _single_col_ipc(arr: pa.Array, name: str, codec: str | None) -> bytes:
+    rb = pa.RecordBatch.from_arrays([arr], names=[name])
+    sink = io.BytesIO()
+    opts = pa.ipc.IpcWriteOptions(compression=codec)
+    with pa.ipc.new_stream(sink, rb.schema, options=opts) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def _single_col_from_ipc(payload: bytes) -> pa.Array:
+    with pa.ipc.open_stream(payload) as r:
+        tbl = r.read_all()
+    col = tbl.column(0)
+    return col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+
+
+def _encode_column(arr: pa.Array, name: str, codec: str | None,
+                   dict_max: int) -> tuple[int, bytes | None, bytes]:
+    """-> (enc, validity bytes or None, enc payload)."""
+    n = len(arr)
+    t = arr.type
+    if pa.types.is_dictionary(t) and len(arr.dictionary) <= dict_max:
+        # dictionary pass-through: values ride ONCE per block by
+        # reference, codes are a small-int plane (the compacted-shuffle
+        # capability: no general-purpose codec over repeated values)
+        idx = arr.indices
+        if idx.type != pa.int32():
+            idx = idx.cast(pa.int32())
+        valid, vbytes = _validity_pair(idx)
+        codes = _fixed_plane(idx, np.dtype(np.int32), valid)
+        denc, dpayload = _encode_int_plane(codes)
+        dict_ipc = _single_col_ipc(arr.dictionary, name, None)
+        payload = (
+            struct.pack("<I", len(dict_ipc)) + dict_ipc
+            + struct.pack("<BI", denc, len(dpayload)) + dpayload
+        )
+        return ENC_DICT, vbytes, payload
+    kind, npdt = _np_kind_of(t if not pa.types.is_dictionary(t) else None)
+    if kind is None:
+        # strings / nested / oversized dictionaries: self-describing
+        # single-column IPC with the general codec (the legacy treatment,
+        # narrowed to the columns that actually need it)
+        return ENC_ARROW, None, _single_col_ipc(arr, name, codec)
+    valid, vbytes = _validity_pair(arr)
+    if (valid is not None and kind in ("int", "float")
+            and 2 * arr.null_count >= n):
+        # null-dominated plane: encode ONLY the valid lanes' values (the
+        # decode scatters them back over zeros via the validity bitmap) —
+        # no zeroing pass, no full-plane stats, and the null lanes cost
+        # nothing on disk. Deterministic: the trigger is the arrow
+        # null_count, the values are exactly the valid lanes in order.
+        vals = np.frombuffer(arr.buffers()[1], npdt, count=n,
+                             offset=arr.offset * npdt.itemsize)
+        sub = np.ascontiguousarray(vals[valid])
+        if kind == "int":
+            se, sp = _encode_int_plane(sub)
+        else:
+            se, sp = _encode_float_plane(sub, codec)
+        return ENC_SPARSE, vbytes, (
+            struct.pack("<IBI", len(sub), se, len(sp)) + sp)
+    if kind == "bool":
+        # fill nulls BEFORE to_numpy: a null-carrying bool array converts
+        # to an object ndarray, which packbits refuses
+        vals = (arr if valid is None else arr.fill_null(False)).to_numpy(
+            zero_copy_only=False)
+        return ENC_PACKBITS, vbytes, np.packbits(
+            vals, bitorder="little").tobytes()
+    if kind == "dec128":
+        planes = np.frombuffer(
+            arr.buffers()[1], np.int64, count=2 * n, offset=16 * arr.offset
+        ).reshape(n, 2)
+        lo, hi = planes[:, 0], planes[:, 1]
+        if valid is not None:
+            lo = np.where(valid, lo, 0)
+            hi = np.where(valid, hi, 0)
+        le, lp = _encode_int_plane(np.ascontiguousarray(lo))
+        he, hp = _encode_int_plane(np.ascontiguousarray(hi))
+        payload = (struct.pack("<BI", le, len(lp)) + lp
+                   + struct.pack("<BI", he, len(hp)) + hp)
+        return ENC_DEC128, vbytes, payload
+    vals = _fixed_plane(arr, npdt, valid)
+    if kind == "int":
+        enc, payload = _encode_int_plane(vals)
+        if enc == ENC_RAW and codec is not None and len(payload) >= 1024:
+            comp = pa.Codec(codec).compress(payload, asbytes=True)
+            if len(comp) + 9 < len(payload):
+                return ENC_CODEC, vbytes, (
+                    struct.pack("<BQ", _CODEC_IDS[codec], len(payload)) + comp
+                )
+        return enc, vbytes, payload
+    enc, payload = _encode_float_plane(vals, codec)
+    return enc, vbytes, payload
+
+
+class BlockColumns(NamedTuple):
+    """A decoded v2 block: host column planes, ready either for direct
+    capacity-bucket assembly (reader.py) or Arrow reconstruction."""
+
+    schema: pa.Schema
+    nrows: int
+    # per column, one of:
+    #   ("plane",  np values, np bool validity | None)
+    #   ("dec128", np lo int64, np hi int64, validity | None)
+    #   ("dict",   np int32 codes, validity | None, pa dictionary values)
+    #   ("arrow",  pa.Array)
+    cols: list
+
+
+def encode_block_v2(batches: list, conf=None, metrics=None) -> bytes:
+    """One length-prefixed v2 block from RecordBatches sharing a schema
+    (run align_dict_batches first). Deterministic: same rows -> same
+    bytes. ``metrics`` (a MetricNode) gets the per-column encoding
+    histogram (shuffle_enc_<name>) and byte counters."""
+    c = conf if conf is not None else active_conf()
+    codec = _fallback_codec(c)
+    dict_max = c.get(SHUFFLE_ENCODING_DICT_MAX)
+    if len(batches) == 1:
+        tbl = pa.Table.from_batches(batches)
+    else:
+        tbl = pa.Table.from_batches(batches).combine_chunks()
+    schema = tbl.schema
+    nrows = tbl.num_rows
+    # schema-only IPC stream (a schema message + EOS): what read_schema
+    # consumes; spelled via the stream writer rather than the serialize()
+    # attribute so the name-dispatch call graph can't cross-link it
+    sb = pa.BufferOutputStream()
+    pa.ipc.new_stream(sb, schema).close()
+    sbytes = sb.getvalue().to_pybytes()
+    out = [V2_MAGIC, struct.pack("<BBHII", 2, 0, tbl.num_columns, nrows,
+                                 len(sbytes)), sbytes]
+    for i, f in enumerate(schema):
+        col = tbl.column(i)
+        arr = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        enc, vbytes, payload = _encode_column(arr, f.name, codec, dict_max)
+        if metrics is not None:
+            metrics.add(f"shuffle_enc_{ENC_NAMES[enc]}", 1)
+        out.append(struct.pack("<BB", enc, 1 if vbytes is not None else 0))
+        if vbytes is not None:
+            out.append(struct.pack("<I", len(vbytes)))
+            out.append(vbytes)
+        out.append(struct.pack("<I", len(payload)))
+        out.append(payload)
+    body = b"".join(out)
+    return struct.pack("<Q", len(body)) + body
+
+
+def decode_block_v2(payload: bytes) -> BlockColumns:
+    """Parse a v2 payload into host column planes. Corrupt blocks fail
+    LOUDLY (ValueError) — never a silently wrong decode."""
+    try:
+        if payload[:4] != V2_MAGIC:
+            raise ValueError("missing AUB2 magic")
+        ver, _, ncols, nrows, slen = struct.unpack_from("<BBHII", payload, 4)
+        if ver != 2:
+            raise ValueError(f"unsupported block version {ver}")
+        pos = 16
+        schema = pa.ipc.read_schema(pa.BufferReader(payload[pos : pos + slen]))
+        pos += slen
+        if len(schema) != ncols:
+            raise ValueError("schema/column-count mismatch")
+        cols = []
+        for i in range(ncols):
+            enc, hasv = struct.unpack_from("<BB", payload, pos)
+            pos += 2
+            valid = None
+            if hasv:
+                (vlen,) = struct.unpack_from("<I", payload, pos)
+                pos += 4
+                vbits = np.frombuffer(payload, np.uint8, count=vlen,
+                                      offset=pos)
+                valid = np.unpackbits(
+                    vbits, count=nrows, bitorder="little").astype(bool)
+                pos += vlen
+            (plen,) = struct.unpack_from("<I", payload, pos)
+            pos += 4
+            body = payload[pos : pos + plen]
+            if len(body) != plen:
+                raise ValueError("column payload truncated")
+            pos += plen
+            t = schema.field(i).type
+            if enc == ENC_ARROW:
+                cols.append(("arrow", _single_col_from_ipc(body)))
+                continue
+            if enc == ENC_DICT:
+                (dlen,) = struct.unpack_from("<I", body, 0)
+                dict_vals = _single_col_from_ipc(body[4 : 4 + dlen])
+                denc, dplen = struct.unpack_from("<BI", body, 4 + dlen)
+                codes = _decode_int_plane(
+                    denc, body[4 + dlen + 5 : 4 + dlen + 5 + dplen], nrows,
+                    np.dtype(np.int32))
+                cols.append(("dict", codes, valid, dict_vals))
+                continue
+            if enc == ENC_DEC128:
+                le, lplen = struct.unpack_from("<BI", body, 0)
+                lo = _decode_int_plane(le, body[5 : 5 + lplen], nrows,
+                                       np.dtype(np.int64))
+                he, hplen = struct.unpack_from("<BI", body, 5 + lplen)
+                hi = _decode_int_plane(
+                    he, body[5 + lplen + 5 : 5 + lplen + 5 + hplen], nrows,
+                    np.dtype(np.int64))
+                cols.append(("dec128", lo, hi, valid))
+                continue
+            if enc == ENC_PACKBITS:
+                bits = np.frombuffer(body, np.uint8)
+                vals = np.unpackbits(
+                    bits, count=nrows, bitorder="little").astype(bool)
+                cols.append(("plane", vals, valid))
+                continue
+            kind, npdt = _np_kind_of(t)
+            if enc == ENC_SPARSE:
+                if valid is None:
+                    raise ValueError("sparse plane without validity")
+                nvalid, se, slen = struct.unpack_from("<IBI", body, 0)
+                sub_body = body[9 : 9 + slen]
+                if kind == "int":
+                    sub = _decode_int_plane(se, sub_body, nvalid, npdt)
+                elif kind == "float":
+                    sub = _decode_float_plane(se, sub_body, nvalid, npdt)
+                else:
+                    raise ValueError(f"sparse on non-plane type {t}")
+                vals = np.zeros(nrows, dtype=npdt)
+                vals[valid] = sub
+                cols.append(("plane", vals, valid))
+                continue
+            if kind == "int":
+                if enc == ENC_CODEC:
+                    cid, raw_len = struct.unpack_from("<BQ", body, 0)
+                    raw = pa.Codec(_CODEC_BY_ID[cid]).decompress(
+                        body[9:], decompressed_size=raw_len, asbytes=True)
+                    vals = np.frombuffer(raw, npdt, count=nrows)
+                else:
+                    vals = _decode_int_plane(enc, body, nrows, npdt)
+            elif kind == "float":
+                vals = _decode_float_plane(enc, body, nrows, npdt)
+            else:
+                raise ValueError(
+                    f"encoding {enc} on non-plane arrow type {t}")
+            cols.append(("plane", vals, valid))
+        return BlockColumns(schema, nrows, cols)
+    except (struct.error, IndexError, KeyError, pa.ArrowInvalid) as e:
+        # KeyError covers corrupt enum bytes (RLE width, codec id) — the
+        # loud-ValueError contract must hold for ANY corrupt byte
+        raise ValueError(f"corrupt v2 shuffle block: {e!r}") from e
+
+
+def block_columns_to_record_batch(bc: BlockColumns) -> pa.RecordBatch:
+    """Arrow reconstruction of a decoded v2 block — the generic consumer
+    path (RSS fetch, skew splits, spill merge readers); byte-equal to
+    what the v1 IPC round trip of the same rows yields."""
+    arrays = []
+    for f, col in zip(bc.schema, bc.cols):
+        arrays.append(_column_to_arrow(f.type, bc.nrows, col))
+    return pa.RecordBatch.from_arrays(arrays, schema=bc.schema)
+
+
+def _validity_buf(valid, nrows):
+    if valid is None:
+        return None, 0
+    return (pa.py_buffer(np.packbits(valid, bitorder="little").tobytes()),
+            int(nrows - valid.sum()))
+
+
+def _column_to_arrow(t: pa.DataType, nrows: int, col) -> pa.Array:
+    tag = col[0]
+    if tag == "arrow":
+        arr = col[1]
+        return arr.cast(t) if arr.type != t else arr
+    if tag == "dict":
+        _, codes, valid, dict_vals = col
+        idx = pa.array(codes, type=t.index_type,
+                       mask=None if valid is None else ~valid)
+        return pa.DictionaryArray.from_arrays(idx, dict_vals.cast(t.value_type))
+    if tag == "dec128":
+        _, lo, hi, valid = col
+        planes = np.empty((nrows, 2), dtype=np.int64)
+        planes[:, 0] = lo
+        planes[:, 1] = hi
+        vbuf, nulls = _validity_buf(valid, nrows)
+        return pa.Array.from_buffers(
+            t, nrows, [vbuf, pa.py_buffer(planes.tobytes())], nulls)
+    _, vals, valid = col
+    vbuf, nulls = _validity_buf(valid, nrows)
+    if pa.types.is_boolean(t):
+        data = pa.py_buffer(np.packbits(vals, bitorder="little").tobytes())
+    else:
+        data = pa.py_buffer(np.ascontiguousarray(vals).tobytes())
+    return pa.Array.from_buffers(t, nrows, [vbuf, data], nulls)

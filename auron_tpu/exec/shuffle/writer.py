@@ -25,9 +25,28 @@ from jax import lax
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch, DeviceBatch
 from auron_tpu.exec.base import ExecOperator, ExecutionContext
-from auron_tpu.exec.shuffle.format import align_dict_batches, encode_block, write_index
+from auron_tpu.exec.shuffle.format import (
+    align_dict_batches,
+    encode_block,
+    encode_block_v2,
+    shuffle_encoding_enabled,
+    write_index,
+)
+
 from auron_tpu.exec.shuffle.partitioning import Partitioning
 from auron_tpu.utils.config import SHUFFLE_COMPRESSION_TARGET_BUF_SIZE
+
+
+def encode_shuffle_block(batches: list, conf, metrics=None) -> bytes:
+    """THE writer-side block encoder: format v2 light-weight columnar
+    encodings under exec.shuffle.encoding (auto = on), the legacy
+    compressed-IPC v1 block with =off — bit-identical file bytes to the
+    pre-v2 writer (run align_dict_batches first; both flush paths and the
+    spill flush share this single decision point)."""
+    if shuffle_encoding_enabled(conf):
+        return encode_block_v2(batches, conf=conf, metrics=metrics)
+    return encode_block(pa.Table.from_batches(batches), conf=conf)
+
 
 
 class ShuffleWriterExec(ExecOperator):
@@ -154,10 +173,13 @@ class _ShuffleStaging:
             return
         with self.ctx.metrics.timer("compress_time"):
             # conf threaded: spill() runs on the requesting task's thread
-            blk = encode_block(
-                pa.Table.from_batches(align_dict_batches(self.staged[pid])),
-                conf=self.ctx.conf,
+            blk = encode_shuffle_block(
+                align_dict_batches(self.staged[pid]),
+                conf=self.ctx.conf, metrics=self.ctx.metrics,
             )
+        self.ctx.metrics.add("shuffle_bytes_raw",
+                             self.staged_bytes[pid])
+        self.ctx.metrics.add("shuffle_bytes_written", len(blk))
         self.regions[pid].append(blk)
         self._region_bytes += len(blk)  # auronlint: guarded-by(self._lock) -- every _flush caller (add_all, spill, blocks_of) holds the staging lock
         self.staged[pid], self.staged_bytes[pid] = [], 0
@@ -306,8 +328,6 @@ class RssShuffleWriterExec(ExecOperator):
         self.rss_resource_id = rss_resource_id
 
     def _execute(self, partition: int, ctx: ExecutionContext):
-        from auron_tpu.exec.shuffle.format import encode_block
-
         writer = ctx.resources[self.rss_resource_id]
         push = writer if callable(writer) else writer.write
         n_out = self.partitioning.num_partitions
@@ -318,10 +338,12 @@ class RssShuffleWriterExec(ExecOperator):
         def flush(pid: int):
             if staged[pid]:
                 with ctx.metrics.timer("compress_time"):
-                    blk = encode_block(
-                        pa.Table.from_batches(align_dict_batches(staged[pid])),
-                        conf=ctx.conf,
+                    blk = encode_shuffle_block(
+                        align_dict_batches(staged[pid]),
+                        conf=ctx.conf, metrics=ctx.metrics,
                     )
+                ctx.metrics.add("shuffle_bytes_raw", staged_bytes[pid])
+                ctx.metrics.add("shuffle_bytes_written", len(blk))
                 with ctx.metrics.timer("push_time"):
                     push(pid, blk)
                 ctx.metrics.add("data_size", len(blk))

@@ -69,6 +69,26 @@ def _lib():
         lib.crc32c_hash.restype = ctypes.c_uint32
     except AttributeError:
         pass  # stale .so without the symbol: callers fall back
+    try:
+        for suffix, fp in (("f64", ctypes.c_double), ("f32", ctypes.c_float)):
+            probe = getattr(lib, f"scaled_probe_{suffix}")
+            probe.argtypes = [
+                ctypes.POINTER(fp), ctypes.c_int64, fp,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ]
+            probe.restype = ctypes.c_int
+            pack = getattr(lib, f"scaled_pack_{suffix}")
+            pack.argtypes = [
+                ctypes.POINTER(fp), ctypes.c_int64, fp, ctypes.c_int64,
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+            ]
+            unpack = getattr(lib, f"scaled_unpack_{suffix}")
+            unpack.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, fp,
+                ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(fp),
+            ]
+    except AttributeError:
+        pass  # stale .so without the scaled kernels: callers fall back
     _LIB = lib
     return lib
 
@@ -181,3 +201,55 @@ def crc32c_host(data: bytes, crc: int = 0) -> int | None:
         return None
     buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
     return int(lib.crc32c_hash(buf, len(data), ctypes.c_uint32(crc)))
+
+
+def scaled_probe_host(a: np.ndarray, s: float):
+    """Fused verify + int-range pass for the shuffle v2 scaled encoding
+    (docs/shuffle.md): returns (lo, hi) when EVERY lane of ``a`` survives
+    round(v*s) -> int -> float -> /s bitwise, None when any lane refuses,
+    or False when the library lacks the kernel (caller runs the numpy
+    twin)."""
+    lib = _lib()
+    fn = getattr(lib, f"scaled_probe_{'f64' if a.dtype == np.float64 else 'f32'}", None) if lib else None
+    if fn is None:
+        return False
+    a = np.ascontiguousarray(a)
+    lo = ctypes.c_int64()
+    hi = ctypes.c_int64()
+    fp = ctypes.c_double if a.dtype == np.float64 else ctypes.c_float
+    ok = fn(_ptr(a, fp), len(a), a.dtype.type(s), ctypes.byref(lo),
+            ctypes.byref(hi))
+    return (lo.value, hi.value) if ok else None
+
+
+def scaled_pack_host(a: np.ndarray, s: float, lo: int,
+                     width: int) -> np.ndarray | None:
+    """Fused pack for a scaled_probe_host-verified plane: one read pass
+    emitting the FOR-narrowed offsets (width in {1,2,4}; 8 = int64
+    passthrough with lo ignored). None = kernel unavailable."""
+    lib = _lib()
+    fn = getattr(lib, f"scaled_pack_{'f64' if a.dtype == np.float64 else 'f32'}", None) if lib else None
+    if fn is None:
+        return None
+    a = np.ascontiguousarray(a)
+    out = np.empty(len(a) * width, dtype=np.uint8)
+    fp = ctypes.c_double if a.dtype == np.float64 else ctypes.c_float
+    fn(_ptr(a, fp), len(a), a.dtype.type(s), lo, width,
+       _ptr(out, ctypes.c_uint8))
+    return out
+
+
+def scaled_unpack_host(payload: np.ndarray, n: int, s: float, lo: int,
+                       width: int, dtype) -> np.ndarray | None:
+    """Fused decode of a scaled plane straight to floats (one pass);
+    None = kernel unavailable (caller runs the numpy twin)."""
+    lib = _lib()
+    dt = np.dtype(dtype)
+    fn = getattr(lib, f"scaled_unpack_{'f64' if dt == np.float64 else 'f32'}", None) if lib else None
+    if fn is None:
+        return None
+    src = np.ascontiguousarray(payload)
+    out = np.empty(n, dtype=dt)
+    fp = ctypes.c_double if dt == np.float64 else ctypes.c_float
+    fn(_ptr(src, ctypes.c_uint8), n, dt.type(s), lo, width, _ptr(out, fp))
+    return out

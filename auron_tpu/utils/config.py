@@ -373,6 +373,44 @@ EXCHANGE_MESH_MAX_BYTES = int_conf(
     "auto-mode ceiling for device-resident exchange payload per shard; "
     "larger exchanges take the durable file path",
 )
+SCAN_ZEROCOPY = str_conf(
+    "exec.scan.zerocopy", "auto", "scan",
+    "zero-copy ingestion (docs/shuffle.md): validity-clean fixed-width "
+    "Arrow/numpy column buffers upload by 64-byte-aligned buffer ALIAS "
+    "instead of a host->device copy (XLA:CPU device_put aliases aligned "
+    "host memory; accelerators still DMA but skip the intermediate numpy "
+    "materialization), validity/selection planes of full clean batches "
+    "come from shared cached all-true planes, and dictionary pages pass "
+    "through by reference. The engine relies on Arrow/ingest buffers "
+    "staying immutable while device arrays reference them (Arrow buffers "
+    "are immutable by contract; Batch.from_pandas documents the same "
+    "contract for user frames). on | off | auto = on. off restores the "
+    "copying ingest path exactly (bit-identical results either way)",
+)
+SHUFFLE_ENCODING = str_conf(
+    "exec.shuffle.encoding", "auto", "shuffle",
+    "shuffle block format v2 (docs/shuffle.md): per-column light-weight "
+    "encodings (dict pass-through, RLE, frame-of-reference bitpack, "
+    "packbits) chosen per block from cheap stats, with the general codec "
+    "only as fallback for incompressible planes — the writer stops paying "
+    "zstd/lz4 over every byte on the hot path, and the reader decodes "
+    "blocks straight into capacity-bucket device buffers instead of via "
+    "an intermediate Arrow table. on | off | auto = on. off restores the "
+    "compressed-IPC v1 blocks and the Arrow-table read path byte-for-byte",
+)
+SHUFFLE_ENCODING_DICT_MAX = int_conf(
+    "exec.shuffle.encoding.dict.max", 4096, "shuffle",
+    "largest dictionary (distinct values) a v2 block will carry for a "
+    "dictionary-preserving column; larger dictionaries were already "
+    "materialized by the writer and encode as plain value columns",
+)
+SHUFFLE_ENCODING_FALLBACK = str_conf(
+    "exec.shuffle.encoding.fallback.codec", "auto", "shuffle",
+    "general-purpose codec for planes no light-weight encoding fits "
+    "(zstd|lz4|none|auto = spill.compression.codec). A codec named here "
+    "but unavailable in the runtime degrades to the light-weight "
+    "encodings with a single stderr warning instead of failing the write",
+)
 IGNORE_CORRUPTED_FILES = bool_conf(
     "files.ignore.corrupted", False, "scan", "tolerate unreadable input files (conf.rs:37)"
 )

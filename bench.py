@@ -178,11 +178,14 @@ def main() -> None:
     # record carries a top_ops section — op-level regressions show in the
     # BENCH_r* trajectory even when end-to-end throughput still passes
     op_totals: dict[str, dict[str, int]] = {}
+    flat_totals: dict[str, int] = {}
     sink_lock = threading.Lock()
 
     def sink(snap: dict) -> None:
         with sink_lock:
             MetricNode.accumulate_op_totals(snap, op_totals)
+            for k, v in MetricNode.flat_totals(snap).items():
+                flat_totals[k] = flat_totals.get(k, 0) + int(v)
 
     api.set_metrics_sink(sink)
 
@@ -231,6 +234,7 @@ def main() -> None:
     counters.reset()  # attribute syncs to the timed runs only, not warmup
     with sink_lock:
         op_totals.clear()  # attribute top_ops to the timed runs only
+        flat_totals.clear()
     from auron_tpu.obs.export import trace_out_arg
 
     trace_out = trace_out_arg(sys.argv[1:], "AURON_TRACE_OUT")
@@ -297,6 +301,15 @@ def main() -> None:
             k: [v[0], v[1]] for k, v in sync_snap.get("op_sync", {}).items()
         },
     }
+    # data-plane breakdown (ISSUE 11): shuffle write/read GB/s, bytes and
+    # the per-column-block encoding histogram, from the same flat rollup
+    # perf_gate emits per class — encoding regressions show per run
+    from perf_gate import shuffle_breakdown
+
+    with sink_lock:
+        shuf = shuffle_breakdown(flat_totals)
+    if shuf is not None:
+        record["shuffle"] = shuf
     if qt.trace is not None and qt.trace.span_op_ns:
         # the SAME ranking re-derived from span-timeline events, plus the
         # agreement check — the two accountings can't silently diverge.
@@ -331,11 +344,20 @@ def main() -> None:
     ingest_key = f"ingest_gb_s@sf{sf:g}" + (
         f":{backend}" if backend != "cpu" else ""
     )
+    # the shuffle data plane ratchets alongside ingest (ROADMAP item 2:
+    # "add a shuffle GB/s ratchet so both gains hold"): raw staged bytes
+    # per second of encode+write work, per (sf, backend)
+    shuffle_key = f"shuffle_gb_s@sf{sf:g}" + (
+        f":{backend}" if backend != "cpu" else ""
+    )
     ratchet = _load_ratchet()
     ingest_best = ratchet.get(ingest_key)
+    shuffle_best = ratchet.get(shuffle_key)
     ratchet_ok = os.environ.get("BENCH_RATCHET", "1") != "0"
     if ratchet_ok and ingest_best is not None:
         record["ingest_floor"] = round(RATCHET_SLACK * ingest_best, 3)
+    if ratchet_ok and shuffle_best is not None:
+        record["shuffle_floor"] = round(RATCHET_SLACK * shuffle_best, 3)
     if backend in ("tpu", "axon"):
         # settle the cluster-sort verdict on real hardware while we have
         # the chip: lax.sort vs bitonic network (jnp + pallas kernel).
@@ -360,15 +382,38 @@ def main() -> None:
             record["sort_bench_error"] = repr(e)[-200:]
     print(json.dumps(record))
     if ratchet_ok:
+        failed = False
         gbs = record["ingest_gb_s"]
         if ingest_best is not None and gbs < RATCHET_SLACK * ingest_best:
             sys.stderr.write(
                 f"bench.py: ingest throughput {gbs} GB/s regressed below "
                 f"{RATCHET_SLACK} x best {ingest_best} ({ingest_key})\n"
             )
+            failed = True
+        shuf_gbs = (record.get("shuffle") or {}).get("shuffle_write_gb_s")
+        if (
+            shuffle_best is not None
+            and shuf_gbs is not None
+            and shuf_gbs < RATCHET_SLACK * shuffle_best
+        ):
+            sys.stderr.write(
+                f"bench.py: shuffle write throughput {shuf_gbs} GB/s "
+                f"regressed below {RATCHET_SLACK} x best {shuffle_best} "
+                f"({shuffle_key})\n"
+            )
+            failed = True
+        if failed:
             sys.exit(1)
+        # only a CORRECT, PASSING run records new bests (the PR-4/PR-5
+        # ratchet lesson: a broken run must never move a floor)
+        changed = False
         if gbs > (ingest_best or 0.0):
             ratchet[ingest_key] = gbs
+            changed = True
+        if shuf_gbs is not None and shuf_gbs > (shuffle_best or 0.0):
+            ratchet[shuffle_key] = shuf_gbs
+            changed = True
+        if changed:
             _save_ratchet(ratchet)
 
 

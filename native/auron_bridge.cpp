@@ -245,6 +245,50 @@ int auron_put_resource_bytes(const char* key, const uint8_t* value,
   return rc;
 }
 
+int auron_put_resource_arrow(const char* key, void* stream) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  /* the pointer crosses as an integer; bridge/api.py imports it through
+   * pyarrow's C-data interface (RecordBatchReader._import_from_c), which
+   * assumes ownership per the ArrowArrayStream spec — no serde, no copy */
+  PyObject* res = PyObject_CallMethod(
+      g_api, "put_resource_c_stream", "sK", key,
+      static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(stream)));
+  if (res != nullptr) {
+    rc = 0;
+    Py_DECREF(res);
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int auron_next_batch_arrow(auron_task_handle h, void* out_array,
+                           void* out_schema) {
+  if (!ensure_init()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyObject_CallMethod(
+      g_api, "next_batch_c", "LKK", (long long)h,
+      static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(out_array)),
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(out_schema)));
+  if (res != nullptr) {
+    rc = static_cast<int>(PyLong_AsLong(res));
+    Py_DECREF(res);
+    if (PyErr_Occurred() != nullptr) {
+      capture_python_error();
+      rc = -1;
+    }
+  } else {
+    capture_python_error();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
 int auron_put_resource_shuffle(const char* key, const uint8_t* manifest,
                                size_t len) {
   if (!ensure_init()) return -1;

@@ -50,6 +50,26 @@ void auron_on_exit(void);
 int auron_put_resource(const char* key, const uint8_t* value, size_t len);
 int auron_put_resource_bytes(const char* key, const uint8_t* value,
                              size_t len);
+
+/* Arrow C data interface (zero-serde boundary, the in-process twin of the
+ * IPC entries above — the reference's L4 design: batches cross as
+ * pointers, never bytes).
+ *
+ * auron_put_resource_arrow: `stream` is a `struct ArrowArrayStream*`
+ * (arrow/c/abi.h; declared void* here so embedders without Arrow headers
+ * can still bind the rest of the ABI). The engine takes ownership per the
+ * C-stream spec (it will call the release callback); the host must keep
+ * the struct memory alive until the call returns. Batches are imported
+ * lazily as the consuming task pulls them.
+ *
+ * auron_next_batch_arrow: exports the task's next batch into
+ * host-allocated `struct ArrowArray*` / `struct ArrowSchema*` structs;
+ * ownership of the exported buffers transfers to the host via the structs'
+ * release callbacks. Returns 1 on a batch, 0 at end-of-stream, negative
+ * on error. */
+int auron_put_resource_arrow(const char* key, void* stream);
+int auron_next_batch_arrow(auron_task_handle h, void* out_array,
+                           void* out_schema);
 /* Shuffle fetch registration: the payload is a JSON manifest of committed
  * map outputs ([{"data": path, "index": path}, ...]) — the MapStatus/
  * shuffle-fetch contract for host-scheduled stages. The reduce task's

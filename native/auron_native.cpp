@@ -12,6 +12,7 @@
 // with pure-numpy fallbacks when the shared library is absent.
 
 #include <cstdint>
+#include <cmath>
 #include <cstring>
 #include <vector>
 
@@ -236,3 +237,164 @@ uint32_t crc32c_hash(const uint8_t* data, int64_t n, uint32_t crc) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// shuffle format v2: fused scaled decimal-in-float probe/pack/unpack
+// (docs/shuffle.md). The numpy twin in exec/shuffle/format.py needs ~12
+// full-plane passes; these run the verify+range and the pack as ONE fused
+// read pass each, which is what keeps the encode under the lz4 byte budget
+// on bandwidth-starved hosts. Arithmetic mirrors the numpy path exactly
+// (rint = round-half-even = np.round; float32 variants compute in float,
+// like the dtype-preserving numpy expressions), so library and fallback
+// produce identical bytes.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+static const double kMaxExact64 = 9007199254740992.0; /* 2^53 */
+
+int scaled_probe_f64(const double* a, int64_t n, double s, int64_t* lo_out,
+                     int64_t* hi_out) {
+  double lo = 0.0, hi = 0.0;
+  int has = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const double t = rint(a[i] * s);
+    if (!(fabs(t) < kMaxExact64)) return 0; /* NaN/Inf/|t|>=2^53 */
+    if (t / s != a[i]) return 0;            /* decode-sim, bitwise */
+    if (t == 0.0 && std::signbit(a[i])) return 0; /* -0.0 packs as +0.0 */
+    if (!has || t < lo) lo = t;
+    if (!has || t > hi) hi = t;
+    has = 1;
+  }
+  *lo_out = (int64_t)lo;
+  *hi_out = (int64_t)hi;
+  return 1;
+}
+
+int scaled_probe_f32(const float* a, int64_t n, float s, int64_t* lo_out,
+                     int64_t* hi_out) {
+  float lo = 0.0f, hi = 0.0f;
+  int has = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const float t = rintf(a[i] * s);
+    if (!(fabsf(t) < 9007199254740992.0f)) return 0;
+    if (t / s != a[i]) return 0;
+    if (t == 0.0f && std::signbit(a[i])) return 0;
+    if (!has || t < lo) lo = t;
+    if (!has || t > hi) hi = t;
+    has = 1;
+  }
+  *lo_out = (int64_t)lo;
+  *hi_out = (int64_t)hi;
+  return 1;
+}
+
+void scaled_pack_f64(const double* a, int64_t n, double s, int64_t lo,
+                     int32_t width, uint8_t* out) {
+  switch (width) {
+    case 1:
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (uint8_t)((int64_t)rint(a[i] * s) - lo);
+      break;
+    case 2: {
+      uint16_t* o = (uint16_t*)out;
+      for (int64_t i = 0; i < n; i++)
+        o[i] = (uint16_t)((int64_t)rint(a[i] * s) - lo);
+      break;
+    }
+    case 4: {
+      uint32_t* o = (uint32_t*)out;
+      for (int64_t i = 0; i < n; i++)
+        o[i] = (uint32_t)((int64_t)rint(a[i] * s) - lo);
+      break;
+    }
+    default: { /* 8: int64 passthrough, lo ignored (caller passes 0) */
+      int64_t* o = (int64_t*)out;
+      for (int64_t i = 0; i < n; i++) o[i] = (int64_t)rint(a[i] * s);
+      break;
+    }
+  }
+}
+
+void scaled_pack_f32(const float* a, int64_t n, float s, int64_t lo,
+                     int32_t width, uint8_t* out) {
+  switch (width) {
+    case 1:
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (uint8_t)((int64_t)rintf(a[i] * s) - lo);
+      break;
+    case 2: {
+      uint16_t* o = (uint16_t*)out;
+      for (int64_t i = 0; i < n; i++)
+        o[i] = (uint16_t)((int64_t)rintf(a[i] * s) - lo);
+      break;
+    }
+    case 4: {
+      uint32_t* o = (uint32_t*)out;
+      for (int64_t i = 0; i < n; i++)
+        o[i] = (uint32_t)((int64_t)rintf(a[i] * s) - lo);
+      break;
+    }
+    default: {
+      int64_t* o = (int64_t*)out;
+      for (int64_t i = 0; i < n; i++) o[i] = (int64_t)rintf(a[i] * s);
+      break;
+    }
+  }
+}
+
+void scaled_unpack_f64(const uint8_t* in, int64_t n, double s, int64_t lo,
+                       int32_t width, double* out) {
+  switch (width) {
+    case 1:
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (double)((int64_t)in[i] + lo) / s;
+      break;
+    case 2: {
+      const uint16_t* p = (const uint16_t*)in;
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (double)((int64_t)p[i] + lo) / s;
+      break;
+    }
+    case 4: {
+      const uint32_t* p = (const uint32_t*)in;
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (double)((int64_t)p[i] + lo) / s;
+      break;
+    }
+    default: {
+      const int64_t* p = (const int64_t*)in;
+      for (int64_t i = 0; i < n; i++) out[i] = (double)(p[i] + lo) / s;
+      break;
+    }
+  }
+}
+
+void scaled_unpack_f32(const uint8_t* in, int64_t n, float s, int64_t lo,
+                       int32_t width, float* out) {
+  switch (width) {
+    case 1:
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (float)((int64_t)in[i] + lo) / s;
+      break;
+    case 2: {
+      const uint16_t* p = (const uint16_t*)in;
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (float)((int64_t)p[i] + lo) / s;
+      break;
+    }
+    case 4: {
+      const uint32_t* p = (const uint32_t*)in;
+      for (int64_t i = 0; i < n; i++)
+        out[i] = (float)((int64_t)p[i] + lo) / s;
+      break;
+    }
+    default: {
+      const int64_t* p = (const int64_t*)in;
+      for (int64_t i = 0; i < n; i++) out[i] = (float)(p[i] + lo) / s;
+      break;
+    }
+  }
+}
+
+}  // extern "C" (scaled kernels)

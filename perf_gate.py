@@ -265,6 +265,11 @@ def run_one(name: str, ws: str) -> None:
         "flat": {k: flat_totals[k] for k in sorted(flat_totals)},
         "ops": {k: v for k, v in ranked},
     }
+    shuf = shuffle_breakdown(flat_totals)
+    if shuf is not None:
+        # data-plane visibility (ISSUE 11): throughputs, bytes and the
+        # per-block encoding histogram ride every gate run
+        brk["shuffle"] = shuf
     if qt.trace is not None and qt.trace.span_op_ns:
         # the same top_ops re-derived from the span timeline, and the
         # agreement check against the metric rollup above — a hop that
@@ -277,6 +282,40 @@ def run_one(name: str, ws: str) -> None:
         }
         brk["span_check"] = qt.trace.op_seconds_skew()
     print(json.dumps(brk), flush=True)
+
+
+def shuffle_breakdown(flat: dict) -> dict | None:
+    """Data-plane rollup from a flat metric-total dict (shared by bench.py
+    and the per-class breakdown line): write/read throughput, bytes, and
+    the per-column-block encoding histogram — encoding regressions show in
+    every gate run, next to top_ops (docs/shuffle.md). Returns None when
+    the run shuffled nothing.
+
+    write GB/s is RAW bytes staged per second of encode+write work (the
+    number compacted encodings move); read GB/s is FILE bytes decoded per
+    second of block-decode + bucket-assembly work. Both use ns timers, so
+    bytes/ns == GB/s exactly."""
+    raw = flat.get("shuffle_bytes_raw", 0)
+    written = flat.get("shuffle_bytes_written", 0) or flat.get("data_size", 0)
+    read = flat.get("shuffle_bytes_read", 0)
+    enc_ns = flat.get("compress_time", 0) + flat.get("write_time", 0)
+    dec_ns = flat.get("decode_time", 0)
+    if not (raw or written or read):
+        return None
+    out = {
+        "bytes_raw": raw,
+        "bytes_written": written,
+        "bytes_read": read,
+        "encodings": {
+            k[len("shuffle_enc_"):]: v
+            for k, v in sorted(flat.items()) if k.startswith("shuffle_enc_")
+        },
+    }
+    if raw and enc_ns:
+        out["shuffle_write_gb_s"] = round(raw / enc_ns, 3)
+    if read and dec_ns:
+        out["shuffle_read_gb_s"] = round(read / dec_ns, 3)
+    return out
 
 
 RATCHET_PATH = os.path.join(ROOT, "PERF_RATCHET.json")

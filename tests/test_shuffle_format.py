@@ -1,0 +1,356 @@
+"""Shuffle block format v2: round-trip fuzz, chooser determinism,
+corruption loudness, codec degradation, bucket-decode reader equality
+(docs/shuffle.md)."""
+
+import decimal
+import io
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exec.basic import MemoryScanExec
+from auron_tpu.exec.shuffle import HashPartitioning, IpcReaderExec, ShuffleWriterExec
+from auron_tpu.exec.shuffle import format as F
+from auron_tpu.exec.shuffle.reader import LocalFileBlockProvider
+from auron_tpu.exec.shuffle.writer import encode_shuffle_block
+from auron_tpu.exprs.ir import col
+from auron_tpu.utils.config import (
+    SHUFFLE_ENCODING,
+    SPILL_COMPRESSION_CODEC,
+    Configuration,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _null_mask(n: int, pattern: str):
+    if pattern == "none" or n == 0:
+        return None
+    if pattern == "all":
+        return np.ones(n, dtype=bool)  # True = null (pa mask convention)
+    m = RNG.random(n) < 0.3
+    if not m.any():
+        m[0] = True
+    return m
+
+
+def _column(kind: str, n: int, pattern: str) -> pa.Array:
+    mask = _null_mask(n, pattern)
+    if kind == "int64":
+        vals = RNG.integers(-(10**12), 10**12, n)
+        return pa.array(vals, mask=mask)
+    if kind == "int_small":
+        return pa.array(RNG.integers(0, 200, n).astype(np.int64), mask=mask)
+    if kind == "int_runs":
+        return pa.array(np.sort(RNG.integers(0, max(n // 50, 1), n)), mask=mask)
+    if kind == "int32":
+        return pa.array(RNG.integers(-1000, 1000, n).astype(np.int32), mask=mask)
+    if kind == "int8":
+        return pa.array(RNG.integers(-100, 100, n).astype(np.int8), mask=mask)
+    if kind == "bool":
+        return pa.array(RNG.random(n) < 0.5, mask=mask)
+    if kind == "float64_dec":
+        return pa.array(np.round(RNG.random(n) * 500, 2), mask=mask)
+    if kind == "float64_rand":
+        return pa.array(RNG.random(n), mask=mask)
+    if kind == "float64_edge":
+        base = np.where(RNG.random(n) < 0.5, -0.0, np.nan)
+        base[::3] = 1.25
+        return pa.array(base, mask=mask)
+    if kind == "float32":
+        return pa.array(
+            np.round(RNG.random(n) * 9, 1).astype(np.float32), mask=mask)
+    if kind == "ts":
+        vals = RNG.integers(0, 10**15, n).astype("datetime64[us]")
+        return pa.array(vals, mask=mask)
+    if kind == "date32":
+        return pa.array(
+            RNG.integers(0, 20000, n).astype(np.int32), mask=mask
+        ).cast(pa.date32())
+    if kind == "decimal":
+        pv = [decimal.Decimal(int(v)).scaleb(-2) for v in
+              RNG.integers(-(10**6), 10**6, n)]
+        arr = pa.array(pv, type=pa.decimal128(12, 2))
+        if mask is not None:
+            arr = pa.array(
+                [None if m else v for v, m in zip(pv, mask)],
+                type=pa.decimal128(12, 2))
+        return arr
+    if kind == "decimal_wide":
+        # wide-decimal (p>18): the limbs genuinely use the high int64
+        pv = [decimal.Decimal(int(v)) * (10**15) for v in
+              RNG.integers(-(10**6), 10**6, n)]
+        arr = pa.array([None if (mask is not None and m) else v
+                        for v, m in zip(pv, mask if mask is not None else
+                                        [False] * n)],
+                       type=pa.decimal128(38, 0))
+        return arr
+    if kind == "dict_str":
+        vals = RNG.choice(["alpha", "beta", "gamma", "delta"], n)
+        arr = pa.array(vals, mask=mask)
+        return arr.dictionary_encode()
+    if kind == "str":
+        vals = [f"s{int(v)}" for v in RNG.integers(0, 50, n)]
+        if mask is not None:
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return pa.array(vals)
+    raise AssertionError(kind)
+
+
+KINDS = ["int64", "int_small", "int_runs", "int32", "int8", "bool",
+         "float64_dec", "float64_rand", "float64_edge", "float32", "ts",
+         "date32", "decimal", "decimal_wide", "dict_str", "str"]
+
+
+def _assert_tables_bit_equal(t1: pa.Table, t2: pa.Table, ctx=""):
+    """Column-wise byte-exact comparison. Arrow's Table.equals treats
+    NaN != NaN, so float columns compare validity + BIT PATTERNS instead
+    (stricter: -0.0 != 0.0, NaN payloads must survive)."""
+    assert t1.schema.equals(t2.schema), ctx
+    for i, f in enumerate(t1.schema):
+        c1 = t1.column(i).combine_chunks()
+        c2 = t2.column(i).combine_chunks()
+        if pa.types.is_floating(f.type):
+            import pyarrow.compute as pc
+
+            v1 = pc.is_valid(c1).to_numpy(zero_copy_only=False)
+            v2_ = pc.is_valid(c2).to_numpy(zero_copy_only=False)
+            assert np.array_equal(v1, v2_), (ctx, f.name)
+            u = np.uint64 if f.type == pa.float64() else np.uint32
+            b1 = c1.fill_null(0).to_numpy(zero_copy_only=False).view(u)
+            b2 = c2.fill_null(0).to_numpy(zero_copy_only=False).view(u)
+            assert np.array_equal(b1[v1], b2[v1]), (ctx, f.name)
+        else:
+            assert c1.equals(c2), (ctx, f.name)
+
+
+@pytest.mark.parametrize("n", [0, 1, 977])
+@pytest.mark.parametrize("pattern", ["none", "some", "all"])
+def test_v2_roundtrip_fuzz(n, pattern):
+    """Every encoding x dtype x NULL pattern decodes byte-exactly to what
+    the legacy zstd-IPC block yields for the same rows."""
+    arrays = [_column(k, n, pattern) for k in KINDS]
+    rb = pa.RecordBatch.from_arrays(arrays, names=KINDS)
+    conf = Configuration().set(SPILL_COMPRESSION_CODEC, "zstd")
+    legacy = list(F.decode_blocks(F.encode_block(rb, conf=conf)))
+    v2 = list(F.decode_blocks(F.encode_block_v2([rb], conf=conf)))
+    t_legacy = pa.Table.from_batches(legacy, schema=rb.schema)
+    t_v2 = pa.Table.from_batches(v2, schema=rb.schema)
+    _assert_tables_bit_equal(t_legacy, t_v2, f"{pattern}/{n}")
+    # and both match the source rows
+    _assert_tables_bit_equal(pa.Table.from_batches([rb]), t_v2, "src")
+
+
+def test_v2_encode_deterministic():
+    rb = pa.RecordBatch.from_arrays(
+        [_column(k, 500, "some") for k in KINDS], names=KINDS)
+    assert F.encode_block_v2([rb]) == F.encode_block_v2([rb])
+
+
+def test_v2_multi_batch_block():
+    rbs = [pa.RecordBatch.from_arrays(
+        [_column("int_small", 100, "none"), _column("float64_dec", 100, "some")],
+        names=["a", "b"]) for _ in range(3)]
+    out = list(F.decode_blocks(F.encode_block_v2(rbs)))
+    got = pa.Table.from_batches(out)
+    want = pa.Table.from_batches(rbs).combine_chunks()
+    assert got.equals(want)
+
+
+def test_v2_scaled_edge_values_roundtrip():
+    """-0.0, NaN, Inf and near-2^53 magnitudes must never decode to
+    different bits (the scaled encoder must refuse them)."""
+    vals = np.array([0.0, -0.0, np.nan, np.inf, -np.inf, 1.25, 2.0**53,
+                     -(2.0**53), 123.456, 1e300, 5e-324])
+    rb = pa.RecordBatch.from_arrays([pa.array(vals)], names=["v"])
+    out = list(F.decode_blocks(F.encode_block_v2([rb])))[0]
+    got = out.column(0).to_numpy(zero_copy_only=False)
+    assert np.array_equal(got.view(np.uint64), vals.view(np.uint64)), got
+
+
+def test_scaled_f32_wide_span_numpy_twin_matches_native():
+    """Regression: a float32 plane whose scaled span needs >24 bits must
+    round-trip exactly on BOTH the native kernel and the numpy fallback
+    (the fallback once subtracted the FOR reference in float32, rounding
+    16777217 offsets to 16777216 — silent corruption), and the two paths
+    must emit identical bytes."""
+    from auron_tpu import native
+
+    vals = np.array([1.0, 16777218.0, 2.0, 33554436.0], dtype=np.float32)
+    rb = pa.RecordBatch.from_arrays([pa.array(vals)], names=["v"])
+    blk_native = F.encode_block_v2([rb])
+    # force the numpy twin
+    orig = native.scaled_probe_host
+    try:
+        native.scaled_probe_host = lambda a, s: False
+        blk_numpy = F.encode_block_v2([rb])
+    finally:
+        native.scaled_probe_host = orig
+    assert blk_native == blk_numpy
+    out = list(F.decode_blocks(blk_numpy))[0].column(0).to_numpy(
+        zero_copy_only=False)
+    assert np.array_equal(out.view(np.uint32), vals.view(np.uint32))
+
+
+def test_v2_corrupt_block_fails_loudly():
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array(np.arange(100, dtype=np.int64))], names=["x"])
+    blk = F.encode_block_v2([rb])
+    payload = blk[8:]
+    # truncated column payload
+    with pytest.raises(ValueError):
+        F.decode_block_v2(payload[: len(payload) // 2])
+    # bad version
+    bad = bytearray(payload)
+    bad[4] = 9
+    with pytest.raises(ValueError):
+        F.decode_block_v2(bytes(bad))
+    # framing overrun
+    with pytest.raises(ValueError):
+        list(F.iter_block_payloads(blk[:-4]))
+
+
+class _UnavailableCodec:
+    @staticmethod
+    def is_available(name):
+        return False
+
+
+def test_unavailable_codec_degrades_with_one_warning(monkeypatch, capsys):
+    """PR-5 importorskip treatment: a conf naming a codec the runtime
+    lacks degrades to light-weight encodings + ONE stderr warning, never
+    a failed write."""
+    F._codec_warned.clear()
+    monkeypatch.setattr(F.pa, "Codec", _UnavailableCodec)
+    conf = Configuration().set("exec.shuffle.encoding.fallback.codec", "zstd")
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array(RNG.random(5000))], names=["v"])  # incompressible floats
+    blk = F.encode_block_v2([rb], conf=conf)
+    blk2 = F.encode_block_v2([rb], conf=conf)
+    err = capsys.readouterr().err
+    assert err.count("unavailable") >= 1
+    # warn once per codec name, not per block
+    assert err.count("'zstd' unavailable") == 1
+    out = list(F.decode_blocks(blk))[0]
+    assert out.column(0).to_pylist() == rb.column(0).to_pylist()
+    assert blk == blk2
+
+
+def test_writer_off_mode_emits_v1_ipc_blocks(tmp_path):
+    """exec.shuffle.encoding=off restores the legacy compressed-IPC block
+    bytes exactly (the conf contract)."""
+    df = pd.DataFrame({"k": np.arange(500) % 7, "v": np.arange(500.0)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    part = HashPartitioning([col(0)], 3)
+    files = {}
+    for mode in ("off", "on"):
+        conf = Configuration().set(SHUFFLE_ENCODING, mode)
+        data = str(tmp_path / f"{mode}.data")
+        index = str(tmp_path / f"{mode}.index")
+        w = ShuffleWriterExec(MemoryScanExec.single([b]), part, data, index)
+        list(w.execute(0, ExecutionContext(partition_id=0, conf=conf)))
+        files[mode] = (data, index)
+    prov_off = LocalFileBlockProvider(*files["off"])
+    prov_on = LocalFileBlockProvider(*files["on"])
+    for p in range(3):
+        for pay in prov_off.iter_payloads(p):
+            assert not F.is_v2_payload(pay)
+            with pa.ipc.open_stream(pay):  # genuinely v1
+                pass
+        for pay in prov_on.iter_payloads(p):
+            assert F.is_v2_payload(pay)
+    # same logical rows either way
+    rows_off = sorted(
+        r["v"] for p in range(3) for rb in prov_off(p) for r in rb.to_pylist())
+    rows_on = sorted(
+        r["v"] for p in range(3) for rb in prov_on(p) for r in rb.to_pylist())
+    assert rows_off == rows_on == sorted(df["v"].tolist())
+
+
+def _read_batches(schema, provider, n_parts, conf):
+    out = []
+    for p in range(n_parts):
+        r = IpcReaderExec(schema, "blocks")
+        ctx = ExecutionContext(partition_id=p, conf=conf)
+        ctx.resources["blocks"] = provider
+        out.extend(b.to_arrow() for b in r.execute(p, ctx))
+    return out
+
+
+@pytest.mark.parametrize("writer_mode", ["off", "on"])
+def test_bucket_decode_matches_legacy_reader(tmp_path, writer_mode):
+    """The reader's direct capacity-bucket decode yields the same rows as
+    the legacy Arrow-table path, for BOTH block versions (mixed-region
+    tolerance), including dict-encoded strings and decimals."""
+    df = pd.DataFrame({
+        "k": np.arange(2000) % 13,
+        "price": np.round(RNG.random(2000) * 100, 2),
+        "s": RNG.choice(["x", "y", "z"], 2000),
+    })
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    part = HashPartitioning([col(0)], 4)
+    conf_w = Configuration().set(SHUFFLE_ENCODING, writer_mode)
+    data = str(tmp_path / "m.data")
+    index = str(tmp_path / "m.index")
+    w = ShuffleWriterExec(MemoryScanExec.single([b]), part, data, index)
+    list(w.execute(0, ExecutionContext(partition_id=0, conf=conf_w)))
+    prov = LocalFileBlockProvider(data, index)
+    legacy = _read_batches(
+        b.schema, prov, 4, Configuration().set(SHUFFLE_ENCODING, "off"))
+    direct = _read_batches(
+        b.schema, prov, 4, Configuration().set(SHUFFLE_ENCODING, "on"))
+    key = lambda rows: sorted(
+        (r["k"], r["price"], r["s"]) for r in rows)
+    legacy_rows = key(r for rb in legacy for r in rb.to_pylist())
+    direct_rows = key(r for rb in direct for r in rb.to_pylist())
+    assert legacy_rows == direct_rows
+    assert legacy_rows == key(df.to_dict("records"))
+
+
+def test_bucket_decode_wide_decimal_and_nulls(tmp_path):
+    pv = [None if i % 5 == 0 else decimal.Decimal(i) * (10**15)
+          for i in range(600)]
+    rb = pa.RecordBatch.from_arrays([
+        pa.array(np.arange(600) % 3),
+        pa.array(pv, type=pa.decimal128(38, 0)),
+    ], names=["k", "d"])
+    b = Batch.from_arrow(rb)
+    part = HashPartitioning([col(0)], 2)
+    data = str(tmp_path / "d.data")
+    index = str(tmp_path / "d.index")
+    w = ShuffleWriterExec(MemoryScanExec.single([b]), part, data, index)
+    list(w.execute(0, ExecutionContext(partition_id=0)))
+    prov = LocalFileBlockProvider(data, index)
+    got = _read_batches(b.schema, prov, 2,
+                        Configuration().set(SHUFFLE_ENCODING, "on"))
+    vals = sorted(
+        (r["d"] for rb_ in got for r in rb_.to_pylist() if r["d"] is not None))
+    want = sorted(v for v in pv if v is not None)
+    assert vals == want
+    nulls = sum(1 for rb_ in got for r in rb_.to_pylist() if r["d"] is None)
+    assert nulls == sum(1 for v in pv if v is None)
+
+
+def test_encoding_histogram_metrics(tmp_path):
+    df = pd.DataFrame({"k": np.arange(3000) % 5,
+                       "price": np.round(RNG.random(3000) * 9, 2)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    data = str(tmp_path / "h.data")
+    index = str(tmp_path / "h.index")
+    w = ShuffleWriterExec(
+        MemoryScanExec.single([b]), HashPartitioning([col(0)], 2), data, index)
+    ctx = ExecutionContext(partition_id=0)
+    list(w.execute(0, ctx))
+    hist = {
+        k: v for k, v in
+        ((m, ctx.metrics.total(f"shuffle_enc_{m}"))
+         for m in F.ENC_NAMES.values()) if v
+    }
+    assert hist, "no encodings recorded"
+    assert ctx.metrics.total("shuffle_bytes_raw") > 0
+    assert ctx.metrics.total("shuffle_bytes_written") > 0

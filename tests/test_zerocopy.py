@@ -1,0 +1,190 @@
+"""Zero-copy ingestion (exec.scan.zerocopy, docs/shuffle.md): bit
+identity against the copying path, eligibility accounting, aligned
+staging, and the Arrow C-FFI bridge handoff."""
+
+import decimal
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu import types as T
+from auron_tpu.columnar import Batch
+from auron_tpu.columnar.batch import (
+    ZERO_COPY_ALIGN,
+    aligned_empty,
+    ingest_stats,
+    reset_ingest_stats,
+)
+from auron_tpu.exec.base import ExecutionContext
+from auron_tpu.exec.scan import ParquetScanExec
+from auron_tpu.utils.config import SCAN_ZEROCOPY, Configuration
+
+RNG = np.random.default_rng(5)
+
+
+def test_aligned_empty_is_aligned():
+    for n in (1, 7, 1000, 131072):
+        for dt in (np.int8, np.int32, np.int64, np.float64, bool):
+            a = aligned_empty(n, dt)
+            assert a.ctypes.data % ZERO_COPY_ALIGN == 0
+            assert len(a) == n and a.dtype == np.dtype(dt)
+    assert len(aligned_empty(0, np.int64)) == 0  # empty: pointer is moot
+
+
+def _mixed_record_batch(n=1000, nulls=True):
+    mask = (RNG.random(n) < 0.2) if nulls else None
+    return pa.RecordBatch.from_arrays([
+        pa.array(RNG.integers(-(10**9), 10**9, n), mask=mask),
+        pa.array(RNG.random(n), mask=mask),
+        pa.array(RNG.integers(0, 2, n).astype(bool), mask=mask),
+        pa.array(RNG.integers(0, 10**14, n).astype("datetime64[us]"), mask=mask),
+        pa.array(RNG.integers(0, 20000, n).astype(np.int32), mask=mask).cast(pa.date32()),
+        pa.array(RNG.choice(["a", "bb", "ccc"], n), mask=mask).dictionary_encode(),
+        pa.array([decimal.Decimal(int(v)).scaleb(-2)
+                  for v in RNG.integers(-(10**6), 10**6, n)],
+                 type=pa.decimal128(10, 2)),
+    ], names=["i", "f", "b", "ts", "d", "s", "dec"])
+
+
+@pytest.mark.parametrize("nulls", [False, True])
+def test_from_arrow_bit_identity_on_vs_off(nulls):
+    rb = _mixed_record_batch(nulls=nulls)
+    off = Batch.from_arrow(rb, conf=Configuration().set(SCAN_ZEROCOPY, "off"))
+    on = Batch.from_arrow(rb, conf=Configuration().set(SCAN_ZEROCOPY, "on"))
+    assert off.to_arrow().equals(on.to_arrow())
+    # device planes identical too
+    import jax
+
+    d_off = jax.device_get(off.device)
+    d_on = jax.device_get(on.device)
+    assert np.array_equal(d_off.sel, d_on.sel)
+    for a, b in zip(d_off.values, d_on.values):
+        assert np.array_equal(a, b)
+    for a, b in zip(d_off.validity, d_on.validity):
+        assert np.array_equal(a, b)
+
+
+def test_from_pandas_bit_identity_on_vs_off():
+    df = pd.DataFrame({
+        "i": RNG.integers(0, 10**9, 2000),
+        "masked": pd.array(
+            [None if v % 7 == 0 else int(v) for v in range(2000)],
+            dtype="Int64"),
+        "f": np.where(RNG.random(2000) < 0.1, np.nan, RNG.random(2000)),
+        "s": RNG.choice(["x", "y"], 2000),
+    })
+    off = Batch.from_pandas(df, conf=Configuration().set(SCAN_ZEROCOPY, "off"))
+    on = Batch.from_pandas(df, conf=Configuration().set(SCAN_ZEROCOPY, "on"))
+    assert off.to_arrow().equals(on.to_arrow())
+
+
+def test_clean_full_batch_planes_are_views():
+    """Validity-clean fixed-width columns of a FULL batch (rows == cap)
+    ride as zero-copy views; nulls/bool force the copy path."""
+    import pyarrow.compute as pc
+
+    n = 1024  # a power-of-two bucket: rows == capacity
+    # pc.add materializes into Arrow-ALLOCATED buffers (64-aligned, like
+    # parquet decode output); numpy-wrapped arrays are only 16-aligned
+    rb = pa.RecordBatch.from_arrays(
+        [pc.add(pa.array(np.arange(n, dtype=np.int64)), 0),
+         pc.add(pa.array(RNG.random(n)), 0.0)],
+        names=["a", "b"])
+    reset_ingest_stats()
+    Batch.from_arrow(rb, conf=Configuration().set(SCAN_ZEROCOPY, "on"))
+    st = ingest_stats()
+    assert st["zerocopy_planes"] == 2, st
+    # a padded (non-full) batch pays the aligned-staging copy instead
+    rb2 = pa.RecordBatch.from_arrays(
+        [pa.array(np.arange(n - 5, dtype=np.int64))], names=["a"])
+    reset_ingest_stats()
+    Batch.from_arrow(rb2, conf=Configuration().set(SCAN_ZEROCOPY, "on"))
+    st = ingest_stats()
+    assert st["copied_planes"] == 1 and st["zerocopy_planes"] == 0, st
+
+
+def test_parquet_scan_zerocopy_bit_identity(tmp_path):
+    """The scan satellite: a predicate-pruned parquet scan produces
+    bit-identical batches with exec.scan.zerocopy on/off, and the clean
+    fixed-width columns actually take the zero-copy path."""
+    n = 4096
+    tbl = pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "b": pa.array(np.round(RNG.random(n), 3)),
+        "c": pa.array([None if i % 11 == 0 else i for i in range(n)],
+                      type=pa.int64()),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path, row_group_size=1024)
+    schema = T.Schema.of(T.Field("a", T.INT64), T.Field("b", T.FLOAT64),
+                         T.Field("c", T.INT64))
+    outs = {}
+    for mode in ("off", "on"):
+        conf = Configuration().set(SCAN_ZEROCOPY, mode)
+        reset_ingest_stats()
+        scan = ParquetScanExec(schema, [path])
+        outs[mode] = [b.to_arrow()
+                      for b in scan.execute(0, ExecutionContext(conf=conf))]
+        if mode == "on":
+            assert ingest_stats()["zerocopy_planes"] > 0
+    assert len(outs["off"]) == len(outs["on"])
+    for x, y in zip(outs["off"], outs["on"]):
+        assert x.equals(y)
+
+
+def test_dictionary_pages_pass_through_by_reference(tmp_path):
+    """A parquet dictionary-encoded column arriving as DictionaryArray
+    keeps its dictionary object by reference (no re-encode)."""
+    n = 2048
+    tbl = pa.table({"s": pa.array(RNG.choice(["p", "q", "r"], n)).dictionary_encode()})
+    path = str(tmp_path / "d.parquet")
+    pq.write_table(tbl, path)
+    schema = T.Schema.of(T.Field("s", T.STRING))
+    scan = ParquetScanExec(schema, [path])
+    out = list(scan.execute(0, ExecutionContext(
+        conf=Configuration().set(SCAN_ZEROCOPY, "on"))))
+    assert out and out[0].dicts[0] is not None
+    got = [v for b in out for v in b.to_arrow().column(0).to_pylist()]
+    assert got == tbl.column(0).to_pylist()
+
+
+def test_c_ffi_stream_handoff_roundtrip():
+    """Arrow C data interface across the bridge: a stream handed by
+    POINTER (no IPC bytes) feeds a task, and results export back through
+    C structs — the serde-free JVM-boundary path."""
+    ctypes_ffi = pytest.importorskip("pyarrow.cffi")
+    ffi = ctypes_ffi.ffi
+    from auron_tpu.bridge import api
+    from auron_tpu.exprs.ir import BinaryOp, col, lit
+    from auron_tpu.plan import builders as B
+
+    rb = pa.record_batch({"x": pa.array(np.arange(64, dtype=np.int64))})
+    reader = pa.RecordBatchReader.from_batches(rb.schema, [rb])
+    c_stream = ffi.new("struct ArrowArrayStream*")
+    reader._export_to_c(int(ffi.cast("uintptr_t", c_stream)))
+    api.put_resource_c_stream("cffi_rt", int(ffi.cast("uintptr_t", c_stream)))
+    try:
+        schema = T.Schema.of(T.Field("x", T.INT64))
+        plan = B.filter_(B.ffi_reader(schema, "cffi_rt"),
+                         [BinaryOp("lt", col(0), lit(10))])
+        h = api.call_native(B.task(plan, partition_id=0).SerializeToString())
+        rows = []
+        while True:
+            c_arr = ffi.new("struct ArrowArray*")
+            c_sch = ffi.new("struct ArrowSchema*")
+            rc = api.next_batch_c(h, int(ffi.cast("uintptr_t", c_arr)),
+                                  int(ffi.cast("uintptr_t", c_sch)))
+            assert rc in (0, 1)
+            if rc == 0:
+                break
+            got = pa.RecordBatch._import_from_c(
+                int(ffi.cast("uintptr_t", c_arr)),
+                int(ffi.cast("uintptr_t", c_sch)))
+            rows += got.column(0).to_pylist()
+        api.finalize_native(h)
+        assert rows == list(range(10))
+    finally:
+        api.remove_resource("cffi_rt")

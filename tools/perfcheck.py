@@ -165,6 +165,80 @@ def main() -> int:
     }))
     retrace_failures += ext_failures
 
+    # ---- data-plane guard (docs/shuffle.md): (1) the v2 encoding chooser
+    # is a DETERMINISTIC function of (schema, block stats) — encoding the
+    # same staged batches twice must produce identical bytes (this is what
+    # keeps fused-vs-eager shuffle files byte-identical and task-attempt
+    # commits interchangeable); (2) the reader's bucket-decode path
+    # compiles NOTHING — a replayed read must add zero XLA compiles (the
+    # assembly is host fills + one aliasing device transfer). Both checks
+    # fail on vacuity (no v2 blocks = broken guard).
+    import numpy as _np
+    import pyarrow as _pa
+
+    from auron_tpu import types as _T
+    from auron_tpu.columnar.batch import Batch as _Batch
+    from auron_tpu.exec.base import ExecutionContext as _Ctx
+    from auron_tpu.exec.basic import MemoryScanExec as _Scan
+    from auron_tpu.exec.shuffle import HashPartitioning as _HashPart
+    from auron_tpu.exec.shuffle import IpcReaderExec as _Reader
+    from auron_tpu.exec.shuffle import ShuffleWriterExec as _Writer
+    from auron_tpu.exec.shuffle.format import encode_block_v2, is_v2_payload
+    from auron_tpu.exec.shuffle.reader import LocalFileBlockProvider as _Prov
+    from auron_tpu.exprs.ir import col as _col
+
+    rng = _np.random.default_rng(11)
+    dp_failures = 0
+    rbs = [_pa.RecordBatch.from_arrays([
+        _pa.array(_np.sort(rng.integers(0, 5000, 20000))),
+        _pa.array(_np.round(rng.random(20000) * 100, 2)),
+        _pa.array(rng.integers(0, 9, 20000).astype(_np.int64)),
+    ], names=["k", "price", "cnt"])]
+    enc1 = encode_block_v2(rbs)
+    enc2 = encode_block_v2(rbs)
+    if enc1 != enc2:
+        dp_failures += 1
+    df = {"k": rng.integers(0, 100, 30000).astype(_np.int64),
+          "v": _np.round(rng.random(30000) * 10, 2)}
+    b = _Batch.from_pydict(df, schema=_T.Schema.of(
+        _T.Field("k", _T.INT64), _T.Field("v", _T.FLOAT64)))
+    dpath = os.path.join(ws, "dp.data")
+    ipath = os.path.join(ws, "dp.index")
+    w = _Writer(_Scan.single([b]), _HashPart([_col(0)], 4), dpath, ipath)
+    list(w.execute(0, _Ctx(partition_id=0)))
+    prov = _Prov(dpath, ipath)
+    v2_blocks = sum(
+        1 for p in range(4) for pay in prov.iter_payloads(p)
+        if is_v2_payload(pay)
+    )
+    if v2_blocks == 0:
+        dp_failures += 1  # encoding never engaged = vacuous guard
+    def read_all() -> int:
+        rows = 0
+        for p in range(4):
+            r = _Reader(b.schema, "dp")
+            ctx = _Ctx(partition_id=p)
+            ctx.resources["dp"] = prov
+            for out in r.execute(p, ctx):
+                rows += out.num_rows()
+        return rows
+
+    rows1 = read_all()
+    compiles_before = counters.compiles
+    rows2 = read_all()
+    decode_compiles = counters.compiles - compiles_before
+    if rows1 != 30000 or rows2 != rows1:
+        dp_failures += 1
+    if decode_compiles != 0:
+        dp_failures += 1
+    print(json.dumps({
+        "check": "data_plane", "deterministic_encode": enc1 == enc2,
+        "v2_blocks": v2_blocks, "rows": rows1,
+        "replay_decode_compiles": decode_compiles,
+        "ok": dp_failures == 0,
+    }))
+    retrace_failures += dp_failures
+
     points = collect_sync_points(ROOT)
     # N/batch budgets are declared against OPERATOR input batches; the
     # pump count is a floor (a stream the sink never times still pumps)
