@@ -1,4 +1,4 @@
-.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate obscheck
+.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate obscheck servecheck servegate
 
 # keep `make` (no target) regenerating the proto, as before the lint gate
 .DEFAULT_GOAL := proto
@@ -49,6 +49,26 @@ test:
 
 bench:
 	python bench.py
+
+# Serving gate (docs/serving.md): boot the SQL server over real HTTP at
+# toy scale and prove the serving contract — serial replay and N
+# concurrent clients byte-identical with ZERO new XLA compiles (plan
+# cache), tenancy/conf isolation incl. plan-knob cache invalidation, no
+# cross-query trace bleed in /queries, bad requests refused
+# (tools/servecheck.py). The >=2x throughput floor + queries/s ratchet
+# run at real scale via `make servegate`.
+servecheck:
+	JAX_PLATFORMS=cpu python tools/servecheck.py
+
+# Concurrency differential gate at real scale (models/servegate.py):
+# serve.gate.clients clients replay the sqlgate corpus against the warm
+# server — bit-identical to serial, zero compiles on the cached legs,
+# concurrent/serial queries/s over the substrate-resolved floor
+# (SERVEGATE_MIN_SPEEDUP overrides; 2.0 accelerators / 1.4 CPU — the
+# measured GIL split, docs/serving.md), queries/s ratcheted in
+# PERF_RATCHET.json, p50/p99 recorded.
+servegate:
+	JAX_PLATFORMS=cpu python -m auron_tpu.models.servegate
 
 # Real-text SQL differential gate (docs/sql.md): 24 actual TPC-DS query
 # strings through sql/ parse->bind->lower and the mesh driver, row-level

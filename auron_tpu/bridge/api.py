@@ -114,10 +114,17 @@ def install_udf_callback(fn_ptr: int) -> None:
 # ---- task entry points ----
 
 
-def call_native(task_bytes: bytes) -> int:
-    """Start a task from a serialized TaskDefinition; returns a handle."""
+def call_native(task_bytes: bytes, extra_resources: dict | None = None) -> int:
+    """Start a task from a serialized TaskDefinition; returns a handle.
+
+    ``extra_resources`` overlay the global map for THIS task only — the
+    in-process serving path's isolation primitive: two concurrent queries
+    each hand their own stage output under the same rid without racing on
+    put_resource/remove_resource (the C ABI keeps using the global map)."""
     with _lock:
         resources = dict(_resources)
+    if extra_resources:
+        resources.update(extra_resources)
     # session-set obs knobs apply inside TaskRuntime.__init__, BEFORE its
     # pump thread starts (a post-start apply would race the task's own
     # span installation); only the HTTP service starts lazily here

@@ -66,6 +66,7 @@ class UniqueProbePipeline:
         self.window = TransferWindow(conf.get(TRANSFER_WINDOW_DEPTH))
 
 
+# auronlint: thread-owned -- one driver per join operator instance; its memo fields are touched only by the thread driving that query's probe stream
 class EquiJoinDriver:
     def __init__(
         self,

@@ -339,6 +339,7 @@ class _PartitionState:
     end_offset: int | None = None  # latest known high watermark
 
 
+# auronlint: thread-owned -- one source per kafka_scan instance; the round-robin cursor belongs to the single thread pumping that scan
 class KafkaWireSource:
     """StreamSource over a real broker: manual partition assignment,
     earliest/latest/offsets startup, offsets() checkpoint surface.

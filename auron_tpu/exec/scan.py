@@ -240,6 +240,35 @@ def adapt_table(tbl: pa.Table, want: "pa.Schema") -> pa.Table:
     return pa.Table.from_arrays(arrays, schema=want)
 
 
+def _assemble_probed(want: pa.Schema, pred_cols: list[int],
+                     ptbl: pa.Table, rtbl: pa.Table | None) -> pa.Table:
+    """Full-schema table from the late-materialization probe's ALREADY
+    decoded predicate columns plus the rest-of-schema decode: the probe
+    plane is reused for both the predicate evaluation and the emitted
+    batch — surviving row groups/stripes no longer decode predicate
+    columns twice. ``ptbl`` is already adapted to the target types;
+    ``rtbl`` holds only the non-predicate columns present in the file
+    (cast/null-fill delegates to adapt_table — ONE definition of the
+    schema-adaption semantics for both scan paths)."""
+    pred_pos = {i: j for j, i in enumerate(pred_cols)}
+    rest_fields = [f for i, f in enumerate(want) if i not in pred_pos]
+    rest = None
+    if rest_fields:
+        rest = (adapt_table(rtbl, pa.schema(rest_fields))
+                if rtbl is not None else
+                pa.Table.from_arrays(
+                    [pa.nulls(ptbl.num_rows, type=f.type)
+                     for f in rest_fields],
+                    schema=pa.schema(rest_fields)))
+    arrays = []
+    for i, f in enumerate(want):
+        if i in pred_pos:
+            arrays.append(ptbl.column(pred_pos[i]))
+        else:
+            arrays.append(rest.column(f.name))
+    return pa.Table.from_arrays(arrays, schema=want)
+
+
 def _pred_columns(preds: list[ir.Expr]) -> set[int]:
     out: set[int] = set()
 
@@ -339,7 +368,11 @@ class ParquetScanExec(ExecOperator):
                         continue
                 # 2) late materialization: decode only the predicate
                 #    columns; a provably-empty group skips the wide decode
-                #    (dictionary/page-check analog at row-group granularity)
+                #    (dictionary/page-check analog at row-group granularity).
+                #    Surviving groups REUSE the probe's decoded planes for
+                #    the emitted batch — only the non-predicate columns are
+                #    decoded below (no double decode)
+                ptbl = None
                 if late_enabled and pred_names:
                     with ctx.metrics.timer("pruning_time"):
                         present = [
@@ -350,18 +383,29 @@ class ParquetScanExec(ExecOperator):
                             pf.read_row_group(rg, columns=present),
                             pa.schema([want_arrow.field(i) for i in pred_cols]),
                         )
+                        ctx.metrics.add("bytes_scanned", ptbl.nbytes)
                         if ptbl.filter(filt).num_rows == 0:
-                            # count the probe only when it's all we read:
-                            # surviving groups count the full decode below
-                            ctx.metrics.add("bytes_scanned", ptbl.nbytes)
                             ctx.metrics.add("row_groups_pruned_late", 1)
                             continue
                 with ctx.metrics.timer("io_time"):
-                    present = [n for n in cols if n in pf.schema_arrow.names]
-                    tbl = adapt_table(
-                        pf.read_row_group(rg, columns=present), want_arrow
-                    )
-                ctx.metrics.add("bytes_scanned", tbl.nbytes)
+                    if ptbl is not None:
+                        pred_set = set(pred_names)
+                        rest = [n for n in cols
+                                if n in pf.schema_arrow.names
+                                and n not in pred_set]
+                        rtbl = (pf.read_row_group(rg, columns=rest)
+                                if rest else None)
+                        tbl = _assemble_probed(want_arrow, pred_cols,
+                                               ptbl, rtbl)
+                        if rtbl is not None:
+                            ctx.metrics.add("bytes_scanned", rtbl.nbytes)
+                    else:
+                        present = [n for n in cols
+                                   if n in pf.schema_arrow.names]
+                        tbl = adapt_table(
+                            pf.read_row_group(rg, columns=present), want_arrow
+                        )
+                        ctx.metrics.add("bytes_scanned", tbl.nbytes)
                 if filt is not None:
                     with ctx.metrics.timer("pruning_time"):
                         tbl = tbl.filter(filt)
@@ -431,7 +475,10 @@ class OrcScanExec(ExecOperator):
                 # late materialization: probe the predicate columns first,
                 # skip the wide stripe decode on zero matches (ORC has no
                 # exposed stripe statistics in pyarrow, so this is the
-                # pruning tier — orc_exec.rs analog)
+                # pruning tier — orc_exec.rs analog). A surviving stripe
+                # REUSES the probe's decoded planes: only the remaining
+                # columns decode below (no double decode)
+                ptbl = None
                 if late_enabled and pred_names:
                     with ctx.metrics.timer("pruning_time"):
                         ptbl = adapt_table(
@@ -440,20 +487,31 @@ class OrcScanExec(ExecOperator):
                             ]),
                             pa.schema([want_arrow.field(i) for i in pred_cols]),
                         )
+                        ctx.metrics.add("bytes_scanned", ptbl.nbytes)
                         if ptbl.filter(filt).num_rows == 0:
                             ctx.metrics.add("stripes_pruned_late", 1)
-                            ctx.metrics.add("bytes_scanned", ptbl.nbytes)
                             continue
                 with ctx.metrics.timer("io_time"):
-                    tbl = adapt_table(
-                        pa.Table.from_batches([
-                            of.read_stripe(stripe_i, columns=present_cols)
-                        ]),
-                        want_arrow,
-                    )
+                    if ptbl is not None:
+                        pred_set = set(pred_names)
+                        rest = [n for n in present_cols if n not in pred_set]
+                        rtbl = (pa.Table.from_batches([
+                            of.read_stripe(stripe_i, columns=rest)
+                        ]) if rest else None)
+                        tbl = _assemble_probed(want_arrow, pred_cols,
+                                               ptbl, rtbl)
+                        if rtbl is not None:
+                            ctx.metrics.add("bytes_scanned", rtbl.nbytes)
+                    else:
+                        tbl = adapt_table(
+                            pa.Table.from_batches([
+                                of.read_stripe(stripe_i, columns=present_cols)
+                            ]),
+                            want_arrow,
+                        )
+                        ctx.metrics.add("bytes_scanned", tbl.nbytes)
                 if filt is not None:
                     tbl = tbl.filter(filt)
-                ctx.metrics.add("bytes_scanned", tbl.nbytes)
                 for i in range(0, tbl.num_rows, bs):
                     chunk = tbl.slice(i, bs).combine_chunks()
                     if chunk.num_rows:

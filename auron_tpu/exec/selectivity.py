@@ -47,6 +47,7 @@ def predictor_enabled(conf) -> bool:
     return conf.get(JOIN_COMPACT_OUTPUT) != "off"
 
 
+# auronlint: thread-owned -- one predictor per operator instance, driven by the single thread executing that query's batch stream (pump or serving thread, never both at once)
 class SelectivityPredictor:
     """EWMA live-count tracker -> predicted compaction capacity bucket.
 

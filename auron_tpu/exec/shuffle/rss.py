@@ -23,7 +23,7 @@ from typing import Iterator
 
 import pyarrow as pa
 
-from auron_tpu.exec.shuffle.format import decode_blocks
+from auron_tpu.exec.shuffle.format import decode_blocks, iter_block_payloads
 
 
 class LocalRssService:
@@ -121,3 +121,12 @@ class RssBlockProvider:
     def __call__(self, partition: int) -> Iterator[pa.RecordBatch]:
         for block in self.service.fetch(self.shuffle_id, partition, self.replica):
             yield from decode_blocks(block)
+
+    def iter_payloads(self, partition: int) -> Iterator[bytes]:
+        """Raw block payloads for the reader's bucketed decode path:
+        format-v2 blocks fetched from the service cross as BYTES and
+        decode straight into capacity-bucket buffers — no intermediate
+        RecordBatch view per block (docs/shuffle.md)."""
+        for block in self.service.fetch(self.shuffle_id, partition,
+                                        self.replica):
+            yield from iter_block_payloads(block)

@@ -319,3 +319,13 @@ class RemoteBlockProvider:
     def __call__(self, partition: int) -> Iterator[pa.RecordBatch]:
         for block in self.client.fetch(self.shuffle_id, partition, self.replica):
             yield from decode_blocks(block)
+
+    def iter_payloads(self, partition: int) -> Iterator[bytes]:
+        """Raw block payloads (the bucketed decode path's input): fetched
+        v2 blocks cross the wire AND the reader boundary as bytes instead
+        of round-tripping through the RecordBatch view."""
+        from auron_tpu.exec.shuffle.format import iter_block_payloads
+
+        for block in self.client.fetch(self.shuffle_id, partition,
+                                       self.replica):
+            yield from iter_block_payloads(block)

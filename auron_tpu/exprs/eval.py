@@ -19,6 +19,7 @@ overflow produces NULL via the checked kernels in decimal_math.py.
 from __future__ import annotations
 
 import re
+import threading
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -763,17 +764,45 @@ def _cmp_apply(op: str, l: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
     raise ValueError(op)
 
 
+#: literal dictionaries memoized by (kind, value): a string literal's
+#: single-entry vocabulary must be the SAME pa.Array object every batch,
+#: so the identity-keyed _unify_two_dicts memo hits on batch 2+ of a
+#: column-vs-literal comparison (q43-class day-name CASE chains evaluate
+#: 7 of these per batch) instead of re-unifying per batch. Bounded; one
+#: lock (concurrent queries share literals, R8).
+_SINGLE_DICT_MEMO: dict = {}
+_SINGLE_DICT_LOCK = threading.Lock()
+
+
 def _single_dict(dtype: T.DataType, value) -> pa.Array:
+    key = (dtype.kind, dtype.to_arrow() if dtype.kind == T.TypeKind.DECIMAL
+           else None, value)
+    try:
+        with _SINGLE_DICT_LOCK:
+            arr = _SINGLE_DICT_MEMO.get(key)
+    except TypeError:            # unhashable value: build uncached
+        arr, key = None, None
+    if arr is not None:
+        return arr
     if dtype.kind == T.TypeKind.BINARY:
-        return pa.array([value if value is not None else b""], type=pa.binary())
-    if dtype.kind == T.TypeKind.DECIMAL:
+        arr = pa.array([value if value is not None else b""],
+                       type=pa.binary())
+    elif dtype.kind == T.TypeKind.DECIMAL:
         import decimal as pydec
 
-        return pa.array(
+        arr = pa.array(
             [value if value is not None else pydec.Decimal(0)],
             type=dtype.to_arrow(),
         )
-    return pa.array([value if value is not None else ""], type=pa.string())
+    else:
+        arr = pa.array([value if value is not None else ""],
+                       type=pa.string())
+    if key is not None:
+        with _SINGLE_DICT_LOCK:
+            if len(_SINGLE_DICT_MEMO) >= 512:
+                _SINGLE_DICT_MEMO.pop(next(iter(_SINGLE_DICT_MEMO)))
+            _SINGLE_DICT_MEMO[key] = arr
+    return arr
 
 
 def _null_like(proto: ColumnVal, cap: int) -> ColumnVal:
@@ -835,8 +864,23 @@ def _unify_vals(vals: list[ColumnVal]) -> list[ColumnVal]:
     return [ev._cast(v, target) for v in vals]
 
 
-def _unify_two_dicts(ld: pa.Array, rd: pa.Array):
-    """Returns (lmap, rmap, rank): per-code unified ids and ordering ranks."""
+#: memo for _unify_two_dicts keyed by dictionary ARRAY IDENTITY: batch
+#: dictionaries are immutable pa.Arrays reused across batches (and, under
+#: the serving layer, across queries — uploaded table views are shared),
+#: so the same (left, right) pair recurs for every batch of a string
+#: comparison. Entries hold strong refs to both arrays, so an id() can
+#: never alias a collected array; bounded LRU; one lock (concurrent
+#: queries evaluate string comparisons from many threads, R8).
+_UNIFY_MEMO: "dict[tuple[int, int], tuple]" = {}
+_UNIFY_MEMO_LOCK = threading.Lock()
+_UNIFY_MEMO_CAP = 1024  # pairs are per (batch dict, other dict); a large
+# table contributes one dict object per uploaded batch, reused across
+# queries — the cap bounds memory, not the working set
+
+
+def _unify_two_dicts_py(ld: pa.Array, rd: pa.Array):
+    """Python fallback (null-bearing vocabularies: arrow encode maps null
+    to a null index, the engine's contract maps it to a vocab id)."""
     vocab: dict = {}
     maps = []
     for d in (ld, rd):
@@ -850,6 +894,44 @@ def _unify_two_dicts(ld: pa.Array, rd: pa.Array):
     rank = np.empty(len(keys), dtype=np.int32)
     rank[order] = np.arange(len(keys), dtype=np.int32)
     return maps[0], maps[1], rank
+
+
+def _unify_two_dicts(ld: pa.Array, rd: pa.Array):
+    """Returns (lmap, rmap, rank): per-code unified ids and ordering ranks.
+
+    Vectorized (arrow dictionary_encode over the concatenated vocabularies
+    — first-occurrence ids, exactly the old setdefault semantics; UTF-8
+    byte order equals code-point order, so the arrow sort ranks match the
+    old python-object argsort) and memoized by array identity: the
+    per-batch python vocab loop was a top GIL site under concurrent
+    serving (models/servegate.py sampling)."""
+    key = (id(ld), id(rd))
+    with _UNIFY_MEMO_LOCK:
+        ent = _UNIFY_MEMO.get(key)
+        if ent is not None and ent[0] is ld and ent[1] is rd:
+            return ent[2], ent[3], ent[4]
+    if ld.null_count or rd.null_count:
+        lmap, rmap, rank = _unify_two_dicts_py(ld, rd)
+    else:
+        import pyarrow.compute as pc
+
+        typ = pa.large_string() if (
+            pa.types.is_large_string(ld.type)
+            or pa.types.is_large_string(rd.type)
+        ) else ld.type
+        both = pa.concat_arrays([ld.cast(typ), rd.cast(typ)])
+        enc = both.dictionary_encode()
+        codes = enc.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+        lmap, rmap = codes[: len(ld)], codes[len(ld):]
+        order = pc.array_sort_indices(enc.dictionary).to_numpy(
+            zero_copy_only=False)
+        rank = np.empty(len(enc.dictionary), dtype=np.int32)
+        rank[order] = np.arange(len(order), dtype=np.int32)
+    with _UNIFY_MEMO_LOCK:
+        if len(_UNIFY_MEMO) >= _UNIFY_MEMO_CAP:
+            _UNIFY_MEMO.pop(next(iter(_UNIFY_MEMO)))
+        _UNIFY_MEMO[key] = (ld, rd, lmap, rmap, rank)
+    return lmap, rmap, rank
 
 
 def _like_to_regex(pattern: str, escape: str) -> "re.Pattern":

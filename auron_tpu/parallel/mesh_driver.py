@@ -211,7 +211,16 @@ class MeshQueryDriver:
                 else range(self._reduce_parts or self.n_parts)
             )
             for p in parts:
-                op = plan_from_proto(resolved)
+                # whole-stage fusion applies to driver-executed stages
+                # exactly as task_from_proto applies it to bridge tasks
+                # (plan/fusion.py; protos untouched, bit-identical by the
+                # PR-7 contract). Before the serving work this path ran
+                # every SQL-lowered mesh stage EAGER — per-batch python
+                # dispatch the fused programs remove, which under
+                # concurrent queries was pure GIL serialization
+                from auron_tpu.plan.fusion import fuse_exec_tree
+
+                op = fuse_exec_tree(plan_from_proto(resolved), self.conf)
                 ctx = ExecutionContext(partition_id=p, conf=self.conf.copy(),
                                        resources=resources)
                 outs[p] = list(op.execute(p, ctx))
@@ -421,7 +430,9 @@ class MeshQueryDriver:
         n_src = self._maybe_coalesce_inputs(child, resources)
         if n_src == self.n_parts and not self.spmd:
             n_src = self._maybe_split_skew(child, resources)
-        op = plan_from_proto(child)
+        from auron_tpu.plan.fusion import fuse_exec_tree
+
+        op = fuse_exec_tree(plan_from_proto(child), self.conf)
         schema = op.schema
         shard_batches: list[Batch] = []
         pids: list[jnp.ndarray] = []

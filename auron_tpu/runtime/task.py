@@ -116,13 +116,14 @@ class TaskRuntime:
         except TaskCancelled:
             pass
         except BaseException as e:  # noqa: BLE001 — relayed to the consumer
-            self._error = e
+            self._error = e  # auronlint: guarded-by(self._queue) -- published BEFORE the _END sentinel; the consumer reads it only after get() returns _END (queue happens-before)
         finally:
             clear_task_context()
             self._queue.put(_END)
 
     def _check_error(self) -> None:
         if self._error is not None:
+            # auronlint: guarded-by(self._queue) -- consumer side of the pump's error relay: only reached after get() returned _END, which the pump enqueues AFTER the write (queue happens-before)
             err, self._error = self._error, None
             raise RuntimeError(
                 f"task stage={self.ctx.stage_id} partition={self.ctx.partition_id} failed"

@@ -1,0 +1,22 @@
+"""Concurrent multi-tenant SQL serving (docs/serving.md).
+
+The server around the engine: ``SqlServer`` executes many queries
+concurrently over one mesh — each under its own query trace and
+per-tenant session conf — with a plan-digest-keyed compiled-plan cache
+above the fusion stage cache (serve/cache.py) and an admission layer
+that shares the executor pool with memory-manager-aware backpressure
+(serve/admission.py). ``utils/httpsvc`` exposes it at ``POST /sql``;
+``models/servegate.py`` is the concurrency differential gate.
+"""
+
+from auron_tpu.serve.admission import AdmissionController, AdmissionTimeout
+from auron_tpu.serve.cache import PlanCache
+from auron_tpu.serve.server import QueryError, SqlServer
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionTimeout",
+    "PlanCache",
+    "QueryError",
+    "SqlServer",
+]

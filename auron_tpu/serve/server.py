@@ -1,0 +1,359 @@
+"""SqlServer: concurrent multi-tenant query execution over one mesh.
+
+One instance serves many concurrent queries (docs/serving.md):
+
+- each query runs under its OWN query trace (obs.query_trace) and its
+  own per-tenant session Configuration — conf is threaded explicitly
+  through the mesh driver and into the collect task's TaskDefinition,
+  never read from ambient thread state (the R7 discipline that made
+  cross-thread conf handling safe);
+- parse -> bind -> lower is skipped on a plan-digest cache hit
+  (serve/cache.py); execution re-enters the fusion stage cache, so a
+  replayed query adds zero new XLA compiles;
+- the admission controller (serve/admission.py) bounds concurrency and
+  applies memory-manager-aware backpressure BEFORE a query touches the
+  executor pool;
+- per-query isolation of the collect stage rides call_native's
+  ``extra_resources`` overlay: concurrent queries hand their own stage
+  output under the shared ``sql:__stage__`` rid without racing on the
+  global resource map.
+
+The server owns the table frames (a catalog's worth of pandas frames,
+as built by sql/catalog.build_tables) and uploads each scanned view
+once per (rid, mesh width) — the Flare compile-once/serve-many shape,
+applied to data residency too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import pandas as pd
+
+from auron_tpu.serve.admission import AdmissionController
+from auron_tpu.serve.cache import PlanCache, plan_cache_key
+from auron_tpu.utils.config import (
+    EXCHANGE_MODE,
+    SERVE_PLAN_CACHE_ENTRIES,
+    SQL_SHUFFLE_PARTITIONS,
+    Configuration,
+    conf_scope,
+)
+
+#: session-conf keys tenants may NOT override: these mutate process-wide
+#: state when a task conf carries them (obs.apply_conf flips the global
+#: recording mode; the http service is the server's own front door) or
+#: reconfigure the server/admission layer itself. A request naming one
+#: fails loudly instead of silently bleeding into every other tenant.
+_SESSION_DENIED_PREFIXES = ("obs.", "http.service.", "serve.")
+
+
+class QueryError(RuntimeError):
+    """A request-level error (bad SQL, bad conf key): HTTP 400."""
+
+
+def _default_base_conf(conf: Optional[Configuration]) -> Configuration:
+    import jax
+
+    conf = (conf or Configuration()).copy()
+    if jax.default_backend() == "cpu" and conf.get(EXCHANGE_MODE) == "auto":
+        # same CPU default as the sqlgate: XLA:CPU cross-module all_to_all
+        # rendezvous starves against host-sort callbacks on small-core
+        # hosts; the durable file transport is the serving default there
+        conf = conf.set(EXCHANGE_MODE, "file")
+    return conf
+
+
+class SqlServer:
+    """In-process SQL serving front end (POST /sql's implementation)."""
+
+    def __init__(self, catalog, frames: dict, conf: Configuration | None = None,
+                 n_parts: int | None = None, mesh=None):
+        self.catalog = catalog
+        self.frames = frames
+        self.conf = _default_base_conf(conf)
+        self.n_parts = (n_parts if n_parts is not None
+                        else self.conf.get(SQL_SHUFFLE_PARTITIONS))
+        self.conf = self.conf.set(SQL_SHUFFLE_PARTITIONS, self.n_parts)
+        # meshes per width: a tenant overriding sql.shuffle.partitions
+        # gets a DIFFERENT plan (the knob rides the plan-cache key) and
+        # must execute at that width; meshes are cheap views over the
+        # same devices. The default width goes through the SAME checked
+        # _mesh_for path as tenant overrides (make_mesh's device-count
+        # assert vanishes under python -O)
+        self._mesh_lock = threading.Lock()
+        self._meshes = {}
+        if mesh is not None:
+            self._meshes[self.n_parts] = mesh
+        self.mesh = self._mesh_for(self.n_parts)
+        self.plan_cache = PlanCache(self.conf.get(SERVE_PLAN_CACHE_ENTRIES))
+        self.admission = AdmissionController(self.conf)
+        # uploaded table views, (rid, n_parts) -> per-partition batch
+        # lists; one upload per scanned view across ALL queries/tenants.
+        # The lock guards only the dict — uploads run OUTSIDE it behind a
+        # per-key in-flight event, so a first-touch staging of one large
+        # table never serializes unrelated concurrent queries
+        self._res_lock = threading.Lock()
+        self._res_cache: dict = {}
+        self._stats_lock = threading.Lock()
+        self.queries_ok = 0
+        self.queries_err = 0
+
+    # ------------------------------------------------------------------
+    # session confs
+
+    def session_conf(self, overrides: dict | None,
+                     tenant: str | None = None) -> Configuration:
+        """Base conf + validated per-request overrides. Unknown keys and
+        process-global keys refuse loudly (QueryError -> 400)."""
+        from auron_tpu.utils.config import _REGISTRY
+
+        conf = self.conf.copy()
+        for k, v in (overrides or {}).items():
+            if any(k.startswith(p) for p in _SESSION_DENIED_PREFIXES):
+                raise QueryError(
+                    f"conf key {k!r} is not session-settable (process-wide "
+                    "or server-level state)")
+            if k not in _REGISTRY:
+                raise QueryError(f"unknown conf key {k!r}")
+            conf = conf.set(k, str(v))
+        return conf
+
+    # ------------------------------------------------------------------
+    # planning
+
+    def plan(self, sql: str, conf: Configuration):
+        """(LoweredQuery, digest-key, cache_hit) — the program-cache front
+        door: a hit skips parse/bind/lower entirely."""
+        from auron_tpu.sql import compile_text
+
+        key = plan_cache_key(sql, conf)
+        lq = self.plan_cache.lookup(key)
+        if lq is not None:
+            return lq, key, True
+        lq = compile_text(sql, self.catalog,
+                          n_parts=conf.get(SQL_SHUFFLE_PARTITIONS))
+        self.plan_cache.insert(key, lq)
+        return lq, key, False
+
+    # ------------------------------------------------------------------
+    # execution
+
+    def _mesh_for(self, n_parts: int):
+        import jax
+
+        from auron_tpu.parallel.mesh import make_mesh
+
+        with self._mesh_lock:
+            mesh = self._meshes.get(n_parts)
+            if mesh is None:
+                # explicit check, not assert-sniffing: make_mesh's own
+                # device-count assert vanishes under python -O and would
+                # hand back a narrower mesh than the plan was lowered for
+                n_dev = len(jax.devices())
+                if n_parts > n_dev:
+                    raise QueryError(
+                        f"sql.shuffle.partitions={n_parts} exceeds the "
+                        f"device count {n_dev}")
+                mesh = make_mesh(n_parts)
+                self._meshes[n_parts] = mesh
+            return mesh
+
+    def _build_resources(self, lq) -> dict:
+        """Batch lists for every table the plan scans, uploaded once per
+        (rid, width). Two first-queries of one table serialize on that
+        table's in-flight event only; queries over already-resident (or
+        different) tables proceed without waiting."""
+        return {use.rid: self._table_view(use, lq.n_parts)
+                for use in lq.tables}
+
+    def _table_view(self, use, n_parts: int):
+        from auron_tpu.models.tpcds import to_batches
+
+        key = (use.rid, n_parts)
+        with self._res_lock:
+            ent = self._res_cache.get(key)
+            if ent is None:
+                ent = self._res_cache[key] = {
+                    "done": threading.Event(), "val": None}
+                builder = True
+            else:
+                builder = False
+        if builder:
+            try:
+                df = self.frames[use.table]
+                if use.replicated:
+                    val = [to_batches(df, 1)[0]] * n_parts
+                else:
+                    val = to_batches(df, n_parts)
+                ent["val"] = val
+            except BaseException:
+                # failed upload must not wedge waiters or poison the
+                # cache: drop the entry, release waiters (they re-raise)
+                with self._res_lock:
+                    self._res_cache.pop(key, None)
+                raise
+            finally:
+                ent["done"].set()
+            return val
+        ent["done"].wait()
+        if ent["val"] is None:
+            raise RuntimeError(
+                f"concurrent upload of {use.rid} failed; retry the query")
+        return ent["val"]
+
+    def _execute(self, lq, conf: Configuration) -> pd.DataFrame:
+        """Run one lowered query under ``conf``: distributed stage on the
+        shared mesh (fresh driver per query — drivers carry per-run
+        state), then the optional collect stage as an isolated task."""
+        import jax
+
+        from auron_tpu.bridge import api
+        from auron_tpu.parallel.mesh_driver import MeshQueryDriver
+        from auron_tpu.plan import builders as B
+        from auron_tpu.sql.lowering import STAGE_RID
+
+        resources = self._build_resources(lq)
+        driver = MeshQueryDriver(self._mesh_for(lq.n_parts), conf=conf)
+        outs = driver.run(lq.distributed, resources)
+        batches = [b for part in outs for b in part]
+        if lq.collect is None:
+            dfs = [b.to_pandas() for b in batches]
+        else:
+            # stage barrier, as in models/sqlgate.execute: retire the
+            # distributed stage's async arrays before the collect task
+            # competes for the XLA:CPU thread pool
+            jax.block_until_ready([b.device for b in batches])
+            # the collect task ships THIS query's conf (tenant knobs +
+            # obs.trace.id) and reads its stage input through the
+            # call-scoped resource overlay — no global-map rendezvous,
+            # no cross-query bleed on the shared STAGE_RID
+            task = B.task(lq.collect, conf=conf.as_dict())
+            h = api.call_native(task.SerializeToString(),
+                                extra_resources={STAGE_RID: [batches]})
+            dfs = []
+            try:
+                while (rb := api.next_batch(h)) is not None:
+                    dfs.append(rb.to_pandas())
+            except BaseException:
+                # a failing per-query collect must not leak its runtime
+                # (handle in api._runtimes, pump thread blocked on the
+                # bounded queue) — finalize cancels/joins; ITS error is
+                # secondary to the one already propagating
+                try:
+                    api.finalize_native(h)
+                except Exception:
+                    pass
+                raise
+            api.finalize_native(h)
+        cols = list(lq.schema.names)
+        dfs = [d for d in dfs if len(d)]
+        if dfs:
+            out = pd.concat(dfs, ignore_index=True)
+            out.columns = cols
+        else:
+            out = pd.DataFrame({c: [] for c in cols})
+        return out
+
+    # ------------------------------------------------------------------
+    # the front door
+
+    def submit(self, sql: str, session: dict | None = None,
+               tenant: str | None = None) -> tuple[pd.DataFrame, dict]:
+        """Plan (or cache-hit) + admit + execute one query. Returns the
+        result frame and a record (digest, cache_hit, timings, trace)."""
+        from auron_tpu import obs
+
+        t_arrive = time.perf_counter()
+        try:
+            # inside the try: a refused conf key (QueryError) and an
+            # admission timeout must count on /serve's queries_err too
+            conf = self.session_conf(session, tenant=tenant)
+            with self.admission.admit() as slot:
+                rec = {
+                    "tenant": tenant,
+                    "cache_hit": False,
+                    "queue_wait_s": round(slot.wait_s, 4),
+                }
+                # conf_scope: everything below (ingest, drivers, jit
+                # backend policies) resolves THIS query's conf, never a
+                # sibling handler thread's
+                with conf_scope(conf), obs.query_trace(
+                    f"serve.{tenant or 'anon'}", conf=conf
+                ) as qt:
+                    lq, key, hit = self.plan(sql, qt.conf or conf)
+                    rec["digest"] = key
+                    rec["cache_hit"] = hit
+                    df = self._execute(lq, qt.conf if qt.conf is not None
+                                       else conf)
+                if qt.summary is not None:
+                    rec["trace_id"] = qt.summary["trace_id"]
+                rec["rows"] = len(df)
+                rec["wall_s"] = round(time.perf_counter() - t_arrive, 4)
+                with self._stats_lock:
+                    self.queries_ok += 1
+                return df, rec
+        except Exception:
+            with self._stats_lock:
+                self.queries_err += 1
+            raise
+
+    def execute_json(self, body: dict) -> dict:
+        """The POST /sql contract (docs/serving.md): body
+        ``{"sql": ..., "conf": {...}?, "tenant": ...?}`` ->
+        ``{"columns": [...], "rows": [[...]], ...record}``. Raises
+        QueryError for request-level problems (handler answers 400)."""
+        if not isinstance(body, dict) or not isinstance(body.get("sql"), str):
+            raise QueryError('body must be a JSON object with a "sql" string')
+        session = body.get("conf")
+        if session is not None and not isinstance(session, dict):
+            raise QueryError('"conf" must be an object of key -> value')
+        from auron_tpu.sql.diagnostics import SqlDiagnostic
+
+        try:
+            df, rec = self.submit(body["sql"], session=session,
+                                  tenant=body.get("tenant"))
+        except SqlDiagnostic as e:
+            raise QueryError(str(e)) from None
+        rec["columns"] = list(df.columns)
+        rec["rows"] = _json_rows(df)
+        return rec
+
+    def stats(self) -> dict:
+        """The /serve endpoint's payload."""
+        with self._stats_lock:
+            ok, err = self.queries_ok, self.queries_err
+        return {
+            "n_parts": self.n_parts,
+            "queries_ok": ok,
+            "queries_err": err,
+            "plan_cache": self.plan_cache.stats(),
+            "admission": self.admission.stats(),
+            "tables_resident": len(self._res_cache),
+        }
+
+
+def _json_rows(df: pd.DataFrame) -> list[list]:
+    """JSON-safe row materialization: numpy scalars -> python, NaN/NaT ->
+    null. Deterministic (shortest-roundtrip float repr), so two identical
+    result frames serialize byte-identically — the property the
+    concurrency differential gate's HTTP leg compares on."""
+    out = []
+    for row in df.itertuples(index=False, name=None):
+        vals = []
+        for v in row:
+            if v is None or (isinstance(v, float) and v != v) or pd.isna(v):
+                vals.append(None)
+            elif hasattr(v, "isoformat"):
+                # datetime-like (pd.Timestamp, date): BEFORE .item() —
+                # Timestamp.item does not exist and a raw Timestamp is
+                # not JSON-serializable (a DATE32 projection would 500)
+                vals.append(v.isoformat())
+            elif hasattr(v, "item"):
+                vals.append(v.item())
+            else:
+                vals.append(v)
+        out.append(vals)
+    return out

@@ -31,6 +31,11 @@ class Token:
     kind: str
     text: str          # raw text (identifiers keep original case)
     pos: SourcePos
+    #: True for a double-quoted identifier. The parser treats quoted and
+    #: bare identifiers identically (the quotes are stripped here), but
+    #: the plan-digest canonicalizer (sql/digest.py) must re-quote them:
+    #: rendered bare, `"a b"` would collide with the two-token `a b`
+    quoted: bool = False
 
     @property
     def upper(self) -> str:
@@ -100,7 +105,7 @@ def tokenize(sql: str) -> list[Token]:
             end = sql.find('"', i + 1)
             if end < 0:
                 raise SqlSyntaxError("unterminated quoted identifier", p, sql)
-            toks.append(Token(IDENT, sql[i + 1 : end], p))
+            toks.append(Token(IDENT, sql[i + 1 : end], p, quoted=True))
             advance(end + 1 - i)
             continue
         if c.isdigit() or (c == "." and sql[i + 1 : i + 2].isdigit()):

@@ -523,6 +523,50 @@ AGG_PARTIAL_DEFER = str_conf(
     "drain at agg_exec.py:427). off restores the eager one-read-per-"
     "batch protocol bit-identically",
 )
+SERVE_MAX_CONCURRENT = int_conf(
+    "serve.admission.max.concurrent", 4, "serve",
+    "queries the SQL server executes simultaneously; arrivals beyond it "
+    "QUEUE (admission control) instead of piling onto the executor pool. "
+    "The analog of the reference's per-task tokio runtimes is bounded "
+    "here instead: lowered plans are pure jitted programs that interleave "
+    "on one device, so the limit shapes memory pressure, not parallel "
+    "substrate",
+)
+SERVE_QUEUE_TIMEOUT_S = float_conf(
+    "serve.admission.queue.timeout.seconds", 60.0, "serve",
+    "longest a query waits in the admission queue (for a concurrency "
+    "slot or for memory headroom) before the server answers busy — the "
+    "queue-don't-die escape hatch's bound",
+)
+SERVE_ADMIT_MEM_FRACTION = float_conf(
+    "serve.admission.memory.fraction", 0.9, "serve",
+    "memory-manager-aware backpressure: a query waits in the admission "
+    "queue while consumer usage exceeds this fraction of the manager's "
+    "budget. Admitted queries past the threshold still run — the memory "
+    "manager degrades them to spilling per its per-query fair shares — "
+    "but new work queues instead of deepening the overcommit",
+)
+SERVE_PLAN_CACHE_ENTRIES = int_conf(
+    "serve.plan.cache.entries", 256, "serve",
+    "bounded size of the plan-digest-keyed compiled-plan cache "
+    "(serve/cache.py): a hit skips parse->bind->lower and re-enters the "
+    "fusion stage cache with zero new XLA compiles; least-recently-used "
+    "entries evict past the bound",
+)
+SERVE_GATE_SF = float_conf(
+    "serve.gate.sf", 1.0, "serve",
+    "scale factor of the concurrency differential gate "
+    "(models/servegate.py). At toy scale per-query wall is GIL-bound "
+    "Python where concurrency cannot pay; >=1 gives queries real device "
+    "compute, the regime the serving claim is about. tier-1 and make "
+    "servecheck override to toy scale (they gate bit-identity and "
+    "zero-compile replay, not throughput)",
+)
+SERVE_GATE_CLIENTS = int_conf(
+    "serve.gate.clients", 8, "serve",
+    "concurrent clients the differential gate replays the corpus with "
+    "(each client replays every corpus query once)",
+)
 UDF_FALLBACK_ENABLE = bool_conf(
     "udf.fallback.enable", True, "expr",
     "evaluate unconvertible expressions via host callback (SparkUDFWrapper analog)",

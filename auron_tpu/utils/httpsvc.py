@@ -18,6 +18,17 @@ http/memory_profiling.rs). The TPU engine's equivalents:
 - /conf         — the resolved configuration registry
 - /healthz      — liveness
 
+With a SQL server installed (install_sql_server; docs/serving.md) the
+service is also the query front door:
+
+- POST /sql     — execute one query: body {"sql": ..., "conf": {...}?,
+                  "tenant": ...?} -> {"columns", "rows", digest,
+                  cache_hit, trace_id, timings}. 400 on bad requests
+                  (unknown conf key, SQL diagnostics), 503 when the
+                  admission queue's bound fires, 500 otherwise.
+- /serve        — server stats: plan-cache hit/miss/eviction counts,
+                  admission occupancy/queue, per-server query counters.
+
 Gated by ``http.service.enable`` (off by default, like the reference's
 feature flag); the bridge starts it lazily on the first task when
 enabled. A handler exception answers 500 and never propagates into task
@@ -50,6 +61,16 @@ _port: int | None = None
 #: the thread-local active_conf() — they'd see whatever conf the SERVING
 #: thread happens to carry, not the conf the service was started under (R7)
 _conf = None
+#: installed SqlServer (serve/server.py); POST /sql and /serve 404 until
+#: a host installs one — observability endpoints never depend on it
+_sql_server = None
+
+
+def install_sql_server(server) -> None:
+    """Install (or with None, uninstall) the SqlServer behind POST /sql."""
+    global _sql_server
+    with _lock:
+        _sql_server = server
 
 
 def _metrics_payload() -> dict:
@@ -130,6 +151,15 @@ class _Handler(BaseHTTPRequestHandler):
                     json.dumps(obs.recent_queries(), indent=2).encode(),
                     "application/json",
                 )
+            elif path == "/serve":
+                srv = _sql_server
+                if srv is None:
+                    self._send(b"no sql server installed\n", "text/plain", 404)
+                else:
+                    self._send(
+                        json.dumps(srv.stats(), indent=2).encode(),
+                        "application/json",
+                    )
             elif path == "/stacks":
                 self._send(_stacks_payload().encode(), "text/plain")
             elif path == "/conf":
@@ -145,6 +175,46 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(b"not found\n", "text/plain", 404)
         except Exception as e:  # noqa: BLE001 — observability must not crash tasks
+            self._send(f"error: {e}\n".encode(), "text/plain", 500)
+
+    def do_POST(self):  # noqa: N802 — http.server API  # auronlint: thread-root(conf-scoped) -- serving handler thread: SqlServer.submit installs conf_scope(session conf) before any engine work
+        try:
+            if self.path.split("?", 1)[0] != "/sql":
+                self._send(b"not found\n", "text/plain", 404)
+                return
+            srv = _sql_server
+            if srv is None:
+                self._send(b"no sql server installed\n", "text/plain", 404)
+                return
+            # serve imports AFTER the 404 checks and INSIDE the try: a
+            # stray POST to an observability-only service must not pay
+            # (or crash the handler on) the pandas-heavy serve import —
+            # the contract is "a handler exception answers 500"
+            from auron_tpu.serve.admission import AdmissionTimeout
+            from auron_tpu.serve.server import QueryError
+
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, TypeError) as e:
+                self._send(f"bad request body: {e}\n".encode(),
+                           "text/plain", 400)
+                return
+            try:
+                payload = srv.execute_json(body)
+            except QueryError as e:
+                self._send(
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json", 400)
+                return
+            except AdmissionTimeout as e:
+                # queue-don't-die's bound: busy, retry later
+                self._send(
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json", 503)
+                return
+            self._send(json.dumps(payload).encode(), "application/json")
+        except Exception as e:  # noqa: BLE001 — the service must survive
             self._send(f"error: {e}\n".encode(), "text/plain", 500)
 
 
@@ -171,7 +241,7 @@ def start(port: int = 0, conf=None) -> int:
 
 
 def stop() -> None:
-    global _server, _port, _conf
+    global _server, _port, _conf, _sql_server
     with _lock:
         if _server is not None:
             _server.shutdown()
@@ -179,6 +249,9 @@ def stop() -> None:
             _server = None
             _port = None
             _conf = None
+        # full teardown regardless of whether the service was running: a
+        # stale installed server must not resurface on the next start()
+        _sql_server = None
 
 
 def maybe_start_from_conf(conf) -> int | None:

@@ -974,6 +974,39 @@ def test_r8_single_root_and_local_objects_are_quiet():
     assert hits == []
 
 
+def test_r8_thread_owned_class_declaration_exempts_writes():
+    """A class declared thread-owned (single-thread instance ownership —
+    the serving-layer pattern: per-query operator instances reachable
+    from both the pump root and the POST /sql handler root) is exempt."""
+    src = _R8_SHARED.format(write="self.n += 1").replace(
+        "class Mgr:",
+        "# auronlint: thread-owned -- fixture: one instance per query, "
+        "one driving thread\nclass Mgr:",
+    )
+    assert _r8({"pkg/m.py": src}) == []
+
+
+def test_r8_detached_thread_owned_is_a_finding():
+    """A thread-owned that anchors to a non-class line is inert — R8
+    reports the detached declaration instead of silently dropping it,
+    AND still reports the unexempted write."""
+    src = _R8_SHARED.format(
+        write="self.n += 1  # auronlint: thread-owned -- wrong anchor"
+    )
+    hits = _r8({"pkg/m.py": src})
+    msgs = [h[2] for h in hits]
+    assert any("does not anchor to a `class`" in m for m in msgs)
+    assert any("Mgr.n" in m for m in msgs)
+
+
+def test_thread_owned_rides_the_lint_ratchet():
+    """thread-owned declarations count as declared debt (LINT_RATCHET)."""
+    from tools.auronlint import ratchet
+
+    assert "thread-owned" in ratchet.load(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
 # ---------------------------------------------------------------------------
 # R9 static sync-budget verification
 # ---------------------------------------------------------------------------

@@ -221,3 +221,97 @@ def test_orc_late_materialization_and_adaption(tmp_path):
         rows2.extend(b.to_arrow().to_pylist())
     assert [r["k"] for r in rows2] == [0, 1, 2]
     assert all(r["missing"] is None for r in rows2)  # schema adaption
+
+
+# ---------------------------------------------------------------------------
+# late materialization decodes predicate columns ONCE (ISSUE 12 satellite):
+# a surviving row group/stripe reuses the probe's decoded plane for the
+# emitted batch instead of re-reading the predicate columns in the wide
+# decode — pinned by spying on the reader's per-call column lists.
+# ---------------------------------------------------------------------------
+
+
+def _no_column_read_twice(calls):
+    """calls: [(group/stripe, columns)] — no column may be requested twice
+    for the same group."""
+    seen: dict = {}
+    for g, cols_req in calls:
+        for c in cols_req:
+            assert c not in seen.setdefault(g, set()), (
+                f"column {c!r} decoded twice for group {g}")
+            seen[g].add(c)
+
+
+def test_parquet_probe_plane_reused_not_double_decoded(
+    tmp_path, monkeypatch
+):
+    path = str(tmp_path / "t.parquet")
+    n = 4000
+    tbl = pa.table({"k": pa.array(range(n), pa.int64()),
+                    "v": pa.array([i % 7 for i in range(n)], pa.int64()),
+                    "w": pa.array([float(i) for i in range(n)])})
+    pq.write_table(tbl, path, row_group_size=1000)
+
+    calls = []
+    orig = pq.ParquetFile.read_row_group
+
+    def spy(self, rg, columns=None, **kw):
+        calls.append((rg, tuple(columns or ())))
+        return orig(self, rg, columns=columns, **kw)
+
+    monkeypatch.setattr(pq.ParquetFile, "read_row_group", spy)
+    schema = T.Schema.of(T.Field("k", T.INT64), T.Field("v", T.INT64),
+                         T.Field("w", T.FLOAT64))
+    # v == 3 survives in every group -> every group probes AND emits
+    op = ParquetScanExec(schema, [path], [BinaryOp("eq", col(1), lit(3))])
+    ctx = ExecutionContext()
+    rows = [r for b in op.execute(0, ctx)
+            for r in b.to_arrow().to_pylist()]
+    assert len(rows) == sum(1 for i in range(n) if i % 7 == 3)
+    _no_column_read_twice(calls)
+    # the surviving groups requested v exactly once (the probe), and the
+    # wide read asked only for the REST of the schema
+    wide = [cols_req for _, cols_req in calls if "v" not in cols_req]
+    assert wide and all(set(c) == {"k", "w"} for c in wide)
+
+    # bit-identity vs the late-materialization-off decode
+    from auron_tpu.utils.config import PARQUET_LATE_MATERIALIZATION, Configuration
+
+    op2 = ParquetScanExec(schema, [path], [BinaryOp("eq", col(1), lit(3))])
+    ctx2 = ExecutionContext(
+        conf=Configuration().set(PARQUET_LATE_MATERIALIZATION, False))
+    rows2 = [r for b in op2.execute(0, ctx2)
+             for r in b.to_arrow().to_pylist()]
+    assert rows == rows2
+
+
+def test_orc_probe_plane_reused_not_double_decoded(tmp_path, monkeypatch):
+    orc = pytest.importorskip("pyarrow.orc")
+
+    from auron_tpu.exec.scan import OrcScanExec
+
+    path = str(tmp_path / "probe.orc")
+    n = 3000
+    tbl = pa.table({"k": pa.array(range(n), pa.int64()),
+                    "v": pa.array([i % 5 for i in range(n)], pa.int64())})
+    orc.write_table(tbl, path, stripe_size=8192)
+
+    calls = []
+    orig = orc.ORCFile.read_stripe
+
+    def spy(self, i, columns=None, **kw):
+        calls.append((i, tuple(columns or ())))
+        return orig(self, i, columns=columns, **kw)
+
+    monkeypatch.setattr(orc.ORCFile, "read_stripe", spy)
+    schema = T.Schema.of(T.Field("k", T.INT64), T.Field("v", T.INT64),
+                         T.Field("missing", T.STRING))
+    op = OrcScanExec(schema, [path], [BinaryOp("eq", col(1), lit(2))])
+    ctx = ExecutionContext()
+    rows = [r for b in op.execute(0, ctx)
+            for r in b.to_arrow().to_pylist()]
+    assert len(rows) == sum(1 for i in range(n) if i % 5 == 2)
+    assert all(r["missing"] is None for r in rows)
+    _no_column_read_twice(calls)
+    wide = [cols_req for _, cols_req in calls if "v" not in cols_req]
+    assert wide and all(set(c) == {"k"} for c in wide)

@@ -74,7 +74,8 @@ _HOST_RETURNING = {
 
 _SUPPRESS_RE = re.compile(
     r"#\s*auronlint:\s*"
-    r"(disable|disable-function|sync-point|sort-payload|thread-root|guarded-by)"
+    r"(disable|disable-function|sync-point|sort-payload|thread-root"
+    r"|guarded-by|thread-owned)"
     r"(?:\((?P<budget>[^)]*)\))?"
     r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
     r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
@@ -270,6 +271,32 @@ class SourceModule:
             if s.kind == "guarded-by" and line in self._lines_covered(s):
                 return s
         return None
+
+    def thread_owned_classes(self) -> tuple[set, list[int]]:
+        """(class names declared ``thread-owned``, detached declaration
+        lines). The declaration sits on (or stands above) a ``class``
+        statement and asserts single-thread INSTANCE ownership: every
+        instance is created for one query/task and driven by exactly one
+        thread at a time, so R8's code-reachability model (which cannot
+        see per-instance confinement) exempts its attribute writes. A
+        declaration that does not anchor to a class line is returned as
+        detached — R8 reports it instead of silently dropping the
+        exemption."""
+        class_lines = {
+            n.lineno: n.name for n in ast.walk(self.tree)
+            if isinstance(n, ast.ClassDef)
+        }
+        owned: set = set()
+        detached: list[int] = []
+        for s in self.suppressions:
+            if s.kind != "thread-owned":
+                continue
+            name = class_lines.get(self.anchor_line(s))
+            if name is None:
+                detached.append(s.line)
+            else:
+                owned.add(name)
+        return owned, detached
 
     # -- scope / taint analysis --------------------------------------------
 

@@ -18,7 +18,16 @@ Mechanics:
   (anything whose expression reads as a lock/condition/guard), or when it
   carries the declaration ``# auronlint: guarded-by(<lock>) -- <why>``
   for locks taken by a caller (the reason documents the protocol, the
-  same stance as ``sync-point``).
+  same stance as ``sync-point``);
+- a class declared ``# auronlint: thread-owned -- <why>`` (on its
+  ``class`` line) is exempt wholesale: its instances are confined to one
+  thread at a time — created per query/task and driven by exactly one
+  thread — which code reachability cannot see (the serving layer made
+  the whole operator tree reachable from BOTH the task pump and the
+  POST /sql handler root, but each query's operator instances still
+  belong to one driving thread). The declaration is the per-instance
+  twin of the escape-analysis exemption below; a detached declaration
+  (not anchored to a ``class`` statement) is itself a finding.
 
 Findings name the racing roots so the reader knows which two threads
 collide. Attributes written from a single root stay silent — per-task
@@ -48,8 +57,18 @@ def analyze(g):
     # per-call parser pattern) — code reachability is not object sharing
     class_names = {fs.cls for fs in g.functions.values() if fs.cls}
     shared_classes: set = set()
-    for ms in g.modules.values():
+    # declared single-thread-instance classes: (rel, cls) exemptions
+    owned: set = set()
+    for rel, ms in g.modules.items():
         shared_classes |= escaping_class_names(ms, class_names)
+        names, detached = ms.mod.thread_owned_classes()
+        owned |= {(rel, n) for n in names}
+        for line in detached:
+            yield rel, line, (
+                "thread-owned declaration does not anchor to a `class` "
+                "statement — the exemption is silently inert; move it "
+                "onto (or directly above) the class line"
+            )
     # (rel, class, attr) -> [(qualname, AttrWrite, roots)]
     groups: dict[tuple, list] = {}
     for q, fs in g.functions.items():
@@ -67,6 +86,8 @@ def analyze(g):
                 (q, w, roots)
             )
     for (rel, cls, attr), sites in sorted(groups.items()):
+        if (rel, cls) in owned:
+            continue  # declared single-thread instance ownership
         all_roots = set()
         for _, _, roots in sites:
             all_roots |= roots

@@ -1,0 +1,214 @@
+"""Serving gate (`make servecheck`): boot the server, prove the contract.
+
+Small-N, toy-SF CI twin of ``make servegate`` (models/servegate.py),
+driven over REAL HTTP — the full POST /sql front door, not in-process
+submit (docs/serving.md):
+
+1. boot httpsvc with a SqlServer over toy TPC-DS frames;
+2. warm leg: POST every subset query once (plans compile + cache);
+3. serial replay over HTTP: every query again — each must HIT the plan
+   cache, add ZERO new XLA compiles, and its ``rows`` payload is the
+   reference output;
+4. concurrent leg: N clients POST the subset simultaneously — every
+   response must be byte-identical to the serial reference, hit the
+   cache, and add zero compiles;
+5. tenancy/conf isolation: a tenant overriding a plan-affecting knob
+   (sql.shuffle.partitions) gets a DIFFERENT digest (cache invalidation
+   by keying) but identical rows; unknown conf keys and process-global
+   keys (obs.mode) answer 400; admission stats show the concurrency;
+6. /queries: every serve.* trace id is distinct (no cross-query trace
+   bleed) and the tenant rides the trace name;
+7. the in-process differential gate machinery itself runs once at toy
+   scale (bit-identity + zero-compile legs; the >=2x throughput floor
+   is make servegate's job at real scale — toy queries are GIL-bound).
+
+Exits nonzero on any failure; one JSON line per check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+TOY_SF = 0.02
+CLIENTS = 4
+SUBSET = ["q3", "q96", "q5a", "q42", "q55", "q1a"]
+
+
+def _post(port: int, body: dict, timeout: float = 120.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/sql",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except ValueError:
+            return e.code, {"error": body.decode(errors="replace")}
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from auron_tpu.jaxenv import force_cpu_backend
+
+    force_cpu_backend(8)
+
+    from auron_tpu.utils.profiling import EngineCounters
+
+    counters = EngineCounters.install()
+
+    from auron_tpu.models import servegate, sqlgate, tpcds
+    from auron_tpu.serve import SqlServer
+    from auron_tpu.sql.catalog import build_tables
+    from auron_tpu.utils import httpsvc
+
+    failures: list[str] = []
+
+    def check(name: str, ok: bool, **info) -> None:
+        if not ok:
+            failures.append(name)
+        print(json.dumps({"check": name, "ok": bool(ok), **info}),
+              flush=True)
+
+    frames = build_tables(tpcds.generate(sf=TOY_SF, seed=42), seed=42)
+    server = SqlServer(sqlgate.gate_catalog(), frames, n_parts=2)
+    port = httpsvc.start(0)
+    httpsvc.install_sql_server(server)
+    try:
+        cases = [sqlgate.case_by_name(n) for n in SUBSET]
+
+        # ---- leg 1: warm over HTTP
+        for c in cases:
+            code, resp = _post(port, {"sql": c.sql, "tenant": "warm"})
+            if code != 200:
+                check("warm", False, query=c.name, code=code,
+                      error=resp.get("error"))
+                return 1
+        # ---- leg 2: serial replay — cache hits, zero compiles, reference
+        compiles0 = counters.compiles
+        reference: dict[str, str] = {}
+        serial_ok = True
+        for c in cases:
+            code, resp = _post(port, {"sql": c.sql, "tenant": "serial"})
+            serial_ok &= code == 200 and resp.get("cache_hit") is True
+            reference[c.name] = json.dumps(
+                {"columns": resp.get("columns"), "rows": resp.get("rows")},
+                sort_keys=True)
+        serial_compiles = counters.compiles - compiles0
+        check("serial_replay_cached", serial_ok and serial_compiles == 0,
+              compiles=serial_compiles)
+
+        # ---- leg 3: concurrent clients over HTTP
+        results: list[tuple[str, int, dict]] = []
+        lock = threading.Lock()
+
+        def client(i: int) -> None:
+            order = cases[i % len(cases):] + cases[:i % len(cases)]
+            for c in order:
+                code, resp = _post(
+                    port, {"sql": c.sql, "tenant": f"client{i}"})
+                with lock:
+                    results.append((c.name, code, resp))
+
+        compiles1 = counters.compiles
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        conc_compiles = counters.compiles - compiles1
+        bad_codes = [c for _, c, _ in results if c != 200]
+        misses = [n for n, _, r in results if not r.get("cache_hit")]
+        diverged = [
+            n for n, _, r in results
+            if json.dumps({"columns": r.get("columns"),
+                           "rows": r.get("rows")},
+                          sort_keys=True) != reference[n]
+        ]
+        check("concurrent_bit_identical",
+              not bad_codes and not diverged and not misses
+              and conc_compiles == 0,
+              queries=len(results), bad_codes=bad_codes[:5],
+              diverged=diverged[:5], cache_misses=misses[:5],
+              compiles=conc_compiles)
+
+        # ---- tenancy/conf isolation
+        c0 = cases[0]
+        code_a, resp_a = _post(port, {"sql": c0.sql, "tenant": "iso"})
+        code_b, resp_b = _post(
+            port, {"sql": c0.sql, "tenant": "iso",
+                   "conf": {"sql.shuffle.partitions": 4}})
+        same_rows = (json.dumps(resp_a.get("rows")) ==
+                     json.dumps(resp_b.get("rows")))
+        check("conf_isolation_plan_knob",
+              code_a == 200 and code_b == 200
+              and resp_a.get("digest") != resp_b.get("digest")
+              and not resp_b.get("cache_hit") and same_rows,
+              digest_a=resp_a.get("digest"), digest_b=resp_b.get("digest"))
+        code_u, _ = _post(port, {"sql": c0.sql,
+                                 "conf": {"no.such.key": 1}})
+        code_d, _ = _post(port, {"sql": c0.sql,
+                                 "conf": {"obs.mode": "off"}})
+        code_s, resp_s = _post(port, {"sql": "select broken from"})
+        check("bad_requests_refused",
+              code_u == 400 and code_d == 400 and code_s == 400,
+              unknown_key=code_u, denied_key=code_d, sql_error=code_s)
+
+        # ---- /queries: no cross-query trace bleed
+        queries = _get(port, "/queries")
+        serve_qs = [q for q in queries
+                    if str(q.get("name", "")).startswith("serve.")]
+        ids = [q["trace_id"] for q in serve_qs]
+        tenants = {q["name"] for q in serve_qs}
+        check("queries_trace_isolation",
+              len(serve_qs) > 0 and len(ids) == len(set(ids))
+              and any(t.startswith("serve.client") for t in tenants),
+              traces=len(serve_qs))
+
+        stats = _get(port, "/serve")
+        check("serve_stats",
+              stats["plan_cache"]["hits"] > 0
+              and stats["admission"]["peak_running"] > 1
+              and stats["queries_err"] >= 1,  # the refused requests
+              stats=stats)
+
+        # ---- the gate machinery itself, in-process at toy scale
+        os.environ.setdefault("SERVEGATE_RATCHET", "0")
+        rec = servegate.run_gate(sf=TOY_SF, clients=CLIENTS, frames=frames,
+                                 names=SUBSET, min_speedup=0.0)
+        check("servegate_toy", rec["ok"],
+              replay_compiles=rec["replay_compiles"],
+              concurrent_compiles=rec["concurrent_compiles"],
+              failures=rec["failures"][:5])
+    finally:
+        httpsvc.stop()
+
+    print(json.dumps({"metric": "servecheck", "sf": TOY_SF,
+                      "clients": CLIENTS, "failures": failures}),
+          flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
