@@ -129,14 +129,64 @@ def call_native(task_bytes: bytes, extra_resources: dict | None = None) -> int:
     # pump thread starts (a post-start apply would race the task's own
     # span installation); only the HTTP service starts lazily here
     rt = TaskRuntime(task_bytes, resources=resources, shared=_resources)
-    # conf-gated observability service (auron/src/http analog)
-    from auron_tpu.utils.httpsvc import maybe_start_from_conf
+    try:
+        # conf-gated observability service (auron/src/http analog)
+        from auron_tpu.utils.httpsvc import maybe_start_from_conf
 
-    maybe_start_from_conf(rt.ctx.conf)
-    h = next(_next_handle)
-    with _lock:
-        _runtimes[h] = rt
+        maybe_start_from_conf(rt.ctx.conf)
+        h = next(_next_handle)
+        with _lock:
+            _runtimes[h] = rt
+    except BaseException:
+        # the runtime's pump thread is already running: a failure before
+        # the handle is published must cancel/join it, or it leaks for
+        # the life of the process (R11 task-runtime protocol)
+        try:
+            rt.finalize()
+        except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- unwind: the original failure is the error; finalize's own is secondary
+            pass
+        raise
     return h
+
+
+def native_task(task_bytes: bytes, extra_resources: dict | None = None):
+    """Context manager around one task's lifecycle: ``call_native`` on
+    entry, ``finalize_native`` on EVERY exit — the R11-clean shape for
+    drain loops (the PR-12 lesson: a failing drain must not leak its
+    runtime's handle and pump thread)::
+
+        with api.native_task(task.SerializeToString()) as h:
+            while (rb := api.next_batch(h)) is not None:
+                ...
+
+    On an exceptional exit the finalize error (if any) is swallowed —
+    the propagating error is the primary one."""
+    return _NativeTask(task_bytes, extra_resources)
+
+
+class _NativeTask:
+    __slots__ = ("_task_bytes", "_extra", "handle")
+
+    def __init__(self, task_bytes: bytes, extra_resources: dict | None):
+        self._task_bytes = task_bytes
+        self._extra = extra_resources
+        self.handle: int | None = None
+
+    def __enter__(self) -> int:
+        self.handle = call_native(self._task_bytes, self._extra)
+        return self.handle
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self.handle is None:
+            return False
+        if exc_type is None:
+            finalize_native(self.handle)
+        else:
+            try:
+                finalize_native(self.handle)
+            except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- unwind: the propagating task error is primary; finalize's own is secondary
+                pass
+        return False
 
 
 def next_batch(handle: int) -> pa.RecordBatch | None:
@@ -179,7 +229,7 @@ def finalize_native(handle: int) -> dict:
     if _metrics_sink is not None:
         try:
             _metrics_sink(snap)
-        except Exception:  # noqa: BLE001 — observability must not fail tasks
+        except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- observability sink isolation: a broken metrics consumer must not fail the task it observes
             pass
     return snap
 

@@ -382,7 +382,12 @@ class HashAggExec(ExecOperator):
 
         mm = MemManager.get()
         table = _AggTableConsumer(self, ctx)
-        mm.register(table)
+        # registration happens inside the try below, next to dense's and
+        # probe's: ~300 lines of setup (knob resolution, dense/probe/
+        # window construction) run between here and the stream loop, and
+        # an exception there must not leak registered consumers in the
+        # process-wide manager (R11; the unregisters in the finally are
+        # membership-checked, so never-registered consumers are safe)
         seen_rows = 0
         seen_groups = 0
         skipping = False
@@ -396,12 +401,11 @@ class HashAggExec(ExecOperator):
         # dense direct-address accumulator (no sort, one fused scatter-
         # reduce per batch); drains into the generic table when the key
         # range outgrows the dense limit
+        # dense is a fixed-footprint table (<= LIMIT slots x field
+        # widths): registered below as an UNSPILLABLE consumer so its
+        # bytes shrink the pool others fair-share (same citizenship as
+        # resident join builds)
         dense = _DenseAggState(self, ctx) if self._dense_eligible() else None
-        if dense is not None:
-            # fixed-footprint table (<= LIMIT slots x field widths): an
-            # UNSPILLABLE consumer so its bytes shrink the pool others
-            # fair-share (same citizenship as resident join builds)
-            mm.register(dense, spillable=False)
 
         def drain_dense_into_table():
             sb, g = dense.state_batch_and_count()
@@ -551,8 +555,6 @@ class HashAggExec(ExecOperator):
         # an fp-sorted state batch (and the dense table, which runs in
         # front, is out of the picture)
         probe = _ProbeScatter(self, ctx, table) if self._probe_eligible() else None
-        if probe is not None:
-            mm.register(probe, spillable=False)
 
         # deferred PARTIAL counts (exec.agg.partial.defer, docs/fusion.md):
         # the generic path's steady-state "ONE round-trip per batch" read
@@ -680,6 +682,11 @@ class HashAggExec(ExecOperator):
                 yield from process_generic(b)
 
         try:
+            mm.register(table)
+            if dense is not None:
+                mm.register(dense, spillable=False)
+            if probe is not None:
+                mm.register(probe, spillable=False)
             for b in self.child_stream(0, partition, ctx):
                 ctx.check_cancelled()
                 if dense is not None:
@@ -1532,7 +1539,14 @@ class _AggTableConsumer:
                 self.compact()
                 if self.state is not None:
                     ds = make_spill(conf=self.ctx.conf)
-                    ds.write_table(self.state.to_arrow(preserve_dicts=True))
+                    try:
+                        ds.write_table(
+                            self.state.to_arrow(preserve_dicts=True))
+                    except BaseException:
+                        # a failed park (disk full, encode error) must
+                        # not strand the container's ledger bytes (R11)
+                        ds.release()
+                        raise
                     self.parked.append(ds)
             self.ctx.metrics.add("spilled_aggs", 1)
             self.state = None

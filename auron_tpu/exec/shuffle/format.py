@@ -256,7 +256,7 @@ def _fallback_codec(conf) -> str | None:
         try:
             if candidate in _CODEC_IDS and pa.Codec.is_available(candidate):
                 return candidate
-        except Exception:  # noqa: BLE001 — availability probe must not raise
+        except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- availability probe: an unprobeable codec means "unavailable", and the stderr warning below IS the boundary routing
             pass
         with _codec_warn_lock:
             if candidate not in _codec_warned:

@@ -128,7 +128,18 @@ class RssNetServer:
                 # the daemon must survive, not die silently
                 time.sleep(0.05)
                 continue
-            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+            try:
+                threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True).start()
+            except Exception:
+                # can't spawn (thread limit): shed THIS connection and
+                # keep accepting — an escaping error here would kill the
+                # accept loop and silently take the whole daemon down
+                # with it (R12)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _handle(self, conn: socket.socket) -> None:  # auronlint: thread-root(foreign) -- per-connection RSS service thread: no task conf_scope installed
         try:

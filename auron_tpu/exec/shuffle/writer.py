@@ -71,12 +71,15 @@ class ShuffleWriterExec(ExecOperator):
         n_out = self.partitioning.num_partitions
         mm = MemManager.get()
         staging = _ShuffleStaging(n_out, ctx)
-        # staging (raw arrow buffers + compressed runs awaiting the final
-        # write) is spill-managed: under pressure it compresses and parks
-        # runs on disk, merged back per partition at write time — the
-        # reference's spill-merge path (sort_repartitioner.rs:98-151)
-        mm.register(staging)
         try:
+            # staging (raw arrow buffers + compressed runs awaiting the
+            # final write) is spill-managed: under pressure it compresses
+            # and parks runs on disk, merged back per partition at write
+            # time — the reference's spill-merge path
+            # (sort_repartitioner.rs:98-151). Registered INSIDE the try:
+            # the finally's unregister+release must cover every path out,
+            # including a failure of register itself (R11)
+            mm.register(staging)
             for parts in partitioned_stream(
                 self.child_stream(0, partition, ctx), self.partitioning, ctx
             ):
@@ -350,16 +353,28 @@ class RssShuffleWriterExec(ExecOperator):
                 staged[pid].clear()
                 staged_bytes[pid] = 0
 
-        for parts in partitioned_stream(
-            self.child_stream(0, partition, ctx), self.partitioning, ctx
-        ):
-            for pid, rb in parts:
-                staged[pid].append(rb)
-                staged_bytes[pid] += rb.nbytes
-                if staged_bytes[pid] >= target:
-                    flush(pid)
-        for pid in range(n_out):
-            flush(pid)
+        try:
+            for parts in partitioned_stream(
+                self.child_stream(0, partition, ctx), self.partitioning, ctx
+            ):
+                for pid, rb in parts:
+                    staged[pid].append(rb)
+                    staged_bytes[pid] += rb.nbytes
+                    if staged_bytes[pid] >= target:
+                        flush(pid)
+            for pid in range(n_out):
+                flush(pid)
+        except BaseException:
+            # a failing map attempt must ABORT so the service drops its
+            # staged blocks — an uncommitted attempt otherwise holds its
+            # pushed bytes forever (local RAM or the remote daemon; the
+            # first-commit-wins retry then runs against a clean slate)
+            if hasattr(writer, "abort"):
+                try:
+                    writer.abort()
+                except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- unwind: the propagating stream error is primary; a failed abort just leaves the attempt for service GC
+                    pass
+            raise
         if hasattr(writer, "flush"):
             writer.flush()
         return

@@ -62,7 +62,7 @@ def _auto_budget() -> int:
 
         if jax.default_backend() != "cpu":
             return 8 << 30
-    except Exception:
+    except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- backend probe: an unprobeable jax means the CPU sizing below, which IS the documented fallback
         pass
     try:
         phys = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
@@ -453,9 +453,15 @@ class HostSpill:
             if self._disk is not None or self._blocks is None:
                 return
             disk = DiskSpill(self._spill_dir, conf=self._conf)
-            with open(disk.path, "ab") as f:
-                for blk in self._blocks:
-                    f.write(blk)
+            try:
+                with open(disk.path, "ab") as f:
+                    for blk in self._blocks:
+                        f.write(blk)
+            except BaseException:
+                # a failed demotion write (disk full) must not leak the
+                # temp file; the blocks stay resident in RAM (R11)
+                disk.release()
+                raise
             freed = self._admitted
             self._blocks, self._nbytes, self._admitted = [], 0, 0
             self._disk = disk

@@ -178,25 +178,33 @@ def run_gate(sf: Optional[float] = None, clients: Optional[int] = None,
     lock = threading.Lock()
 
     def client(i: int) -> None:
-        order = cases[i % len(cases):] + cases[:i % len(cases)]
-        for c in order:
-            try:
-                df, rec = server.submit(c.sql, tenant=f"client{i}")
-            except Exception as e:  # noqa: BLE001 — the gate records
+        try:
+            order = cases[i % len(cases):] + cases[:i % len(cases)]
+            for c in order:
+                try:
+                    df, rec = server.submit(c.sql, tenant=f"client{i}")
+                except Exception as e:  # noqa: BLE001 — the gate records
+                    with lock:
+                        conc_failures.append(
+                            f"client{i} {c.name}: {type(e).__name__}: {e}")
+                    continue
                 with lock:
-                    conc_failures.append(
-                        f"client{i} {c.name}: {type(e).__name__}: {e}")
-                continue
+                    conc_lat.append(rec["wall_s"])
+                    if "trace_id" in rec:
+                        trace_ids.append(rec["trace_id"])
+                    if not rec["cache_hit"]:
+                        conc_failures.append(
+                            f"client{i} missed the plan cache: {c.name}")
+                    if not _frames_identical(reference[c.name], df):
+                        conc_failures.append(
+                            f"client{i} diverged from serial: {c.name}")
+        except BaseException as e:  # noqa: BLE001
+            # the comparison code above runs on this client thread too: an
+            # escaping error would kill the thread silently and the gate
+            # would under-count — record it as a failure instead (R12)
             with lock:
-                conc_lat.append(rec["wall_s"])
-                if "trace_id" in rec:
-                    trace_ids.append(rec["trace_id"])
-                if not rec["cache_hit"]:
-                    conc_failures.append(
-                        f"client{i} missed the plan cache: {c.name}")
-                if not _frames_identical(reference[c.name], df):
-                    conc_failures.append(
-                        f"client{i} diverged from serial: {c.name}")
+                conc_failures.append(
+                    f"client{i} crashed: {type(e).__name__}: {e}")
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(clients)]

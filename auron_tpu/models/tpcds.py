@@ -154,10 +154,11 @@ def run_q1_class(data: TpcdsData, n_partitions: int = 4, year: int = 2000) -> pd
         )
         outs = []
         for p in range(n_partitions):
-            h = api.call_native(B.task(partial, partition_id=p).SerializeToString())
-            while (rb := api.next_batch(h)) is not None:
-                outs.append(Batch.from_arrow(rb))
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(partial, partition_id=p).SerializeToString()
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    outs.append(Batch.from_arrow(rb))
         inter_schema = _agg_inter_schema(partial)
         api.put_resource("q1_inter", [outs])
         final = B.hash_agg(
@@ -165,11 +166,12 @@ def run_q1_class(data: TpcdsData, n_partitions: int = 4, year: int = 2000) -> pd
             [("count_star", None, "cnt"), ("sum", col(0), "total"), ("avg", col(0), "mean")],
             "final",
         )
-        h = api.call_native(B.task(final, partition_id=0).SerializeToString())
         frames = []
-        while (rb := api.next_batch(h)) is not None:
-            frames.append(rb.to_pandas())
-        api.finalize_native(h)
+        with api.native_task(
+            B.task(final, partition_id=0).SerializeToString()
+        ) as h:
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
         return pd.concat(frames).reset_index(drop=True)
     finally:
         for k in ("q1_fact", "q1_dd", "q1_dd_build", "q1_inter"):
@@ -268,20 +270,21 @@ def run_q3_class(
         pairs = []
         handles = []
         # column pruning now runs on every task in task_from_proto
-        for p in range(n_map):
-            data_f = os.path.join(work, f"map{p}.data")
-            index_f = os.path.join(work, f"map{p}.index")
-            w = B.shuffle_writer(partial, part, data_f, index_f)
-            # start every map task before draining: each task pumps on its
-            # own thread (Spark executor slots; XLA releases the GIL)
-            handles.append(
-                api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
-            )
-            pairs.append((data_f, index_f))
-        for h in handles:
-            while api.next_batch(h) is not None:
-                pass
-            api.finalize_native(h)
+        try:
+            for p in range(n_map):
+                data_f = os.path.join(work, f"map{p}.data")
+                index_f = os.path.join(work, f"map{p}.index")
+                w = B.shuffle_writer(partial, part, data_f, index_f)
+                # start every map task before draining: each task pumps on
+                # its own thread (Spark executor slots; XLA releases the GIL)
+                handles.append(
+                    api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
+                )
+                pairs.append((data_f, index_f))
+        except BaseException:
+            _finalize_quietly(handles)
+            raise
+        _drain_all(handles)
 
         # ---- reduce stage: ipc read -> final agg -> sort desc -> limit
         inter_schema = _agg_inter_schema(partial)
@@ -293,10 +296,11 @@ def run_q3_class(
         )
         frames = []
         for p in range(n_reduce):
-            h = api.call_native(B.task(final, stage_id=2, partition_id=p).SerializeToString())
-            while (rb := api.next_batch(h)) is not None:
-                frames.append(rb.to_pandas())
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(final, stage_id=2, partition_id=p).SerializeToString()
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    frames.append(rb.to_pandas())
         if not frames:
             return pd.DataFrame({"d_year": [], "i_brand_id": [], "s": []})
         merged = pd.concat(frames).reset_index(drop=True)
@@ -371,10 +375,11 @@ def run_q72_class(
             d = os.path.join(work, f"{side}{p}.data")
             i = os.path.join(work, f"{side}{p}.index")
             w = B.shuffle_writer(scan, part, d, i)
-            h = api.call_native(B.task(w, stage_id=1, partition_id=p).SerializeToString())
-            while api.next_batch(h) is not None:
-                pass
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(w, stage_id=1, partition_id=p).SerializeToString()
+            ) as h:
+                while api.next_batch(h) is not None:
+                    pass
             return side, (d, i)
 
         results = run_tasks_parallel([
@@ -408,15 +413,14 @@ def run_q72_class(
             # result is re-sorted for comparison), so it asserts full
             # SMJ-input-sort elision — the Spark extension sets the same
             # flag when the parent's requiredChildOrdering is empty
-            h = api.call_native(
+            out = []
+            with api.native_task(
                 B.task(agg_f, stage_id=2, partition_id=p,
                        conf={"auron.smj.elide.sorts": "full"})
                 .SerializeToString()
-            )
-            out = []
-            while (rb := api.next_batch(h)) is not None:
-                out.append(rb.to_pandas())
-            api.finalize_native(h)
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    out.append(rb.to_pandas())
             return out
 
         frames = [
@@ -503,11 +507,12 @@ def run_q95_class(
         agg_f = B.hash_agg(agg_p, [(col(2), "customer")],
                            [("count_star", None, "cnt")], "final")
         def reduce_task(p: int):
-            h = api.call_native(B.task(agg_f, stage_id=2, partition_id=p).SerializeToString())
             out = []
-            while (rb := api.next_batch(h)) is not None:
-                out.append(rb.to_pandas())
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(agg_f, stage_id=2, partition_id=p).SerializeToString()
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    out.append(rb.to_pandas())
             return out
 
         frames = [
@@ -559,11 +564,10 @@ def run_windowed_query(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
                            [("sum", col(4), "rev")], "final")
         w = B.window(agg_f, [col(0)], [(col(2), SortSpec(asc=False))],
                      [("rank", None, None, 1, False, "rk")])
-        h = api.call_native(B.task(w).SerializeToString())
         frames = []
-        while (rb := api.next_batch(h)) is not None:
-            frames.append(rb.to_pandas())
-        api.finalize_native(h)
+        with api.native_task(B.task(w).SerializeToString()) as h:
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
         out = pd.concat(frames)
         return (
             out[out.rk <= 2]
@@ -631,20 +635,20 @@ def run_q6_class(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
                              [("avg", col(1), "cat_avg")], "partial")
         frames = []
         for p in range(n_partitions):
-            h = api.call_native(B.task(partial, partition_id=p).SerializeToString())
-            while (rb := api.next_batch(h)) is not None:
-                frames.append(Batch.from_arrow(rb))
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(partial, partition_id=p).SerializeToString()
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    frames.append(Batch.from_arrow(rb))
         api.put_resource("q6_inter", [frames])
         final = B.hash_agg(
             B.memory_scan(_agg_inter_schema(partial), "q6_inter"),
             [(col(0), "cat")], [("avg", col(1), "cat_avg")], "final",
         )
-        h = api.call_native(B.task(final).SerializeToString())
         cat_avg_batches = []
-        while (rb := api.next_batch(h)) is not None:
-            cat_avg_batches.append(Batch.from_arrow(rb))
-        api.finalize_native(h)
+        with api.native_task(B.task(final).SerializeToString()) as h:
+            while (rb := api.next_batch(h)) is not None:
+                cat_avg_batches.append(Batch.from_arrow(rb))
         api.put_resource("q6_catavg", [cat_avg_batches] * n_partitions)
         ca_schema = T.Schema.of(
             T.Field("cat", T.INT32), T.Field("cat_avg", T.FLOAT64)
@@ -674,10 +678,11 @@ def run_q6_class(data: TpcdsData, n_partitions: int = 2) -> pd.DataFrame:
         # column pruning now runs on every task in task_from_proto
         frames = []
         for p in range(n_partitions):
-            h = api.call_native(B.task(agg_f, partition_id=p).SerializeToString())
-            while (rb := api.next_batch(h)) is not None:
-                frames.append(rb.to_pandas())
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(agg_f, partition_id=p).SerializeToString()
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    frames.append(rb.to_pandas())
         out = pd.concat(frames).groupby("d_year").agg(cnt=("cnt", "sum")).reset_index()
         return out.sort_values("d_year").reset_index(drop=True)
     finally:
@@ -743,17 +748,18 @@ def run_q18_class(
         part = B.hash_partitioning([col(0), col(1)], n_reduce)
         pairs = []
         handles = []
-        for p in range(n_map):
-            d = os.path.join(work, f"q18_{p}.data")
-            i = os.path.join(work, f"q18_{p}.index")
-            handles.append(api.call_native(
-                B.task(B.shuffle_writer(partial, part, d, i),
-                       stage_id=1, partition_id=p).SerializeToString()))
-            pairs.append((d, i))
-        for h in handles:
-            while api.next_batch(h) is not None:
-                pass
-            api.finalize_native(h)
+        try:
+            for p in range(n_map):
+                d = os.path.join(work, f"q18_{p}.data")
+                i = os.path.join(work, f"q18_{p}.index")
+                handles.append(api.call_native(
+                    B.task(B.shuffle_writer(partial, part, d, i),
+                           stage_id=1, partition_id=p).SerializeToString()))
+                pairs.append((d, i))
+        except BaseException:
+            _finalize_quietly(handles)
+            raise
+        _drain_all(handles)
         api.put_resource("q18_blocks", MultiMapBlockProvider(pairs))
         final = B.hash_agg(
             B.ipc_reader(_agg_inter_schema(partial), "q18_blocks"),
@@ -761,10 +767,11 @@ def run_q18_class(
         )
         frames = []
         for p in range(n_reduce):
-            h = api.call_native(B.task(final, stage_id=2, partition_id=p).SerializeToString())
-            while (rb := api.next_batch(h)) is not None:
-                frames.append(rb.to_pandas())
-            api.finalize_native(h)
+            with api.native_task(
+                B.task(final, stage_id=2, partition_id=p).SerializeToString()
+            ) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    frames.append(rb.to_pandas())
         return (
             pd.concat(frames).sort_values(["cat", "d_year"]).reset_index(drop=True)
         )
@@ -814,11 +821,10 @@ def run_generate_class(data: TpcdsData) -> pd.DataFrame:
                          [("count_star", None, "cnt")], "partial")
         agg_f = B.hash_agg(agg, [(col(0), "tag")],
                            [("count_star", None, "cnt")], "final")
-        h = api.call_native(B.task(agg_f).SerializeToString())
         frames = []
-        while (rb := api.next_batch(h)) is not None:
-            frames.append(rb.to_pandas())
-        api.finalize_native(h)
+        with api.native_task(B.task(agg_f).SerializeToString()) as h:
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
         return pd.concat(frames).sort_values("tag").reset_index(drop=True)
     finally:
         api.remove_resource("qg_item")
@@ -856,11 +862,10 @@ def run_windowed2_class(data: TpcdsData) -> pd.DataFrame:
             [("lag", None, col(4), 1, False, "prev_price"),
              ("agg", "sum", col(4), 1, False, "run_sum")],
         )
-        h = api.call_native(B.task(w).SerializeToString())
         frames = []
-        while (rb := api.next_batch(h)) is not None:
-            frames.append(rb.to_pandas())
-        api.finalize_native(h)
+        with api.native_task(B.task(w).SerializeToString()) as h:
+            while (rb := api.next_batch(h)) is not None:
+                frames.append(rb.to_pandas())
         out = pd.concat(frames)
         return (
             out.sort_values(["ss_item_sk", "ss_sold_date_sk"])
@@ -887,6 +892,30 @@ def windowed2_class_oracle(data: TpcdsData) -> pd.DataFrame:
     ]
 
 
+def _finalize_quietly(handles: list) -> None:
+    """Best-effort finalize of every handle (idempotent per handle) —
+    the unwind half of the started-tasks protocols below."""
+    for h in handles:
+        try:
+            api.finalize_native(h)
+        except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- unwind: sibling finalize errors are secondary to the propagating task error
+            pass
+
+
+def _drain_all(handles: list) -> None:
+    """Drain every started task to exhaustion and finalize it; on error,
+    finalize the rest too — a failing map task must not leak its
+    siblings' runtimes (R11; the PR-12 leaked-TaskRuntime class)."""
+    try:
+        for h in handles:
+            while api.next_batch(h) is not None:
+                pass
+            api.finalize_native(h)
+    except BaseException:
+        _finalize_quietly(handles)
+        raise
+
+
 # ---------------------------------------------------------------------------
 # round-3 gate widening (VERDICT r2 #6): multi-exchange plans, rollup/expand,
 # scalar subqueries, windowed joins, union, conditional/distinct aggregation
@@ -902,13 +931,12 @@ def _drain_task_arrow(plan, stage_id=0, partition_id=0) -> list:
     """Like _drain_task but keeps engine Arrow batches (NO pandas round
     trip: pandas turns nullable int64 into float64, silently breaking
     join-key equality when the frames are re-ingested)."""
-    h = api.call_native(
-        B.task(plan, stage_id=stage_id, partition_id=partition_id).SerializeToString()
-    )
     out = []
-    while (rb := api.next_batch(h)) is not None:
-        out.append(rb)
-    api.finalize_native(h)
+    with api.native_task(
+        B.task(plan, stage_id=stage_id, partition_id=partition_id).SerializeToString()
+    ) as h:
+        while (rb := api.next_batch(h)) is not None:
+            out.append(rb)
     return out
 
 
@@ -947,12 +975,11 @@ def _shuffle_stage(plan, out_schema, key_cols, n_map, n_reduce, work, rid, stage
         d = os.path.join(work, f"{rid}_m{p}.data")
         i = os.path.join(work, f"{rid}_m{p}.index")
         w = B.shuffle_writer(plan, part, d, i)
-        h = api.call_native(
+        with api.native_task(
             B.task(w, stage_id=stage_id, partition_id=p).SerializeToString()
-        )
-        while api.next_batch(h) is not None:
-            pass
-        api.finalize_native(h)
+        ) as h:
+            while api.next_batch(h) is not None:
+                pass
         return d, i
 
     pairs = run_tasks_parallel(

@@ -86,8 +86,11 @@ class TaskRuntime:
     def _pump(self) -> None:  # auronlint: thread-root(conf-scoped) -- task pump thread; installs conf_scope(self.ctx.conf) before touching engine code
         from auron_tpu.utils.logging import clear_task_context, set_task_context
 
-        set_task_context(self.ctx.stage_id, self.ctx.partition_id)
         try:
+            # INSIDE the try: if context installation itself raises, the
+            # finally below must still enqueue _END — a pump that dies
+            # before the sentinel leaves next_batch blocked forever (R12)
+            set_task_context(self.ctx.stage_id, self.ctx.partition_id)
             with conf_scope(self.ctx.conf), obs.span(
                 f"task s{self.ctx.stage_id}p{self.ctx.partition_id}",
                 cat="task", parent=self._obs_parent, trace=self._obs_trace,

@@ -43,8 +43,8 @@ def start_host_transfer(*arrays) -> None:
         if copy is not None:
             try:
                 copy()
-            except Exception:
-                pass  # unsupported backend/layout: harvest pays instead
+            except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- async-copy probe: an unsupported backend/layout degrades to the harvest paying the transfer, the documented pre-window behavior
+                pass
 
 
 def harvest(*arrays) -> tuple[np.ndarray, ...]:  # auronlint: thread-root(foreign) -- window harvests run on whichever thread drains (incl. cross-thread spill drains)

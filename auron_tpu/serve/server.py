@@ -244,7 +244,7 @@ class SqlServer:
                 # secondary to the one already propagating
                 try:
                     api.finalize_native(h)
-                except Exception:
+                except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- unwind: the propagating collect error is primary; finalize's own is secondary
                     pass
                 raise
             api.finalize_native(h)

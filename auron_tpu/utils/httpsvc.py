@@ -33,6 +33,11 @@ Gated by ``http.service.enable`` (off by default, like the reference's
 feature flag); the bridge starts it lazily on the first task when
 enabled. A handler exception answers 500 and never propagates into task
 threads — observability must not fail queries.
+
+The service speaks HTTP/1.1 with persistent connections: serving
+clients issue many ``POST /sql`` requests over one socket instead of
+paying TCP setup per query. Request bodies are always drained before a
+response (keep-alive framing), bounded by ``_MAX_BODY``.
 """
 
 from __future__ import annotations
@@ -103,7 +108,24 @@ def _stacks_payload() -> str:
     return "\n".join(out) + "\n"
 
 
+#: bound on the POST /sql body the handler will drain before answering:
+#: keep-alive framing requires consuming the body even on early-return
+#: paths, and an unbounded Content-Length would let one request park the
+#: handler thread on a multi-GB read
+_MAX_BODY = 64 << 20
+
+
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1: connections persist across requests so serving clients
+    # stop paying per-request TCP setup (every response carries
+    # Content-Length via _send, which 1.1 framing requires)
+    protocol_version = "HTTP/1.1"
+    #: idle keep-alive connections release their handler thread after
+    #: this many seconds (handle_one_request treats the socket timeout
+    #: as close_connection) — without it an abandoned client parks a
+    #: ThreadingHTTPServer thread forever
+    timeout = 60
+
     def log_message(self, fmt, *args):  # quiet
         pass
 
@@ -111,6 +133,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # tell the client, not just the socket: without the header a
+            # 1.1 client would assume keep-alive and race our close
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
@@ -179,6 +205,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 — http.server API  # auronlint: thread-root(conf-scoped) -- serving handler thread: SqlServer.submit installs conf_scope(session conf) before any engine work
         try:
+            # drain the body FIRST, before any early-return response:
+            # with keep-alive, unread body bytes would be parsed as the
+            # start of the NEXT request and corrupt the connection
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+            except (ValueError, TypeError):
+                n = -1
+            if n < 0 or n > _MAX_BODY:
+                self.close_connection = True
+                self._send(b"bad request body: unacceptable "
+                           b"Content-Length\n", "text/plain", 400)
+                return
+            raw = self.rfile.read(n)
             if self.path.split("?", 1)[0] != "/sql":
                 self._send(b"not found\n", "text/plain", 404)
                 return
@@ -194,8 +233,7 @@ class _Handler(BaseHTTPRequestHandler):
             from auron_tpu.serve.server import QueryError
 
             try:
-                n = int(self.headers.get("Content-Length", "0"))
-                body = json.loads(self.rfile.read(n) or b"{}")
+                body = json.loads(raw or b"{}")
             except (ValueError, TypeError) as e:
                 self._send(f"bad request body: {e}\n".encode(),
                            "text/plain", 400)
@@ -215,6 +253,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._send(json.dumps(payload).encode(), "application/json")
         except Exception as e:  # noqa: BLE001 — the service must survive
+            # conservative: after an arbitrary handler failure the
+            # request-stream position is not trustworthy for reuse
+            self.close_connection = True
             self._send(f"error: {e}\n".encode(), "text/plain", 500)
 
 

@@ -29,3 +29,63 @@ def enable_row_metrics(monkeypatch):
 
     env_key = "AURON_TPU_" + METRICS_ROW_COUNTS.key.upper().replace(".", "_")
     monkeypatch.setenv(env_key, "true")
+
+
+@pytest.fixture(scope="module")
+def leak_canary():
+    """Tier-1 leak canary (R11's dynamic twin): a suite that drives whole
+    queries must leave the process registries as it found them —
+    ``api._runtimes`` (a failing request leaked one per query before
+    PR 12), the global resource map, and the obs ring registry (a ring
+    owned by a suite-spawned thread that never exited = a stuck waiter).
+    Autoused by the serving and sqlgate suites; teardown asserts the
+    baselines restored."""
+    import threading
+    import time
+
+    from auron_tpu.bridge import api
+    from auron_tpu.obs import core as obs_core
+
+    with api._lock:
+        base_rt = set(api._runtimes)
+        base_res = set(api._resources)
+    base_threads = {t.ident for t in threading.enumerate()}
+
+    yield
+
+    with api._lock:
+        leaked_rt = {h: type(rt).__name__ for h, rt in api._runtimes.items()
+                     if h not in base_rt}
+        leaked_res = sorted(set(api._resources) - base_res)
+    assert not leaked_rt, (
+        f"suite leaked task runtimes {leaked_rt} — every call_native "
+        "needs its finalize_native on every path (R11)")
+    assert not leaked_res, (
+        f"suite leaked resource-map entries {leaked_res} — every "
+        "put_resource needs its remove_resource")
+
+    # obs rings: suite-spawned threads must have exited (their rings go
+    # dead and prune); a STILL-LIVE post-baseline thread owning a ring is
+    # the stuck-waiter shape. Short grace: handler/pump threads may be
+    # mid-exit when the last test returns.
+    deadline = time.monotonic() + 5.0
+    while True:
+        live_now = {t.ident for t in threading.enumerate()}
+        with obs_core._reg_lock:
+            stuck = [r.tname for r in obs_core._rings
+                     if r.ident in live_now and r.ident not in base_threads]
+        if not stuck or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    assert not stuck, (
+        f"suite-spawned threads still alive with obs rings: {stuck} — "
+        "a waiter was never released (R11 inflight-event shape)")
+    # and the registry prunes dead rings once retention lapses — the
+    # eviction path the /trace endpoint's memory bound rests on
+    with obs_core._reg_lock:
+        obs_core._prune_locked(
+            time.perf_counter_ns() + obs_core._RETENTION_NS)
+        live_now = {t.ident for t in threading.enumerate()}
+        undead = [r.tname for r in obs_core._rings
+                  if r.ident not in live_now]
+    assert not undead, f"dead-thread rings survived a forced prune: {undead}"

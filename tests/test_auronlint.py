@@ -1555,3 +1555,834 @@ def test_r2_call_form_decorator_in_loop_reports_once():
     hits = _hits(rep, "R2")
     assert len(hits) == 1
     assert "defined inside a loop" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# the CFG layer (exception edges) — the R11/R12 substrate
+# ---------------------------------------------------------------------------
+
+
+def _cfg_of(src: str):
+    import ast as _ast
+
+    from tools.auronlint.cfg import build_cfg
+
+    tree = _ast.parse(textwrap.dedent(src))
+    fn = next(n for n in _ast.walk(tree) if isinstance(n, _ast.FunctionDef))
+    return fn, build_cfg(fn)
+
+
+def test_cfg_try_finally_covers_exception_edges():
+    """A release in a finally is on EVERY path; without the finally the
+    exception edge out of the loop leaks."""
+    from tools.auronlint.cfg import leak_paths
+
+    fn, cfg = _cfg_of(
+        """
+        def f():
+            h = acquire()
+            try:
+                for x in stream():
+                    use(h, x)
+            finally:
+                h.release()
+        """
+    )
+    acq = next(n for n in cfg.stmt_nodes() if n.line == 3)
+    rel = {n.idx for n in cfg.stmt_nodes() if n.line == 8}
+    assert leak_paths(cfg, acq.idx, rel) == []
+
+    fn, cfg = _cfg_of(
+        """
+        def f():
+            h = acquire()
+            for x in stream():
+                use(h, x)
+            h.release()
+        """
+    )
+    acq = next(n for n in cfg.stmt_nodes() if n.line == 3)
+    rel = {n.idx for n in cfg.stmt_nodes() if n.line == 6}
+    assert leak_paths(cfg, acq.idx, rel) == ["an exception path"]
+
+
+def test_cfg_narrow_handler_lets_exceptions_escape():
+    """`except ValueError` does not stop a TypeError: the exception edge
+    continues outward past narrow handlers, stops at broad ones."""
+    from tools.auronlint.cfg import leak_paths
+
+    fn, cfg = _cfg_of(
+        """
+        def f():
+            h = acquire()
+            try:
+                use(h)
+            except ValueError:
+                h.release()
+            h.release()
+        """
+    )
+    acq = next(n for n in cfg.stmt_nodes() if n.line == 3)
+    rel = {n.idx for n in cfg.stmt_nodes() if n.line in (7, 8)}
+    assert leak_paths(cfg, acq.idx, rel) == ["an exception path"]
+
+    fn, cfg = _cfg_of(
+        """
+        def f():
+            h = acquire()
+            try:
+                use(h)
+            except Exception:
+                h.release()
+            else:
+                h.release()
+        """
+    )
+    acq = next(n for n in cfg.stmt_nodes() if n.line == 3)
+    rel = {n.idx for n in cfg.stmt_nodes() if n.line in (7, 9)}
+    assert leak_paths(cfg, acq.idx, rel) == []
+
+
+def test_cfg_return_through_finally_and_with_exit():
+    """A return inside try/finally traverses the finally; a with-exit
+    does not invent a path straight to the function exit."""
+    from tools.auronlint.cfg import leak_paths
+
+    fn, cfg = _cfg_of(
+        """
+        def f():
+            h = acquire()
+            with lock:
+                use(h)
+            h.release()
+            return 1
+        """
+    )
+    acq = next(n for n in cfg.stmt_nodes() if n.line == 3)
+    rel = {n.idx for n in cfg.stmt_nodes() if n.line == 6}
+    # the with body can raise -> exception leak; but NO normal-path leak
+    # through the with-exit (the split-exit-node property)
+    assert leak_paths(cfg, acq.idx, rel) == ["an exception path"]
+
+
+# ---------------------------------------------------------------------------
+# R11 resource lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _r11(src: str, rel: str = "fixture.py"):
+    from tools.auronlint.rules.lifecycle import ResourceLifecycleRule
+
+    return _lint(src, ResourceLifecycleRule(), rel)
+
+
+def test_r11_rediscovers_pr12_taskruntime_leak_shape():
+    """The exact pre-fix PR-12 collect drain: a failing next_batch leaks
+    the runtime (handle + pump thread). R11 must find it."""
+    rep = _r11(
+        """
+        from auron_tpu.bridge import api
+
+        def _execute(task_bytes):
+            h = api.call_native(task_bytes)
+            dfs = []
+            while (rb := api.next_batch(h)) is not None:
+                dfs.append(rb.to_pandas())
+            api.finalize_native(h)
+            return dfs
+        """
+    )
+    hits = _hits(rep, "R11")
+    assert len(hits) == 1
+    assert "task runtime" in hits[0].message
+    assert "an exception path" in hits[0].message
+
+
+def test_r11_quiet_on_pr12_fixed_shape_and_context_manager():
+    """The post-fix shape (finalize in the except unwind) and the
+    native_task context manager are both clean."""
+    rep = _r11(
+        """
+        from auron_tpu.bridge import api
+
+        def _execute(task_bytes):
+            h = api.call_native(task_bytes)
+            dfs = []
+            try:
+                while (rb := api.next_batch(h)) is not None:
+                    dfs.append(rb.to_pandas())
+            except BaseException:
+                try:
+                    api.finalize_native(h)
+                except Exception:
+                    pass
+                raise
+            api.finalize_native(h)
+            return dfs
+
+        def _execute2(task_bytes):
+            out = []
+            with api.native_task(task_bytes) as h:
+                while (rb := api.next_batch(h)) is not None:
+                    out.append(rb)
+            return out
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
+def test_r11_spill_container_fire_and_fixed():
+    rep = _r11(
+        """
+        from auron_tpu.memory.memmgr import make_spill
+
+        def park(self, tbl):
+            ds = make_spill(conf=self.conf)
+            ds.write_table(tbl)
+            self.parked.append(ds)
+        """
+    )
+    hits = _hits(rep, "R11")
+    assert len(hits) == 1 and "spill container" in hits[0].message
+
+    rep = _r11(
+        """
+        from auron_tpu.memory.memmgr import make_spill
+
+        def park(self, tbl):
+            ds = make_spill(conf=self.conf)
+            try:
+                ds.write_table(tbl)
+            except BaseException:
+                ds.release()
+                raise
+            self.parked.append(ds)
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
+def test_r11_mm_registration_fire_and_fixed():
+    """register() before the protecting try leaks on a setup failure —
+    the agg_exec shape this PR fixed."""
+    rep = _r11(
+        """
+        def _execute(self, ctx):
+            mm = get_manager()
+            table = TableConsumer(self, ctx)
+            mm.register(table)
+            win = TransferWindow(ctx.conf)
+            try:
+                for b in stream():
+                    table.add(b)
+            finally:
+                mm.unregister(table)
+        """
+    )
+    hits = _hits(rep, "R11")
+    assert len(hits) == 1 and "register -> unregister" in hits[0].message
+
+    rep = _r11(
+        """
+        def _execute(self, ctx):
+            mm = get_manager()
+            table = TableConsumer(self, ctx)
+            win = TransferWindow(ctx.conf)
+            try:
+                mm.register(table)
+                for b in stream():
+                    table.add(b)
+            finally:
+                mm.unregister(table)
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
+def test_r11_conditional_release_idiom_is_quiet():
+    """`if guard is not None: mm.unregister(guard)` in the finally is
+    the dynamic ownership check — not a leak path around the release."""
+    rep = _r11(
+        """
+        def _execute(self, ctx):
+            mm = get_manager()
+            guard = None
+            try:
+                build = self._build(ctx)
+                guard = BuildGuard(self, build)
+                mm.register(guard, spillable=False)
+                for b in stream():
+                    probe(build, b)
+            finally:
+                if guard is not None:
+                    mm.unregister(guard)
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
+def test_r11_inflight_event_stuck_waiter_fire_and_fixed():
+    """The PR-12 upload-event class: a builder that fails before set()
+    wedges every waiter. Storing the event does NOT transfer ownership;
+    waiting on it proves the waiter side."""
+    rep = _r11(
+        """
+        import threading
+
+        def _table_view(self, key):
+            with self._res_lock:
+                ent = self._res_cache.get(key)
+                if ent is None:
+                    ent = self._res_cache[key] = {"done": threading.Event(), "val": None}
+                    builder = True
+                else:
+                    builder = False
+            if builder:
+                ent["val"] = self._build(key)
+                ent["done"].set()
+                return ent["val"]
+            ent["done"].wait()
+            return ent["val"]
+        """
+    )
+    hits = _hits(rep, "R11")
+    assert len(hits) == 1 and "in-flight event" in hits[0].message
+
+    rep = _r11(
+        """
+        import threading
+
+        def _table_view(self, key):
+            with self._res_lock:
+                ent = self._res_cache.get(key)
+                if ent is None:
+                    ent = self._res_cache[key] = {"done": threading.Event(), "val": None}
+                    builder = True
+                else:
+                    builder = False
+            if builder:
+                try:
+                    ent["val"] = self._build(key)
+                finally:
+                    ent["done"].set()
+                return ent["val"]
+            ent["done"].wait()
+            return ent["val"]
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
+def test_r11_owned_by_declaration_suppresses_with_reason():
+    rep = _r11(
+        """
+        from auron_tpu.memory.memmgr import make_spill
+
+        def park(self, tbl):
+            ds = make_spill(conf=self.conf)  # auronlint: owned-by(self.parked) -- drained and released by drain()
+            ds.write_table(tbl)
+            self.parked.append(ds)
+        """
+    )
+    assert not _hits(rep, "R11")
+    (sup,) = _suppressed(rep, "R11")
+    assert "drained and released" in sup.reason
+
+
+def test_r11_owned_by_requires_holder_argument():
+    rep = _r11(
+        """
+        from auron_tpu.memory.memmgr import make_spill
+
+        def park(self, tbl):
+            ds = make_spill(conf=self.conf)  # auronlint: owned-by -- someone releases it
+            ds.write_table(tbl)
+        """
+    )
+    assert [f for f in rep.findings if f.rule == "lint.suppression"]
+
+
+def test_r11_normal_path_leak_reported():
+    """A release only in the except arm misses the normal path."""
+    rep = _r11(
+        """
+        from auron_tpu.memory.memmgr import make_spill
+
+        def park(self, tbl):
+            ds = make_spill(conf=self.conf)
+            try:
+                ds.write_table(tbl)
+            except Exception:
+                ds.release()
+                raise
+            return None
+        """
+    )
+    hits = _hits(rep, "R11")
+    assert len(hits) == 1 and "a normal path" in hits[0].message
+
+
+def test_r11_transfers_end_tracking():
+    """Returning, yielding, storing and with-managing all hand the
+    resource off — no finding."""
+    rep = _r11(
+        """
+        from auron_tpu.memory.memmgr import make_spill
+        from auron_tpu import obs
+
+        def make(self):
+            ds = make_spill(conf=self.conf)
+            return ds
+
+        def stash(self):
+            ds = make_spill(conf=self.conf)
+            self._spill = ds
+
+        def managed(self):
+            sp = obs.span("x")
+            with sp:
+                work()
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
+# ---------------------------------------------------------------------------
+# R12 error-path discipline
+# ---------------------------------------------------------------------------
+
+
+def _r12(sources: dict):
+    from tools.auronlint.rules.errorpath import analyze
+
+    return list(analyze(_graph(sources)))
+
+
+def test_r12_fires_on_swallowed_broad_in_foreign_reachable():
+    finds = _r12({
+        "pkg/svc.py": """
+        class Svc:
+            def handle(self):  # auronlint: thread-root(foreign) -- test fixture
+                self.work()
+
+            def work(self):
+                try:
+                    step()
+                except Exception:
+                    pass
+        """,
+    })
+    assert len([f for f in finds if "swallowed" in f[2]]) == 1
+
+
+def test_r12_narrow_swallow_and_unreachable_are_quiet():
+    finds = _r12({
+        "pkg/svc.py": """
+        class Svc:
+            def handle(self):  # auronlint: thread-root(foreign) -- test fixture
+                self.work()
+
+            def work(self):
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+
+        def unreachable_helper():
+            try:
+                step()
+            except Exception:
+                pass
+        """,
+    })
+    assert not [f for f in finds if "swallowed" in f[2]]
+
+
+def test_r12_thread_target_escape_fire_and_routed():
+    finds = _r12({
+        "pkg/daemon.py": """
+        import threading
+
+        class Daemon:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                while self.running():
+                    self.step()
+        """,
+    })
+    assert len([f for f in finds if "kills its thread" in f[2]]) == 1
+
+    finds = _r12({
+        "pkg/daemon.py": """
+        import threading
+
+        class Daemon:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                try:
+                    while self.running():
+                        self.step()
+                except BaseException as e:
+                    self._error = e
+        """,
+    })
+    assert not [f for f in finds if "kills its thread" in f[2]]
+
+
+def test_r12_http_handler_entry_checked():
+    finds = _r12({
+        "pkg/http.py": """
+        from http.server import BaseHTTPRequestHandler
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                payload = self.render()
+                self.wfile.write(payload)
+        """,
+    })
+    assert len([f for f in finds if "handler entry" in f[2]]) == 1
+
+
+def test_r12_manual_lock_release_skipped_on_raise():
+    finds = _r12({
+        "pkg/locky.py": """
+        class T:
+            def handle(self):  # auronlint: thread-root(foreign) -- test fixture
+                self.work()
+
+            def work(self):
+                self._lock.acquire()
+                step()
+                self._lock.release()
+        """,
+    })
+    assert len([f for f in finds if "not released" in f[2]]) == 1
+
+    finds = _r12({
+        "pkg/locky.py": """
+        class T:
+            def handle(self):  # auronlint: thread-root(foreign) -- test fixture
+                self.work()
+
+            def work(self):
+                self._lock.acquire()
+                try:
+                    step()
+                finally:
+                    self._lock.release()
+        """,
+    })
+    assert not [f for f in finds if "not released" in f[2]]
+
+
+def test_r12_annotated_swallow_rides_suppression():
+    """A reasoned disable=R12 keeps the deliberate swallow out of the
+    failing set (and in the ratchet's suppressed counts)."""
+    from tools.auronlint.core import SourceModule, lint_paths
+    import os as _os
+    import tempfile as _tf
+
+    src = textwrap.dedent("""
+        class Svc:
+            def handle(self):  # auronlint: thread-root(foreign) -- test fixture
+                self.work()
+
+            def work(self):
+                try:
+                    step()
+                except Exception:  # auronlint: disable=R12 -- probe isolation: fallthrough is the contract
+                    pass
+    """)
+    with _tf.TemporaryDirectory() as td:
+        pkg = _os.path.join(td, "auron_tpu")
+        _os.makedirs(pkg)
+        path = _os.path.join(pkg, "svc.py")
+        with open(path, "w") as f:
+            f.write(src)
+        from tools.auronlint.rules.errorpath import ErrorPathRule
+
+        rep = lint_paths([pkg], td, [ErrorPathRule()])
+        assert not [f for f in rep.unsuppressed if f.rule == "R12"]
+        assert [f for f in rep.suppressed if f.rule == "R12"]
+
+
+# ---------------------------------------------------------------------------
+# R13 retrace stability
+# ---------------------------------------------------------------------------
+
+
+def _r13(sources: dict):
+    from tools.auronlint.rules.retracestab import analyze
+
+    return analyze(_graph(sources))
+
+
+def test_r13_fires_on_lambda_float_rowcount_and_identity_keys():
+    finds, stats = _r13({
+        "pkg/kern.py": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("emit", "scale", "n", "cfg"))
+        def prog(dev, *, emit, scale, n, cfg):
+            return dev
+        """,
+        "pkg/use.py": """
+        from pkg.kern import prog
+
+        class Driver:
+            def run(self, b):
+                return prog(b.device, emit=lambda x: x, scale=0.5,
+                            n=b.num_rows(), cfg=FreshConfig())
+        """,
+    })
+    msgs = " | ".join(m for _, _, m in finds)
+    assert "lambda" in msgs
+    assert "float literal" in msgs
+    assert "row count" in msgs
+    assert "per-call object identity" in msgs
+    assert stats["proved"] == 0 and stats["covered"] == 1
+
+
+def test_r13_finite_keys_prove_and_shape_only_entries_count():
+    finds, stats = _r13({
+        "pkg/kern.py": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("steps", "bucket", "flags"))
+        def prog(dev, *, steps, bucket, flags):
+            return dev
+
+        @jax.jit
+        def shape_only(dev):
+            return dev
+        """,
+        "pkg/use.py": """
+        from pkg.kern import prog
+
+        def run(b, conf):
+            steps = tuple(sig for sig in b.schema)
+            return prog(b.device, steps=steps,
+                        bucket=compaction_bucket(b.capacity),
+                        flags=conf.get("exec.knob"))
+        """,
+    })
+    assert not finds
+    assert stats["covered"] == 2 and stats["proved"] == 2
+
+
+def test_r13_closure_over_rebound_module_state_fires():
+    finds, stats = _r13({
+        "pkg/kern.py": """
+        import jax
+
+        _MODE = "a"
+        _MODE = "b"
+
+        @jax.jit
+        def prog(dev):
+            return dev if _MODE == "a" else dev + 1
+        """,
+    })
+    assert len([m for _, _, m in finds if "rebound" in m]) == 1
+    assert stats["proved"] == 0
+
+
+def test_r13_live_tree_coverage_and_floors():
+    """Vacuity teeth: the analysis must see every module-level jit entry
+    in plan/fusion.py and exec/ that an independent AST scan finds, and
+    the proved floor must hold on the live tree."""
+    import ast as _ast
+
+    from tools.auronlint.callgraph import build_graph
+    from tools.auronlint.rules.retracestab import (
+        R13_MIN_COVERED, R13_MIN_PROVED, _JIT_RE, analyze,
+    )
+
+    finds, stats = analyze(build_graph(REPO_ROOT))
+    assert stats["covered"] >= R13_MIN_COVERED
+    assert stats["proved"] >= R13_MIN_PROVED
+
+    # independent discovery: decorated module-level defs + module-level
+    # jit-wrapped assigns under plan/fusion.py and exec/
+    expected = set()
+    for rel in list(stats["entries"]):
+        pass
+    import os as _os
+
+    for base, _, files in _os.walk(_os.path.join(REPO_ROOT, "auron_tpu")):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = _os.path.join(base, fname)
+            rel = _os.path.relpath(path, REPO_ROOT).replace("\\", "/")
+            if not (rel == "auron_tpu/plan/fusion.py"
+                    or rel.startswith("auron_tpu/exec/")):
+                continue
+            tree = _ast.parse(open(path).read())
+            for node in tree.body:
+                if isinstance(node, _ast.FunctionDef) and any(
+                    _JIT_RE.search(_ast.unparse(d))
+                    for d in node.decorator_list
+                ):
+                    expected.add(f"{rel}::{node.name}")
+                elif isinstance(node, _ast.Assign) and isinstance(
+                    node.value, _ast.Call
+                ) and _JIT_RE.search(_ast.unparse(node.value.func)) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], _ast.Name):
+                    expected.add(f"{rel}::{node.targets[0].id}")
+    assert expected, "independent scan found no jit entries — scan broken"
+    missing = expected - set(stats["entries"])
+    assert not missing, f"R13 lost sight of jit entries: {sorted(missing)}"
+
+
+def test_r13_vacuity_floor_fails_loudly(monkeypatch):
+    from tools.auronlint.rules import retracestab
+
+    rule = retracestab.RetraceStabilityRule()
+    monkeypatch.setattr(retracestab, "R13_MIN_COVERED", 10_000)
+    finds = list(rule.check_tree(REPO_ROOT))
+    assert any("vacuity" in m for _, _, m in finds)
+
+
+# ---------------------------------------------------------------------------
+# incremental parse/summary cache (tools/auronlint/filecache.py)
+# ---------------------------------------------------------------------------
+
+_CACHE_FIXTURE = """
+import jax.numpy as jnp
+
+def f(xs):
+    s = jnp.sum(xs)
+    return s.item()
+"""
+
+
+def _fresh_cache(root):
+    """A FileCache as a NEW process would see it: drop the in-process
+    instance so the next lookup must come from disk."""
+    from tools.auronlint import filecache
+
+    filecache._caches.pop(root, None)
+    return filecache.file_cache(root)
+
+
+def test_filecache_warm_run_replays_identical_findings(tmp_path):
+    from tools.auronlint import filecache
+
+    root = str(tmp_path)
+    pkg = tmp_path / "auron_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(_CACHE_FIXTURE))
+    cold = run_tree(root)
+    assert _hits(cold, "R1"), "fixture should fire R1"
+    assert os.path.exists(os.path.join(root, filecache.CACHE_BASENAME))
+    fc = _fresh_cache(root)
+    warm = run_tree(root)
+    assert fc.hits >= 1 and fc.misses == 0
+    assert warm.to_json() == cold.to_json()
+
+
+def test_filecache_invalidates_on_file_edit(tmp_path):
+    root = str(tmp_path)
+    pkg = tmp_path / "auron_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(_CACHE_FIXTURE))
+    cold = run_tree(root)
+    assert len(_hits(cold, "R1")) == 1
+    # the edit adds a second violation; a stale cache would still say 1
+    (pkg / "mod.py").write_text(textwrap.dedent(_CACHE_FIXTURE) + textwrap.dedent("""
+def g(xs):
+    return jnp.max(xs).item()
+"""))
+    _fresh_cache(root)
+    warm = run_tree(root)
+    assert len(_hits(warm, "R1")) == 2
+
+
+def test_filecache_invalidates_on_mid_process_rewrite(tmp_path):
+    """The in-process memo must re-validate signatures too: a fixture
+    tree rewritten between two run_tree calls in ONE process (exactly
+    what this test does) must not serve stale summaries."""
+    root = str(tmp_path)
+    pkg = tmp_path / "auron_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(_CACHE_FIXTURE))
+    assert len(_hits(run_tree(root), "R1")) == 1
+    (pkg / "mod.py").write_text(
+        "def clean():\n    return 1\n")
+    assert len(_hits(run_tree(root), "R1")) == 0
+
+
+def test_filecache_invalidates_on_linter_source_change(tmp_path, monkeypatch):
+    from tools.auronlint import filecache
+
+    root = str(tmp_path)
+    pkg = tmp_path / "auron_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(_CACHE_FIXTURE))
+    run_tree(root)
+    # a rule edit changes the package digest: every entry must go cold
+    monkeypatch.setattr(filecache, "_tools_digest", lambda: "rule-edited")
+    fc = _fresh_cache(root)
+    run_tree(root)
+    assert fc.hits == 0 and fc.misses >= 1
+
+
+def test_filecache_corruption_and_disable_are_nonfatal(tmp_path, monkeypatch):
+    from tools.auronlint import filecache
+
+    root = str(tmp_path)
+    pkg = tmp_path / "auron_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(_CACHE_FIXTURE))
+    cache_path = tmp_path / filecache.CACHE_BASENAME
+    cache_path.write_bytes(b"\x80garbage, not a pickle")
+    _fresh_cache(root)
+    rep = run_tree(root)  # advisory: corruption = cold run, not a crash
+    assert len(_hits(rep, "R1")) == 1
+    # temp + os.replace left no partial files behind
+    strays = [p for p in os.listdir(root)
+              if p.startswith(filecache.CACHE_BASENAME + ".")]
+    assert not strays
+    # and the rewritten cache is loadable again
+    fc = _fresh_cache(root)
+    run_tree(root)
+    assert fc.hits >= 1
+
+    other = tmp_path / "disabled"
+    (other / "auron_tpu").mkdir(parents=True)
+    (other / "auron_tpu" / "mod.py").write_text(
+        textwrap.dedent(_CACHE_FIXTURE))
+    monkeypatch.setenv("AURONLINT_CACHE", "0")
+    rep = run_tree(str(other))
+    assert len(_hits(rep, "R1")) == 1
+    assert not os.path.exists(other / filecache.CACHE_BASENAME)
+
+
+def test_sarif_out_artifact_and_time_budget(tmp_path, capsys):
+    from tools.auronlint.__main__ import main
+
+    target = os.path.join(REPO_ROOT, "auron_tpu", "utils", "httpsvc.py")
+    out = tmp_path / "artifacts" / "lint.sarif"  # dir must be created
+    assert main([target, "--sarif-out", str(out)]) == 0
+    data = json.loads(out.read_text())
+    assert data["version"] == "2.1.0"
+    capsys.readouterr()
+
+    # a zero budget always trips: exit 1, loud stderr, artifact STILL
+    # written (CI wants the report most when the gate fails)
+    out2 = tmp_path / "b.sarif"
+    assert main([target, "--sarif-out", str(out2), "--time-budget", "0"]) == 1
+    assert json.loads(out2.read_text())["version"] == "2.1.0"
+    assert "exceeded the --time-budget" in capsys.readouterr().err
+    strays = [p for p in os.listdir(tmp_path) if p.startswith("b.sarif.")]
+    assert not strays  # temp + os.replace left nothing behind

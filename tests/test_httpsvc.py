@@ -223,6 +223,83 @@ def test_metrics_snapshot_hammer_under_mutation(svc):
         t.join()
 
 
+def test_keepalive_connection_reused_across_requests(svc):
+    """HTTP/1.1 persistence: many requests ride ONE socket (the serving
+    clients' per-query connection-setup cost this removes)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", svc, timeout=10)
+    try:
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200 and not r.will_close
+        r.read()
+        sock = conn.sock
+        assert sock is not None  # kept alive after the response
+        for _ in range(3):
+            conn.request("GET", "/conf")
+            r = conn.getresponse()
+            assert r.status == 200
+            json.loads(r.read())
+            assert conn.sock is sock  # same socket — no reconnect
+    finally:
+        conn.close()
+
+
+def test_keepalive_post_sql_drains_body_on_early_return_paths(svc):
+    """POST bodies must be consumed before ANY response (404 included):
+    with keep-alive, unread bytes would be parsed as the next request."""
+    import http.client
+
+    class _Srv:
+        def execute_json(self, body):
+            return {"echo": body.get("sql")}
+
+        def stats(self):
+            return {}
+
+    httpsvc.install_sql_server(_Srv())
+    conn = http.client.HTTPConnection("127.0.0.1", svc, timeout=10)
+    try:
+        conn.request("POST", "/sql", body=json.dumps({"sql": "q1"}))
+        r = conn.getresponse()
+        assert r.status == 200 and json.loads(r.read())["echo"] == "q1"
+        sock = conn.sock
+        # bodied POST to an unknown path: the 404 must drain the body or
+        # these 4096 bytes corrupt the kept-alive stream
+        conn.request("POST", "/nope", body=b"x" * 4096)
+        r = conn.getresponse()
+        assert r.status == 404
+        r.read()
+        assert conn.sock is sock
+        conn.request("POST", "/sql", body=json.dumps({"sql": "q2"}))
+        r = conn.getresponse()
+        assert r.status == 200 and json.loads(r.read())["echo"] == "q2"
+        assert conn.sock is sock
+    finally:
+        conn.close()
+        httpsvc.install_sql_server(None)
+
+
+def test_keepalive_unacceptable_content_length_400s_and_closes(svc):
+    """A Content-Length past _MAX_BODY is refused WITHOUT draining —
+    the handler must advertise Connection: close, not pretend the
+    stream is still framed."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", svc, timeout=10)
+    try:
+        conn.putrequest("POST", "/sql")
+        conn.putheader("Content-Length", str(httpsvc._MAX_BODY + 1))
+        conn.endheaders()
+        r = conn.getresponse()
+        assert r.status == 400
+        assert r.will_close  # Connection: close advertised
+        r.read()
+    finally:
+        conn.close()
+
+
 def test_conf_gated_autostart():
     from auron_tpu.utils.config import Configuration
 

@@ -36,6 +36,13 @@ from auron_tpu.utils.config import (
 TOY_SF = 0.02
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _suite_leak_canary(leak_canary):
+    """Tier-1 leak canary (conftest): runtimes/resource-map/obs rings
+    must return to their pre-suite baselines after this module."""
+    yield
+
+
 @pytest.fixture(scope="module")
 def frames():
     data = tpcds.generate(sf=TOY_SF, seed=42)
@@ -44,7 +51,15 @@ def frames():
 
 @pytest.fixture(scope="module")
 def server(frames):
-    return SqlServer(sqlgate.gate_catalog(), frames, n_parts=2)
+    srv = SqlServer(sqlgate.gate_catalog(), frames, n_parts=2)
+    yield srv
+    # in-flight upload events: every entry that is still resident must
+    # have released its waiters (a cleared event after the builder
+    # returned = the PR-12 stuck-waiter shape)
+    with srv._res_lock:
+        stuck = [k for k, ent in srv._res_cache.items()
+                 if not ent["done"].is_set() or ent["val"] is None]
+    assert not stuck, f"resource-map entries with unreleased waiters: {stuck}"
 
 
 def _sql(name):

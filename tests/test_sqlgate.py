@@ -27,6 +27,13 @@ TOY_SF = 0.02
 SUBSET = ["q3", "q34", "q59", "q96", "q5a", "q93a"]
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _suite_leak_canary(leak_canary):
+    """Tier-1 leak canary (conftest): runtimes/resource-map/obs rings
+    must return to their pre-suite baselines after this module."""
+    yield
+
+
 @pytest.fixture(scope="module")
 def catalog():
     return sqlgate.gate_catalog()

@@ -1,6 +1,6 @@
 """auronlint — engine-invariant static analysis for the JAX/TPU side.
 
-Ten rule families over ``auron_tpu/`` (see docs/auronlint.md):
+Thirteen rule families over ``auron_tpu/`` (see docs/auronlint.md):
 
   R1  host-sync hygiene      implicit device->host transfers
   R2  retrace discipline     bounded jit compile cache
@@ -12,15 +12,23 @@ Ten rule families over ``auron_tpu/`` (see docs/auronlint.md):
   R8  lock discipline        cross-root shared writes must hold a lock
   R9  sync-budget proof      declared budgets vs static multiplicity
   R10 jit purity             no effects/context reads inside traces
+  R11 resource lifecycle     every acquire reaches its release on every
+                             path, exception edges included
+  R12 error-path discipline  boundary routing; no swallowed unwinds in
+                             server/foreign-reachable code
+  R13 retrace stability      jit cache keys drawn from finite sets
+                             (vacuity-checked coverage floors)
 
-R7-R10 are interprocedural: a package-wide call graph + per-function
+R7-R13 are interprocedural: a package-wide call graph + per-function
 summaries (tools/auronlint/callgraph.py, summaries.py) with reachability
-from in-source ``thread-root`` declarations. Run as ``make lint`` /
+from in-source ``thread-root`` declarations; R11/R12 additionally use
+per-function CFGs with exception edges (cfg.py). Run as ``make lint`` /
 ``python -m tools.auronlint`` (``make lint-changed`` for the per-file
-fast mode); gated in tier-1 by ``tests/test_auronlint.py`` with
-suppression counts ratcheted via LINT_RATCHET.json (ratchet.py). Shares
-its finding/report schema — JSON and SARIF — with ``tools/jvm_lint.py``
-(tools/auronlint/report.py).
+fast mode); full-tree runs are incremental via the persistent
+parse/summary cache (filecache.py); gated in tier-1 by
+``tests/test_auronlint.py`` with suppression counts ratcheted via
+LINT_RATCHET.json (ratchet.py). Shares its finding/report schema — JSON
+and SARIF — with ``tools/jvm_lint.py`` (tools/auronlint/report.py).
 """
 
 from __future__ import annotations
@@ -36,8 +44,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 
 def run_tree(root: str | None = None, rules=ALL_RULES) -> Report:
     """Lint the whole engine tree (the `make lint` / tier-1 entry point)."""
+    from tools.auronlint.filecache import save_all
+
     root = root or REPO_ROOT
-    return lint_paths([os.path.join(root, "auron_tpu")], root, rules)
+    report = lint_paths([os.path.join(root, "auron_tpu")], root, rules)
+    # persist the parse/summary cache the run just built/validated so
+    # the NEXT full-tree run (tier-1, make lint) starts warm
+    save_all()
+    return report
 
 
 __all__ = [

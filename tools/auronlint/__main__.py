@@ -1,10 +1,14 @@
 """CLI: python -m tools.auronlint [paths...] [--json|--sarif] [--changed]
+                                  [--sarif-out PATH] [--time-budget S]
 
 Exit status 0 = zero unsuppressed findings AND no lint-ratchet regression
-(the `make lint` contract). Full-tree runs (no paths, no --changed)
-enforce LINT_RATCHET.json: per-rule suppressed-finding counts and the
-sync-point/guarded-by declaration counts may only shrink; improvements
-are persisted automatically, regressions fail the run.
+(the `make lint` contract) AND wall time within --time-budget when one
+is set. Full-tree runs (no paths, no --changed) enforce
+LINT_RATCHET.json: per-rule suppressed-finding counts and the
+sync-point/guarded-by/owned-by declaration counts may only shrink;
+improvements are persisted automatically, regressions fail the run.
+--sarif-out writes the SARIF artifact to a stable path for CI pickup
+regardless of the exit status.
 
 --changed lints only files touched per `git status` (the `make
 lint-changed` inner loop): per-file rules only — the interprocedural
@@ -19,6 +23,8 @@ import argparse
 import os
 import subprocess
 import sys
+import tempfile
+import time
 
 
 def _changed_paths(root: str) -> list[str] | None:
@@ -55,6 +61,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--json", action="store_true", help="machine-readable report")
     p.add_argument("--sarif", action="store_true",
                    help="SARIF 2.1.0 report (CI annotations)")
+    p.add_argument("--sarif-out", metavar="PATH",
+                   help="ALSO write the SARIF report to PATH (stable CI "
+                        "artifact location; temp + os.replace)")
+    p.add_argument("--time-budget", type=float, metavar="SECONDS",
+                   help="fail when the run's wall time exceeds SECONDS "
+                        "(tier-1 guard: a rule must not blow up the gate)")
     p.add_argument("--show-suppressed", action="store_true")
     p.add_argument("--rules", help="comma-separated rule ids (default: all)")
     p.add_argument("--changed", action="store_true",
@@ -63,6 +75,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no-ratchet", action="store_true",
                    help="skip LINT_RATCHET.json enforcement on a full run")
     args = p.parse_args(argv)
+    t_start = time.perf_counter()
 
     rules = ALL_RULES
     if args.rules:
@@ -108,6 +121,28 @@ def main(argv: list[str] | None = None) -> int:
     if ratchet_eligible and not args.no_ratchet:
         ratchet_problems = check_and_update(report, REPO_ROOT)
 
+    # persist the parse/summary cache for every mode (--changed warms the
+    # files it touched; run_tree already flushed, this is then a no-op)
+    from tools.auronlint.filecache import save_all
+
+    save_all()
+
+    if args.sarif_out:
+        # stable artifact path for CI: temp + os.replace so a crashed
+        # run never leaves a truncated artifact (the _save_ratchet
+        # lesson), and the file exists even when the run fails
+        out = os.path.abspath(args.sarif_out)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(out),
+                                   prefix=os.path.basename(out) + ".")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(report.to_sarif())
+            os.replace(tmp, out)
+        except BaseException:
+            os.unlink(tmp)
+            raise
+
     if args.sarif:
         print(report.to_sarif())
     elif args.json:
@@ -116,7 +151,18 @@ def main(argv: list[str] | None = None) -> int:
         print(report.render(show_suppressed=args.show_suppressed))
     for prob in ratchet_problems:
         print(prob, file=sys.stderr)
-    return 0 if report.ok() and not ratchet_problems else 1
+
+    over_budget = False
+    if args.time_budget is not None:
+        wall = time.perf_counter() - t_start
+        if wall > args.time_budget:
+            print(f"auronlint: wall time {wall:.1f}s exceeded the "
+                  f"--time-budget {args.time_budget:.1f}s (a rule pass "
+                  "is blowing up the gate — profile it or raise the "
+                  "budget consciously)", file=sys.stderr)
+            over_budget = True
+    return 0 if report.ok() and not ratchet_problems and not over_budget \
+        else 1
 
 
 if __name__ == "__main__":

@@ -396,17 +396,19 @@ def build_graph(root: str, subdir: str = "auron_tpu") -> CallGraph:
     hit = _cache.get(base)
     if hit is not None and hit[0] == sig:
         return hit[1]
-    mods = []
+    from tools.auronlint.filecache import file_cache
+
+    fc = file_cache(root)
+    g = CallGraph()
     for path in files:
         rel = os.path.relpath(path, root).replace("\\", "/")
         if rel in EXCLUDED_RELS:
             continue
         try:
-            with open(path, encoding="utf-8") as f:
-                mods.append(SourceModule(path, rel, f.read()))
+            g.add_module(fc.summary(path, rel))
         except (OSError, SyntaxError):
             continue  # lint.parse finding comes from the runner
-    g = build_graph_from_modules(mods)
+    g.finalize()
     _cache[base] = (sig, g)
     return g
 

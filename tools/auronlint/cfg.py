@@ -1,0 +1,472 @@
+"""Per-function control-flow graphs WITH exception edges (R11/R12).
+
+The interprocedural layer (callgraph.py / summaries.py) answers "who can
+call whom, from which thread"; what it cannot answer is the question both
+PR-12 review rounds had to settle by hand: *does this acquisition reach
+its release on every path out of the function — including the paths an
+exception takes?* That is a per-function control-flow property, so this
+module adds the missing layer: a small statement-level CFG per function
+with explicit exception edges, built once per function and shared by the
+lifecycle rule (R11) and the error-path rule (R12).
+
+Model (deliberately over-approximate, like everything in this linter —
+extra paths can only surface extra questions, never hide a leak):
+
+- nodes are statements plus synthetic ``entry`` / ``exit`` (normal
+  return) / ``raise`` (an exception ESCAPES the function) nodes;
+- any statement that does real work (contains a call, attribute access,
+  subscript, arithmetic, ``raise``, ``assert``, ``yield`` — a ``yield``
+  can raise GeneratorExit when the consumer abandons the generator) gets
+  an exception edge to the innermost enclosing handler set, or to
+  ``raise`` when nothing broad encloses it;
+- ``except`` clauses catch per their declared breadth: a bare / broad
+  handler (``Exception``, ``BaseException``) stops propagation, narrow
+  handlers let the exception ALSO continue outward (we cannot type
+  exceptions statically);
+- ``finally`` bodies are single regions whose exits connect to every
+  continuation that can traverse them (normal fall-through, exception
+  re-raise, ``return``/``break``/``continue`` unwinds) — merging those
+  continuations loses path correlation but only ADDS paths;
+- ``with`` is try/finally with a synthetic ``with-exit`` node; the
+  lifecycle analysis treats a resource used as a context manager as
+  released at that node.
+
+The exported analysis, :func:`leak_paths`, does plain reachability over
+this graph: from an acquisition node, can ``exit`` or ``raise`` be
+reached without passing a release node? Each reachable escape is a leak
+witness with its kind ("a normal path" / "an exception path").
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+#: exception names a handler may declare that stop ANY exception
+BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+@dataclass
+class Node:
+    idx: int
+    kind: str                  # "entry" | "exit" | "raise" | "stmt" | "withexit" | "findispatch"
+    stmt: ast.AST | None = None
+    line: int = 0
+    succ: set = field(default_factory=set)       # normal-flow successors
+    exc_succ: set = field(default_factory=set)   # exception-flow successors
+
+
+class FuncCFG:
+    def __init__(self):
+        self.nodes: list[Node] = []
+        self.entry = self._add("entry")
+        self.exit = self._add("exit")
+        self.raised = self._add("raise")
+        #: with-exit node idx -> list of context-manager var/expr info
+        self.with_exits: dict[int, list] = {}
+
+    def _add(self, kind: str, stmt: ast.AST | None = None) -> int:
+        n = Node(len(self.nodes), kind, stmt,
+                 getattr(stmt, "lineno", 0) if stmt is not None else 0)
+        self.nodes.append(n)
+        return n.idx
+
+    def node(self, idx: int) -> Node:
+        return self.nodes[idx]
+
+    def successors(self, idx: int):
+        n = self.nodes[idx]
+        return n.succ | n.exc_succ
+
+    def stmt_nodes(self):
+        return [n for n in self.nodes if n.stmt is not None]
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+
+    def name_of(e):
+        if isinstance(e, ast.Name):
+            return e.id
+        if isinstance(e, ast.Attribute):
+            return e.attr
+        return ""
+
+    if isinstance(t, ast.Tuple):
+        return any(name_of(e) in BROAD_EXC_NAMES for e in t.elts)
+    return name_of(t) in BROAD_EXC_NAMES
+
+
+_SIMPLE_EXPRS = (ast.Constant, ast.Name)
+
+
+def _is_safe_expr(expr: ast.AST) -> bool:
+    """Expressions whose evaluation cannot (realistically) raise:
+    constants, name loads, plain attribute chains (a raising property is
+    outside this linter's pragmatism), `not`/`is` forms over the same,
+    and container literals of the same."""
+    if isinstance(expr, _SIMPLE_EXPRS):
+        return True
+    if isinstance(expr, ast.Attribute):
+        return _is_safe_expr(expr.value)
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return _is_safe_expr(expr.operand)
+    if isinstance(expr, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops) \
+            and _is_safe_expr(expr.left) \
+            and all(_is_safe_expr(c) for c in expr.comparators)
+    if isinstance(expr, (ast.List, ast.Tuple, ast.Set)):
+        return all(_is_safe_expr(e) for e in expr.elts)
+    if isinstance(expr, ast.Dict):
+        return all(k is not None and _is_safe_expr(k) for k in expr.keys) \
+            and all(_is_safe_expr(v) for v in expr.values)
+    return False
+
+
+def may_raise(stmt: ast.AST) -> bool:
+    """Could executing this statement raise? Over-approximate: anything
+    touching attributes, subscripts, calls or operators can (descriptors,
+    __getitem__, __add__ ...). Only trivially-safe statements are exempt."""
+    if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                         ast.Nonlocal, ast.Import, ast.ImportFrom)):
+        # imports can raise, but an ImportError there is a deployment
+        # problem, not a lifecycle path — modeling it drowns the signal
+        return False
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, ast.Return):
+        return stmt.value is not None and not _is_safe_expr(stmt.value)
+    if isinstance(stmt, ast.Assign):
+        def safe_target(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return all(safe_target(e) for e in t.elts)
+            return isinstance(t, ast.Name) or (
+                isinstance(t, ast.Attribute) and _is_safe_expr(t.value)
+            )
+
+        return not (
+            _is_safe_expr(stmt.value)
+            and all(safe_target(t) for t in stmt.targets)
+        )
+    if isinstance(stmt, ast.Expr):
+        return not _is_safe_expr(stmt.value)
+    return True
+
+
+@dataclass
+class _Env:
+    """Where non-linear control transfers go from the current region."""
+
+    exc: tuple            # node idxs an escaping exception flows to
+    ret: tuple            # node idxs a `return` flows to (finally chain -> exit)
+    brk: list | None      # collector list for `break` frontier
+    cont: tuple | None    # node idxs `continue` flows to
+
+
+def build_cfg(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> FuncCFG:
+    cfg = FuncCFG()
+    env = _Env(exc=(cfg.raised,), ret=(cfg.exit,), brk=None, cont=None)
+    frontier = _seq(cfg, fn.body, {cfg.entry}, env)
+    for f in frontier:
+        cfg.nodes[f].succ.add(cfg.exit)
+    return cfg
+
+
+def _seq(cfg: FuncCFG, stmts: list, frontier: set, env: _Env) -> set:
+    for stmt in stmts:
+        frontier = _stmt(cfg, stmt, frontier, env)
+        if not frontier:
+            break  # unreachable code after return/raise/break/continue
+    return frontier
+
+
+def _link(cfg: FuncCFG, frontier: set, node: int) -> None:
+    for f in frontier:
+        cfg.nodes[f].succ.add(node)
+
+
+def _stmt(cfg: FuncCFG, stmt: ast.AST, frontier: set, env: _Env) -> set:
+    # nested defs/classes: their bodies are separate CFGs (built by the
+    # caller per function); the def statement itself is a plain binding
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        node = cfg._add("stmt", stmt)
+        _link(cfg, frontier, node)
+        return {node}
+
+    if isinstance(stmt, ast.If):
+        node = cfg._add("stmt", stmt)  # test evaluation
+        _link(cfg, frontier, node)
+        if not _is_safe_expr(stmt.test):
+            cfg.nodes[node].exc_succ.update(env.exc)
+        out = _seq(cfg, stmt.body, {node}, env)
+        if stmt.orelse:
+            out |= _seq(cfg, stmt.orelse, {node}, env)
+        else:
+            out |= {node}
+        return out
+
+    if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+        header = cfg._add("stmt", stmt)  # test / iterator advance
+        _link(cfg, frontier, header)
+        cfg.nodes[header].exc_succ.update(env.exc)
+        brk_frontier: list = []
+        inner = _Env(exc=env.exc, ret=env.ret, brk=brk_frontier,
+                     cont=(header,))
+        body_out = _seq(cfg, stmt.body, {header}, inner)
+        _link(cfg, body_out, header)  # back edge
+        out = {header} | set(brk_frontier)
+        if stmt.orelse:
+            out = _seq(cfg, stmt.orelse, out, env)
+        return out
+
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        header = cfg._add("stmt", stmt)  # context-expr evaluation + __enter__
+        _link(cfg, frontier, header)
+        cfg.nodes[header].exc_succ.update(env.exc)
+        # TWO exit nodes so the exceptional traversal cannot bleed into
+        # the normal one: wexit_n resumes the fall-through (and unwinding
+        # returns/breaks/continues — merged, which only ADDS paths the
+        # body's own transfer statements already take); wexit_e carries a
+        # body exception outward after __exit__ ran. __exit__ itself is
+        # assumed non-raising on the normal path — without that, every
+        # acquisition inside a with block would "leak" through its lock's
+        # __exit__.
+        wexit_n = cfg._add("withexit", stmt)
+        wexit_e = cfg._add("withexit", stmt)
+        # the header too: entering `with resource:` hands the resource to
+        # the with statement structurally (if __enter__ raises, cleanup
+        # is the context manager's own contract, not this function's)
+        cfg.with_exits[header] = list(stmt.items)
+        cfg.with_exits[wexit_n] = list(stmt.items)
+        cfg.with_exits[wexit_e] = list(stmt.items)
+        inner = _Env(exc=(wexit_e,), ret=(wexit_n,),
+                     brk=[wexit_n] if env.brk is not None else None,
+                     cont=(wexit_n,) if env.cont is not None else None)
+        body_out = _seq(cfg, stmt.body, {header}, inner)
+        _link(cfg, body_out, wexit_n)
+        cfg.nodes[wexit_e].exc_succ.update(env.exc)
+        if _contains_transfer(stmt.body, ast.Return):
+            cfg.nodes[wexit_n].succ.update(env.ret)
+        if env.brk is not None and _contains_transfer(stmt.body, ast.Break):
+            env.brk.append(wexit_n)
+        if env.cont is not None and _contains_transfer(stmt.body, ast.Continue):
+            cfg.nodes[wexit_n].succ.update(env.cont)
+        return {wexit_n}
+
+    if isinstance(stmt, ast.Try):
+        return _try(cfg, stmt, frontier, env)
+
+    # ---- simple statements ------------------------------------------------
+    node = cfg._add("stmt", stmt)
+    _link(cfg, frontier, node)
+    if may_raise(stmt):
+        cfg.nodes[node].exc_succ.update(env.exc)
+
+    if isinstance(stmt, ast.Return):
+        cfg.nodes[node].succ.update(env.ret)
+        return set()
+    if isinstance(stmt, ast.Raise):
+        cfg.nodes[node].succ.update(env.exc)
+        return set()
+    if isinstance(stmt, ast.Break):
+        if env.brk is not None:
+            env.brk.append(node)
+        return set()
+    if isinstance(stmt, ast.Continue):
+        if env.cont is not None:
+            cfg.nodes[node].succ.update(env.cont)
+        return set()
+    return {node}
+
+
+def _contains_transfer(stmts: list, kind: type) -> bool:
+    """Does this region lexically contain a Return/Break/Continue that
+    transfers OUT of it? Nested defs are separate scopes; nested loops
+    capture their own break/continue."""
+
+    def scan(nodes) -> bool:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, kind):
+                return True
+            if kind in (ast.Break, ast.Continue) and isinstance(
+                node, (ast.For, ast.AsyncFor, ast.While)
+            ):
+                continue  # its breaks/continues bind to it
+            if scan(ast.iter_child_nodes(node)):
+                return True
+        return False
+
+    return scan(stmts)
+
+
+def _try(cfg: FuncCFG, stmt: ast.Try, frontier: set, env: _Env) -> set:
+    has_broad = any(_handler_is_broad(h) for h in stmt.handlers)
+    guarded = stmt.body + [s for h in stmt.handlers for s in h.body] \
+        + stmt.orelse
+
+    # finally region (if any): TWO copies of the body so the exceptional
+    # traversal (exception propagating outward after the finally ran)
+    # never bleeds into the normal continuation — one shared copy would
+    # route every try/finally's fall-through to the raise node.
+    # Statements INSIDE a finally get no exception edges of their own:
+    # "cleanup step 1 raised, skipping cleanup step 2" is the
+    # unwind-internal-failure class, and modeling it would demand a
+    # nested try per cleanup line — noise, not signal (the deliberate
+    # compromise; handler bodies stay fully modeled).
+    if stmt.finalbody:
+        fin_env = _Env(exc=(), ret=env.ret, brk=env.brk, cont=env.cont)
+        # normal copy: fall-through + return/break/continue unwinds
+        fin_in = cfg._add("findispatch", stmt)
+        fin_out = _seq(cfg, stmt.finalbody, {fin_in}, fin_env)
+        for f in fin_out:
+            if _contains_transfer(guarded, ast.Return):
+                cfg.nodes[f].succ.update(env.ret)
+            if env.cont is not None and _contains_transfer(
+                guarded, ast.Continue
+            ):
+                cfg.nodes[f].succ.update(env.cont)
+        if env.brk is not None and fin_out and _contains_transfer(
+            guarded, ast.Break
+        ):
+            env.brk.extend(fin_out)
+        # exceptional copy: entered from escaping exceptions, re-raises
+        fin_in_exc = cfg._add("findispatch", stmt)
+        fin_out_exc = _seq(cfg, stmt.finalbody, {fin_in_exc}, fin_env)
+        for f in fin_out_exc:
+            cfg.nodes[f].exc_succ.update(env.exc)
+        outer_exc: tuple = (fin_in_exc,)
+        outer_ret: tuple = (fin_in,)
+        outer_brk = [fin_in] if env.brk is not None else None
+        outer_cont = (fin_in,) if env.cont is not None else None
+    else:
+        fin_in = None
+        fin_out = set()
+        outer_exc = env.exc
+        outer_ret = env.ret
+        outer_brk = env.brk
+        outer_cont = env.cont
+
+    # handler heads: where exceptions from the body dispatch
+    handler_heads = []
+    for h in stmt.handlers:
+        head = cfg._add("stmt", h)
+        handler_heads.append(head)
+    body_exc = tuple(handler_heads) + (() if has_broad or not stmt.handlers
+                                       else outer_exc)
+    if not stmt.handlers:
+        body_exc = outer_exc
+
+    body_env = _Env(exc=body_exc, ret=outer_ret, brk=outer_brk,
+                    cont=outer_cont)
+    body_out = _seq(cfg, stmt.body, frontier, body_env)
+
+    # handler bodies run with the OUTER exception env (their own raises
+    # propagate past this try, through the finally when present)
+    handler_env = _Env(exc=outer_exc, ret=outer_ret, brk=outer_brk,
+                       cont=outer_cont)
+    out = set()
+    for h, head in zip(stmt.handlers, handler_heads):
+        out |= _seq(cfg, h.body, {head}, handler_env)
+
+    if stmt.orelse:
+        body_out = _seq(cfg, stmt.orelse, body_out, body_env)
+    out |= body_out
+
+    if fin_in is not None:
+        _link(cfg, out, fin_in)
+        return set(fin_out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reachability / leak analysis
+# ---------------------------------------------------------------------------
+
+
+def leak_paths(cfg: FuncCFG, acquire_node: int, release_nodes: set) -> list[str]:
+    """Escape kinds reachable from ``acquire_node`` without passing a
+    release: subset of {"a normal path", "an exception path"}. A release
+    node KILLS the traversal (the resource is safe past it). Traversal
+    starts from the acquire's NORMAL successors only — if the acquiring
+    statement itself raises, the resource was never produced."""
+    seen = set()
+    stack = list(cfg.node(acquire_node).succ)
+    found = set()
+    while stack:
+        u = stack.pop()
+        if u in seen or u in release_nodes:
+            continue
+        seen.add(u)
+        if u == cfg.exit:
+            found.add("a normal path")
+            continue
+        if u == cfg.raised:
+            found.add("an exception path")
+            continue
+        stack.extend(cfg.successors(u))
+    order = {"an exception path": 0, "a normal path": 1}
+    return sorted(found, key=order.get)
+
+
+def reaches_raise_uncovered(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """For thread-entry functions (R12): the first line of a statement
+    that can raise while covered by NO try at all — an exception there
+    escapes the function and kills its thread silently. ``finally`` and
+    ``except`` bodies are exempt (they ARE the boundary's unwind code),
+    as are nested defs (separate CFGs)."""
+
+    def scan(stmts, covered: bool):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Try):
+                inner_covered = covered or bool(stmt.handlers)
+                hit = scan(stmt.body, inner_covered)
+                if hit:
+                    return hit
+                hit = scan(stmt.orelse, inner_covered)
+                if hit:
+                    return hit
+                continue  # handler/finally bodies exempt
+            if isinstance(stmt, ast.If):
+                if not covered and not _is_safe_expr(stmt.test):
+                    return stmt.lineno
+                for part in (stmt.body, stmt.orelse):
+                    hit = scan(part, covered)
+                    if hit:
+                        return hit
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if not covered:
+                    return stmt.lineno  # context expr / __enter__ may raise
+                hit = scan(stmt.body, covered)
+                if hit:
+                    return hit
+                continue
+            if isinstance(stmt, ast.While):
+                if not covered and not _is_safe_expr(stmt.test):
+                    return stmt.lineno
+                for part in (stmt.body, stmt.orelse):
+                    hit = scan(part, covered)
+                    if hit:
+                        return hit
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if not covered:
+                    return stmt.lineno  # the iterator advance may raise
+                for part in (stmt.body, stmt.orelse):
+                    hit = scan(part, covered)
+                    if hit:
+                        return hit
+                continue
+            if not covered and may_raise(stmt):
+                return stmt.lineno
+        return None
+
+    return scan(fn.body, False)

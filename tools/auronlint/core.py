@@ -32,6 +32,7 @@ docs/auronlint.md)::
     def _pump(self):        # auronlint: thread-root(conf-scoped) -- task pump installs conf_scope
     def spill(self) -> int: # auronlint: thread-root(foreign) -- MemManager dispatches cross-thread
     self.n += 1             # auronlint: guarded-by(self._lock) -- caller holds the table lock
+    ds = make_spill(conf=c) # auronlint: owned-by(self.parked) -- drained+released by drain()/finally
 
 ``thread-root`` marks a function as a thread entry point the call-graph
 reachability (tools/auronlint/callgraph.py) starts from: ``foreign`` =
@@ -75,7 +76,7 @@ _HOST_RETURNING = {
 _SUPPRESS_RE = re.compile(
     r"#\s*auronlint:\s*"
     r"(disable|disable-function|sync-point|sort-payload|thread-root"
-    r"|guarded-by|thread-owned)"
+    r"|guarded-by|thread-owned|owned-by)"
     r"(?:\((?P<budget>[^)]*)\))?"
     r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
     r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
@@ -191,8 +192,9 @@ class SourceModule:
                 # the parenthesized argument is the root kind and is required
                 if budget not in THREAD_ROOT_KINDS:
                     self.bad_budgets.append(line)
-            elif kind == "guarded-by":
-                # the argument names the protecting lock and is required
+            elif kind in ("guarded-by", "owned-by"):
+                # the argument names the protecting lock / the owner that
+                # releases the resource, and is required
                 if not budget:
                     self.bad_budgets.append(line)
             elif budget and (
@@ -248,6 +250,13 @@ class SourceModule:
                 if rule == "R6" and line in self._lines_covered(sup):
                     return sup
                 continue
+            if sup.kind == "owned-by":
+                # dedicated lifecycle hand-off declaration (sort-payload's
+                # twin): the named holder releases the resource on paths
+                # R11 cannot see — suppresses R11 only
+                if rule == "R11" and line in self._lines_covered(sup):
+                    return sup
+                continue
             if sup.covers_rule(rule) and line in self._lines_covered(sup):
                 return sup
         return None
@@ -269,6 +278,16 @@ class SourceModule:
         """The guarded-by declaration covering a write site, if any."""
         for s in self.suppressions:
             if s.kind == "guarded-by" and line in self._lines_covered(s):
+                return s
+        return None
+
+    def owner_for(self, line: int) -> Suppression | None:
+        """The owned-by declaration covering an acquisition site, if any:
+        ``# auronlint: owned-by(<holder>) -- <why>`` asserts that the
+        named holder releases the resource on every path R11 cannot see
+        (a container drained elsewhere, a caller contract)."""
+        for s in self.suppressions:
+            if s.kind == "owned-by" and line in self._lines_covered(s):
                 return s
         return None
 
@@ -517,6 +536,10 @@ def lint_paths(paths: list[str], root: str, rules) -> Report:
             files += iter_py_files(p)
         else:
             files.append(p)
+    # late import: filecache imports summaries which imports this module
+    from tools.auronlint.filecache import file_cache
+
+    fc = file_cache(root)
     seen = set()
     modules: dict[str, SourceModule] = {}
     for path in files:
@@ -525,9 +548,7 @@ def lint_paths(paths: list[str], root: str, rules) -> Report:
             continue
         seen.add(rel)
         try:
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            mod = SourceModule(path, rel, src)
+            mod = fc.module(path, rel)
             modules[rel] = mod
         except (OSError, SyntaxError) as e:
             report.findings.append(Finding(
@@ -545,11 +566,13 @@ def lint_paths(paths: list[str], root: str, rules) -> Report:
             report.findings.append(Finding(
                 TOOL, "lint.suppression", rel, line,
                 "malformed annotation argument (sync-point(<count>/batch|"
-                "<count>/task|call), thread-root(foreign|conf-scoped) or "
-                "guarded-by(<lock>) -- <why>)",
+                "<count>/task|call), thread-root(foreign|conf-scoped), "
+                "guarded-by(<lock>) or owned-by(<holder>) -- <why>)",
             ))
         for rule in rules:
-            for line, message in rule.check_module(mod):
+            if type(rule).check_module is Rule.check_module:
+                continue  # tree-only rule: nothing per-file to run
+            for line, message in fc.rule_findings(rel, rule, mod):
                 sup = mod.suppression_for(rule.name, line)
                 report.findings.append(Finding(
                     TOOL, rule.name, rel, line, message,
@@ -566,8 +589,7 @@ def lint_paths(paths: list[str], root: str, rules) -> Report:
                 # them so their suppressions still apply
                 try:
                     fp = os.path.join(root, rel)
-                    with open(fp, encoding="utf-8") as f:
-                        mod = modules[rel] = SourceModule(fp, rel, f.read())
+                    mod = modules[rel] = fc.module(fp, rel)
                 except (OSError, SyntaxError):
                     mod = None
             if mod is not None and line:

@@ -1,0 +1,225 @@
+"""Persistent per-file parse/summary cache for `make lint` / tier-1.
+
+The full-tree run used to re-parse and re-summarize every module in
+``auron_tpu/`` on every invocation — twice, in fact: once for the
+per-file rules (core.lint_paths) and once for the call graph
+(callgraph.build_graph). This module gives both paths ONE loader:
+
+- in-process: each file is parsed at most once per run, shared between
+  the runner and the graph builder;
+- across runs: ``ModuleSummary`` objects (which carry their
+  ``SourceModule``, AST included) are pickled to ``.auronlint.cache``
+  at the repo root, keyed per file by ``(mtime_ns, size)``. A warm
+  tier-1 run unpickles the unchanged package instead of re-parsing it;
+- per-file rule findings ride the same entries: ``check_module`` is a
+  pure function of the source, so an unchanged file's findings replay
+  without running the rule at all (the tree rules R4/R7-R13 always run
+  — their input is the whole package, not one file).
+
+Invalidation is two-level: a per-file stat signature, and a whole-cache
+digest over the linter's OWN sources (``tools/auronlint/**/*.py``) — a
+rule edit must never serve stale summaries, and nobody remembers to
+bump a version constant (the jvm_lint ABI-pin lesson).
+
+The cache file is written via temp + ``os.replace`` (the
+``_save_ratchet`` lesson: a crashed run must leave either the old cache
+or the new one, never a truncated pickle) and is advisory everywhere: a
+missing, corrupt, or version-skewed cache means a cold run, never a
+failure. ``AURONLINT_CACHE=0`` disables it entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+
+CACHE_BASENAME = ".auronlint.cache"
+_PICKLE_PROTO = 4
+
+
+def _enabled() -> bool:
+    return os.environ.get("AURONLINT_CACHE", "1") != "0"
+
+
+def _tools_digest() -> str:
+    """Content digest of the linter's own package: any rule/core edit
+    invalidates every cached summary."""
+    base = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for r, dirs, files in os.walk(base):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for f in sorted(files):
+            if f.endswith(".py"):
+                p = os.path.join(r, f)
+                h.update(os.path.relpath(p, base).encode())
+                with open(p, "rb") as fh:
+                    h.update(fh.read())
+    return h.hexdigest()
+
+
+class FileCache:
+    """One repo root's parse/summary store. ``summary()`` is the single
+    entry point; everything else is plumbing around it."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.path = os.path.join(root, CACHE_BASENAME)
+        #: rel -> (sig, ModuleSummary) produced or unpickled THIS
+        #: process; the sig re-validates on every lookup so a file
+        #: rewritten mid-process (fixture trees, watch loops) re-parses
+        self._live: dict = {}
+        #: rel -> {"sig": (mtime_ns, size), "ms": pickled ModuleSummary,
+        #:         "findings": {rule name: [(line, message), ...]}}
+        self._disk: dict[str, dict] = {}
+        #: rels whose disk entry matched this run's stat signature —
+        #: only their cached per-rule findings are trustworthy
+        self._disk_valid: set[str] = set()
+        #: rel -> {rule name: findings} produced/validated THIS process
+        self._findings: dict[str, dict] = {}
+        self._digest = _tools_digest()
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        if _enabled():
+            self._load()
+
+    # -- disk ---------------------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "rb") as f:
+                payload = pickle.load(f)
+            if (payload.get("digest") == self._digest
+                    and payload.get("proto") == _PICKLE_PROTO):
+                self._disk = payload["files"]
+        except (OSError, pickle.UnpicklingError, EOFError, KeyError,
+                AttributeError, ImportError, IndexError, ValueError):
+            # advisory: any skew or corruption = cold run
+            self._disk = {}
+
+    def save(self) -> None:
+        """Persist every summary built/validated this run, merged over
+        the prior entries (a --changed run must not evict the rest of
+        the tree). Temp + os.replace; failures are silent — the cache
+        must never fail the lint run that feeds it."""
+        if not _enabled() or not self._dirty:
+            return
+        files = dict(self._disk)
+        for rel, (sig, ms) in self._live.items():
+            # the sig captured when the summary was BUILT, not a fresh
+            # stat: a file rewritten after its lint must not get the old
+            # summary filed under the new signature
+            if sig is None:
+                continue
+            old = files.get(rel) if rel in self._disk_valid else None
+            findings = dict(old["findings"]) if old else {}
+            findings.update(self._findings.get(rel, {}))
+            files[rel] = {
+                "sig": sig,
+                "ms": pickle.dumps(ms, protocol=_PICKLE_PROTO),
+                "findings": findings,
+            }
+        payload = {"digest": self._digest, "proto": _PICKLE_PROTO,
+                   "files": files}
+        try:
+            fd, tmp = tempfile.mkstemp(
+                dir=self.root, prefix=CACHE_BASENAME + ".")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(payload, f, protocol=_PICKLE_PROTO)
+                os.replace(tmp, self.path)
+            except BaseException:
+                os.unlink(tmp)
+                raise
+        except OSError:
+            pass
+        self._dirty = False
+
+    # -- lookup -------------------------------------------------------------
+
+    @staticmethod
+    def _sig(path: str) -> tuple | None:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
+    def summary(self, path: str, rel: str):
+        """The ModuleSummary for one file — from this process, the disk
+        cache, or a fresh parse (raising OSError/SyntaxError exactly
+        like ``SourceModule`` so lint.parse findings still fire)."""
+        from tools.auronlint.core import SourceModule
+        from tools.auronlint.summaries import summarize_module
+
+        sig = self._sig(path)
+        live = self._live.get(rel)
+        if live is not None:
+            if sig is not None and live[0] == sig:
+                return live[1]
+            # the file changed under this process: every derived fact
+            # (findings included) is stale
+            del self._live[rel]
+            self._findings.pop(rel, None)
+            self._disk_valid.discard(rel)
+        hit = self._disk.get(rel) if _enabled() else None
+        if hit is not None and sig is not None and hit["sig"] == sig:
+            try:
+                ms = pickle.loads(hit["ms"])
+                self._live[rel] = (sig, ms)
+                self._disk_valid.add(rel)
+                self.hits += 1
+                return ms
+            except (pickle.UnpicklingError, EOFError, AttributeError,
+                    ImportError, IndexError, ValueError):
+                pass  # corrupt entry: fall through to a fresh parse
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        ms = summarize_module(SourceModule(path, rel, src))
+        self._live[rel] = (sig, ms)
+        self._dirty = True
+        self.misses += 1
+        return ms
+
+    def module(self, path: str, rel: str):
+        """The SourceModule view of the same entry (lint_paths' shape)."""
+        return self.summary(path, rel).mod
+
+    def rule_findings(self, rel: str, rule, mod) -> list:
+        """``list(rule.check_module(mod))`` memoized per (file, rule):
+        per-file rules are pure functions of the source, so an unchanged
+        file's findings replay from the cache. Only trustworthy for rels
+        whose summary came from a matching disk entry; otherwise the
+        rule runs and its result is recorded for the next run."""
+        per_rel = self._findings.setdefault(rel, {})
+        out = per_rel.get(rule.name)
+        if out is not None:
+            return out
+        if rel in self._disk_valid:
+            cached = self._disk[rel].get("findings", {}).get(rule.name)
+            if cached is not None:
+                per_rel[rule.name] = cached
+                return cached
+        out = [(line, message) for line, message in rule.check_module(mod)]
+        per_rel[rule.name] = out
+        self._dirty = True
+        return out
+
+
+_caches: dict[str, FileCache] = {}
+
+
+def file_cache(root: str) -> FileCache:
+    """Process-wide cache instance for one repo root."""
+    fc = _caches.get(root)
+    if fc is None:
+        fc = _caches[root] = FileCache(root)
+    return fc
+
+
+def save_all() -> None:
+    """Flush every instantiated cache (end-of-run hook in __main__)."""
+    for fc in _caches.values():
+        fc.save()

@@ -43,7 +43,8 @@ def current_counts(report, root: str) -> dict[str, int]:
     counts: dict[str, int] = {}
     for f in report.suppressed:
         counts[f.rule] = counts.get(f.rule, 0) + 1
-    decls = {"sync-point": 0, "guarded-by": 0, "thread-owned": 0}
+    decls = {"sync-point": 0, "guarded-by": 0, "thread-owned": 0,
+             "owned-by": 0}
     for ms in build_graph(root).modules.values():
         for s in ms.mod.suppressions:
             if s.kind in decls:

@@ -2,11 +2,14 @@
 one entry here, one section in docs/auronlint.md."""
 
 from tools.auronlint.rules.budgetproof import BudgetProofRule
+from tools.auronlint.rules.errorpath import ErrorPathRule
 from tools.auronlint.rules.host_sync import HostSyncRule
 from tools.auronlint.rules.jitpurity import JitPurityRule
+from tools.auronlint.rules.lifecycle import ResourceLifecycleRule
 from tools.auronlint.rules.lockguard import LockGuardRule
 from tools.auronlint.rules.registry_sync import RegistrySyncRule
 from tools.auronlint.rules.retrace import RetraceRule
+from tools.auronlint.rules.retracestab import RetraceStabilityRule
 from tools.auronlint.rules.shapes import ShapeBucketRule
 from tools.auronlint.rules.sortpayload import SortPayloadRule
 from tools.auronlint.rules.threadctx import ThreadContextRule
@@ -23,16 +26,22 @@ ALL_RULES = (
     LockGuardRule(),
     BudgetProofRule(),
     JitPurityRule(),
+    ResourceLifecycleRule(),
+    ErrorPathRule(),
+    RetraceStabilityRule(),
 )
 
 __all__ = [
     "ALL_RULES",
     "BudgetProofRule",
+    "ErrorPathRule",
     "HostSyncRule",
     "JitPurityRule",
     "LockGuardRule",
     "RegistrySyncRule",
+    "ResourceLifecycleRule",
     "RetraceRule",
+    "RetraceStabilityRule",
     "ShapeBucketRule",
     "SortPayloadRule",
     "ThreadContextRule",

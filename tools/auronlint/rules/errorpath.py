@@ -1,0 +1,215 @@
+"""R12 — error-path discipline in server/foreign-reachable code.
+
+The serving and shuffle layers have a CONTRACT for escaping exceptions:
+an HTTP handler answers 500 (and counts ``queries_err``), the task pump
+relays through ``_error`` + the ``_END`` sentinel, the RSS daemon replies
+an error frame. An exception that instead kills a daemon thread vanishes
+— the client hangs, the queue wedges, nobody ever sees a traceback. R12
+makes the contract static, anchored at the same in-source declarations
+the interprocedural rules use (``thread-root``) plus the thread-creation
+sites the summaries can see:
+
+- **swallowed-broad**: ``except:`` / ``except Exception:`` /
+  ``except BaseException:`` whose body is ONLY ``pass``, in code
+  reachable from any declared thread root. A swallowed broad exception
+  in boundary-reachable code erases the error AND every invariant the
+  unwind was supposed to restore. Narrow swallows (``except OSError:
+  pass`` around a close) are fine.
+- **escaping-thread-entry**: a function that some ``threading.Thread(
+  target=...)`` site actually starts (or an http.server ``do_GET`` /
+  ``do_POST`` handler method) containing may-raise statements covered by
+  NO try at all — the thread dies silently there instead of routing the
+  error through the boundary. ``finally``/``except`` bodies are exempt
+  (they ARE the boundary's unwind code).
+- **raise-skips-unwind**: a manually-acquired lock (``x.acquire()``)
+  whose matching ``x.release()`` is skipped on some exception path out
+  of the function (checked over the exception-aware CFG, cfg.py). Use
+  ``with x:`` — the reason the engine has exactly zero manual acquires.
+
+Deliberate exceptions declare themselves with ``# auronlint:
+disable=R12 -- <why>`` (e.g. a best-effort cleanup whose failure is
+strictly secondary to the error already propagating).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.auronlint.cfg import (
+    build_cfg, leak_paths, reaches_raise_uncovered,
+)
+from tools.auronlint.core import Rule
+
+#: with-items / receivers that read as a lock for the manual-acquire check
+_LOCK_NAME_RE = re.compile(r"lock|mutex|guard|_cv\b|cond|sem", re.IGNORECASE)
+
+
+class ErrorPathRule(Rule):
+    name = "R12"
+    doc = "error-path discipline: boundary routing, no swallowed unwinds"
+
+    def check_tree(self, root: str):
+        from tools.auronlint.callgraph import build_graph
+
+        yield from analyze(build_graph(root))
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+
+    def nm(e):
+        return e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else "")
+
+    if isinstance(t, ast.Tuple):
+        return any(nm(e) in ("Exception", "BaseException") for e in t.elts)
+    return nm(t) in ("Exception", "BaseException")
+
+
+def _body_is_pass(h: ast.ExceptHandler) -> bool:
+    return all(isinstance(s, ast.Pass) for s in h.body)
+
+
+def _find_def(ms, fs):
+    for n in ast.walk(ms.mod.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.lineno == fs.lineno and n.name == fs.name:
+            return n
+    return None
+
+
+def _thread_targets(ms) -> dict[str, int]:
+    """Function qualnames this module hands to ``threading.Thread(
+    target=...)`` (the functions whose escaping exceptions kill a thread
+    with no relay), mapped to the spawn line."""
+    out: dict[str, int] = {}
+    for node in ast.walk(ms.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name) else "")
+        if fname != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            t = kw.value
+            name = None
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                name = t.attr
+            elif isinstance(t, ast.Name):
+                name = t.id
+            if name is None:
+                continue
+            for q, fs in ms.functions.items():
+                if fs.name == name:
+                    out[q] = node.lineno
+    return out
+
+
+#: http.server dispatches these by name; an escaping exception surfaces
+#: only as a stderr traceback on the handler thread
+_FRAMEWORK_ENTRIES = {"do_GET", "do_POST", "do_PUT", "do_DELETE"}
+
+
+def analyze(g):
+    """(rel, line, message) findings over a built CallGraph."""
+    reach = g.roots_reaching()
+
+    for rel in sorted(g.modules):
+        ms = g.modules[rel]
+
+        # ---- escaping-thread-entry ------------------------------------
+        entries = _thread_targets(ms)
+        for q, fs in ms.functions.items():
+            is_entry = q in entries or (
+                fs.cls is not None and fs.name in _FRAMEWORK_ENTRIES
+            )
+            if not is_entry:
+                continue
+            node = _find_def(ms, fs)
+            if node is None:
+                continue
+            line = reaches_raise_uncovered(node)
+            if line is not None:
+                how = ("a threading.Thread target" if q in entries
+                       else "an http.server handler entry")
+                yield rel, line, (
+                    f"'{fs.name}' is {how}: an exception here escapes the "
+                    "function and kills its thread silently — no relay, "
+                    "no 500, no error frame; wrap the work in the "
+                    "boundary's try and route the error through the "
+                    "contract (the _pump/_error, do_POST/500, _handle/"
+                    "error-frame pattern)"
+                )
+
+        # ---- swallowed-broad + raise-skips-unwind ---------------------
+        for q, fs in ms.functions.items():
+            if q not in reach:
+                continue  # not boundary-reachable: R12 is a boundary rule
+            node = _find_def(ms, fs)
+            if node is None:
+                continue
+            for n in ast.walk(node):
+                if isinstance(n, ast.ExceptHandler) and _broad_handler(n) \
+                        and _body_is_pass(n):
+                    yield rel, n.lineno, (
+                        f"broad exception swallowed with `pass` in "
+                        f"'{fs.name}' (reachable from a declared thread "
+                        "root) — the error AND the unwind vanish; catch "
+                        "the narrow expected type, or route/log through "
+                        "the boundary contract"
+                    )
+            yield from _manual_locks(rel, fs, node)
+
+
+def _lock_recv(n: ast.AST) -> str | None:
+    """Dotted text of a lock-ish receiver (``self._lock``, ``lock``)."""
+    if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
+        return None
+    try:
+        text = ast.unparse(n.func.value)
+    except Exception:
+        return None
+    return text if _LOCK_NAME_RE.search(text) else None
+
+
+def _manual_locks(rel, fs, node):
+    """x.acquire() ... x.release() checked over the exception-aware CFG:
+    a path out of the function holding the lock is a finding."""
+    acquires = []
+    for n in ast.walk(node):
+        recv = _lock_recv(n)
+        if recv is not None and n.func.attr == "acquire":
+            acquires.append((recv, n.lineno))
+    if not acquires:
+        return
+    try:
+        cfg = build_cfg(node)
+    except RecursionError:
+        return
+    for lock_name, line in acquires:
+        acq_node = None
+        release_nodes = set()
+        for cn in cfg.stmt_nodes():
+            for n in ast.walk(cn.stmt):
+                if _lock_recv(n) != lock_name:
+                    continue
+                if n.func.attr == "acquire" and n.lineno == line:
+                    acq_node = cn.idx
+                elif n.func.attr == "release":
+                    release_nodes.add(cn.idx)
+        if acq_node is None:
+            continue
+        leaks = leak_paths(cfg, acq_node, release_nodes)
+        if "an exception path" in leaks:
+            yield rel, line, (
+                f"'{lock_name}.acquire()' in '{fs.name}' is not released "
+                "on some exception path — a raise that skips the unwind "
+                "leaves the lock held forever; use `with "
+                f"{lock_name}:` or release in a finally"
+            )

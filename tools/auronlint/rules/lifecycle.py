@@ -1,0 +1,399 @@
+"""R11 — resource lifecycle: every acquisition reaches its release on
+every path, INCLUDING exception edges.
+
+The engine's strongest dynamic invariant — "nothing leaks when a query
+fails" — was enforced only by whichever failure the gates happened to
+inject: PR 12's review rounds found a leaked ``TaskRuntime`` per failing
+collect request and stuck upload waiters exactly because no static rule
+covered the lifecycle class. R11 closes that: a registry of the engine's
+acquire/release protocols, checked per function over the exception-aware
+CFG (tools/auronlint/cfg.py).
+
+Protocols (the resource is the value an acquire call produces, tracked
+by the local name it binds — or, for registration-style protocols, the
+argument name handed to the acquiring call):
+
+- ``task-runtime``   TaskRuntime(...) / api.call_native(...) ->
+                     ``.finalize()`` / ``api.finalize_native(h)``
+- ``spill``          make_spill/HostSpill/DiskSpill -> ``.release()``
+- ``shuffle-staging``_ShuffleStaging(...) -> ``.release()``/``.close()``
+- ``mm-registration``mm.register(x) -> mm.unregister(x)
+- ``inflight-event`` threading.Event() bound outside __init__ ->
+                     ``.set()`` reachable on ALL paths (waiters must be
+                     released even when the builder fails — the PR-12
+                     upload-event lesson; storing the event does NOT
+                     transfer ownership, that is how waiters find it)
+- ``span``           obs.span(...) NOT used as a context manager ->
+                     ``.close()``/``.__exit__()``
+
+Ownership transfers end tracking for value-style protocols: returning or
+yielding the resource, storing it into an attribute/subscript/container,
+or using it as a context manager (``with`` releases it structurally).
+Anything else must release on every CFG path — a path that reaches the
+function's normal exit or its escaping-exception exit with the resource
+still held is a finding. Deliberate hand-offs the analysis cannot see
+declare themselves::
+
+    ds = make_spill(conf=c)  # auronlint: owned-by(self.parked) -- drained and released by drain()/the _execute finally
+
+(the holder argument is required, and like every annotation the reason
+is too; owned-by counts ride LINT_RATCHET.json next to guarded-by).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.auronlint.cfg import build_cfg, leak_paths
+from tools.auronlint.core import Rule, SourceModule
+
+
+@dataclass(frozen=True)
+class Protocol:
+    pid: str
+    desc: str
+    #: bare/attribute call names whose RESULT is the resource
+    acquire_calls: frozenset = frozenset()
+    #: method names: receiver.m(x) acquires for the ARGUMENT name x
+    acquire_arg_methods: frozenset = frozenset()
+    #: receiver-name regex-ish restriction for acquire_arg_methods
+    acquire_arg_recv: frozenset = frozenset()
+    #: resource.m() releases
+    release_methods: frozenset = frozenset()
+    #: f(resource) / receiver.f(resource) releases
+    release_fns: frozenset = frozenset()
+    #: receiver.m(resource) releases (the unregister twin of register)
+    release_arg_methods: frozenset = frozenset()
+    #: resource.m() proves THIS path does not own the resource (waiting
+    #: on an in-flight event is the waiter side, not the builder side)
+    disown_methods: frozenset = frozenset()
+    #: storing the resource (attr/subscript/container) transfers ownership
+    stores_transfer: bool = True
+    #: acquisitions inside __init__/__new__/__post_init__ are exempt
+    #: (long-lived instance state, owned by the instance's own lifecycle)
+    skip_in_init: bool = False
+
+
+PROTOCOLS: tuple[Protocol, ...] = (
+    Protocol(
+        "task-runtime", "task runtime (create -> finalize)",
+        acquire_calls=frozenset({"TaskRuntime", "call_native"}),
+        release_methods=frozenset({"finalize"}),
+        release_fns=frozenset({"finalize_native"}),
+    ),
+    Protocol(
+        "spill", "spill container (create -> release)",
+        acquire_calls=frozenset({"make_spill", "HostSpill", "DiskSpill"}),
+        release_methods=frozenset({"release"}),
+    ),
+    Protocol(
+        "shuffle-staging", "shuffle staging (open -> release/close)",
+        acquire_calls=frozenset({"_ShuffleStaging"}),
+        release_methods=frozenset({"release", "close"}),
+    ),
+    Protocol(
+        "mm-registration",
+        "memory-manager consumer (register -> unregister)",
+        acquire_arg_methods=frozenset({"register"}),
+        acquire_arg_recv=frozenset({"mm", "manager", "memmgr"}),
+        release_arg_methods=frozenset({"unregister"}),
+        stores_transfer=False,   # registration is not a value one can hand off
+    ),
+    Protocol(
+        "inflight-event",
+        "in-flight event (create -> set releases waiters)",
+        acquire_calls=frozenset({"Event"}),
+        release_methods=frozenset({"set"}),
+        disown_methods=frozenset({"wait"}),
+        stores_transfer=False,   # storing it is HOW waiters find it
+        skip_in_init=True,       # __init__ events are instance state
+    ),
+    Protocol(
+        "span", "span (open -> close)",
+        acquire_calls=frozenset({"span"}),
+        release_methods=frozenset({"close", "__exit__"}),
+    ),
+)
+
+
+@dataclass
+class _Acq:
+    proto: Protocol
+    name: str          # tracked local name
+    node: int          # CFG node of the acquisition
+    line: int
+    #: names the resource is also reachable through ("ent" for the dict
+    #: holding an event) — release matching follows the same name
+
+
+def _call_name(call: ast.Call) -> tuple[str, str | None]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id, None
+    if isinstance(f, ast.Attribute):
+        recv = f.value.id if isinstance(f.value, ast.Name) else "<expr>"
+        return f.attr, recv
+    return "", None
+
+
+def _find_acquire_calls(expr: ast.AST, proto: Protocol):
+    """Acquire calls of ``proto`` anywhere inside an assigned value
+    expression (an Event buried in a dict literal still counts: the
+    assignment's target is the name waiters reach it through)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name, recv = _call_name(node)
+            if name in proto.acquire_calls:
+                yield node
+
+
+def _name_targets(stmt: ast.Assign) -> list[str]:
+    out = []
+    for t in stmt.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+    return out
+
+
+def _has_store_target(stmt: ast.Assign) -> bool:
+    return any(isinstance(t, (ast.Attribute, ast.Subscript))
+               for t in stmt.targets)
+
+
+def _rooted_at(expr: ast.AST, name: str) -> bool:
+    """Is this expression an access chain rooted at ``name`` (``x``,
+    ``x["done"]``, ``x.event`` ...)?"""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return isinstance(expr, ast.Name) and expr.id == name
+
+
+class _FnScan:
+    """Per-function acquisition/release/transfer classification over the
+    statements that became CFG nodes."""
+
+    def __init__(self, fn: ast.AST, cfg):
+        self.fn = fn
+        self.cfg = cfg
+        self.in_init = fn.name in ("__init__", "__new__", "__post_init__")
+
+    # -- acquisitions -------------------------------------------------------
+
+    def acquisitions(self) -> list[_Acq]:
+        out = []
+        for node in self.cfg.stmt_nodes():
+            stmt = node.stmt
+            if isinstance(stmt, ast.Assign):
+                for proto in PROTOCOLS:
+                    if not proto.acquire_calls:
+                        continue
+                    if proto.skip_in_init and self.in_init:
+                        continue
+                    if any(_find_acquire_calls(stmt.value, proto)):
+                        for name in _name_targets(stmt):
+                            out.append(_Acq(proto, name, node.idx,
+                                            stmt.lineno))
+                            break  # one tracked name per acquisition
+            call = _stmt_call(stmt)
+            if call is not None:
+                name, recv = _call_name(call)
+                for proto in PROTOCOLS:
+                    if name in proto.acquire_arg_methods and (
+                        not proto.acquire_arg_recv
+                        or recv in proto.acquire_arg_recv
+                    ):
+                        if call.args and isinstance(call.args[0], ast.Name):
+                            out.append(_Acq(proto, call.args[0].id,
+                                            node.idx, stmt.lineno))
+        return out
+
+    # -- releases / transfers ----------------------------------------------
+
+    def release_nodes(self, acq: _Acq) -> set:
+        """CFG nodes past which ``acq`` is safe: releases, ownership
+        transfers, rebinds (tracking ends — a rebind is its own problem
+        but not THIS leak), and with-blocks managing the resource."""
+        proto = acq.proto
+        out = set()
+        for node in self.cfg.stmt_nodes():
+            stmt = node.stmt
+            if self._releases(stmt, acq):
+                out.add(node.idx)
+                continue
+            if proto.stores_transfer and self._transfers(stmt, acq):
+                out.add(node.idx)
+                continue
+            if self._rebinds(stmt, acq):
+                out.add(node.idx)
+                continue
+            # the conditional-release idiom: `if x is not None:
+            # x.release()` — the test IS the dynamic ownership check, so
+            # the header counts as the release (the path around the body
+            # is the not-owned case, not a leak)
+            if isinstance(stmt, ast.If) and _mentions_name(stmt.test,
+                                                           acq.name):
+                if self._match_release(
+                    (n for s in stmt.body for n in ast.walk(s)), acq
+                ):
+                    out.add(node.idx)
+        for wexit, items in self.cfg.with_exits.items():
+            for item in items:
+                if _rooted_at(item.context_expr, acq.name):
+                    out.add(wexit)
+        return out
+
+    def _releases(self, stmt: ast.AST, acq: _Acq) -> bool:
+        return self._match_release(
+            (n for part in _node_exprs(stmt) for n in ast.walk(part)), acq
+        )
+
+    @staticmethod
+    def _match_release(nodes, acq: _Acq) -> bool:
+        proto = acq.proto
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            name, recv = _call_name(node)
+            f = node.func
+            if name in (proto.release_methods | proto.disown_methods) \
+                    and isinstance(f, ast.Attribute) \
+                    and _rooted_at(f.value, acq.name):
+                return True
+            if name in proto.release_fns and node.args \
+                    and _rooted_at(node.args[0], acq.name):
+                return True
+            if name in proto.release_arg_methods and node.args \
+                    and _rooted_at(node.args[0], acq.name):
+                return True
+        return False
+
+    def _transfers(self, stmt: ast.AST, acq: _Acq) -> bool:
+        name = acq.name
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            return _mentions_name(stmt.value, name)
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, (ast.Yield, ast.YieldFrom)
+        ):
+            v = stmt.value.value
+            return v is not None and _mentions_name(v, name)
+        if isinstance(stmt, ast.Assign):
+            # stored into an attribute/subscript (instance/container owns
+            # it now), or into a container literal that is itself stored
+            if _mentions_name(stmt.value, name) and _has_store_target(stmt):
+                return True
+            return False
+        call = _stmt_call(stmt)
+        if call is not None:
+            cname, _ = _call_name(call)
+            # appending/inserting the resource into a collection hands it
+            # to the collection's owner
+            if cname in ("append", "add", "put", "insert", "extend",
+                         "setdefault", "appendleft"):
+                return any(_mentions_name(a, name) for a in call.args)
+        return False
+
+    def _rebinds(self, stmt: ast.AST, acq: _Acq) -> bool:
+        if isinstance(stmt, ast.Assign):
+            if acq.name in _name_targets(stmt) and not any(
+                _find_acquire_calls(stmt.value, acq.proto)
+            ):
+                return True
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # the loop target rebinds the name each iteration
+            for n in ast.walk(stmt.target):
+                if isinstance(n, ast.Name) and n.id == acq.name:
+                    return True
+        if isinstance(stmt, ast.Delete):
+            return any(isinstance(t, ast.Name) and t.id == acq.name
+                       for t in stmt.targets)
+        return False
+
+
+def _stmt_call(stmt: ast.AST) -> ast.Call | None:
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        return stmt.value
+    return None
+
+
+def _node_exprs(stmt: ast.AST) -> list:
+    """The AST actually EXECUTED at a CFG node. Compound statements'
+    nodes are their headers (test / iterator / context exprs) — their
+    bodies have their own nodes, and a def statement executes none of
+    its body — so release/transfer matching must not walk into them."""
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.While, ast.If)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return []
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type] if stmt.type is not None else []
+    return [stmt]
+
+
+def _mentions_name(expr: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(expr))
+
+
+def _functions_of(mod: SourceModule):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class ResourceLifecycleRule(Rule):
+    name = "R11"
+    doc = "resource lifecycle: acquisitions reach releases on all paths"
+
+    def check_module(self, mod: SourceModule):
+        yield from check_module(mod)
+
+
+def check_module(mod: SourceModule):
+    for fn in _functions_of(mod):
+        # functions defining a protocol's own machinery check themselves
+        # structurally, not against the protocol they implement
+        try:
+            cfg = build_cfg(fn)
+        except RecursionError:  # pathological nesting: skip, never crash
+            continue
+        scan = _FnScan(fn, cfg)
+        acqs = scan.acquisitions()
+        if not acqs:
+            continue
+        # nested-def spans: an acquisition textually inside a nested def
+        # belongs to THAT function's CFG walk, not this one
+        nested = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        ]
+        for acq in acqs:
+            if any(lo <= acq.line <= hi for lo, hi in nested):
+                continue
+            leaks = leak_paths(cfg, acq.node, scan.release_nodes(acq))
+            if not leaks:
+                continue
+            # owned-by on the acquire line suppresses through the normal
+            # suppression machinery (core.suppression_for) so the declared
+            # hand-off rides the ratchet as a suppressed finding
+            yield acq.line, (
+                f"{acq.proto.desc}: '{acq.name}' acquired here can reach "
+                f"the end of '{fn.name}' on {' and '.join(leaks)} without "
+                f"its release ({_release_words(acq.proto)}) — release in "
+                "a finally/except unwind, hand ownership off explicitly, "
+                "or declare `# auronlint: owned-by(<holder>) -- <why>`"
+            )
+
+
+def _release_words(proto: Protocol) -> str:
+    parts = [f".{m}()" for m in sorted(proto.release_methods)]
+    parts += [f"{f}(x)" for f in sorted(proto.release_fns)]
+    parts += [f".{m}(x)" for m in sorted(proto.release_arg_methods)]
+    return " / ".join(parts)
