@@ -18,7 +18,7 @@
 AURONLINT_TIME_BUDGET ?= 60
 lint:
 	JAX_PLATFORMS=cpu python -m tools.auronlint --sarif-out build/auronlint.sarif --time-budget $(AURONLINT_TIME_BUDGET)
-	python tools/jvm_lint.py
+	python tools/jvm_lint.py --sarif-out build/jvm_lint.sarif
 
 # Inner-loop fast mode: lint only git-touched engine files with the
 # per-file rules (the whole-package interprocedural pass R4/R7-R13 stays

@@ -28,18 +28,15 @@ from auron_tpu.exec.joins.core import (
 
 def _compact_join_output_enabled() -> bool:
     from auron_tpu.exec.base import current_context
-    from auron_tpu.utils.config import JOIN_COMPACT_OUTPUT, active_conf
+    from auron_tpu.jaxenv import is_tpu
+    from auron_tpu.utils.config import (
+        JOIN_COMPACT_OUTPUT, active_conf, resolve_tri,
+    )
 
     ctx = current_context()
     conf = ctx.conf if ctx is not None else active_conf()
-    mode = conf.get(JOIN_COMPACT_OUTPUT)
-    if mode == "on":
-        return True
-    if mode == "off":
-        return False
-    from auron_tpu.jaxenv import is_tpu
-
-    return not is_tpu()  # auto: syncs are cheap on CPU, costly on the link
+    # auto: syncs are cheap on CPU, costly on the link
+    return resolve_tri(conf.get(JOIN_COMPACT_OUTPUT), not is_tpu())
 
 
 class UniqueProbePipeline:

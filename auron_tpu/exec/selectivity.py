@@ -33,18 +33,15 @@ from auron_tpu.utils.config import (
     SELECTIVITY_HEADROOM,
     SELECTIVITY_PREDICTOR_ENABLE,
     SELECTIVITY_SHRINK_PATIENCE,
+    resolve_tri,
 )
 
 
 def predictor_enabled(conf) -> bool:
     """Knob resolution: on | off | auto (= on wherever compaction runs —
     the predictor only exists to unblock the compaction boundary)."""
-    mode = conf.get(SELECTIVITY_PREDICTOR_ENABLE)
-    if mode == "on":
-        return True
-    if mode == "off":
-        return False
-    return conf.get(JOIN_COMPACT_OUTPUT) != "off"
+    compacting = resolve_tri(conf.get(JOIN_COMPACT_OUTPUT), True)
+    return resolve_tri(conf.get(SELECTIVITY_PREDICTOR_ENABLE), compacting)
 
 
 # auronlint: thread-owned -- one predictor per operator instance, driven by the single thread executing that query's batch stream (pump or serving thread, never both at once)

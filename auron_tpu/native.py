@@ -41,27 +41,37 @@ def _lib():
         lib = ctypes.CDLL(so)
     except OSError:
         return None
+    # one literal `lib.<sym>.argtypes/.restype =` statement per export —
+    # auronlint R15 cross-checks these bindings against the C signatures
+    # in native/auron_native.cpp, so they must stay statically visible
+    # (no getattr loops) and every void kernel pins restype = None
+    # (ctypes' default c_int return on a void function reads garbage).
     lib.murmur3_i32.argtypes = [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32),
     ]
+    lib.murmur3_i32.restype = None
     lib.murmur3_i64.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32),
     ]
+    lib.murmur3_i64.restype = None
     lib.murmur3_bytes.argtypes = [
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
     ]
+    lib.murmur3_bytes.restype = None
     lib.radix_partition.argtypes = [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.radix_partition.restype = None
     lib.loser_tree_merge.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.loser_tree_merge.restype = None
     try:
         lib.crc32c_hash.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_uint32,
@@ -70,23 +80,36 @@ def _lib():
     except AttributeError:
         pass  # stale .so without the symbol: callers fall back
     try:
-        for suffix, fp in (("f64", ctypes.c_double), ("f32", ctypes.c_float)):
-            probe = getattr(lib, f"scaled_probe_{suffix}")
-            probe.argtypes = [
-                ctypes.POINTER(fp), ctypes.c_int64, fp,
-                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ]
-            probe.restype = ctypes.c_int
-            pack = getattr(lib, f"scaled_pack_{suffix}")
-            pack.argtypes = [
-                ctypes.POINTER(fp), ctypes.c_int64, fp, ctypes.c_int64,
-                ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
-            ]
-            unpack = getattr(lib, f"scaled_unpack_{suffix}")
-            unpack.argtypes = [
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, fp,
-                ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(fp),
-            ]
+        lib.scaled_probe_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.scaled_probe_f64.restype = ctypes.c_int
+        lib.scaled_probe_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.scaled_probe_f32.restype = ctypes.c_int
+        lib.scaled_pack_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.scaled_pack_f64.restype = None
+        lib.scaled_pack_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.scaled_pack_f32.restype = None
+        lib.scaled_unpack_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.scaled_unpack_f64.restype = None
+        lib.scaled_unpack_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_float,
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.scaled_unpack_f32.restype = None
     except AttributeError:
         pass  # stale .so without the scaled kernels: callers fall back
     _LIB = lib
@@ -99,6 +122,20 @@ def available() -> bool:
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def murmur3_i32_host(v: np.ndarray, seed: int = 42) -> np.ndarray:
+    v = np.ascontiguousarray(v, dtype=np.int32)
+    out = np.empty(len(v), dtype=np.int32)
+    lib = _lib()
+    if lib is None:  # numpy fallback via the device kernel on host arrays
+        import jax.numpy as jnp
+
+        from auron_tpu.ops.hashing import murmur3_i32
+
+        return np.asarray(murmur3_i32(jnp.asarray(v), jnp.uint32(seed)).view(jnp.int32))
+    lib.murmur3_i32(_ptr(v, ctypes.c_int32), len(v), seed, _ptr(out, ctypes.c_int32))
+    return out
 
 
 def murmur3_i64_host(v: np.ndarray, seed: int = 42) -> np.ndarray:

@@ -371,7 +371,9 @@ def kafka_scan(schema: T.Schema, topic: str, source_resource_id: str,
         source_resource_id=source_resource_id,
         max_batch_records=max_batch_records,
     )
-    for k, v in (start_offsets or {}).items():
+    # sorted: proto emission must be byte-stable regardless of the
+    # caller's dict build order (the serialized plan feeds digests)
+    for k, v in sorted((start_offsets or {}).items(), key=lambda kv: int(kv[0])):
         n.start_offsets[int(k)] = int(v)
     if pb_field_ids:
         n.pb_field_ids.extend(pb_field_ids)
@@ -383,6 +385,7 @@ def kafka_scan(schema: T.Schema, topic: str, source_resource_id: str,
 def task(plan: pb.PhysicalPlanNode, stage_id=0, partition_id=0,
          conf: dict | None = None) -> pb.TaskDefinition:
     t = pb.TaskDefinition(plan=plan, stage_id=stage_id, partition_id=partition_id)
-    for k, v in (conf or {}).items():
+    # sorted: task protos diff byte-for-byte across processes
+    for k, v in sorted((conf or {}).items()):
         t.conf[k] = str(v)
     return t

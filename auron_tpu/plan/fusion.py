@@ -1199,6 +1199,6 @@ def fuse_exec_tree(plan: ExecOperator, conf: Configuration) -> ExecOperator:
     """Apply whole-stage fusion to an instantiated exec tree. A no-op when
     ``exec.fuse.enable`` resolves off for every segment; bit-identical
     results either way (tests/test_fusion.py fuzzes the equivalence)."""
-    if conf.get(FUSE_ENABLE) == "off":
+    if not resolve_tri(conf.get(FUSE_ENABLE), True):
         return plan
     return _visit(plan, conf)

@@ -29,17 +29,10 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
-from auron_tpu.utils.config import (
-    CASE_SENSITIVE,
-    SQL_SHUFFLE_PARTITIONS,
-    Configuration,
-)
+from auron_tpu.sql.digest import PLAN_KNOBS
+from auron_tpu.utils.config import CASE_SENSITIVE, Configuration
 
-#: conf options whose values the parse->bind->lower pipeline reads: their
-#: RESOLVED values ride the cache key, so a session conf changing any of
-#: them can never be served a stale plan. Extend when the lowering grows
-#: a new knob — test_serve.py's invalidation test is the tripwire.
-PLAN_KNOBS = (SQL_SHUFFLE_PARTITIONS, CASE_SENSITIVE)
+__all__ = ["PLAN_KNOBS", "PlanCache", "plan_cache_key"]
 
 
 def plan_cache_key(sql: str, conf: Configuration) -> str:

@@ -31,6 +31,35 @@ from __future__ import annotations
 import hashlib
 
 from auron_tpu.sql.lexer import IDENT, STRING, tokenize
+from auron_tpu.utils.config import (
+    CASE_SENSITIVE,
+    FUSE_AGG_INPUTS,
+    FUSE_ENABLE,
+    FUSE_MIN_OPS,
+    FUSE_PROBE,
+    FUSE_SHUFFLE,
+    HOST_SORT_MODE,
+    SQL_SHUFFLE_PARTITIONS,
+)
+
+#: conf options whose values the parse->bind->lower pipeline reads: their
+#: RESOLVED values ride the serving cache key (serve/cache.py), so a
+#: session conf changing any of them can never be served a stale plan.
+#: This tuple lives HERE — next to the digest whose equality contract it
+#: completes — and auronlint R14 enforces it: any knob read reachable
+#: from sql/lowering.py or plan/fusion.py over the call graph must be
+#: listed, so forgetting to extend it when the lowering grows a knob is
+#: a lint failure, not a wrong-plan cache hit in production.
+PLAN_KNOBS = (
+    SQL_SHUFFLE_PARTITIONS,
+    CASE_SENSITIVE,
+    FUSE_ENABLE,
+    FUSE_MIN_OPS,
+    FUSE_AGG_INPUTS,
+    FUSE_PROBE,
+    FUSE_SHUFFLE,
+    HOST_SORT_MODE,
+)
 
 
 def canonical_text(sql: str, fold_ident_case: bool = True) -> str:

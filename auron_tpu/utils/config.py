@@ -207,6 +207,7 @@ MEM_WAIT_TIMEOUT_S = float_conf(
     "how long a below-fair-share consumer waits for siblings to release "
     "memory before it is forced to spill (auron-memmgr lib.rs WAIT_TIME)",
 )
+# auronlint: disable=R14 -- policy hook: columnar/batch.py hardcodes next_pow2 today; the knob reserves the config surface the paper's bucketing ablation needs
 BATCH_SIZE_BUCKETS = str_conf(
     "batch.capacity.buckets", "auto", "exec",
     "capacity bucketing policy for static shapes: auto = next_pow2",
@@ -262,13 +263,16 @@ DEVICE_SORT_IMPL = str_conf(
     "pallas = VMEM-resident bitonic Pallas kernel; auto = pallas on TPU "
     "when the problem fits the VMEM gate, else lax (ops/bitonic.py)",
 )
+# auronlint: disable=R14 -- upstream-parity surface (conf.rs:53): SMJ fallback is not implemented in this engine yet; the key must exist so ported configs round-trip
 SMJ_FALLBACK_ENABLE = bool_conf(
     "smj.fallback.enable", True, "join",
     "fall back from hash join to sort-merge when the build side exceeds budget (SMJ_FALLBACK_* in conf.rs:53-55)",
 )
+# auronlint: disable=R14 -- upstream-parity surface (conf.rs:54): read only by the unimplemented SMJ fallback
 SMJ_FALLBACK_ROWS_THRESHOLD = int_conf(
     "smj.fallback.rows.threshold", 10_000_000, "join", ""
 )
+# auronlint: disable=R14 -- upstream-parity surface (conf.rs:55): read only by the unimplemented SMJ fallback
 SMJ_FALLBACK_MEM_SIZE_THRESHOLD = int_conf(
     "smj.fallback.mem.threshold.bytes", 1 << 30, "join", ""
 )
@@ -332,6 +336,7 @@ AGG_DENSE_HOST_SCATTER = str_conf(
     "applied to scatter-reduce). Accelerators keep the fused device "
     "scatter",
 )
+# auronlint: disable=R14 -- upstream-parity surface (agg_ctx.rs:611): spilled-agg merge is single-pass here, bucketed merge not ported yet
 AGG_SPILL_BUCKETS = int_conf(
     "agg.spill.buckets", 64, "agg",
     "number of hash buckets for spilled aggregation merge (agg/agg_ctx.rs:611)",

@@ -2386,3 +2386,484 @@ def test_sarif_out_artifact_and_time_budget(tmp_path, capsys):
     assert "exceeded the --time-budget" in capsys.readouterr().err
     strays = [p for p in os.listdir(tmp_path) if p.startswith("b.sarif.")]
     assert not strays  # temp + os.replace left nothing behind
+
+
+# ---------------------------------------------------------------------------
+# R14 config-knob contract
+# ---------------------------------------------------------------------------
+
+
+_R14_CONFIG = """
+def str_conf(key, default=None, doc=""):
+    return (key, default, doc)
+
+def resolve_tri(mode, auto):
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return auto
+
+FUSE_MODE = str_conf("exec.fuse.mode", "auto",
+                     doc="on | off | auto = on when compacting")
+PARTS = str_conf("sql.parts", "8", doc="partition count")
+DEAD = str_conf("sql.dead", "x", doc="declared, read by nobody")
+"""
+
+
+def _r14(sources: dict):
+    from tools.auronlint.rules.confcontract import analyze
+
+    return analyze(
+        _graph(sources),
+        anchor_rels=("pkg/lowering.py",),
+        digest_rel="pkg/digest.py",
+    )
+
+
+def test_r14_fires_on_raw_get_dead_knob_and_tri_bypass():
+    finds, stats = _r14({
+        "pkg/config.py": _R14_CONFIG,
+        "pkg/digest.py": """
+        from pkg.config import PARTS
+
+        PLAN_KNOBS = (PARTS,)
+        """,
+        "pkg/lowering.py": """
+        from pkg.config import FUSE_MODE, PARTS
+
+        def lower(conf):
+            legacy = conf.get("sql.raw.key")
+            mode = conf.get(FUSE_MODE)
+            if mode == "off":
+                return None
+            return conf.get(PARTS)
+        """,
+    })
+    msgs = " | ".join(m for _, _, m in finds)
+    assert "raw-string conf read conf.get('sql.raw.key')" in msgs
+    assert "knob DEAD ('sql.dead') is declared but never read" in msgs
+    assert "tri-state knob FUSE_MODE read without resolve_tri" in msgs
+    # the teeth: FUSE_MODE is read during lowering but not cache-keyed
+    assert "plan-affecting knob FUSE_MODE" in msgs
+    assert "MISSING from sql/digest.py PLAN_KNOBS" in msgs
+    assert stats["declared"] == 3 and stats["tri"] == 1
+    assert stats["plan_proved"] == 1  # PARTS is keyed; FUSE_MODE is not
+
+
+def test_r14_contract_clean_when_keyed_and_resolved():
+    finds, stats = _r14({
+        "pkg/config.py": _R14_CONFIG.replace(
+            'DEAD = str_conf("sql.dead", "x", doc="declared, read by nobody")\n',
+            "",
+        ),
+        "pkg/digest.py": """
+        from pkg.config import FUSE_MODE, PARTS
+
+        PLAN_KNOBS = (PARTS, FUSE_MODE)
+        """,
+        "pkg/lowering.py": """
+        from pkg.config import FUSE_MODE, PARTS, resolve_tri
+
+        def lower(conf):
+            fuse = resolve_tri(conf.get(FUSE_MODE), True)
+            parts = conf.get(PARTS)
+            return parts if fuse else None
+        """,
+    })
+    assert finds == []
+    assert stats["plan_proved"] == 2
+
+
+def test_r14_knob_object_passed_to_helper_still_counts_as_plan_read():
+    """The knob need not feed conf.get() in the anchor module itself —
+    loading the knob OBJECT inside the closure (passing it down to a
+    helper that reads it) is the same contract obligation."""
+    finds, _stats = _r14({
+        "pkg/config.py": _R14_CONFIG.replace(
+            'DEAD = str_conf("sql.dead", "x", doc="declared, read by nobody")\n',
+            "",
+        ),
+        "pkg/digest.py": """
+        from pkg.config import PARTS
+
+        PLAN_KNOBS = (PARTS,)
+        """,
+        "pkg/helper.py": """
+        def read_knob(conf, knob):
+            return conf.get(knob)
+        """,
+        "pkg/lowering.py": """
+        from pkg.config import FUSE_MODE, PARTS, resolve_tri
+        from pkg.helper import read_knob
+
+        def lower(conf):
+            fuse = resolve_tri(read_knob(conf, FUSE_MODE), True)
+            return read_knob(conf, PARTS) if fuse else None
+        """,
+    })
+    assert any("plan-affecting knob FUSE_MODE" in m for _, _, m in finds)
+
+
+def test_r14_declaration_suppression_honored_in_tree(tmp_path):
+    """Reference-parity debt: a reasoned disable=R14 on the declaration
+    line keeps a never-read knob out of the gate (and in the ratchet)."""
+    from tools.auronlint.core import lint_paths
+    from tools.auronlint.rules.confcontract import ConfContractRule
+
+    at = tmp_path / "auron_tpu" / "utils"
+    at.mkdir(parents=True)
+    (tmp_path / "auron_tpu" / "__init__.py").write_text("")
+    (at / "config.py").write_text(textwrap.dedent("""
+        def str_conf(key, default=None, doc=""):
+            return (key, default, doc)
+
+        PARITY = str_conf("upstream.parity.knob", "x")  # auronlint: disable=R14 -- upstream-parity surface, fixture
+        LOUD = str_conf("dead.loud.knob", "y")
+    """))
+    rep = lint_paths([os.path.join(str(tmp_path), "auron_tpu")],
+                     str(tmp_path), [ConfContractRule()])
+    dead = [f for f in rep.findings if "declared but never read" in f.message]
+    assert {f.suppressed for f in dead} == {True, False}
+    sup = next(f for f in dead if f.suppressed)
+    assert "PARITY" in sup.message and "upstream-parity" in (sup.reason or "")
+
+
+def test_r14_vacuity_floors_fail_loudly(monkeypatch):
+    from tools.auronlint.rules import confcontract
+
+    rule = confcontract.ConfContractRule()
+    monkeypatch.setattr(confcontract, "R14_MIN_DECLARED", 10_000)
+    finds = list(rule.check_tree(REPO_ROOT))
+    assert any("R14 vacuity check" in m for _, _, m in finds)
+
+    rule2 = confcontract.ConfContractRule()
+    monkeypatch.setattr(confcontract, "R14_MIN_DECLARED", 1)
+    monkeypatch.setattr(confcontract, "R14_MIN_PLAN_PROVED", 10_000)
+    finds2 = list(rule2.check_tree(REPO_ROOT))
+    assert any("plan-path knobs proved" in m for _, _, m in finds2)
+
+
+def test_r14_live_tree_proves_fuse_knobs_into_plan_knobs():
+    """The serving-cache teeth on the real tree: the closure from
+    lowering/fusion must reach the fuse family and prove every
+    plan-affecting knob into PLAN_KNOBS (this PR's live findings — the
+    FUSE_*/HOST_SORT_MODE cache-split bugs — stay fixed)."""
+    from tools.auronlint.callgraph import build_graph
+    from tools.auronlint.rules.confcontract import (
+        R14_MIN_DECLARED, R14_MIN_PLAN_PROVED, analyze,
+    )
+
+    _finds, stats = analyze(build_graph(REPO_ROOT))
+    assert stats["declared"] >= R14_MIN_DECLARED
+    assert stats["plan_proved"] >= R14_MIN_PLAN_PROVED
+    assert {"FUSE_ENABLE", "HOST_SORT_MODE"} <= set(stats["plan_read"])
+    assert set(stats["plan_read"]) <= set(stats["plan_knobs"])
+
+
+def test_config_doc_drift_gate_detects_stale_doc(monkeypatch, tmp_path):
+    """The generated-artifact gate: byte-level doc drift is a finding,
+    and the clean regen is drift-free."""
+    from tools.auronlint.rules.confcontract import config_doc_drift
+    from tools.gen_config_doc import regenerate
+
+    assert list(config_doc_drift(REPO_ROOT)) == []
+
+    doc = os.path.join(REPO_ROOT, "docs", "CONFIG.md")
+    with open(doc, encoding="utf-8") as fh:
+        original = fh.read()
+    try:
+        with open(doc, "a", encoding="utf-8") as fh:
+            fh.write("| fake.knob | x | drift |\n")
+        finds = list(config_doc_drift(REPO_ROOT))
+        assert any("stale" in m for _, _, m in finds)
+    finally:
+        with open(doc, "w", encoding="utf-8") as fh:
+            fh.write(original)
+    # regenerate() is idempotent on a clean tree
+    regenerate()
+    with open(doc, encoding="utf-8") as fh:
+        assert fh.read() == original
+
+
+# ---------------------------------------------------------------------------
+# R15 FFI/ABI lockstep
+# ---------------------------------------------------------------------------
+
+
+_MINI_NATIVE_CPP = """
+#include <cstdint>
+
+extern "C" {
+
+static int32_t private_helper(int32_t a) { return a; }
+
+int32_t add_i32(const int32_t* xs, int64_t n) { return 0; }
+
+void scale_f64(double* xs, int64_t n, double f) { }
+
+uint64_t helper_sym(int32_t a) { return 0; }
+
+}  // extern "C"
+"""
+
+_MINI_NATIVE_PY_DRIFTED = """
+import ctypes
+
+
+def _bind(lib):
+    lib.add_i32.argtypes = [ctypes.POINTER(ctypes.c_int32)]
+    lib.add_i32.restype = ctypes.c_int32
+    lib.scale_f64.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_double,
+    ]
+    lib.gone_sym.argtypes = [ctypes.c_int32]
+    lib.gone_sym.restype = ctypes.c_int32
+
+
+def add_i32_host(xs):
+    return 0
+
+
+def scale_f64_host(xs, f):
+    return xs
+"""
+
+_MINI_NATIVE_PY_OK = """
+import ctypes
+
+# auronlint: unbound-native(helper_sym) -- fixture: debug-only export, no engine caller
+
+
+def _bind(lib):
+    lib.add_i32.argtypes = [ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    lib.add_i32.restype = ctypes.c_int32
+    lib.scale_f64.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_double,
+    ]
+    lib.scale_f64.restype = None
+
+
+def add_i32_host(xs):
+    return 0
+
+
+def scale_f64_host(xs, f):
+    return xs
+"""
+
+
+def _write_native_tree(tmp_path, py_src, cpp_src=_MINI_NATIVE_CPP):
+    (tmp_path / "native").mkdir()
+    (tmp_path / "auron_tpu").mkdir()
+    (tmp_path / "native" / "auron_native.cpp").write_text(cpp_src)
+    (tmp_path / "auron_tpu" / "native.py").write_text(py_src)
+    return str(tmp_path)
+
+
+def _r15(root):
+    from tools.auronlint.rules.ffilockstep import analyze
+
+    return analyze(root)
+
+
+def test_r15_fires_on_arity_restype_unbound_and_stale(tmp_path):
+    finds, stats = _r15(_write_native_tree(tmp_path, _MINI_NATIVE_PY_DRIFTED))
+    msgs = " | ".join(m for _, _, m in finds)
+    assert "add_i32.argtypes has 1 entries but the C signature" in msgs
+    assert "scale_f64 binding has no explicit restype" in msgs
+    assert "exported native symbol helper_sym" in msgs
+    assert "binds symbol gone_sym" in msgs
+    assert "helper_sym has no numpy twin" in msgs
+    # static functions are not exports; the parser saw the 3 real ones
+    assert stats["exports"] == 3
+
+
+def test_r15_clean_boundary_with_unbound_declaration(tmp_path):
+    finds, stats = _r15(_write_native_tree(tmp_path, _MINI_NATIVE_PY_OK))
+    assert finds == []
+    assert stats["exports"] == 3 and stats["bound"] == 2
+    assert ("add_i32", "add_i32_host") in stats["pairs"]
+
+
+def test_r15_width_mismatch_and_stale_unbound_fire(tmp_path):
+    drifted = _MINI_NATIVE_PY_OK.replace(
+        "ctypes.c_int64]", "ctypes.c_int32]"
+    ).replace(
+        "unbound-native(helper_sym)", "unbound-native(add_i32)"
+    )
+    finds, _stats = _r15(_write_native_tree(tmp_path, drifted))
+    msgs = " | ".join(m for _, _, m in finds)
+    assert "add_i32.argtypes[1] is ctypes.c_int32" in msgs
+    assert "unbound-native(add_i32) declaration is stale" in msgs
+    assert "helper_sym" in msgs  # lost its declaration -> unbound again
+
+
+def test_r15_vacuity_floor_fails_loudly(monkeypatch):
+    from tools.auronlint.rules import ffilockstep
+
+    rule = ffilockstep.FfiLockstepRule()
+    monkeypatch.setattr(ffilockstep, "R15_MIN_TWINS", 10_000)
+    finds = list(rule.check_tree(REPO_ROOT))
+    assert any("R15 vacuity check" in m for _, _, m in finds)
+
+
+def test_r15_live_tree_bindings_in_lockstep():
+    finds, stats = _r15(REPO_ROOT)
+    assert finds == [], "\n".join(m for _, _, m in finds)
+    from tools.auronlint.rules.ffilockstep import (
+        R15_MIN_BOUND, R15_MIN_BRIDGE_DECLS, R15_MIN_EXPORTS, R15_MIN_TWINS,
+    )
+
+    assert stats["exports"] >= R15_MIN_EXPORTS
+    assert stats["bound"] >= R15_MIN_BOUND
+    assert stats["bridge_decls"] >= R15_MIN_BRIDGE_DECLS
+    assert stats["twins"] >= R15_MIN_TWINS
+
+
+# ---------------------------------------------------------------------------
+# R16 determinism taint
+# ---------------------------------------------------------------------------
+
+
+def _r16(sources: dict, anchors=("pkg/digest.py",), funcs=None):
+    from tools.auronlint.rules.determinism import analyze
+
+    return analyze(_graph(sources), anchor_rels=anchors,
+                   anchor_funcs=funcs or {})
+
+
+def test_r16_fires_on_set_dict_clock_and_id():
+    finds, stats = _r16({
+        "pkg/digest.py": """
+        import time
+
+        def digest(parts, opts):
+            tags = {p.name for p in parts}
+            body = ",".join(tags)
+            for k, v in opts.items():
+                body += k
+            return body + str(time.time()) + str(id(opts))
+        """,
+    })
+    msgs = " | ".join(m for _, _, m in finds)
+    assert "set iterated into a join" in msgs
+    assert "unsorted .items() iterated into a for loop" in msgs
+    assert "wall-clock read time.time()" in msgs
+    assert "id() on a digest-reachable path" in msgs
+    assert stats["covered"] == 1
+
+
+def test_r16_closure_scans_callees_but_not_unreachable_code():
+    finds, stats = _r16({
+        "pkg/digest.py": """
+        from pkg.canon import canon
+
+        def digest(parts, opts):
+            tags = sorted({p.name for p in parts})
+            body = ",".join(tags)
+            for k, v in sorted(opts.items()):
+                body += canon(k)
+            return body
+        """,
+        "pkg/canon.py": """
+        import time
+
+        def canon(s):
+            return s.lower()
+
+        def untainted_elsewhere():
+            return time.time()
+        """,
+    })
+    assert finds == []  # sorted() wrappers pass; unreachable clock passes
+    assert stats["covered"] == 2  # digest + canon, NOT untainted_elsewhere
+
+
+def test_r16_entropy_env_and_uuid_fire_through_closure():
+    finds, _stats = _r16({
+        "pkg/digest.py": """
+        from pkg.helper import salt
+
+        def digest(parts):
+            return salt() + len(parts)
+        """,
+        "pkg/helper.py": """
+        import os
+        import random
+        import uuid
+
+        def salt():
+            a = random.random()
+            b = uuid.uuid4()
+            c = os.environ["HOME"]
+            d = os.getenv("USER")
+            return hash((a, b, c, d))
+        """,
+    })
+    msgs = " | ".join(m for _, _, m in finds)
+    assert "entropy read random()" in msgs
+    assert "uuid.uuid4()" in msgs
+    assert "os.environ read" in msgs
+    assert "os.getenv()" in msgs
+
+
+def test_r16_nondeterministic_declaration_suppresses_in_tree(tmp_path):
+    """The dedicated R16 declaration: a reasoned ``nondeterministic``
+    annotation keeps a sanctioned site out of the gate; an unannotated
+    one still fires."""
+    from tools.auronlint.core import lint_paths
+    from tools.auronlint.rules.determinism import DeterminismRule
+
+    at = tmp_path / "auron_tpu" / "sql"
+    at.mkdir(parents=True)
+    (at / "digest.py").write_text(textwrap.dedent("""
+        def digest(parts):
+            tags = {p for p in parts}
+            return ",".join(tags)  # auronlint: nondeterministic -- fixture: caller folds with XOR, order-free
+
+        def digest2(parts):
+            tags = {p for p in parts}
+            return ";".join(tags)
+    """))
+    rep = lint_paths([os.path.join(str(tmp_path), "auron_tpu")],
+                     str(tmp_path), [DeterminismRule()])
+    joins = [f for f in rep.findings if "set iterated" in f.message]
+    assert {f.suppressed for f in joins} == {True, False}
+    assert next(f for f in joins if f.suppressed).reason
+
+
+def test_r16_vacuity_floor_fails_loudly(monkeypatch):
+    from tools.auronlint.rules import determinism
+
+    rule = determinism.DeterminismRule()
+    monkeypatch.setattr(determinism, "R16_MIN_COVERED", 10_000)
+    finds = list(rule.check_tree(REPO_ROOT))
+    assert any("R16 vacuity check" in m for _, _, m in finds)
+
+
+def test_r16_live_tree_closure_meets_floor():
+    from tools.auronlint.callgraph import build_graph
+    from tools.auronlint.rules.determinism import R16_MIN_COVERED, analyze
+
+    _finds, stats = analyze(build_graph(REPO_ROOT))
+    assert stats["covered"] >= R16_MIN_COVERED
+    assert "auron_tpu/sql/digest.py" in stats["rels"]
+    assert "auron_tpu/plan/builders.py" in stats["rels"]
+
+
+def test_unbound_native_and_nondeterministic_route_to_their_rules():
+    """Declaration routing: the dedicated R15/R16 annotations suppress
+    ONLY their rule — a disable they are not must not leak across."""
+    from tools.auronlint.core import SourceModule
+
+    src = textwrap.dedent("""
+        x = 1  # auronlint: unbound-native(foo_sym) -- dormant export
+        y = 2  # auronlint: nondeterministic -- order folded away
+    """)
+    mod = SourceModule("f.py", "f.py", src)
+    assert mod.suppression_for("R15", 2) is not None
+    assert mod.suppression_for("R16", 2) is None
+    assert mod.suppression_for("R16", 3) is not None
+    assert mod.suppression_for("R15", 3) is None
+    assert mod.suppression_for("R1", 3) is None

@@ -335,3 +335,22 @@ def test_unique_build_residual_condition_noncompact_emit():
     want = want[want.rv > 14].sort_values("k").reset_index(drop=True)
     assert got["k"].tolist() == want["k"].tolist()
     assert got["rv"].tolist() == want["rv"].tolist()
+
+
+def test_compact_join_output_knob_tri_resolution(monkeypatch):
+    """Tri-state semantics of spark.auron.join.compact.output pinned
+    after the resolve_tri rewrite: on/off force, auto follows the
+    backend (tests run on the CPU backend, where syncs are cheap and
+    auto resolves to compaction ON)."""
+    from auron_tpu.exec import base as exec_base
+    from auron_tpu.exec.joins.driver import _compact_join_output_enabled
+    from auron_tpu.utils.config import (
+        JOIN_COMPACT_OUTPUT, Configuration, conf_scope,
+    )
+
+    # drop the last test's lingering operator context so the gate reads
+    # the scoped conf, not a stale task's
+    monkeypatch.delattr(exec_base._ctx_local, "ctx", raising=False)
+    for mode, want in (("on", True), ("off", False), ("auto", True)):
+        with conf_scope(Configuration({JOIN_COMPACT_OUTPUT.key: mode})):
+            assert _compact_join_output_enabled() is want, mode

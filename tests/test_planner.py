@@ -229,3 +229,27 @@ def test_collect_and_udaf_over_wire():
     assert got["p"][0] == pytest.approx(np.percentile([1.0, 9.0, 5.0], 90))
     assert sorted(got["cl"][0]) == [1.0, 5.0, 9.0]
     assert list(got["cl"][1]) == [2.0]
+
+
+def test_builder_proto_emission_is_insertion_order_stable():
+    """Serialized plan/task protos feed digests and goldens, so builder
+    emission must be byte-stable regardless of the caller's dict build
+    order (R16's contract, pinned dynamically): kafka_scan offsets and
+    task conf maps serialize identically from reversed insertion
+    orders."""
+    schema = T.Schema([T.Field("v", T.INT64)])
+
+    fwd = {0: 7, 1: 11, 2: 13, 10: 17}
+    rev = dict(reversed(list(fwd.items())))
+    a = B.kafka_scan(schema, "t", "res", start_offsets=fwd)
+    b = B.kafka_scan(schema, "t", "res", start_offsets=rev)
+    assert a.SerializeToString(deterministic=True) == \
+        b.SerializeToString(deterministic=True)
+
+    plan = B.memory_scan(schema, "rid")
+    conf_fwd = {"spark.a": "1", "spark.b": "2", "spark.c": "3"}
+    conf_rev = dict(reversed(list(conf_fwd.items())))
+    ta = B.task(plan, conf=conf_fwd)
+    tb = B.task(plan, conf=conf_rev)
+    assert ta.SerializeToString(deterministic=True) == \
+        tb.SerializeToString(deterministic=True)

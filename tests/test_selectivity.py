@@ -94,3 +94,12 @@ def test_transfer_window_empty_arrays_and_harvest():
     assert [pl for _, pl in out] == ["a"]
     (v,) = harvest(jnp.arange(3))
     assert list(v) == [0, 1, 2]
+
+
+def test_predictor_enabled_auto_follows_compaction_auto():
+    """The predictor's auto arm resolves through the compaction knob's
+    OWN tri-state (resolve_tri composition, not a manual == chain): with
+    both knobs at auto on the CPU backend, compaction is on, so the
+    predictor is too; forcing compaction on keeps it on."""
+    assert predictor_enabled(_conf())  # both auto -> CPU -> on
+    assert predictor_enabled(_conf(**{JOIN_COMPACT_OUTPUT.key: "on"}))

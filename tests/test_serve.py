@@ -162,6 +162,52 @@ def test_plan_cache_key_includes_plan_knobs():
     assert plan_cache_key(sql, conf2) == plan_cache_key(sql, conf2)
 
 
+def test_plan_cache_key_splits_on_fusion_and_host_sort_knobs():
+    """The cache-split bugs auronlint R14 found in this tree: the fuse
+    family and exec.host.sort are read during lowering/fusion, so two
+    sessions differing on them must land on DIFFERENT cache keys —
+    before PLAN_KNOBS covered them, both tenants shared one compiled
+    plan and the second silently ran under the first's settings."""
+    from auron_tpu.utils.config import (
+        FUSE_AGG_INPUTS,
+        FUSE_ENABLE,
+        FUSE_MIN_OPS,
+        FUSE_PROBE,
+        FUSE_SHUFFLE,
+        HOST_SORT_MODE,
+    )
+
+    sql = _sql("q96")
+    for knob, a, b in (
+        (FUSE_ENABLE, "on", "off"),
+        (FUSE_PROBE, "on", "off"),
+        (FUSE_SHUFFLE, "on", "off"),
+        (FUSE_MIN_OPS, 2, 9),
+        (FUSE_AGG_INPUTS, True, False),
+        (HOST_SORT_MODE, "on", "off"),
+    ):
+        ka = plan_cache_key(sql, Configuration().set(knob, a))
+        kb = plan_cache_key(sql, Configuration().set(knob, b))
+        assert ka != kb, f"{knob.key} does not split the plan cache"
+    # defaults are stable: two fresh sessions share the compiled plan
+    assert plan_cache_key(sql, Configuration()) == plan_cache_key(
+        sql, Configuration())
+
+
+def test_plan_knobs_single_source_of_truth():
+    """PLAN_KNOBS lives in sql/digest.py (next to the digest it keys);
+    serve/cache.py re-exports the SAME tuple — two copies would drift."""
+    from auron_tpu.serve import cache
+    from auron_tpu.sql import digest
+
+    assert cache.PLAN_KNOBS is digest.PLAN_KNOBS
+    assert {k.key for k in digest.PLAN_KNOBS} >= {
+        "sql.shuffle.partitions",
+        "exec.fuse.enable",
+        "exec.host.sort",
+    }
+
+
 # ---------------------------------------------------------------------------
 # program cache: accounting, eviction, invalidation, zero-compile replay
 # ---------------------------------------------------------------------------

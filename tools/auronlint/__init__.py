@@ -1,6 +1,6 @@
 """auronlint — engine-invariant static analysis for the JAX/TPU side.
 
-Thirteen rule families over ``auron_tpu/`` (see docs/auronlint.md):
+Sixteen rule families over ``auron_tpu/`` (see docs/auronlint.md):
 
   R1  host-sync hygiene      implicit device->host transfers
   R2  retrace discipline     bounded jit compile cache
@@ -18,8 +18,16 @@ Thirteen rule families over ``auron_tpu/`` (see docs/auronlint.md):
                              server/foreign-reachable code
   R13 retrace stability      jit cache keys drawn from finite sets
                              (vacuity-checked coverage floors)
+  R14 config-knob contract   every read declared, tri-states through
+                             resolve_tri, plan-affecting knobs in the
+                             digest's PLAN_KNOBS, docs/CONFIG.md
+                             generated in lockstep
+  R15 FFI/ABI lockstep       native exports <-> bridge header <->
+                             ctypes argtypes/restype <-> numpy twins
+  R16 determinism taint      digest/golden/shuffle-reachable code is
+                             order- and clock-deterministic
 
-R7-R13 are interprocedural: a package-wide call graph + per-function
+R7-R16 are interprocedural: a package-wide call graph + per-function
 summaries (tools/auronlint/callgraph.py, summaries.py) with reachability
 from in-source ``thread-root`` declarations; R11/R12 additionally use
 per-function CFGs with exception edges (cfg.py). Run as ``make lint`` /

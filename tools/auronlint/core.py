@@ -76,7 +76,7 @@ _HOST_RETURNING = {
 _SUPPRESS_RE = re.compile(
     r"#\s*auronlint:\s*"
     r"(disable|disable-function|sync-point|sort-payload|thread-root"
-    r"|guarded-by|thread-owned|owned-by)"
+    r"|guarded-by|thread-owned|owned-by|unbound-native|nondeterministic)"
     r"(?:\((?P<budget>[^)]*)\))?"
     r"(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?"
     r"\s*(?:--\s*(?P<reason>.*?))?\s*$"
@@ -192,9 +192,10 @@ class SourceModule:
                 # the parenthesized argument is the root kind and is required
                 if budget not in THREAD_ROOT_KINDS:
                     self.bad_budgets.append(line)
-            elif kind in ("guarded-by", "owned-by"):
+            elif kind in ("guarded-by", "owned-by", "unbound-native"):
                 # the argument names the protecting lock / the owner that
-                # releases the resource, and is required
+                # releases the resource / the exported C symbol left
+                # deliberately unbound, and is required
                 if not budget:
                     self.bad_budgets.append(line)
             elif budget and (
@@ -255,6 +256,18 @@ class SourceModule:
                 # twin): the named holder releases the resource on paths
                 # R11 cannot see — suppresses R11 only
                 if rule == "R11" and line in self._lines_covered(sup):
+                    return sup
+                continue
+            if sup.kind == "unbound-native":
+                # declares an exported C symbol (named in the argument) as
+                # deliberately unbound from Python — suppresses R15 only
+                if rule == "R15" and line in self._lines_covered(sup):
+                    return sup
+                continue
+            if sup.kind == "nondeterministic":
+                # declares a sanctioned nondeterminism site on a
+                # digest-reachable path — suppresses R16 only
+                if rule == "R16" and line in self._lines_covered(sup):
                     return sup
                 continue
             if sup.covers_rule(rule) and line in self._lines_covered(sup):

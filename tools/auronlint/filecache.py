@@ -78,6 +78,15 @@ class FileCache:
         self._disk_valid: set[str] = set()
         #: rel -> {rule name: findings} produced/validated THIS process
         self._findings: dict[str, dict] = {}
+        #: rel -> {key: derived value} produced/validated THIS process —
+        #: per-file scan results the tree rules replay warm (see derived)
+        self._derived: dict[str, dict] = {}
+        #: auxiliary derived blobs for non-Python inputs (R15's parsed
+        #: C declarations over native/*.h and *.cpp): key ->
+        #: {"sigs": {path: (mtime_ns, size)}, "blob": pickled value}
+        self._aux: dict[str, dict] = {}
+        #: key -> (sigs, value) validated/built THIS process
+        self._aux_live: dict[str, tuple] = {}
         self._digest = _tools_digest()
         self._dirty = False
         self.hits = 0
@@ -94,6 +103,7 @@ class FileCache:
             if (payload.get("digest") == self._digest
                     and payload.get("proto") == _PICKLE_PROTO):
                 self._disk = payload["files"]
+                self._aux = payload.get("aux", {})
         except (OSError, pickle.UnpicklingError, EOFError, KeyError,
                 AttributeError, ImportError, IndexError, ValueError):
             # advisory: any skew or corruption = cold run
@@ -116,13 +126,21 @@ class FileCache:
             old = files.get(rel) if rel in self._disk_valid else None
             findings = dict(old["findings"]) if old else {}
             findings.update(self._findings.get(rel, {}))
+            derived = dict(old.get("derived", {})) if old else {}
+            for key, value in self._derived.get(rel, {}).items():
+                try:
+                    derived[key] = pickle.dumps(
+                        value, protocol=_PICKLE_PROTO)
+                except (pickle.PicklingError, TypeError):
+                    pass  # unpicklable derived value: recompute next run
             files[rel] = {
                 "sig": sig,
                 "ms": pickle.dumps(ms, protocol=_PICKLE_PROTO),
                 "findings": findings,
+                "derived": derived,
             }
         payload = {"digest": self._digest, "proto": _PICKLE_PROTO,
-                   "files": files}
+                   "files": files, "aux": self._aux}
         try:
             fd, tmp = tempfile.mkstemp(
                 dir=self.root, prefix=CACHE_BASENAME + ".")
@@ -163,6 +181,7 @@ class FileCache:
             # (findings included) is stale
             del self._live[rel]
             self._findings.pop(rel, None)
+            self._derived.pop(rel, None)
             self._disk_valid.discard(rel)
         hit = self._disk.get(rel) if _enabled() else None
         if hit is not None and sig is not None and hit["sig"] == sig:
@@ -206,6 +225,68 @@ class FileCache:
         per_rel[rule.name] = out
         self._dirty = True
         return out
+
+    def derived(self, rel: str, key: str, builder):
+        """``builder()`` memoized per (file, key): tree rules' per-module
+        scan phases are pure functions of the source, so an unchanged
+        file's scan replays from the cache instead of re-walking its AST
+        (the interprocedural composition over the scans still runs every
+        time — only the O(tree-nodes) extraction is cached). Callers
+        whose scan depends on tree-wide inputs fold a digest of those
+        inputs into ``key``. Only trustworthy for rels whose summary came
+        from a matching disk entry; otherwise the builder runs and its
+        result is recorded for the next run."""
+        per_rel = self._derived.setdefault(rel, {})
+        if key in per_rel:
+            return per_rel[key]
+        if rel in self._disk_valid:
+            blob = self._disk[rel].get("derived", {}).get(key)
+            if blob is not None:
+                try:
+                    value = pickle.loads(blob)
+                    per_rel[key] = value
+                    return value
+                except (pickle.UnpicklingError, EOFError, AttributeError,
+                        ImportError, IndexError, ValueError):
+                    pass  # corrupt entry: fall through to the builder
+        value = builder()
+        per_rel[key] = value
+        self._dirty = True
+        return value
+
+    def aux(self, key: str, paths: list, builder):
+        """Derived blob for a set of non-Python inputs, keyed on their
+        stat signatures — R15's parsed C declarations over
+        ``native/*.h``/``*.cpp`` ride here so a warm run skips the
+        parse. ``builder()`` runs when any input's signature moved (or
+        any input is missing — a vanished file must not serve its old
+        parse). Same advisory contract as the summary store: corruption
+        means a rebuild, never a failure."""
+        sigs = {p: self._sig(p) for p in paths}
+        live = self._aux_live.get(key)
+        if live is not None and live[0] == sigs:
+            return live[1]
+        complete = None not in sigs.values()
+        ent = self._aux.get(key) if _enabled() else None
+        if ent is not None and complete and ent.get("sigs") == sigs:
+            try:
+                value = pickle.loads(ent["blob"])
+                self._aux_live[key] = (sigs, value)
+                self.hits += 1
+                return value
+            except (pickle.UnpicklingError, EOFError, AttributeError,
+                    ImportError, IndexError, ValueError):
+                pass  # corrupt entry: rebuild
+        value = builder()
+        self._aux_live[key] = (sigs, value)
+        if complete:
+            self._aux[key] = {
+                "sigs": sigs,
+                "blob": pickle.dumps(value, protocol=_PICKLE_PROTO),
+            }
+            self._dirty = True
+        self.misses += 1
+        return value
 
 
 _caches: dict[str, FileCache] = {}

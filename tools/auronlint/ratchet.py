@@ -44,7 +44,7 @@ def current_counts(report, root: str) -> dict[str, int]:
     for f in report.suppressed:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     decls = {"sync-point": 0, "guarded-by": 0, "thread-owned": 0,
-             "owned-by": 0}
+             "owned-by": 0, "unbound-native": 0, "nondeterministic": 0}
     for ms in build_graph(root).modules.values():
         for s in ms.mod.suppressions:
             if s.kind in decls:

@@ -2,7 +2,10 @@
 one entry here, one section in docs/auronlint.md."""
 
 from tools.auronlint.rules.budgetproof import BudgetProofRule
+from tools.auronlint.rules.confcontract import ConfContractRule
+from tools.auronlint.rules.determinism import DeterminismRule
 from tools.auronlint.rules.errorpath import ErrorPathRule
+from tools.auronlint.rules.ffilockstep import FfiLockstepRule
 from tools.auronlint.rules.host_sync import HostSyncRule
 from tools.auronlint.rules.jitpurity import JitPurityRule
 from tools.auronlint.rules.lifecycle import ResourceLifecycleRule
@@ -29,12 +32,18 @@ ALL_RULES = (
     ResourceLifecycleRule(),
     ErrorPathRule(),
     RetraceStabilityRule(),
+    ConfContractRule(),
+    FfiLockstepRule(),
+    DeterminismRule(),
 )
 
 __all__ = [
     "ALL_RULES",
     "BudgetProofRule",
+    "ConfContractRule",
+    "DeterminismRule",
     "ErrorPathRule",
+    "FfiLockstepRule",
     "HostSyncRule",
     "JitPurityRule",
     "LockGuardRule",

@@ -1,0 +1,279 @@
+"""R16 — determinism taint: digest-reachable code is order- and
+clock-deterministic.
+
+Three artifacts must be byte-stable across processes, hosts, and
+PYTHONHASHSEED values: plan digests (the serving cache key and the
+multi-tenant admission ledger key), plan-proto emission (goldens diff
+serialized plans byte-for-byte), and the shuffle-block encoding chooser
+(reader and writer must pick the same decode path from the same bytes).
+A ``set`` iterated into any of them, a dict whose iteration order leaks
+into output, a wall-clock or ``os.environ`` read, or ``id()``-keyed
+ordering makes the artifact flap — the cache misses (or worse, splits)
+on semantically identical inputs, and golden diffs churn.
+
+The rule anchors at the emission surfaces — every function in
+``sql/digest.py``, ``plan/explain.py``, ``plan/builders.py`` plus the
+shuffle-block encoders in ``exec/shuffle/format.py`` — and closes over
+NON-generic call edges (resolved imports/methods only), then scans every
+function in the closure for:
+
+- iteration over a ``set``/``frozenset`` (literal, comprehension,
+  constructor call, or a local assigned from one) in a ``for``,
+  comprehension, or ``join`` argument, unless wrapped in ``sorted()``;
+- ``.items()`` / ``.keys()`` / ``.values()`` iterated unsorted — dict
+  insertion order is deterministic only when every inserter is, which
+  is exactly what cross-boundary dicts (parameters, protos, JSON) do
+  not guarantee;
+- wall-clock/entropy reads: ``time.*``, ``datetime.now/utcnow/today``,
+  ``random.*``, ``uuid.*``, ``os.environ`` / ``os.getenv`` (the env
+  layer belongs to ``utils/config.py`` — ``env_key_for`` and friends —
+  which the closure exempts);
+- ``id()`` calls — CPython address-keyed ordering differs per process.
+
+Sanctioned sites carry ``# auronlint: nondeterministic -- <reason>``
+(a dedicated declaration routed to R16 only). Vacuity floor: the rule
+KNOWS how many functions the closure covered and fails the tree when
+the count drops below the recorded floor — an anchor rename that empties
+the closure fails loudly instead of passing vacuously.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.auronlint.core import Rule
+from tools.auronlint.rules.confcontract import own_nodes
+
+#: floor for the vacuity check: functions the determinism closure must
+#: keep covering tree-wide. Raise as emission surfaces grow; a DROP
+#: means an anchor module/function was renamed out from under the rule.
+R16_MIN_COVERED = 60
+
+#: whole-module anchors: everything these files define emits into a
+#: deterministic artifact (digests, EXPLAIN goldens, plan protos)
+ANCHOR_RELS = (
+    "auron_tpu/sql/digest.py",
+    "auron_tpu/plan/explain.py",
+    "auron_tpu/plan/builders.py",
+)
+
+#: named anchors: the shuffle-block encoding choosers (writer-side
+#: encode picks the codec the reader must re-derive from the bytes)
+ANCHOR_FUNCS = {
+    "auron_tpu/exec/shuffle/format.py": {"encode_block", "encode_block_v2"},
+}
+
+#: modules exempt from the env-read clause: the config env layer OWNS
+#: process-environment access (env_key_for and the override reader)
+ENV_EXEMPT_RELS = {"auron_tpu/utils/config.py"}
+
+_DICT_ITERS = {"items", "keys", "values"}
+_CLOCK_ATTRS = {
+    "time": {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns", "process_time"},
+    "datetime": {"now", "utcnow", "today"},
+    "date": {"today"},
+}
+
+
+def _callee(node: ast.Call):
+    """(receiver-root-name-or-None, terminal-name) of a call."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return None, f.id
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        while isinstance(v, ast.Attribute):
+            v = v.value
+        return (v.id if isinstance(v, ast.Name) else None), f.attr
+    return None, None
+
+
+def _is_set_expr(node, assigns, depth=0) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        recv, name = _callee(node)
+        if recv is None and name in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.Name) and depth < 2:
+        src = assigns.get(node.id)
+        if src is not None:
+            return _is_set_expr(src, assigns, depth + 1)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.BitOr,
+                                                            ast.BitAnd,
+                                                            ast.Sub)):
+        return _is_set_expr(node.left, assigns, depth + 1) \
+            or _is_set_expr(node.right, assigns, depth + 1)
+    return False
+
+
+def _is_unsorted_dict_iter(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _DICT_ITERS
+            and not node.args and not node.keywords)
+
+
+def _closure(g, anchor_rels, anchor_funcs) -> set:
+    seen = set()
+    for q, fs in g.functions.items():
+        if fs.rel in anchor_rels:
+            seen.add(q)
+        elif fs.name in anchor_funcs.get(fs.rel, ()):
+            seen.add(q)
+    frontier = list(seen)
+    while frontier:
+        q = frontier.pop()
+        for e in g.edges_out.get(q, ()):
+            if e.generic or e.callee in seen:
+                continue
+            seen.add(e.callee)
+            frontier.append(e.callee)
+    return seen
+
+
+def _scan_function(rel: str, fn, findings: list) -> None:
+    """Hazard scan over one function's own nodes (nested defs are their
+    own closure rows)."""
+    assigns: dict[str, ast.AST] = {}
+    nodes = list(own_nodes(fn))
+    for n in nodes:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name):
+            assigns[n.targets[0].id] = n.value
+
+    def check_iter(expr, where: str):
+        if _is_set_expr(expr, assigns):
+            findings.append((rel, expr.lineno, (
+                f"set iterated into {where} on a digest-reachable path — "
+                "set order depends on PYTHONHASHSEED; wrap in sorted() "
+                "or declare `# auronlint: nondeterministic -- <reason>`"
+            )))
+        elif _is_unsorted_dict_iter(expr):
+            findings.append((rel, expr.lineno, (
+                f"unsorted .{expr.func.attr}() iterated into {where} on "
+                "a digest-reachable path — dict order is whatever the "
+                "inserter did; wrap in sorted() (or declare "
+                "`# auronlint: nondeterministic -- <reason>` if the "
+                "order provably cannot reach the output)"
+            )))
+
+    for n in nodes:
+        if isinstance(n, (ast.For, ast.AsyncFor)):
+            check_iter(n.iter, "a for loop")
+        elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            for gen in n.generators:
+                check_iter(gen.iter, "a comprehension")
+        elif isinstance(n, ast.Call):
+            recv, name = _callee(n)
+            # any attribute .join(x) counts — the receiver is usually a
+            # string LITERAL (",".join(...)), which has no root name
+            if name == "join" and isinstance(n.func, ast.Attribute) \
+                    and len(n.args) == 1:
+                check_iter(n.args[0], "a join")
+            if recv is None and name == "id" and n.args:
+                findings.append((rel, n.lineno, (
+                    "id() on a digest-reachable path — CPython addresses "
+                    "differ per process; key on a stable identity or "
+                    "declare `# auronlint: nondeterministic -- <reason>`"
+                )))
+            elif recv in _CLOCK_ATTRS and name in _CLOCK_ATTRS[recv]:
+                findings.append((rel, n.lineno, (
+                    f"wall-clock read {recv}.{name}() on a "
+                    "digest-reachable path — the artifact must be "
+                    "byte-stable across runs; pass time in from the "
+                    "caller or declare "
+                    "`# auronlint: nondeterministic -- <reason>`"
+                )))
+            elif recv == "random" or (recv is None and name in (
+                    "random", "randint", "randrange", "shuffle",
+                    "getrandbits")):
+                findings.append((rel, n.lineno, (
+                    f"entropy read {name}() on a digest-reachable path — "
+                    "seed it from the plan or declare "
+                    "`# auronlint: nondeterministic -- <reason>`"
+                )))
+            elif recv == "uuid" and name.startswith("uuid"):
+                findings.append((rel, n.lineno, (
+                    f"uuid.{name}() on a digest-reachable path — "
+                    "per-call identity; derive ids from plan content or "
+                    "declare `# auronlint: nondeterministic -- <reason>`"
+                )))
+            elif name == "getenv" and rel not in ENV_EXEMPT_RELS:
+                findings.append((rel, n.lineno, (
+                    "os.getenv() on a digest-reachable path — env reads "
+                    "belong to utils/config.py (env_key_for); read "
+                    "through a ConfigOption"
+                )))
+        elif isinstance(n, ast.Attribute) and n.attr == "environ" \
+                and isinstance(n.value, ast.Name) and n.value.id == "os" \
+                and rel not in ENV_EXEMPT_RELS:
+            findings.append((rel, n.lineno, (
+                "os.environ read on a digest-reachable path — env reads "
+                "belong to utils/config.py (env_key_for); read through "
+                "a ConfigOption"
+            )))
+
+
+def analyze(g, anchor_rels=ANCHOR_RELS, anchor_funcs=ANCHOR_FUNCS):
+    """(findings, stats) over a built CallGraph."""
+    findings: list = []
+    closure = _closure(g, anchor_rels, anchor_funcs)
+
+    # FunctionDef nodes by (rel, lineno) — summaries carry def linenos.
+    # Only the handful of modules the closure touches get walked; the
+    # rest of the package is irrelevant to this rule
+    closure_rels = {g.functions[q].rel for q in closure
+                    if q in g.functions}
+    def_at: dict[tuple, ast.AST] = {}
+    for rel in sorted(closure_rels):
+        if rel not in g.modules:
+            continue
+        for n in ast.walk(g.modules[rel].mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                def_at[(rel, n.lineno)] = n
+
+    covered = 0
+    for q in sorted(closure):
+        fs = g.functions.get(q)
+        if fs is None or fs.rel in ENV_EXEMPT_RELS:
+            continue
+        fn = def_at.get((fs.rel, fs.lineno))
+        if fn is None:
+            continue
+        covered += 1
+        _scan_function(fs.rel, fn, findings)
+
+    stats = {
+        "covered": covered,
+        "closure": len(closure),
+        "rels": sorted({g.functions[q].rel for q in closure
+                        if q in g.functions}),
+    }
+    return findings, stats
+
+
+class DeterminismRule(Rule):
+    name = "R16"
+    doc = "determinism taint: digest-reachable code is order/clock-stable"
+
+    def __init__(self):
+        self.last_stats: dict | None = None
+
+    def check_tree(self, root: str):
+        from tools.auronlint.callgraph import build_graph
+
+        findings, stats = analyze(build_graph(root))
+        self.last_stats = stats
+        yield from findings
+        if stats["covered"] < R16_MIN_COVERED:
+            yield "auron_tpu", 0, (
+                f"R16 vacuity check: only {stats['covered']} functions "
+                f"covered by the determinism closure (floor "
+                f"{R16_MIN_COVERED}) — an emission-surface anchor was "
+                "renamed out from under the rule; fix ANCHOR_RELS/"
+                "ANCHOR_FUNCS or consciously lower R16_MIN_COVERED with "
+                "review"
+            )

@@ -51,8 +51,9 @@ class ErrorPathRule(Rule):
 
     def check_tree(self, root: str):
         from tools.auronlint.callgraph import build_graph
+        from tools.auronlint.filecache import file_cache
 
-        yield from analyze(build_graph(root))
+        yield from analyze(build_graph(root), fc=file_cache(root))
 
 
 def _broad_handler(h: ast.ExceptHandler) -> bool:
@@ -116,15 +117,21 @@ def _thread_targets(ms) -> dict[str, int]:
 _FRAMEWORK_ENTRIES = {"do_GET", "do_POST", "do_PUT", "do_DELETE"}
 
 
-def analyze(g):
-    """(rel, line, message) findings over a built CallGraph."""
+def analyze(g, fc=None):
+    """(rel, line, message) findings over a built CallGraph. ``fc``:
+    optional FileCache whose ``derived`` store replays the per-module
+    thread-target scan for unchanged files (fixtures pass None)."""
     reach = g.roots_reaching()
 
     for rel in sorted(g.modules):
         ms = g.modules[rel]
 
         # ---- escaping-thread-entry ------------------------------------
-        entries = _thread_targets(ms)
+        if fc is not None:
+            entries = fc.derived(
+                rel, "r12threads", lambda m=ms: _thread_targets(m))
+        else:
+            entries = _thread_targets(ms)
         for q, fs in ms.functions.items():
             is_entry = q in entries or (
                 fs.cls is not None and fs.name in _FRAMEWORK_ENTRIES

@@ -1,0 +1,547 @@
+"""R15 — FFI/ABI lockstep: C signatures and ctypes bindings move together.
+
+The ctypes seam (auron_tpu/native.py <-> native/auron_native.cpp) and the
+embedding bridge (native/auron_bridge.h <-> auron_bridge.cpp <->
+bridge/api.py) are the engine's highest-risk boundary: a stale argtypes
+list after a C signature change corrupts memory silently, and a missing
+``restype`` truncates a 64-bit return through ctypes' int default. This
+rule parses the C declarations with a small fixed-grammar parser (the
+files are plain C ABI — no templates, no overloads) and proves, per
+exported symbol:
+
+- **kernel bindings** (auron_native.cpp): every exported symbol has a
+  ctypes binding in native.py whose argtypes match the C parameter list
+  in arity, scalar width/signedness, and pointerness (pointee width
+  checked; ``c_void_p`` is the sanctioned wildcard for opaque
+  pointers), and an EXPLICIT restype (``None`` for void — a missing
+  restype silently defaults to c_int);
+- **coverage both ways**: an exported symbol with no binding is a
+  finding unless native.py carries a reasoned
+  ``# auronlint: unbound-native(<symbol>) -- <why>`` declaration; a
+  binding for a symbol the .cpp no longer exports is a finding (the
+  load would AttributeError at runtime, or worse, bind a stale .so);
+- **numpy twins**: every exported kernel has a host twin
+  (``<sym>_host``, f64/f32 variants folding to one ``<base>_host``)
+  so the engine runs library-less and the generated parity suite
+  (tests/test_native_parity.py) can pin native == numpy byte-identical;
+- **bridge lockstep** (auron_bridge.h vs auron_bridge.cpp): every
+  header declaration has a definition with the identical normalized
+  signature and vice versa, and every definition that calls into the
+  Python engine does so via ``PyObject_CallMethod(g_api, "<fn>", ...)``
+  where ``<fn>`` is a real function in bridge/api.py.
+
+Vacuity floors: the rule KNOWS how many symbols it checked on each
+boundary and fails the tree when any count drops below the recorded
+floor — deleting the header (or the parser losing the grammar) fails
+loudly instead of passing on zero symbols.
+
+Parsed C declarations are memoized through the lint file cache keyed on
+the native sources' stat signatures (tools/auronlint/filecache.py), so
+warm runs skip the parse.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.auronlint.core import Rule, SourceModule
+
+#: floors for the vacuity check: exported kernel symbols seen/bound,
+#: bridge declarations cross-checked, host twins enumerated. Raise as
+#: kernels are added; a DROP means the parser lost real symbols.
+R15_MIN_EXPORTS = 12
+R15_MIN_BOUND = 12
+R15_MIN_BRIDGE_DECLS = 13
+R15_MIN_TWINS = 9
+
+NATIVE_CPP = "native/auron_native.cpp"
+BRIDGE_H = "native/auron_bridge.h"
+BRIDGE_CPP = "native/auron_bridge.cpp"
+NATIVE_PY = "auron_tpu/native.py"
+BRIDGE_API = "auron_tpu/bridge/api.py"
+
+# -- C declaration parser (fixed grammar: plain C ABI, fixed-width types) ----
+
+#: scalar width/signedness classes; pointers are ("ptr", pointee class)
+_C_WIDTHS = {
+    "void": "void", "char": "i8", "int8_t": "i8", "uint8_t": "u8",
+    "int16_t": "i16", "uint16_t": "u16", "int": "i32", "int32_t": "i32",
+    "uint32_t": "u32", "int64_t": "i64", "uint64_t": "u64", "size_t": "u64",
+    "float": "f32", "double": "f64", "bool": "u8",
+}
+
+_DECL_RE = re.compile(
+    r"([A-Za-z_][\w\s]*?[\w*])\s+([A-Za-z_]\w*)\s*\(([^)]*)\)\s*(;|\{)"
+)
+_TYPEDEF_RE = re.compile(r"typedef\s+([A-Za-z_][\w\s]*?[\w*])\s+(\w+)\s*;")
+_FNPTR_TYPEDEF_RE = re.compile(
+    r"typedef\s+[^;(]*\(\s*\*\s*(\w+)\s*\)\s*\([^;]*?\)\s*;", re.S
+)
+_CALLMETHOD_RE = re.compile(r'PyObject_CallMethod\(\s*g_api\s*,\s*"(\w+)"')
+
+_C_KEYWORDS = {"if", "while", "for", "switch", "return", "else", "sizeof",
+               "do", "case"}
+
+
+def _strip_c(text: str) -> tuple:
+    """(comments-stripped, comments+strings-stripped) views of one C
+    source, both LENGTH-preserving so offsets map 1:1 to the original —
+    structure is parsed on the fully-stripped view (brace counting must
+    not be fooled by braces in strings), while function bodies are
+    sliced from the comments-only view (the g_api call-name cross-check
+    reads string literals)."""
+    def blank(m):
+        return re.sub(r"[^\n]", " ", m.group(0))
+
+    def blank_str(m):
+        s = m.group(0)
+        return '"' + " " * (len(s) - 2) + '"'
+
+    nocomment = re.sub(r"/\*.*?\*/", blank, text, flags=re.S)
+    nocomment = re.sub(r"//[^\n]*", blank, nocomment)
+    stripped = re.sub(r'"(?:[^"\\\n]|\\.)*"', blank_str, nocomment)
+    return nocomment, stripped
+
+
+def _canon_type(t: str, typedefs: dict) -> tuple:
+    """Canonical descriptor for one C type: ("scalar", width-class) or
+    ("ptr", pointee-class) — double pointers collapse to
+    ("ptr", "ptr")."""
+    t = t.strip()
+    stars = t.count("*")
+    base = None
+    for tok in re.sub(r"[*&]", " ", t).split():
+        if tok in ("const", "struct", "unsigned", "signed", "inline"):
+            continue
+        base = tok
+        break
+    base = typedefs.get(base, base)
+    if base in typedefs:
+        base = typedefs[base]
+    cls = "fnptr" if base and typedefs.get(base) == "fnptr" else \
+        _C_WIDTHS.get(base or "", base or "?")
+    if stars == 0:
+        return ("scalar", cls)
+    if stars == 1:
+        return ("ptr", cls)
+    return ("ptr", "ptr")
+
+
+def _split_params(params: str, typedefs: dict) -> list:
+    params = params.strip()
+    if not params or params == "void":
+        return []
+    out = []
+    for p in params.split(","):
+        p = p.strip()
+        base = typedefs.get(p)
+        if base == "fnptr" or typedefs.get(p.split()[0] if p.split() else "") == "fnptr":
+            out.append(("scalar", "fnptr"))
+            continue
+        # drop the trailing parameter name (last identifier not glued
+        # to a star); "const uint8_t* data" -> type "const uint8_t*"
+        m = re.match(r"^(.*?)(\b[A-Za-z_]\w*)?$", p.rstrip())
+        typ = (m.group(1) or p).strip() if m else p
+        if not typ:
+            typ = p
+        out.append(_canon_type(typ, typedefs))
+    return out
+
+
+def parse_c_functions(text: str, extra_typedefs: dict | None = None) -> dict:
+    """{name: {"ret": desc, "params": [desc], "line": n, "kind":
+    "decl"|"def", "body": str-or-None}} for every function
+    declaration/definition in one C source. Exported definitions are
+    the non-static ones at file/extern-"C" depth. ``extra_typedefs``
+    carries typedefs from an included header (a .cpp implementing a
+    header's ABI resolves the header's typedef names)."""
+    bodies_text, text = _strip_c(text)
+    typedefs = dict(extra_typedefs or {})
+    for m in _FNPTR_TYPEDEF_RE.finditer(text):
+        typedefs[m.group(1)] = "fnptr"
+    for m in _TYPEDEF_RE.finditer(text):
+        if "(" not in m.group(1):
+            canon = _canon_type(m.group(1), typedefs)
+            typedefs[m.group(2)] = m.group(1).strip() if canon[0] == "scalar" \
+                else m.group(1).strip()
+    out: dict[str, dict] = {}
+    for m in _DECL_RE.finditer(text):
+        ret_text, name, params, tail = m.groups()
+        if name in _C_KEYWORDS or "=" in ret_text:
+            continue
+        ret_toks = ret_text.split()
+        if "typedef" in ret_toks:
+            continue
+        static = "static" in ret_toks
+        prefix = text[: m.start()]
+        depth = prefix.count("{") - prefix.count("}")
+        extern_blocks = len(re.findall(r'extern\s*"[^"]*"\s*\{', prefix))
+        line = prefix.count("\n") + 1
+        body = None
+        if tail == "{":
+            # brace-matched body for the g_api call-name cross-check
+            i = m.end() - 1
+            d = 0
+            for j in range(i, len(text)):
+                if text[j] == "{":
+                    d += 1
+                elif text[j] == "}":
+                    d -= 1
+                    if d == 0:
+                        body = bodies_text[i: j + 1]
+                        break
+        entry = {
+            "ret": _canon_type(ret_text.replace("extern", " "), typedefs),
+            "params": _split_params(params, typedefs),
+            "line": line,
+            "kind": "def" if tail == "{" else "decl",
+            "static": static,
+            "exported": (not static) and depth <= extern_blocks,
+            "body": body,
+        }
+        # a redeclaration does not shadow a definition
+        if name not in out or (entry["kind"] == "def" and entry["exported"]):
+            out[name] = entry
+    out["__typedefs__"] = typedefs
+    return out
+
+
+# -- ctypes side -------------------------------------------------------------
+
+_CTYPES_WIDTHS = {
+    "c_int8": "i8", "c_uint8": "u8", "c_byte": "i8", "c_ubyte": "u8",
+    "c_int16": "i16", "c_uint16": "u16", "c_int": "i32", "c_int32": "i32",
+    "c_uint": "u32", "c_uint32": "u32", "c_int64": "i64", "c_long": "i64",
+    "c_longlong": "i64", "c_uint64": "u64", "c_ulonglong": "u64",
+    "c_size_t": "u64", "c_float": "f32", "c_double": "f64", "c_bool": "u8",
+}
+
+
+def _ctypes_desc(node: ast.AST) -> tuple | None:
+    """Canonical descriptor for one ctypes argtypes/restype expression,
+    or None when unrecognized (unrecognized is a finding — the binding
+    must be statically checkable)."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return ("scalar", "void")
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name == "c_void_p":
+        return ("ptr", "void")
+    if name == "c_char_p":
+        return ("ptr", "i8")
+    if name in _CTYPES_WIDTHS:
+        return ("scalar", _CTYPES_WIDTHS[name])
+    if isinstance(node, ast.Call):
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name) else None)
+        if fname == "POINTER" and node.args:
+            inner = _ctypes_desc(node.args[0])
+            if inner is None:
+                return None
+            return ("ptr", "ptr" if inner[0] == "ptr" else inner[1])
+        if fname == "CFUNCTYPE":
+            return ("scalar", "fnptr")
+    return None
+
+
+def _desc_match(c: tuple, py: tuple) -> bool:
+    """ctypes descriptor satisfies C descriptor; c_void_p is the
+    sanctioned wildcard for any pointer (opaque handles), and a C
+    fnptr parameter accepts c_void_p/CFUNCTYPE."""
+    if c[1] == "fnptr":
+        return py == ("scalar", "fnptr") or py == ("ptr", "void")
+    if c[0] == "ptr" and py == ("ptr", "void"):
+        return True
+    if c[0] == "ptr" and py[0] == "ptr":
+        return py[1] in (c[1], "void") or c[1] == "void"
+    return c == py
+
+
+def collect_bindings(mod: SourceModule) -> dict:
+    """{sym: {"argtypes": [...exprs], "argtypes_line", "restype": expr,
+    "restype_line"}} from ``lib.<sym>.argtypes = [...]`` /
+    ``lib.<sym>.restype = <t>`` statements anywhere in native.py."""
+    out: dict[str, dict] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Attribute) \
+                or t.attr not in ("argtypes", "restype"):
+            continue
+        recv = t.value
+        if not (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)):
+            continue
+        sym = recv.attr
+        ent = out.setdefault(sym, {})
+        if t.attr == "argtypes":
+            elts = node.value.elts \
+                if isinstance(node.value, (ast.List, ast.Tuple)) else None
+            ent["argtypes"] = elts
+            ent["argtypes_line"] = node.lineno
+        else:
+            ent["restype"] = node.value
+            ent["restype_line"] = node.lineno
+    return out
+
+
+def _twin_names(sym: str) -> tuple:
+    """Candidate host-twin names for one exported kernel: exact
+    ``<sym>_host`` or the f64/f32 family / trailing-qualifier fold
+    (``scaled_pack_f64`` -> ``scaled_pack_host``, ``crc32c_hash`` ->
+    ``crc32c_host``)."""
+    names = [f"{sym}_host"]
+    if "_" in sym:
+        names.append(sym.rsplit("_", 1)[0] + "_host")
+    return tuple(names)
+
+
+def unbound_declarations(mod: SourceModule) -> dict:
+    """{symbol: declaration line} from
+    ``# auronlint: unbound-native(<symbol>) -- why`` comments."""
+    return {s.budget: s.line for s in mod.suppressions
+            if s.kind == "unbound-native" and s.budget}
+
+
+def _load_module(root: str, rel: str) -> SourceModule | None:
+    path = os.path.join(root, rel)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return SourceModule(path, rel, fh.read())
+    except (OSError, SyntaxError):
+        return None
+
+
+def _parsed_c(root: str, rel: str, include_rels: tuple = ()) -> dict | None:
+    """Parsed C functions for one native source, memoized through the
+    lint file cache keyed on the stat signatures of the file AND its
+    included headers (whose typedefs the parse resolves)."""
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return None
+    paths = [path] + [os.path.join(root, r) for r in include_rels]
+
+    def build():
+        typedefs: dict = {}
+        for inc in paths[1:]:
+            try:
+                with open(inc, encoding="utf-8") as fh:
+                    typedefs.update(
+                        parse_c_functions(fh.read())["__typedefs__"])
+            except OSError:
+                pass
+        with open(path, encoding="utf-8") as fh:
+            return parse_c_functions(fh.read(), typedefs)
+
+    try:
+        from tools.auronlint.filecache import file_cache
+
+        fc = file_cache(root)
+    except Exception:
+        fc = None
+    if fc is not None:
+        return fc.aux(f"c::{rel}", paths, build)
+    return build()
+
+
+def analyze(root: str):
+    """(findings, stats) over the native boundary of one tree. Findings
+    anchor in the Python files where possible (suppressible); pure C-side
+    lockstep breaks anchor in the C file that drifted."""
+    findings: list = []
+    stats = {"exports": 0, "bound": 0, "bridge_decls": 0, "twins": 0,
+             "pairs": [], "api_calls": {}}
+
+    native = _parsed_c(root, NATIVE_CPP)
+    bridge_h = _parsed_c(root, BRIDGE_H)
+    bridge_cpp = _parsed_c(root, BRIDGE_CPP, include_rels=(BRIDGE_H,))
+    py = _load_module(root, NATIVE_PY)
+    api = _load_module(root, BRIDGE_API)
+
+    # ---- kernel side: auron_native.cpp <-> native.py ctypes ----------------
+    if native is not None and py is not None:
+        exports = {n: d for n, d in native.items()
+                   if n != "__typedefs__" and d["kind"] == "def"
+                   and d["exported"]}
+        bindings = collect_bindings(py)
+        declared_unbound = unbound_declarations(py)
+        twins = {f.name for f in ast.walk(py.tree)
+                 if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        stats["exports"] = len(exports)
+        stats["bound"] = sum(1 for s in exports if s in bindings)
+
+        for sym, decl in sorted(exports.items()):
+            b = bindings.get(sym)
+            if b is None:
+                if sym in declared_unbound:
+                    pass  # reasoned unbound-native declaration
+                else:
+                    findings.append((NATIVE_PY, 1, (
+                        f"exported native symbol {sym} "
+                        f"({NATIVE_CPP}:{decl['line']}) has no ctypes "
+                        "binding in native.py — bind it with explicit "
+                        "argtypes/restype, or declare "
+                        f"`# auronlint: unbound-native({sym}) -- <why>`"
+                    )))
+            else:
+                args = b.get("argtypes")
+                line = b.get("argtypes_line", 1)
+                if args is None:
+                    findings.append((NATIVE_PY, line, (
+                        f"{sym}.argtypes is not a static list literal — "
+                        "the binding must be statically checkable "
+                        "against the C signature"
+                    )))
+                elif len(args) != len(decl["params"]):
+                    findings.append((NATIVE_PY, line, (
+                        f"{sym}.argtypes has {len(args)} entries but the "
+                        f"C signature ({NATIVE_CPP}:{decl['line']}) takes "
+                        f"{len(decl['params'])} parameters — stale "
+                        "binding corrupts memory silently"
+                    )))
+                else:
+                    for i, (cdesc, expr) in enumerate(
+                            zip(decl["params"], args)):
+                        pdesc = _ctypes_desc(expr)
+                        if pdesc is None or not _desc_match(cdesc, pdesc):
+                            got = ast.unparse(expr)
+                            findings.append((NATIVE_PY, expr.lineno, (
+                                f"{sym}.argtypes[{i}] is {got} but the C "
+                                f"parameter is {cdesc[1]}"
+                                f"{'*' if cdesc[0] == 'ptr' else ''} "
+                                f"({NATIVE_CPP}:{decl['line']}) — width/"
+                                "pointerness mismatch"
+                            )))
+                rt = b.get("restype")
+                if rt is None:
+                    findings.append((NATIVE_PY, line, (
+                        f"{sym} binding has no explicit restype — ctypes "
+                        "defaults to c_int, truncating the "
+                        f"{decl['ret'][1]} return; set "
+                        f"`lib.{sym}.restype = "
+                        f"{'None' if decl['ret'][1] == 'void' else '<ctype>'}`"
+                    )))
+                else:
+                    rdesc = _ctypes_desc(rt)
+                    if rdesc is None or not _desc_match(decl["ret"], rdesc):
+                        findings.append((NATIVE_PY, b.get("restype_line", line), (
+                            f"{sym}.restype is {ast.unparse(rt)} but the "
+                            f"C return type is {decl['ret'][1]}"
+                            f"{'*' if decl['ret'][0] == 'ptr' else ''} "
+                            f"({NATIVE_CPP}:{decl['line']})"
+                        )))
+            twin_found = next(
+                (t for t in _twin_names(sym) if t in twins), None)
+            if twin_found is None and sym not in declared_unbound:
+                findings.append((NATIVE_PY, 1, (
+                    f"native kernel {sym} has no numpy twin "
+                    f"({' or '.join(_twin_names(sym))}) in native.py — "
+                    "the engine must run library-less and the parity "
+                    "suite pins native == numpy"
+                )))
+            elif twin_found is not None:
+                stats["pairs"].append((sym, twin_found))
+
+        stats["twins"] = len({t for _s, t in stats["pairs"]})
+        for sym, bline in sorted(bindings.items()):
+            if sym not in exports:
+                findings.append((NATIVE_PY, bline.get("argtypes_line")
+                                 or bline.get("restype_line") or 1, (
+                    f"native.py binds symbol {sym} which "
+                    f"{NATIVE_CPP} does not export — remove the stale "
+                    "binding or restore the kernel"
+                )))
+        for sym, line in sorted(declared_unbound.items()):
+            if sym in bindings or sym not in exports:
+                findings.append((NATIVE_PY, line, (
+                    f"unbound-native({sym}) declaration is stale — the "
+                    "symbol is "
+                    + ("already bound" if sym in bindings
+                       else f"not exported by {NATIVE_CPP}")
+                    + "; drop the declaration"
+                )))
+
+    # ---- bridge side: auron_bridge.h <-> auron_bridge.cpp <-> api.py -------
+    if bridge_h is not None and bridge_cpp is not None:
+        decls = {n: d for n, d in bridge_h.items()
+                 if n != "__typedefs__" and d["kind"] == "decl"}
+        defs = {n: d for n, d in bridge_cpp.items()
+                if n != "__typedefs__" and d["kind"] == "def"
+                and d["exported"]}
+        stats["bridge_decls"] = len(decls)
+        api_fns = set()
+        if api is not None:
+            api_fns = {f.name for f in ast.walk(api.tree)
+                       if isinstance(f, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+        for name, d in sorted(decls.items()):
+            impl = defs.get(name)
+            if impl is None:
+                findings.append((BRIDGE_H, d["line"], (
+                    f"bridge ABI symbol {name} is declared in the header "
+                    f"but {BRIDGE_CPP} does not define it — the .so "
+                    "would fail link-time or dlsym"
+                )))
+                continue
+            if d["params"] != impl["params"] or d["ret"] != impl["ret"]:
+                findings.append((BRIDGE_CPP, impl["line"], (
+                    f"bridge symbol {name} definition signature drifted "
+                    f"from the header ({BRIDGE_H}:{d['line']}) — the "
+                    "header freezes the ABI; change both in lockstep"
+                )))
+            for called in _CALLMETHOD_RE.findall(impl.get("body") or ""):
+                stats["api_calls"][name] = called
+                if api_fns and called not in api_fns:
+                    findings.append((BRIDGE_CPP, impl["line"], (
+                        f"bridge symbol {name} calls bridge.api."
+                        f"{called}() which {BRIDGE_API} does not define "
+                        "— the call would raise AttributeError through "
+                        "the embedded interpreter"
+                    )))
+        for name, impl in sorted(defs.items()):
+            if name not in decls:
+                findings.append((BRIDGE_CPP, impl["line"], (
+                    f"bridge symbol {name} is exported by the .cpp but "
+                    f"missing from {BRIDGE_H} — the header freezes the "
+                    "ABI; declare it"
+                )))
+
+    return findings, stats
+
+
+class FfiLockstepRule(Rule):
+    name = "R15"
+    doc = "FFI/ABI lockstep: C signatures <-> ctypes bindings <-> twins"
+
+    def __init__(self):
+        self.last_stats: dict | None = None
+
+    def check_tree(self, root: str):
+        if not os.path.exists(os.path.join(root, NATIVE_CPP)) \
+                and not os.path.exists(os.path.join(root, BRIDGE_H)):
+            return  # tree without a native boundary: nothing to prove
+        findings, stats = analyze(root)
+        self.last_stats = stats
+        yield from findings
+        checks = (
+            ("exports", R15_MIN_EXPORTS, "exported kernel symbols parsed"),
+            ("bound", R15_MIN_BOUND, "kernel symbols ctypes-bound"),
+            ("bridge_decls", R15_MIN_BRIDGE_DECLS,
+             "bridge ABI declarations cross-checked"),
+            ("twins", R15_MIN_TWINS, "numpy twins enumerated"),
+        )
+        for key, floor, what in checks:
+            if stats[key] < floor:
+                yield "auron_tpu", 0, (
+                    f"R15 vacuity check: only {stats[key]} {what} (floor "
+                    f"{floor}) — the parser lost real symbols (or the "
+                    "boundary shrank); fix the discovery or consciously "
+                    "lower the floor with review"
+                )
+                break

@@ -155,12 +155,21 @@ class RegistrySyncRule(Rule):
             with open(os.path.join(root, rel), encoding="utf-8") as f:
                 return f.read()
 
+        # parsed engine modules come from the shared file cache — the
+        # same ASTs every other rule uses, no re-parse per run
+        from tools.auronlint.filecache import file_cache
+
+        fc = file_cache(root)
+
+        def tree_of(rel):
+            return fc.module(os.path.join(root, rel), rel).tree
+
         try:
             proto_src = read(_PROTO)
-            planner_tree = ast.parse(read(_PLANNER))
-            explain_tree = ast.parse(read(_EXPLAIN))
-            builders_tree = ast.parse(read(_BUILDERS))
-        except OSError as e:
+            planner_tree = tree_of(_PLANNER)
+            explain_tree = tree_of(_EXPLAIN)
+            builders_tree = tree_of(_BUILDERS)
+        except (OSError, SyntaxError) as e:
             yield _PROTO, 0, f"registry cross-check could not read tree: {e}"
             return
 
@@ -179,8 +188,8 @@ class RegistrySyncRule(Rule):
         for fname in sorted(os.listdir(conv_dir)):
             if fname.endswith(".py"):
                 try:
-                    tree = ast.parse(read(f"auron_tpu/convert/{fname}"))
-                except SyntaxError:
+                    tree = tree_of(f"auron_tpu/convert/{fname}")
+                except (OSError, SyntaxError):
                     continue
                 converted |= _name_mentions(tree, plan_variants)
 
@@ -243,16 +252,30 @@ class RegistrySyncRule(Rule):
 
         # scalar-function rename map -> live registry
         try:
-            conv_exprs_tree = ast.parse(read(_CONV_EXPRS))
+            conv_exprs_tree = tree_of(_CONV_EXPRS)
         except (OSError, SyntaxError) as e:
             yield _CONV_EXPRS, 0, f"could not parse rename map: {e}"
             return
         renames = _dict_str_values(conv_exprs_tree, "_FN_RENAME")
         rename_line = _assign_line(conv_exprs_tree, "_FN_RENAME")
-        try:
+        def _registry_names() -> list:
+            # the import pulls in the whole engine (jax included) — the
+            # aux cache keys the result on the registrant modules' file
+            # signatures so warm lint runs never pay it
             from auron_tpu.functions import extended as _ext  # noqa: F401
             from auron_tpu.functions.registry import registry as fn_registry
-            known = set(fn_registry.names())
+            return sorted(fn_registry.names())
+
+        try:
+            from tools.auronlint.filecache import file_cache
+
+            fn_dir = os.path.join(root, "auron_tpu", "functions")
+            reg_paths = sorted(
+                os.path.join(fn_dir, f) for f in os.listdir(fn_dir)
+                if f.endswith(".py")
+            )
+            known = set(file_cache(root).aux(
+                "fn_registry_names", reg_paths, _registry_names))
         except Exception as e:  # engine unimportable in this env
             yield _CONV_EXPRS, 0, (
                 f"function registry unimportable ({type(e).__name__}: {e}); "

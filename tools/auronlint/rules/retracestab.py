@@ -292,8 +292,13 @@ def analyze(g):
     for rel in sorted(g.modules):
         ms = g.modules[rel]
         mod = ms.mod
+        jit_entries = module_jit_entries(mod)
+        if not jit_entries:
+            # the rebound-name scan walks the whole module tree — skip
+            # it for the vast majority of modules with no jit entry
+            continue
         rebound = _rebound_module_names(mod)
-        for name, fn, statics, line in module_jit_entries(mod):
+        for name, fn, statics, line in jit_entries:
             qual = f"{rel}::{name}"
             wrapped_qual = f"{rel}::{fn.name}" if fn is not None else None
             ent = entries[qual] = {

@@ -484,13 +484,48 @@ def run_all() -> list[str]:
     return findings
 
 
+def write_sarif(rep, path: str) -> None:
+    """Write the SARIF artifact to a stable CI path: temp + os.replace so
+    a crashed run never leaves a truncated artifact, and the file exists
+    even when the run fails (mirrors auronlint --sarif-out)."""
+    import os
+    import tempfile
+
+    out = os.path.abspath(path)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(out),
+                               prefix=os.path.basename(out) + ".")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(rep.to_sarif())
+        os.replace(tmp, out)
+    except BaseException:
+        os.unlink(tmp)
+        raise
+
+
 if __name__ == "__main__":
     import sys
 
-    if "--json" in sys.argv or "--sarif" in sys.argv:
+    sarif_out = None
+    if "--sarif-out" in sys.argv:
+        i = sys.argv.index("--sarif-out")
+        if i + 1 >= len(sys.argv):
+            print("jvm_lint: --sarif-out needs a PATH", file=sys.stderr)
+            raise SystemExit(2)
+        sarif_out = sys.argv[i + 1]
+    if sarif_out or "--json" in sys.argv or "--sarif" in sys.argv:
         rep = run_report()
+        if sarif_out:
+            write_sarif(rep, sarif_out)
         # one shared emitter pair for both gates (tools/auronlint/report.py)
-        print(rep.to_sarif() if "--sarif" in sys.argv else rep.to_json())
+        if "--sarif" in sys.argv:
+            print(rep.to_sarif())
+        elif "--json" in sys.argv:
+            print(rep.to_json())
+        else:
+            for f in rep.findings:
+                print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
         raise SystemExit(0 if rep.ok() else 1)
     problems = run_all()
     for p in problems:
