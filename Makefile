@@ -1,4 +1,4 @@
-.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate obscheck servecheck servegate
+.PHONY: proto test native jvm-compile bench lint lint-changed perfcheck sqlgate obscheck servecheck servegate streamgate
 
 # keep `make` (no target) regenerating the proto, as before the lint gate
 .DEFAULT_GOAL := proto
@@ -73,6 +73,15 @@ servecheck:
 # PERF_RATCHET.json, p50/p99 recorded.
 servegate:
 	JAX_PLATFORMS=cpu python -m auron_tpu.models.servegate
+
+# Streaming gate (docs/streaming.md): fused vs eager Calc-chain
+# differential over one deterministic Kafka corpus (bit-identical
+# emissions, fused must beat eager), zero-compile replay, a crash-resume
+# bit-identity leg, and the sustained stream_events_s ratchet in
+# PERF_RATCHET.json. The kill-at-every-seam fuzz runs in tier-1
+# (tests/test_stream_exactly_once.py); this is the at-scale run.
+streamgate:
+	JAX_PLATFORMS=cpu python -m auron_tpu.models.streamgate
 
 # Real-text SQL differential gate (docs/sql.md): 24 actual TPC-DS query
 # strings through sql/ parse->bind->lower and the mesh driver, row-level

@@ -17,6 +17,7 @@ resource map; ``RssBlockProvider`` plugs into IpcReaderExec.
 
 from __future__ import annotations
 
+import struct
 import threading
 from collections import defaultdict
 from typing import Iterator
@@ -107,6 +108,46 @@ class RssPartitionWriterClient:
 
     def abort(self) -> None:
         self.service.abort_attempt(self.shuffle_id, self.map_id, self.attempt)
+
+
+def push_payloads(provider, writer, num_partitions: int, metrics=None) -> int:
+    """The PUSH half of the raw-bytes pair (docs/shuffle.md; the fetch
+    half is ``iter_payloads`` on the block providers): relay every block
+    payload of a finished map output into an RSS partition writer
+    WITHOUT the RecordBatch round trip. Payloads re-frame (length
+    prefix) and cross as bytes, so format-v2 blocks arrive in the
+    service byte-identical to the source file — no decode, no re-chosen
+    encodings, no Arrow materialization. This is the local-output
+    migration path (executor decommission / late RSS adoption): the
+    committed ``.data``/``.index`` pair a ShuffleWriterExec produced
+    moves into the service as pure I/O.
+
+    ``provider`` is anything exposing ``iter_payloads(partition)``
+    (LocalFileBlockProvider, RemoteBlockProvider, RssBlockProvider);
+    ``writer`` follows the RssPartitionWriter contract (``write`` /
+    optional ``flush``/``abort``, or a bare callable). A failing relay
+    aborts the attempt so the service drops its staged blocks — the
+    same unwind RssShuffleWriterExec performs. Returns the number of
+    payloads pushed."""
+    push = writer if callable(writer) else writer.write
+    pushed = 0
+    try:
+        for pid in range(num_partitions):
+            for payload in provider.iter_payloads(pid):
+                push(pid, struct.pack("<Q", len(payload)) + payload)
+                pushed += 1
+        if metrics is not None:
+            metrics.add("rss_push_payloads", pushed)
+    except BaseException:
+        if hasattr(writer, "abort"):
+            try:
+                writer.abort()
+            except Exception:  # noqa: BLE001  # auronlint: disable=R12 -- unwind: the propagating relay error is primary; a failed abort just leaves the attempt for service GC
+                pass
+        raise
+    if hasattr(writer, "flush"):
+        writer.flush()
+    return pushed
 
 
 class RssBlockProvider:

@@ -27,7 +27,7 @@ import pyarrow as pa
 from auron_tpu import types as T
 from auron_tpu.columnar.batch import Batch
 from auron_tpu.exec.base import ExecOperator, ExecutionContext
-from auron_tpu.exec.basic import batch_from_columns
+from auron_tpu.exec.basic import FilterExec, ProjectExec, batch_from_columns
 from auron_tpu.exprs import Evaluator, ir
 
 
@@ -257,14 +257,47 @@ class MockKafkaSource:
         return dict(self._pos)
 
 
+def stream_calc_fused(conf) -> bool:
+    """Resolve the stream.calc.fuse tri-state (auto = on)."""
+    from auron_tpu.utils.config import STREAM_CALC_FUSE, resolve_tri
+
+    return resolve_tri(conf.get(STREAM_CALC_FUSE), True)
+
+
+# auronlint: thread-owned -- one slot source per StreamingCalcExec chain; the slot is loaded and drained by the single thread pumping that stream
+class _MicroBatchSlotSource(ExecOperator):
+    """One-micro-batch-at-a-time source under a streaming Calc chain: the
+    driver drops each deserialized batch into ``slot`` and re-drives the
+    chain built above it. The chain is built ONCE per stream and passed
+    through plan/fusion.py, whose program cache keys on (schema, segment
+    signature, capacity bucket) — so a long-running stream compiles once
+    and every subsequent event batch costs one dispatch (the
+    StreamExecCalc -> whole-stage-fusion economics of PR 7, applied to
+    the per-event path)."""
+
+    def __init__(self, schema: T.Schema):
+        super().__init__([], schema)
+        self.slot: Batch | None = None
+
+    def _execute(self, partition: int, ctx: ExecutionContext) -> Iterator[Batch]:
+        b, self.slot = self.slot, None
+        if b is not None:
+            yield b
+
+
 @dataclass
 class StreamingCalcExec:
     """Calc (filter + project) over a record stream, micro-batch at a time.
 
     The push-based drain loop of FlinkAuronCalcOperator: poll -> deserialize
-    -> device batch -> predicates refine the selection mask -> projections
-    evaluate -> emit. Stateless, so engine checkpointing passes through via
-    ``source.offsets()``.
+    -> device batch -> Calc chain -> emit. Under stream.calc.fuse (auto =
+    on) the chain is a real exec tree (_MicroBatchSlotSource -> FilterExec
+    -> ProjectExec) passed through ``fuse_exec_tree``, so the predicates
+    and projections compile into ONE whole-stage program per (schema,
+    segment signature, capacity bucket) and each micro-batch costs a
+    single dispatch; =off keeps the eager per-op evaluator loop,
+    bit-identically. Stateless either way, so engine checkpointing passes
+    through via ``source.offsets()``.
     """
 
     source: StreamSource
@@ -284,23 +317,51 @@ class StreamingCalcExec:
             if errs:
                 ctx.metrics.add("deserialize_errors", errs)
 
+    def build_chain(self, conf) -> tuple[_MicroBatchSlotSource, ExecOperator]:
+        """(slot source, Calc chain over it) — passed through whole-stage
+        fusion when stream.calc.fuse resolves on. Exposed so the
+        continuous-query pipeline (auron_tpu/stream) drives the same
+        chain the standalone Calc rides."""
+        from auron_tpu.plan.fusion import fuse_exec_tree
+
+        src = _MicroBatchSlotSource(self.in_schema)
+        plan: ExecOperator = src
+        if self.predicates:
+            plan = FilterExec(plan, list(self.predicates))
+        plan = ProjectExec(plan, [e for e, _ in self.projections],
+                           [n for _, n in self.projections])
+        if stream_calc_fused(conf):
+            plan = fuse_exec_tree(plan, conf)
+        return src, plan
+
     def _run(self, ctx: ExecutionContext) -> Iterator[Batch]:
-        ev = Evaluator(self.in_schema)
+        if stream_calc_fused(ctx.conf):
+            src, chain = self.build_chain(ctx.conf)
+            ev = None
+        else:
+            src = chain = None
+            ev = Evaluator(self.in_schema)
         while (payloads := self.source.poll(self.max_batch_records)) is not None:
             ctx.check_cancelled()
             rb = self.deserializer.deserialize(payloads)
             if rb.num_rows == 0:
                 continue
             b = Batch.from_arrow(rb)
-            sel = b.device.sel
-            for p in self.predicates:
-                cv = ev.evaluate(b, [p])[0]
-                sel = sel & cv.validity & cv.values.astype(bool)
-            vals = ev.evaluate(b, [e for e, _ in self.projections])
-            out = batch_from_columns(vals, [n for _, n in self.projections], sel)
-            ctx.metrics.add("stream_batches", 1)
-            ctx.metrics.add("stream_rows", out.num_rows())
-            yield out
+            if chain is not None:
+                src.slot = b
+                outs = list(chain.execute(0, ctx))
+            else:
+                sel = b.device.sel
+                for p in self.predicates:
+                    cv = ev.evaluate(b, [p])[0]
+                    sel = sel & cv.validity & cv.values.astype(bool)
+                vals = ev.evaluate(b, [e for e, _ in self.projections])
+                outs = [batch_from_columns(
+                    vals, [n for _, n in self.projections], sel)]
+            for out in outs:
+                ctx.metrics.add("stream_batches", 1)
+                ctx.metrics.add("stream_rows", out.num_rows())
+                yield out
 
 
 class KafkaScanExec(ExecOperator):

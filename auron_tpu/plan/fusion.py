@@ -522,6 +522,7 @@ def _note_dispatch(sig, capacity: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
+# auronlint: thread-owned -- one fused operator per query/stream plan instance; its link/prep memo fields are touched only by the single thread driving that plan's batch stream (task pump, serving handler, or stream pump — never two at once)
 class FusedStageExec(ExecOperator):
     """One pipeline segment compiled as a single per-batch XLA program.
 
@@ -856,6 +857,7 @@ def _mirror_project_schema(exprs, names, schema: T.Schema) -> T.Schema:
     return T.Schema(tuple(fields))
 
 
+# auronlint: thread-owned -- segments are built and mutated only inside one fuse_exec_tree call on the thread lowering that plan
 class _Segment:
     """Static description of one fusable run, built bottom-up."""
 

@@ -178,3 +178,82 @@ class TaskRuntime:
             # (the metric half of the span-vs-metrics cross-check)
             self._obs_trace.add_task_metrics(snap)
         return snap
+
+
+# auronlint: thread-owned -- _error/exhausted are written by the pump while it lives and by stop() only after Thread.join() (sequential handoff); status() readers never write
+class StreamTaskRuntime:
+    """Long-running pump for a continuous streaming pipeline
+    (auron_tpu/stream): the batch TaskRuntime's shape — one daemon
+    thread owning the engine work, conf-scoped, error relayed to the
+    owner — but the loop is ``pipeline.step()`` forever instead of
+    draining a finite operator tree, and the consumer-facing surface is
+    ``status()``/``stop()`` instead of a batch queue (emissions leave
+    through the pipeline's sink, not through here).
+
+    The whole stream runs under ONE query trace named
+    ``stream.<view>``: the pipeline's per-emission and per-checkpoint
+    spans (watermark, lag, emit_seq) attribute to it, and the summary
+    lands on /queries when the stream ends.
+    """
+
+    def __init__(self, pipeline, name: str | None = None):
+        self.pipeline = pipeline
+        self.name = name or pipeline.plan.name
+        obs.apply_conf(pipeline.conf)
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self.exhausted = False
+        self._thread = threading.Thread(
+            target=self._pump_stream, daemon=True,
+            name=f"auron-stream-{self.name}")
+        self._thread.start()
+
+    def _pump_stream(self) -> None:  # auronlint: thread-root(conf-scoped) -- stream pump thread; installs conf_scope(pipeline.conf) before driving the engine
+        try:
+            with conf_scope(self.pipeline.conf), obs.query_trace(
+                f"stream.{self.name}", conf=self.pipeline.conf
+            ):
+                while not self._stop.is_set():
+                    if not self.pipeline.step():
+                        self.exhausted = True
+                        return
+        except BaseException as e:  # noqa: BLE001 — relayed via status()/stop()
+            self._error = e
+
+    # ------------------------------------------------------------------
+
+    def status(self) -> dict:
+        """Live stream state for /stream inspect: progress counters,
+        watermark, and the error (if the pump died)."""
+        p = self.pipeline
+        return {
+            "name": self.name,
+            "alive": self._thread.is_alive(),
+            "exhausted": self.exhausted,
+            "steps": p.steps,
+            "emit_seq": p.emit_seq,
+            "watermark_ms": p.tracker.watermark_ms,
+            "open_groups": len(p.store),
+            "checkpoints": p.ckpt_seq,
+            "metrics": dict(p.metrics),
+            "error": repr(self._error) if self._error is not None else None,
+        }
+
+    def stop(self, timeout: float = 30.0, drain: bool = False) -> dict:
+        """Stop the pump, close the pipeline, return the final status.
+        ``drain=True`` force-closes all open windows first (finite
+        sources / orderly shutdown)."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        if drain and self._error is None and not self._thread.is_alive():
+            self.pipeline.drain()
+        try:
+            self.pipeline.close()
+        except BaseException as e:  # noqa: BLE001 — surfaced below with the pump error taking precedence
+            if self._error is None:
+                self._error = e
+        st = self.status()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"stream {self.name} failed") from err
+        return st

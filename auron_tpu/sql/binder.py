@@ -340,6 +340,12 @@ class ExprBinder:
                 if isinstance(b, A.IntervalLit):
                     if e.op == "-" and b is e.left:
                         raise SqlUnsupported("interval - date", "", e.pos)
+                    if b.unit != "day":
+                        # time-unit intervals belong to streaming windows/
+                        # watermarks; a sub-day DATE32 offset has no lowering
+                        raise SqlUnsupported(
+                            f"interval unit {b.unit}",
+                            "date arithmetic folds DAY intervals only", b.pos)
                     base = self.bind(a)
                     if not (isinstance(base.e, ir.Literal)
                             and base.dtype == T.DATE32):

@@ -32,11 +32,41 @@ _RESERVED = {
 
 _CMP_OPS = {"=", "<>", "!=", "<", "<=", ">", ">="}
 
+#: normalized interval units. DAY is the batch subset; the time units
+#: exist for streaming window sizes and watermark delays only — the
+#: binder's date-arithmetic fold refuses them (sub-day date offsets have
+#: no DATE32 lowering).
+_INTERVAL_UNITS = {
+    "DAY": "day", "DAYS": "day",
+    "HOUR": "hour", "HOURS": "hour",
+    "MINUTE": "minute", "MINUTES": "minute",
+    "SECOND": "second", "SECONDS": "second",
+    "MILLISECOND": "millisecond", "MILLISECONDS": "millisecond",
+}
+
 
 def parse(sql: str) -> A.Query:
     """Parse one SQL statement; diagnostics carry the full text."""
     try:
         return _Parser(tokenize(sql)).parse_query_top()
+    except SqlDiagnostic as e:
+        raise e.with_sql(sql) from None
+
+
+def parse_streaming_view(sql: str) -> A.StreamingView:
+    """Parse a CREATE STREAMING VIEW statement (stream subsystem front
+    door)::
+
+        CREATE STREAMING VIEW <name>
+          [WATERMARK FOR <col> AS <col> - INTERVAL '<n>' <unit>]
+        AS <query>
+
+    The inner query is the ordinary grammar; window calls (TUMBLE/HOP)
+    ride GROUP BY as plain function calls and are given meaning by
+    stream/lowering.py.
+    """
+    try:
+        return _Parser(tokenize(sql)).parse_streaming_view_top()
     except SqlDiagnostic as e:
         raise e.with_sql(sql) from None
 
@@ -103,6 +133,37 @@ class _Parser:
         if t.kind != EOF:
             raise SqlSyntaxError(f"unexpected trailing input {t.text!r}", t.pos)
         return q
+
+    def parse_streaming_view_top(self) -> A.StreamingView:
+        pos = self.peek().pos
+        self.expect_kw("CREATE")
+        self.expect_kw("STREAMING")
+        self.expect_kw("VIEW")
+        name = self.ident("view name").text
+        watermark = None
+        if self.eat_kw("WATERMARK"):
+            wpos = self.peek().pos
+            self.expect_kw("FOR")
+            col = A.Ident((self.ident("watermark column").text,),
+                          pos=self.peek().pos)
+            self.expect_kw("AS")
+            expr = self.parse_expr()
+            # the only supported shape: <same col> - INTERVAL '<n>' <unit>
+            if not (isinstance(expr, A.BinOp) and expr.op == "-"
+                    and isinstance(expr.left, A.Ident)
+                    and expr.left.parts[-1].lower() == col.parts[0].lower()
+                    and isinstance(expr.right, A.IntervalLit)):
+                raise SqlUnsupported(
+                    "watermark expression",
+                    "only <col> - INTERVAL '<n>' <unit> is supported", wpos)
+            watermark = A.Watermark(col, expr.right, pos=wpos)
+        self.expect_kw("AS")
+        q = self.parse_query()
+        self.eat_op(";")
+        t = self.peek()
+        if t.kind != EOF:
+            raise SqlSyntaxError(f"unexpected trailing input {t.text!r}", t.pos)
+        return A.StreamingView(name, watermark, q, pos=pos)
 
     def parse_query(self) -> A.Query:
         pos = self.peek().pos
@@ -371,10 +432,12 @@ class _Parser:
             if v.kind not in (NUMBER, STRING) or not v.text.strip().isdigit():
                 raise SqlSyntaxError("INTERVAL expects an integer", v.pos)
             u = self.ident("interval unit")
-            if u.upper not in ("DAY", "DAYS"):
+            unit = _INTERVAL_UNITS.get(u.upper)
+            if unit is None:
                 raise SqlUnsupported(f"interval unit {u.text}",
-                                     "only DAY intervals", u.pos)
-            return A.IntervalLit(int(v.text), "day", pos=t.pos)
+                                     "DAY (batch) or time units (streaming "
+                                     "windows/watermarks)", u.pos)
+            return A.IntervalLit(int(v.text), unit, pos=t.pos)
         # the raw dsdgen form: `date + 30 days`
         if t.kind == NUMBER and t.text.isdigit() and self.peek(1).is_kw("DAY", "DAYS"):
             self.next()

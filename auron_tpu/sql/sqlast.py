@@ -261,6 +261,33 @@ class Query(Node):
     pos: SourcePos = _pos_field()
 
 
+# -- streaming DDL (auron_tpu/stream) ----------------------------------------
+
+
+@dataclass(frozen=True)
+class Watermark(Node):
+    """WATERMARK FOR <col> AS <col> - INTERVAL '<n>' <unit>: event time
+    advances to max(observed <col>) - delay; windows whose end falls at
+    or before the watermark close and emit."""
+
+    col: Ident
+    delay: IntervalLit
+    pos: SourcePos = _pos_field()
+
+
+@dataclass(frozen=True)
+class StreamingView(Node):
+    """CREATE STREAMING VIEW <name> [WATERMARK ...] AS <query> — the
+    continuous-query statement the stream subsystem compiles
+    (stream/lowering.py); the inner query is ordinary AST, with
+    TUMBLE/HOP window calls in its GROUP BY."""
+
+    name: str
+    watermark: Optional[Watermark]
+    query: Query
+    pos: SourcePos = _pos_field()
+
+
 # ---------------------------------------------------------------------------
 # canonical rendering (the fuzz round-trip's second leg)
 # ---------------------------------------------------------------------------

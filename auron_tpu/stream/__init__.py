@@ -1,0 +1,26 @@
+"""Continuous streaming SQL (ROADMAP item 4, docs/streaming.md).
+
+The batch engine's pieces composed into long-running pipelines:
+``CREATE STREAMING VIEW`` texts (sql/parser.py ``parse_streaming_view``)
+lower onto the existing streaming operators — Kafka source →
+whole-stage-fused Calc chain (exec/streaming.py) → event-time windowed
+grouped aggregation (host scatter state, the PR-3 incremental-agg
+shape) → watermark-driven emission → pluggable sink — with a
+checkpoint coordinator that atomically snapshots source offsets +
+window state so a killed pipeline resumes emission-for-emission
+bit-identically (exactly-once output; tests/test_stream_exactly_once.py
+kills at every instrumented point and diffs).
+"""
+
+from auron_tpu.stream.checkpoint import CheckpointCoordinator
+from auron_tpu.stream.lowering import StreamingPlan, lower_streaming_view
+from auron_tpu.stream.pipeline import StreamKilled, StreamPipeline
+from auron_tpu.stream.sink import CollectSink, JsonlFileSink, make_sink
+from auron_tpu.stream.state import WindowStore
+from auron_tpu.stream.windows import WatermarkTracker, WindowSpec
+
+__all__ = [
+    "CheckpointCoordinator", "CollectSink", "JsonlFileSink", "StreamKilled",
+    "StreamPipeline", "StreamingPlan", "WatermarkTracker", "WindowSpec",
+    "WindowStore", "lower_streaming_view", "make_sink",
+]

@@ -1947,6 +1947,52 @@ def test_r11_transfers_end_tracking():
     assert not _hits(rep, "R11")
 
 
+def test_r11_snapshot_temp_fire():
+    """A checkpoint temp created but neither published (os.replace) nor
+    torn down (os.unlink) on the exception path is a half-written file a
+    future restore could mistake for progress."""
+    rep = _r11(
+        """
+        import os
+        from auron_tpu.stream.checkpoint import snapshot_tmp
+
+        def write_one(final, data):
+            tmp = snapshot_tmp(final)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, final)
+        """
+    )
+    hits = _hits(rep, "R11")
+    assert len(hits) == 1
+    assert "checkpoint temp file" in hits[0].message
+
+
+def test_r11_snapshot_temp_quiet_on_replace_or_unlink_unwind():
+    """The shipped shape — publish on success, unlink on the unwind —
+    releases the temp on every path."""
+    rep = _r11(
+        """
+        import os
+        from auron_tpu.stream.checkpoint import snapshot_tmp
+
+        def write_one(final, data):
+            tmp = snapshot_tmp(final)
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, final)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        """
+    )
+    assert not _hits(rep, "R11")
+
+
 # ---------------------------------------------------------------------------
 # R12 error-path discipline
 # ---------------------------------------------------------------------------

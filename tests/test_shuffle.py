@@ -445,3 +445,77 @@ def test_rss_fetch_rides_iter_payloads_raw_bytes(tmp_path):
     bucketed = read_all("on")    # iter_payloads -> bucketed decode
     legacy = read_all("off")     # RecordBatch view path
     assert bucketed == legacy and len(bucketed) == 2000
+
+
+def test_rss_push_rides_iter_payloads_raw_bytes(tmp_path):
+    """ISSUE-20 satellite: the PUSH half of the raw-bytes pair — a
+    finished local map output migrates into the RSS service via
+    push_payloads as raw block payloads, never through the RecordBatch
+    view, and the pushed bytes are byte-identical to the source file's
+    payloads (no decode -> re-encode)."""
+    from auron_tpu.exec.shuffle.format import is_v2_payload
+    from auron_tpu.exec.shuffle.rss import (
+        LocalRssService, RssBlockProvider, RssPartitionWriterClient,
+        push_payloads,
+    )
+
+    rng = np.random.default_rng(13)
+    df = pd.DataFrame({"k": rng.integers(0, 40, 2500).astype(np.int64),
+                       "v": np.round(rng.random(2500) * 100, 2)})
+    b = Batch.from_arrow(pa.RecordBatch.from_pandas(df, preserve_index=False))
+    n_reduce = 4
+    data, index = _write(tmp_path, [b], HashPartitioning([col(0)], n_reduce))
+
+    class NoDecodeProvider(LocalFileBlockProvider):
+        """The relay must never materialize the RecordBatch view."""
+
+        def __call__(self, partition):
+            raise AssertionError("push relay touched the RecordBatch view")
+
+    src = NoDecodeProvider(data, index)
+    src_payloads = [p for part in range(n_reduce)
+                    for p in src.iter_payloads(part)]
+    # vacuity: the source actually holds v2 payloads to relay
+    assert src_payloads and any(is_v2_payload(p) for p in src_payloads)
+
+    svc = LocalRssService()
+    w = RssPartitionWriterClient(svc, "mig", 0)
+    pushed = push_payloads(src, w, n_reduce)
+    assert pushed == len(src_payloads)
+
+    # byte identity: what the service serves back IS the source payloads
+    dst = RssBlockProvider(svc, "mig")
+    dst_payloads = [p for part in range(n_reduce)
+                    for p in dst.iter_payloads(part)]
+    assert dst_payloads == src_payloads
+
+    # and the migrated output reads back as the original rows
+    out = _read_all(b.schema, dst, n_reduce)
+    total = pd.concat(out.values())
+    assert sorted(total["v"].tolist()) == sorted(df["v"].tolist())
+
+
+def test_rss_push_relay_aborts_on_failure():
+    """A failing relay aborts the attempt (service drops staged blocks)."""
+    from auron_tpu.exec.shuffle.rss import push_payloads
+
+    class ExplodingProvider:
+        def iter_payloads(self, partition):
+            yield b"AUB2xxxx"
+            raise RuntimeError("fetch died")
+
+    events = []
+
+    class Writer:
+        def write(self, pid, blk):
+            events.append(("write", pid))
+
+        def abort(self):
+            events.append(("abort",))
+
+        def flush(self):
+            events.append(("flush",))
+
+    with pytest.raises(RuntimeError, match="fetch died"):
+        push_payloads(ExplodingProvider(), Writer(), 2)
+    assert ("abort",) in events and ("flush",) not in events

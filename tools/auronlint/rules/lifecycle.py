@@ -114,6 +114,15 @@ PROTOCOLS: tuple[Protocol, ...] = (
         acquire_calls=frozenset({"span"}),
         release_methods=frozenset({"close", "__exit__"}),
     ),
+    Protocol(
+        # stream/checkpoint.py: an .inprogress temp path must either be
+        # atomically published (os.replace) or torn down (os.unlink) —
+        # a leaked temp is a half-written checkpoint a future restore
+        # could mistake for progress
+        "snapshot-temp", "checkpoint temp file (create -> replace/unlink)",
+        acquire_calls=frozenset({"snapshot_tmp"}),
+        release_fns=frozenset({"replace", "unlink"}),
+    ),
 )
 
 

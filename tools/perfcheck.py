@@ -239,6 +239,58 @@ def main() -> int:
     }))
     retrace_failures += dp_failures
 
+    # ---- streaming Calc retrace guard (ROADMAP item 4 / docs/streaming.md):
+    # the per-event path rides ONE whole-stage program — a StreamingCalcExec
+    # chain must (1) actually fuse (vacuity: at least one new segment) and
+    # (2) replay with ZERO new programs/compiles, because a long-running
+    # stream that recompiles per micro-batch has lost the economics the
+    # fused chain exists for.
+    import json as _json
+
+    from auron_tpu.exec.streaming import (
+        JsonRowDeserializer as _Json,
+        MockKafkaSource as _Kafka,
+        StreamingCalcExec as _Calc,
+    )
+    from auron_tpu.exprs.ir import BinaryOp as _Bin
+    from auron_tpu.exprs.ir import lit as _lit
+    from auron_tpu.plan.fusion import fusion_stats as _fstats
+
+    sc_failures = 0
+    s_schema = _T.Schema.of(_T.Field("id", _T.INT64), _T.Field("v", _T.FLOAT64))
+    s_recs = [_json.dumps({"id": i, "v": i * 0.5}).encode() for i in range(512)]
+
+    def stream_rows() -> int:
+        calc = _Calc(
+            source=_Kafka([s_recs[:256], s_recs[256:]]),
+            deserializer=_Json(s_schema), in_schema=s_schema,
+            predicates=[_Bin("gteq", _col(0), _lit(8))],
+            projections=[(_col(0), "id"),
+                         (_Bin("mul", _col(1), _lit(2.0)), "v2")],
+            max_batch_records=64)
+        return sum(b.num_rows() for b in calc.run(_Ctx()))
+
+    fs_a = _fstats()
+    srows1 = stream_rows()
+    fs_b = _fstats()
+    srows2 = stream_rows()
+    fs_c = _fstats()
+    if fs_b["segments"] - fs_a["segments"] <= 0:
+        sc_failures += 1  # chain never fused = vacuous guard
+    if fs_c["programs"] != fs_b["programs"] or fs_c["compiles"] != fs_b["compiles"]:
+        sc_failures += 1
+    if srows1 != 504 or srows2 != srows1:
+        sc_failures += 1
+    print(json.dumps({
+        "check": "stream_calc_retrace",
+        "segments": fs_b["segments"] - fs_a["segments"],
+        "rows": srows1,
+        "programs_run1": fs_b["programs"], "programs_run2": fs_c["programs"],
+        "compiles_run1": fs_b["compiles"], "compiles_run2": fs_c["compiles"],
+        "ok": sc_failures == 0,
+    }))
+    retrace_failures += sc_failures
+
     points = collect_sync_points(ROOT)
     # N/batch budgets are declared against OPERATOR input batches; the
     # pump count is a floor (a stream the sink never times still pumps)
